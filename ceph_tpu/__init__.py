@@ -1,0 +1,27 @@
+"""ceph_tpu — a TPU-native distributed object-storage framework.
+
+A ground-up redesign of the capabilities of Ceph (reference: Ceph v11.0.2)
+for TPU hardware: the two matrix-heavy hot paths — CRUSH bucket placement
+(reference: src/crush/mapper.c) and erasure-code encode/decode (reference:
+src/erasure-code/) — are batched JAX/XLA/Pallas kernels, while the
+surrounding distributed-storage machinery (object store, messenger,
+monitor/consensus, OSD data plane, client stack) is rebuilt idiomatically
+in async Python with native helpers.
+
+Layer map (mirrors reference SURVEY.md §1):
+  common/    core runtime: config, logging, perf counters, encoding, throttle
+  ops/       JAX/Pallas device kernels: jenkins hash, straw2 placement,
+             GF(2^8) bit-sliced matmul erasure coding
+  crush/     CRUSH data model, host bit-exact mapper, builder, compiler
+  ec/        erasure-code plugin framework (jax / reed_sol / cauchy / lrc / shec)
+  store/     transactional ObjectStore (mem / file+WAL) and KV abstraction
+  msg/       asyncio messenger with typed messages and delivery policies
+  mon/       monitor: Paxos consensus, elector, map services
+  osd/       OSDMap placement pipeline, PG peering, replicated/EC backends
+  client/    Objecter + librados-style API + striper
+  parallel/  device-mesh data plane: sharded EC, ring recovery collectives
+  services/  RBD-style block images and higher-level services over RADOS
+  tools/     CLIs: rados, crushtool, osdmaptool, ec benchmark, vstart
+"""
+
+from ceph_tpu.version import __version__  # noqa: F401

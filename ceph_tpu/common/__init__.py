@@ -1,0 +1,13 @@
+"""Core runtime shared by every daemon and client.
+
+Reference parity: src/common/ (CephContext common/ceph_context.h:37,
+md_config_t common/config.h:78, PerfCounters common/perf_counters.h:68,
+Throttle common/Throttle.h:28, encoding include/encoding.h).
+"""
+
+from ceph_tpu.common.config import Config, Option, OPT_TYPES
+from ceph_tpu.common.context import Context
+from ceph_tpu.common.perf_counters import PerfCounters
+from ceph_tpu.common.throttle import Throttle
+
+__all__ = ["Config", "Option", "OPT_TYPES", "Context", "PerfCounters", "Throttle"]

@@ -1,0 +1,281 @@
+"""Typed configuration system with defaults, observers and runtime injection.
+
+Reference parity: md_config_t (common/config.h:78,96) over the generated
+OPTION() table (common/config_opts.h).  Re-designed as a declarative Option
+registry: each subsystem registers options at import time; values are layered
+(defaults < config file < env < argv < injectargs) and observers are notified
+with the set of changed keys, exactly like md_config_t::apply_changes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+OPT_TYPES = ("int", "float", "bool", "str", "addr", "uuid", "size")
+
+
+def _parse_size(v: str) -> int:
+    suffixes = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+    s = str(v).strip().lower()
+    if s and s[-1] in suffixes:
+        return int(float(s[:-1]) * suffixes[s[-1]])
+    return int(s, 0) if isinstance(v, str) else int(v)
+
+
+def _coerce(type_: str, v: Any) -> Any:
+    if type_ == "int":
+        return int(v, 0) if isinstance(v, str) else int(v)
+    if type_ == "float":
+        return float(v)
+    if type_ == "bool":
+        if isinstance(v, str):
+            return v.strip().lower() in ("1", "true", "yes", "on")
+        return bool(v)
+    if type_ == "size":
+        return _parse_size(v)
+    return str(v)
+
+
+@dataclass
+class Option:
+    name: str
+    type: str
+    default: Any
+    desc: str = ""
+    # observer-safe options may change at runtime; others need restart
+    runtime: bool = True
+
+    def __post_init__(self):
+        assert self.type in OPT_TYPES, self.type
+        if self.default is not None:
+            self.default = _coerce(self.type, self.default)
+
+
+class Config:
+    """Layered typed config with change observers.
+
+    Meta-variable expansion supports $name/$cluster/$type/$id/$pid like the
+    reference's md_config_t::expand_meta.
+    """
+
+    def __init__(self, options: Optional[Iterable[Option]] = None):
+        self._lock = threading.RLock()
+        self._schema: Dict[str, Option] = {}
+        self._values: Dict[str, Any] = {}
+        self._observers: List[Tuple[Tuple[str, ...], Callable[[set], None]]] = []
+        self._meta = {"cluster": "ceph-tpu", "name": "client.admin",
+                      "type": "client", "id": "admin", "pid": str(os.getpid())}
+        for opt in DEFAULT_OPTIONS:
+            self.register(opt)
+        for opt in options or ():
+            self.register(opt)
+
+    # -- schema ------------------------------------------------------------
+    def register(self, opt: Option) -> None:
+        with self._lock:
+            self._schema[opt.name] = opt
+
+    def register_many(self, opts: Iterable[Option]) -> None:
+        for o in opts:
+            self.register(o)
+
+    def schema(self) -> Dict[str, Option]:
+        return dict(self._schema)
+
+    # -- meta --------------------------------------------------------------
+    def set_daemon_name(self, type_: str, id_: str) -> None:
+        with self._lock:
+            self._meta.update(
+                {"type": type_, "id": id_, "name": f"{type_}.{id_}"})
+
+    def expand_meta(self, s: str) -> str:
+        if not isinstance(s, str) or "$" not in s:
+            return s
+        out = s
+        for k, v in self._meta.items():
+            out = out.replace("$" + k, v)
+        return out
+
+    # -- get/set -----------------------------------------------------------
+    def get(self, name: str) -> Any:
+        with self._lock:
+            opt = self._schema[name]
+            v = self._values.get(name, opt.default)
+            return self.expand_meta(v) if opt.type == "str" else v
+
+    def __getitem__(self, name: str) -> Any:
+        return self.get(name)
+
+    def set(self, name: str, value: Any, notify: bool = True) -> None:
+        self.set_many({name: value}, notify=notify)
+
+    def set_many(self, kv: Dict[str, Any], notify: bool = True) -> None:
+        changed = set()
+        with self._lock:
+            for name, value in kv.items():
+                if name not in self._schema:
+                    raise KeyError(f"unknown config option {name!r}")
+                opt = self._schema[name]
+                cv = _coerce(opt.type, value)
+                if self._values.get(name, opt.default) != cv:
+                    self._values[name] = cv
+                    changed.add(name)
+        if notify and changed:
+            self._notify(changed)
+
+    # -- layers ------------------------------------------------------------
+    def parse_env(self, env: Optional[Dict[str, str]] = None) -> None:
+        env = os.environ if env is None else env
+        kv = {}
+        for name in self._schema:
+            ev = env.get("CEPH_TPU_" + name.upper())
+            if ev is not None:
+                kv[name] = ev
+        if kv:
+            self.set_many(kv)
+
+    def parse_argv(self, argv: List[str]) -> List[str]:
+        """Consume --opt-name value / --opt-name=value; return leftovers."""
+        rest, kv, i = [], {}, 0
+        while i < len(argv):
+            a = argv[i]
+            if a.startswith("--"):
+                body = a[2:]
+                if "=" in body:
+                    key, val = body.split("=", 1)
+                else:
+                    key = body
+                    opt = self._schema.get(key.replace("-", "_"))
+                    if opt is not None and opt.type == "bool":
+                        val = "true"
+                    elif i + 1 < len(argv):
+                        i += 1
+                        val = argv[i]
+                    else:
+                        val = "true"
+                key = key.replace("-", "_")
+                if key in self._schema:
+                    kv[key] = val
+                else:
+                    rest.append(a)
+            else:
+                rest.append(a)
+            i += 1
+        if kv:
+            self.set_many(kv)
+        return rest
+
+    def parse_file(self, path: str) -> None:
+        """ini-ish conf file: `key = value` lines, [section] headers applying
+        to matching daemon names (global/<type>/<type>.<id>)."""
+        section = "global"
+        wanted = {"global", self._meta["type"], self._meta["name"]}
+        kv: Dict[str, Any] = {}
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].split(";", 1)[0].strip()
+                if not line:
+                    continue
+                if line.startswith("[") and line.endswith("]"):
+                    section = line[1:-1].strip()
+                    continue
+                if "=" in line and section in wanted:
+                    k, v = line.split("=", 1)
+                    k = k.strip().replace(" ", "_").replace("-", "_")
+                    if k in self._schema:
+                        kv[k] = v.strip()
+        if kv:
+            self.set_many(kv)
+
+    def injectargs(self, args: str) -> str:
+        """Runtime mutation, reference: md_config_t::injectargs via admin
+        socket. Returns human-readable report."""
+        toks = args.split()
+        leftover = self.parse_argv(toks)
+        if leftover:
+            return f"ignored unknown args: {leftover}"
+        return "applied"
+
+    # -- observers ---------------------------------------------------------
+    def add_observer(self, keys: Iterable[str], fn: Callable[[set], None]) -> None:
+        with self._lock:
+            self._observers.append((tuple(keys), fn))
+
+    def remove_observer(self, fn: Callable[[set], None]) -> None:
+        with self._lock:
+            self._observers = [(k, f) for k, f in self._observers if f is not fn]
+
+    def _notify(self, changed: set) -> None:
+        with self._lock:
+            obs = list(self._observers)
+        for keys, fn in obs:
+            hit = changed.intersection(keys)
+            if hit:
+                fn(hit)
+
+    # -- introspection -----------------------------------------------------
+    def dump(self) -> Dict[str, Any]:
+        with self._lock:
+            return {n: self._values.get(n, o.default)
+                    for n, o in sorted(self._schema.items())}
+
+    def diff(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._values)
+
+    def dump_json(self) -> str:
+        return json.dumps(self.dump(), default=str, indent=1, sort_keys=True)
+
+
+# Central defaults table (reference: common/config_opts.h, 1126 OPTIONs; we
+# grow this as subsystems land — each entry documents its reference knob).
+DEFAULT_OPTIONS: List[Option] = [
+    Option("log_level", "int", 1, "global log verbosity"),
+    Option("log_file", "str", "", "log sink path; empty = stderr"),
+    Option("log_max_recent", "int", 10000, "ring buffer size (log/Log.cc)"),
+    Option("admin_socket", "str", "", "unix admin socket path"),
+    Option("public_addr", "addr", "127.0.0.1:0", "daemon bind address"),
+    Option("ms_type", "str", "async", "messenger implementation"),
+    Option("ms_tcp_nodelay", "bool", True, "disable nagle"),
+    Option("ms_initial_backoff", "float", 0.2, "reconnect backoff start"),
+    Option("ms_max_backoff", "float", 15.0, "reconnect backoff cap"),
+    Option("ms_inject_socket_failures", "int", 0,
+           "fault injection: fail 1-in-N socket ops (config_opts.h:197)"),
+    Option("ms_dispatch_throttle_bytes", "size", "100m",
+           "inflight dispatch byte throttle"),
+    Option("mon_lease", "float", 5.0, "paxos lease seconds (mon/Paxos.h:912)"),
+    Option("mon_tick_interval", "float", 5.0, "monitor tick"),
+    Option("mon_election_timeout", "float", 5.0, "elector timeout"),
+    Option("mon_paxos_batch_interval", "float", 0.05,
+           "pending-proposal batching window (PaxosService)"),
+    Option("osd_heartbeat_interval", "float", 1.0, "osd/OSD.cc:4223"),
+    Option("osd_heartbeat_grace", "float", 6.0, "mark-down grace"),
+    Option("osd_pool_default_size", "int", 3, "replica count"),
+    Option("osd_pool_default_min_size", "int", 0, "0 = size - size/2"),
+    Option("osd_pool_default_pg_num", "int", 8, "pgs per new pool"),
+    Option("osd_op_queue", "str", "wpq", "op scheduler (config_opts.h:706)"),
+    Option("osd_op_num_shards", "int", 5, "sharded op queue shards"),
+    Option("osd_op_num_threads_per_shard", "int", 2, ""),
+    Option("osd_recovery_max_active", "int", 3, "parallel recovery ops"),
+    Option("osd_max_object_size", "size", "128m", ""),
+    Option("osd_client_message_size_cap", "size", "500m", ""),
+    Option("osd_scrub_interval", "float", 60.0, "light scrub cadence (test scale)"),
+    Option("objectstore", "str", "memstore", "backend: memstore|filestore"),
+    Option("objectstore_path", "str", "", "data dir for filestore"),
+    Option("filestore_journal_size", "size", "64m", "WAL size"),
+    Option("filestore_kill_at", "int", 0,
+           "crash injection at Nth txn (config_opts.h:1171)"),
+    Option("objecter_inflight_ops", "int", 1024, "client op throttle"),
+    Option("objecter_inflight_op_bytes", "size", "100m", ""),
+    Option("ec_batch_window_us", "int", 200,
+           "TPU EC batch-collector window (ShardedOpWQ analog)"),
+    Option("ec_batch_max_stripes", "int", 64, "max stripes per TPU launch"),
+    Option("tpu_backend", "str", "auto", "auto|tpu|cpu for device kernels"),
+    Option("crush_backend", "str", "auto", "auto|jax|host placement backend"),
+    Option("heartbeat_inject_failure", "int", 0,
+           "seconds to fake missed heartbeats (config_opts.h:172)"),
+]

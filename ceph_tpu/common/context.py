@@ -1,0 +1,58 @@
+"""Per-process context object.
+
+Reference parity: CephContext (common/ceph_context.h:37) — the per-process
+"god object" carrying config, logging, perf counters and the admin command
+server.  Redesigned minimal: explicit construction, no refcounting (python
+GC), admin socket is attached lazily by daemons that want it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.logging import ClusterLog, LogSystem
+from ceph_tpu.common.perf_counters import PerfCountersCollection
+
+
+class Context:
+    def __init__(self, name: str = "client.admin",
+                 config: Optional[Config] = None):
+        self.config = config or Config()
+        type_, _, id_ = name.partition(".")
+        self.config.set_daemon_name(type_ or "client", id_ or "admin")
+        self.name = name
+        self.log = LogSystem(
+            name=f"ceph-tpu.{name}",
+            level=self.config["log_level"],
+            log_file=self.config["log_file"],
+            max_recent=self.config["log_max_recent"],
+        )
+        self.perf = PerfCountersCollection()
+        self.cluster_log = ClusterLog(name)
+        self.admin_socket = None  # attached by daemons (common/admin_socket.py)
+        self.config.add_observer(["log_level"], self._on_log_level)
+
+    def _on_log_level(self, changed: set) -> None:
+        self.log.set_default_level(self.config["log_level"])
+
+    def logger(self, subsys: str):
+        return self.log.get(subsys)
+
+
+def global_init(name: str, argv=None, conf_file: Optional[str] = None,
+                env: bool = True) -> Context:
+    """Process bring-up (reference: global_init, global/global_init.h:31):
+    layered config parse then Context construction.  Daemonization/setuid are
+    intentionally absent — process supervision is the launcher's job
+    (tools/vstart.py)."""
+    cfg = Config()
+    type_, _, id_ = name.partition(".")
+    cfg.set_daemon_name(type_ or "client", id_ or "admin")
+    if conf_file:
+        cfg.parse_file(conf_file)
+    if env:
+        cfg.parse_env()
+    if argv:
+        cfg.parse_argv(list(argv))
+    return Context(name, cfg)

@@ -1,0 +1,105 @@
+"""Named performance counters per daemon.
+
+Reference parity: PerfCounters (common/perf_counters.h:68) — u64 counters
+(inc/set), averages (avgcount/sum via tinc), and time counters; dumped over
+the admin socket as `perf dump`.  Redesigned lock-light: plain dict of slots
+guarded by one mutex (python ints are big enough that we need no sharding).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+TYPE_U64 = "u64"
+TYPE_AVG = "avg"
+TYPE_TIME = "time"
+
+
+class PerfCounters:
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._types: Dict[str, str] = {}
+        self._vals: Dict[str, float] = {}
+        self._sums: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+
+    def add_u64(self, key: str) -> None:
+        self._types[key] = TYPE_U64
+        self._vals[key] = 0
+
+    def add_avg(self, key: str) -> None:
+        self._types[key] = TYPE_AVG
+        self._sums[key] = 0.0
+        self._counts[key] = 0
+
+    def add_time(self, key: str) -> None:
+        self._types[key] = TYPE_TIME
+        self._sums[key] = 0.0
+        self._counts[key] = 0
+
+    def inc(self, key: str, by: int = 1) -> None:
+        with self._lock:
+            self._vals[key] = self._vals.get(key, 0) + by
+
+    def set(self, key: str, v: float) -> None:
+        with self._lock:
+            self._vals[key] = v
+
+    def tinc(self, key: str, seconds: float) -> None:
+        with self._lock:
+            self._sums[key] = self._sums.get(key, 0.0) + seconds
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def time_block(self, key: str):
+        pc = self
+
+        class _T:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                pc.tinc(key, time.perf_counter() - self.t0)
+                return False
+
+        return _T()
+
+    def dump(self) -> Dict[str, object]:
+        with self._lock:
+            out: Dict[str, object] = {}
+            for k, t in self._types.items():
+                if t == TYPE_U64:
+                    out[k] = self._vals.get(k, 0)
+                else:
+                    out[k] = {"avgcount": self._counts.get(k, 0),
+                              "sum": self._sums.get(k, 0.0)}
+            # untyped ad-hoc counters still show up
+            for k, v in self._vals.items():
+                out.setdefault(k, v)
+            return out
+
+
+class PerfCountersCollection:
+    """All counter groups in a process, for `perf dump` (admin socket)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._groups: Dict[str, PerfCounters] = {}
+
+    def create(self, name: str) -> PerfCounters:
+        with self._lock:
+            pc = self._groups.get(name)
+            if pc is None:
+                pc = self._groups[name] = PerfCounters(name)
+            return pc
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._groups.pop(name, None)
+
+    def dump(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {n: g.dump() for n, g in self._groups.items()}

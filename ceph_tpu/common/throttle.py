@@ -1,0 +1,90 @@
+"""Backpressure primitives.
+
+Reference parity: Throttle (common/Throttle.h:28) — bounded counter with
+blocking get / non-blocking get_or_fail / put, used for message and op
+budgets.  Both a threading and an asyncio variant are provided because our
+messenger is asyncio while store backends use worker threads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Optional
+
+
+class Throttle:
+    def __init__(self, name: str, max_: int):
+        self.name = name
+        self.max = max_
+        self.cur = 0
+        self._cv = threading.Condition()
+
+    def get(self, c: int = 1) -> None:
+        if self.max <= 0:
+            return
+        with self._cv:
+            while self.cur + c > self.max and self.cur > 0:
+                self._cv.wait()
+            self.cur += c
+
+    def get_or_fail(self, c: int = 1) -> bool:
+        if self.max <= 0:
+            return True
+        with self._cv:
+            if self.cur + c > self.max and self.cur > 0:
+                return False
+            self.cur += c
+            return True
+
+    def put(self, c: int = 1) -> None:
+        if self.max <= 0:
+            return
+        with self._cv:
+            self.cur -= c
+            assert self.cur >= 0
+            self._cv.notify_all()
+
+    def reset_max(self, m: int) -> None:
+        with self._cv:
+            self.max = m
+            self._cv.notify_all()
+
+
+class AsyncThrottle:
+    def __init__(self, name: str, max_: int):
+        self.name = name
+        self.max = max_
+        self.cur = 0
+        self._cond: Optional[asyncio.Condition] = None
+
+    def _cv(self) -> asyncio.Condition:
+        if self._cond is None:
+            self._cond = asyncio.Condition()
+        return self._cond
+
+    async def get(self, c: int = 1) -> None:
+        if self.max <= 0:
+            return
+        cv = self._cv()
+        async with cv:
+            while self.cur + c > self.max and self.cur > 0:
+                await cv.wait()
+            self.cur += c
+
+    def get_or_fail(self, c: int = 1) -> bool:
+        if self.max <= 0:
+            return True
+        if self.cur + c > self.max and self.cur > 0:
+            return False
+        self.cur += c
+        return True
+
+    async def put(self, c: int = 1) -> None:
+        if self.max <= 0:
+            return
+        cv = self._cv()
+        async with cv:
+            self.cur -= c
+            assert self.cur >= 0
+            cv.notify_all()

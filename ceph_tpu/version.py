@@ -1,0 +1,9 @@
+__version__ = "0.1.0"
+
+# Feature bits negotiated by the messenger (reference: include/ceph_features.h).
+# We keep a single monotonically growing int; peers AND their masks.
+FEATURES_ALL = 0xFFFF_FFFF
+FEATURE_BASE = 1 << 0
+FEATURE_EC_TPU = 1 << 1
+FEATURE_CRUSH_TPU = 1 << 2
+FEATURE_MESH_DATAPLANE = 1 << 3

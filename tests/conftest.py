@@ -1,0 +1,21 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before jax imports.
+
+All kernel tests run on CPU devices so they are hermetic; the same code paths
+run on real TPU when available (bench.py / driver).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ctx():
+    from ceph_tpu.common.context import Context
+    return Context("client.test")

@@ -1,0 +1,171 @@
+"""Core runtime tests: config layering/observers, encoding framing, counters,
+throttle (reference test analog: src/test/common/)."""
+
+import threading
+
+import pytest
+
+from ceph_tpu.common.config import Config, Option
+from ceph_tpu.common.context import Context, global_init
+from ceph_tpu.common.encoding import Decoder, Encodable, Encoder
+from ceph_tpu.common.perf_counters import PerfCounters
+from ceph_tpu.common.throttle import Throttle
+
+
+class TestConfig:
+    def test_defaults_and_types(self):
+        cfg = Config()
+        assert cfg["osd_pool_default_size"] == 3
+        assert isinstance(cfg["ms_dispatch_throttle_bytes"], int)
+        assert cfg["ms_dispatch_throttle_bytes"] == 100 << 20
+
+    def test_set_coerces(self):
+        cfg = Config()
+        cfg.set("osd_pool_default_size", "5")
+        assert cfg["osd_pool_default_size"] == 5
+        cfg.set("ms_tcp_nodelay", "false")
+        assert cfg["ms_tcp_nodelay"] is False
+        cfg.set("filestore_journal_size", "1g")
+        assert cfg["filestore_journal_size"] == 1 << 30
+
+    def test_unknown_raises(self):
+        with pytest.raises(KeyError):
+            Config().set("no_such_option", 1)
+
+    def test_observer_fires_once_per_change(self):
+        cfg = Config()
+        seen = []
+        cfg.add_observer(["mon_lease"], lambda ch: seen.append(set(ch)))
+        cfg.set("mon_lease", 7.5)
+        cfg.set("mon_lease", 7.5)  # no-op: same value
+        assert seen == [{"mon_lease"}]
+
+    def test_argv_and_injectargs(self):
+        cfg = Config()
+        rest = cfg.parse_argv(["--mon-lease", "9", "positional",
+                               "--ms-type=simple"])
+        assert rest == ["positional"]
+        assert cfg["mon_lease"] == 9.0
+        assert cfg["ms_type"] == "simple"
+        cfg.injectargs("--mon-lease 11")
+        assert cfg["mon_lease"] == 11.0
+
+    def test_meta_expansion(self):
+        cfg = Config()
+        cfg.set_daemon_name("osd", "3")
+        cfg.set("log_file", "/tmp/$name.log")
+        assert cfg["log_file"] == "/tmp/osd.3.log"
+
+    def test_conf_file_sections(self, tmp_path):
+        p = tmp_path / "ceph.conf"
+        p.write_text("""
+[global]
+mon lease = 2.5
+[osd]
+osd heartbeat grace = 99
+[mon]
+mon tick interval = 42
+""")
+        cfg = Config()
+        cfg.set_daemon_name("osd", "0")
+        cfg.parse_file(str(p))
+        assert cfg["mon_lease"] == 2.5
+        assert cfg["osd_heartbeat_grace"] == 99.0
+        assert cfg["mon_tick_interval"] == 5.0  # [mon] section skipped
+
+
+class Point(Encodable):
+    STRUCT_V = 2
+    STRUCT_COMPAT = 1
+
+    def __init__(self, x=0, y=0, label=""):
+        self.x, self.y, self.label = x, y, label
+
+    def encode_payload(self, enc):
+        enc.s32(self.x).s32(self.y).string(self.label)
+
+    @classmethod
+    def decode_payload(cls, dec, struct_v):
+        x, y = dec.s32(), dec.s32()
+        label = dec.string() if struct_v >= 2 else ""
+        return cls(x, y, label)
+
+
+class TestEncoding:
+    def test_roundtrip(self):
+        p = Point(-3, 7, "hello")
+        assert Point.from_bytes(p.to_bytes()) == p
+
+    def test_forward_compat_skips_trailing(self):
+        # a v3 encoder appends a field; v2 decoder must skip it cleanly
+        enc = Encoder()
+        enc.u8(3).u8(1)
+        lenpos = len(enc.buf)
+        enc.u32(0)
+        start = len(enc.buf)
+        enc.s32(1).s32(2).string("x").u64(999)  # extra trailing field
+        import struct as _s
+        _s.pack_into("<I", enc.buf, lenpos, len(enc.buf) - start)
+        enc.string("after")  # data following the struct
+        dec = Decoder(enc.getvalue())
+        p = Point.decode(dec)
+        assert (p.x, p.y, p.label) == (1, 2, "x")
+        assert dec.string() == "after"
+
+    def test_incompat_rejected(self):
+        enc = Encoder()
+        enc.u8(9).u8(9).u32(0)
+        with pytest.raises(ValueError):
+            Point.decode(Decoder(enc.getvalue()))
+
+    def test_containers(self):
+        enc = Encoder()
+        enc.map_({"b": 2, "a": 1}, lambda e, k: e.string(k),
+                 lambda e, v: e.u32(v))
+        enc.list_([Point(1, 1), Point(2, 2)], lambda e, p: e.struct(p))
+        dec = Decoder(enc.getvalue())
+        assert dec.map_(lambda d: d.string(), lambda d: d.u32()) == {"a": 1, "b": 2}
+        pts = dec.list_(lambda d: Point.decode(d))
+        assert pts[1].x == 2
+
+
+class TestPerfThrottle:
+    def test_counters(self):
+        pc = PerfCounters("osd")
+        pc.add_u64("ops")
+        pc.add_time("op_lat")
+        pc.inc("ops", 3)
+        pc.tinc("op_lat", 0.5)
+        d = pc.dump()
+        assert d["ops"] == 3
+        assert d["op_lat"]["avgcount"] == 1
+
+    def test_throttle_blocks_and_releases(self):
+        t = Throttle("b", 2)
+        t.get(2)
+        got = []
+
+        def worker():
+            t.get(1)
+            got.append(1)
+
+        th = threading.Thread(target=worker)
+        th.start()
+        assert not t.get_or_fail(1)
+        t.put(2)
+        th.join(timeout=5)
+        assert got == [1]
+
+    def test_oversized_grant_allowed_when_idle(self):
+        # reference semantics: a request larger than max succeeds if count==0
+        t = Throttle("b", 4)
+        assert t.get_or_fail(10)
+        t.put(10)
+
+
+def test_context_and_global_init():
+    ctx = global_init("osd.7", argv=["--log-level", "3"])
+    assert ctx.config["log_level"] == 3
+    log = ctx.logger("osd")
+    log.info("boot")
+    assert any("boot" in line for line in ctx.log.dump_recent())
