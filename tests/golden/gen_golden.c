@@ -1,0 +1,281 @@
+/*
+ * Golden-vector generator for CRUSH bit-exactness tests.
+ *
+ * This harness is ORIGINAL code that links against the *reference* Ceph
+ * CRUSH C sources (mapper.c/builder.c/hash.c) at generation time only —
+ * the reference tree is NOT part of this repository; only the JSON vectors
+ * it emits are committed (tests/golden/*.json).  Regenerate with
+ * tests/golden/generate.py, which compiles this file with
+ *   gcc gen_golden.c <ref>/src/crush/{mapper,builder,hash,crush}.c
+ *
+ * Output: one JSON object on stdout with
+ *   - hash vectors for crush_hash32_{1..5}
+ *   - crush_ln samples (full 64K range checksummed + first/last 512 raw)
+ *   - per-scenario crush_do_rule results over many inputs x
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "crush/crush.h"
+#include "crush/builder.h"
+#include "crush/hash.h"
+#include "crush/mapper.h"
+
+/* crush_ln is static in mapper.c; re-derive it through straw2 is awkward,
+ * so we compile mapper.c with -Dcrush_ln_static= via generate.py instead.
+ * Simpler: declare the straw2 path exercised by do_rule only, and dump
+ * crush_ln indirectly via a tiny two-item straw2 duel is lossy.  We instead
+ * include mapper.c directly so statics are visible. */
+#define dprintk(args...) /* nothing */
+#include MAPPER_C_PATH
+
+static struct crush_bucket *mk(struct crush_map *m, int alg, int type,
+                               int n, int *items, int *weights, int *idout) {
+  struct crush_bucket *b =
+      crush_make_bucket(m, alg, CRUSH_HASH_RJENKINS1, type, n, items, weights);
+  crush_add_bucket(m, 0, b, idout);
+  return b;
+}
+
+static void emit_rule_results(struct crush_map *map, int ruleno,
+                              int result_max, const __u32 *weight,
+                              int weight_max, int nx, int first) {
+  int result[64], scratch[64 * 3];
+  if (!first) printf(",");
+  printf("[");
+  for (int x = 0; x < nx; x++) {
+    int len = crush_do_rule(map, ruleno, x, result, result_max, weight,
+                            weight_max, scratch);
+    if (x) printf(",");
+    printf("[");
+    for (int i = 0; i < len; i++)
+      printf(i ? ",%d" : "%d", result[i]);
+    printf("]");
+  }
+  printf("]");
+}
+
+static void set_tunables(struct crush_map *map, int profile) {
+  if (profile == 0) { /* legacy */
+    map->choose_local_tries = 2;
+    map->choose_local_fallback_tries = 5;
+    map->choose_total_tries = 19;
+    map->chooseleaf_descend_once = 0;
+    map->chooseleaf_vary_r = 0;
+    map->chooseleaf_stable = 0;
+    map->straw_calc_version = 0;
+  } else { /* jewel/optimal */
+    map->choose_local_tries = 0;
+    map->choose_local_fallback_tries = 0;
+    map->choose_total_tries = 50;
+    map->chooseleaf_descend_once = 1;
+    map->chooseleaf_vary_r = 1;
+    map->chooseleaf_stable = 1;
+    map->straw_calc_version = 1;
+  }
+}
+
+/* deterministic LCG so weights are reproducible in python */
+static unsigned lcg_state = 12345;
+static unsigned lcg(void) {
+  lcg_state = lcg_state * 1103515245u + 12345u;
+  return (lcg_state >> 16) & 0x7fff;
+}
+
+int main(void) {
+  printf("{");
+
+  /* ---- hash vectors ---- */
+  printf("\"hash\":[");
+  for (int i = 0; i < 64; i++) {
+    unsigned a = i * 2654435761u, b = i * 40503u + 7, c = i + 0xdeadbeefu,
+             d = i * 97u, e = i * 1000003u;
+    if (i) printf(",");
+    printf("[%u,%u,%u,%u,%u]", crush_hash32(CRUSH_HASH_RJENKINS1, a),
+           crush_hash32_2(CRUSH_HASH_RJENKINS1, a, b),
+           crush_hash32_3(CRUSH_HASH_RJENKINS1, a, b, c),
+           crush_hash32_4(CRUSH_HASH_RJENKINS1, a, b, c, d),
+           crush_hash32_5(CRUSH_HASH_RJENKINS1, a, b, c, d, e));
+  }
+  printf("],");
+
+  /* ---- crush_ln: full-range FNV checksum + sparse raw samples ---- */
+  unsigned long long fnv = 1469598103934665603ull;
+  printf("\"ln_samples\":[");
+  for (unsigned u = 0; u < 0x10000; u++) {
+    unsigned long long v = (unsigned long long)crush_ln(u);
+    fnv = (fnv ^ v) * 1099511628211ull;
+    if (u % 509 == 0) printf(u ? ",%llu" : "%llu", v);
+  }
+  printf("],\"ln_fnv\":%llu,", fnv);
+
+  /* ---- scenario A: flat straw2 root over 12 osds, varied weights ---- */
+  {
+    struct crush_map *m = crush_create();
+    set_tunables(m, 1);
+    int items[12], w[12], id;
+    for (int i = 0; i < 12; i++) { items[i] = i; w[i] = (i + 1) * 0x8000; }
+    mk(m, CRUSH_BUCKET_STRAW2, 10, 12, items, w, &id);
+    struct crush_rule *r = crush_make_rule(3, 0, 1, 1, 10);
+    crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, id, 0);
+    crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSE_FIRSTN, 0, 0);
+    crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(m, r, 0);
+    crush_finalize(m);
+    __u32 weight[12];
+    for (int i = 0; i < 12; i++) weight[i] = 0x10000;
+    weight[3] = 0;           /* out */
+    weight[5] = 0x8000;      /* half in */
+    printf("\"scenarios\":[");
+    emit_rule_results(m, 0, 3, weight, 12, 256, 1);
+  }
+
+  /* ---- scenario B: two-level straw2, chooseleaf firstn, jewel ---- */
+  {
+    struct crush_map *m = crush_create();
+    set_tunables(m, 1);
+    int hostids[5];
+    int osd = 0;
+    for (int h = 0; h < 5; h++) {
+      int items[4], w[4];
+      int n = 2 + (h % 3); /* sizes 2,3,4,2,3 */
+      for (int i = 0; i < n; i++) {
+        items[i] = osd++;
+        w[i] = 0x10000 + (int)(lcg() % 0x10000);
+      }
+      struct crush_bucket *hb =
+          mk(m, CRUSH_BUCKET_STRAW2, 1, n, items, w, &hostids[h]);
+      (void)hb;
+    }
+    int hw[5];
+    for (int h = 0; h < 5; h++) {
+      struct crush_bucket *hb = m->buckets[-1 - hostids[h]];
+      hw[h] = hb->weight;
+    }
+    int rootid;
+    mk(m, CRUSH_BUCKET_STRAW2, 10, 5, hostids, hw, &rootid);
+    struct crush_rule *r = crush_make_rule(3, 0, 1, 1, 10);
+    crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, rootid, 0);
+    crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1);
+    crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(m, r, 0);
+    crush_finalize(m);
+    __u32 weight[16];
+    for (int i = 0; i < 14; i++) weight[i] = 0x10000;
+    weight[2] = 0; weight[7] = 0xc000;
+    emit_rule_results(m, 0, 3, weight, 14, 256, 0);
+
+    /* scenario C: same map, chooseleaf INDEP (EC-style), result_max 4 */
+    struct crush_rule *r2 = crush_make_rule(3, 1, 3, 1, 10);
+    crush_rule_set_step(r2, 0, CRUSH_RULE_TAKE, rootid, 0);
+    crush_rule_set_step(r2, 1, CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1);
+    crush_rule_set_step(r2, 2, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(m, r2, 1);
+    emit_rule_results(m, 1, 4, weight, 14, 256, 0);
+  }
+
+  /* ---- scenario D: every bucket alg as a host, choose firstn via types --- */
+  {
+    struct crush_map *m = crush_create();
+    set_tunables(m, 1);
+    int algs[5] = {CRUSH_BUCKET_UNIFORM, CRUSH_BUCKET_LIST, CRUSH_BUCKET_TREE,
+                   CRUSH_BUCKET_STRAW, CRUSH_BUCKET_STRAW2};
+    int hostids[5], hw[5];
+    int osd = 0;
+    for (int h = 0; h < 5; h++) {
+      int items[5], w[5];
+      int n = 3 + (h % 2);
+      for (int i = 0; i < n; i++) {
+        items[i] = osd++;
+        /* uniform buckets need equal weights */
+        w[i] = (algs[h] == CRUSH_BUCKET_UNIFORM)
+                   ? 0x10000
+                   : 0x8000 + (int)(lcg() % 0x18000);
+      }
+      mk(m, algs[h], 1, n, items, w, &hostids[h]);
+      hw[h] = m->buckets[-1 - hostids[h]]->weight;
+    }
+    int rootid;
+    mk(m, CRUSH_BUCKET_STRAW2, 10, 5, hostids, hw, &rootid);
+    struct crush_rule *r = crush_make_rule(4, 0, 1, 1, 10);
+    crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, rootid, 0);
+    crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSE_FIRSTN, 0, 1); /* hosts */
+    crush_rule_set_step(r, 2, CRUSH_RULE_CHOOSE_FIRSTN, 1, 0); /* 1 osd each */
+    crush_rule_set_step(r, 3, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(m, r, 0);
+    crush_finalize(m);
+    __u32 weight[32];
+    for (int i = 0; i < osd; i++) weight[i] = 0x10000;
+    weight[1] = 0x4000;
+    emit_rule_results(m, 0, 4, weight, osd, 256, 0);
+  }
+
+  /* ---- scenario E: legacy tunables, straw1 two-level chooseleaf ---- */
+  {
+    struct crush_map *m = crush_create();
+    set_tunables(m, 0);
+    int hostids[4], hw[4];
+    int osd = 0;
+    for (int h = 0; h < 4; h++) {
+      int items[3], w[3];
+      for (int i = 0; i < 3; i++) {
+        items[i] = osd++;
+        w[i] = 0x10000 + (int)(lcg() % 0x20000);
+      }
+      mk(m, CRUSH_BUCKET_STRAW, 1, 3, items, w, &hostids[h]);
+      hw[h] = m->buckets[-1 - hostids[h]]->weight;
+    }
+    int rootid;
+    mk(m, CRUSH_BUCKET_STRAW, 10, 4, hostids, hw, &rootid);
+    struct crush_rule *r = crush_make_rule(3, 0, 1, 1, 10);
+    crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, rootid, 0);
+    crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1);
+    crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(m, r, 0);
+    crush_finalize(m);
+    __u32 weight[12];
+    for (int i = 0; i < 12; i++) weight[i] = 0x10000;
+    weight[4] = 0;
+    emit_rule_results(m, 0, 3, weight, 12, 256, 0);
+  }
+
+  /* ---- scenario F: bigger cluster, 32 hosts x 4 osds, jewel, repl 3 --- */
+  {
+    struct crush_map *m = crush_create();
+    set_tunables(m, 1);
+    int hostids[32], hw[32];
+    int osd = 0;
+    for (int h = 0; h < 32; h++) {
+      int items[4], w[4];
+      for (int i = 0; i < 4; i++) {
+        items[i] = osd++;
+        w[i] = 0x10000;
+      }
+      mk(m, CRUSH_BUCKET_STRAW2, 1, 4, items, w, &hostids[h]);
+      hw[h] = m->buckets[-1 - hostids[h]]->weight;
+    }
+    int rootid;
+    mk(m, CRUSH_BUCKET_STRAW2, 10, 32, hostids, hw, &rootid);
+    struct crush_rule *r = crush_make_rule(3, 0, 1, 1, 10);
+    crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, rootid, 0);
+    crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1);
+    crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(m, r, 0);
+    /* EC 8+4 indep rule */
+    struct crush_rule *r2 = crush_make_rule(3, 1, 3, 1, 16);
+    crush_rule_set_step(r2, 0, CRUSH_RULE_TAKE, rootid, 0);
+    crush_rule_set_step(r2, 1, CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1);
+    crush_rule_set_step(r2, 2, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(m, r2, 1);
+    crush_finalize(m);
+    __u32 weight[128];
+    for (int i = 0; i < osd; i++) weight[i] = 0x10000;
+    weight[10] = 0; weight[50] = 0; weight[77] = 0x8000;
+    emit_rule_results(m, 0, 3, weight, osd, 512, 0);
+    emit_rule_results(m, 1, 12, weight, osd, 512, 0);
+  }
+
+  printf("]}\n");
+  return 0;
+}

@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""Regenerate CRUSH golden vectors from the reference C implementation.
+
+Requires the reference tree (default /root/reference).  Compiles
+gen_golden.c against the reference's crush sources in a temp dir and writes
+crush_golden.json next to this script; also re-extracts the crush_ln lookup
+constants into ceph_tpu/crush/_ln_tables.json.  The committed JSON is what
+the test suite / package consume; this script only needs to run when
+scenarios change.  The python side rebuilds identical maps in
+tests/test_crush_golden.py (mirroring gen_golden.c's LCG weight streams).
+"""
+
+import json
+import pathlib
+import re
+import subprocess
+import sys
+import tempfile
+
+HERE = pathlib.Path(__file__).resolve().parent
+REF = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "/root/reference")
+
+
+def extract_ln_tables():
+    """Pull the 514 crush_ln constants out of crush_ln_table.h as data."""
+    txt = (REF / "src/crush/crush_ln_table.h").read_text()
+    m = re.search(r"__RH_LH_tbl\[128\*2\+2\] = \{(.*?)\};", txt, re.S)
+    vals = [int(v, 16) for v in re.findall(r"0x([0-9a-fA-F]+)ll", m.group(1))]
+    m2 = re.search(r"__LL_tbl\[256\] = \{(.*?)\};", txt, re.S)
+    ll = [int(v, 16) for v in re.findall(r"0x([0-9a-fA-F]+)ull?", m2.group(1))]
+    assert len(vals) == 258 and len(ll) == 256
+    out = HERE.parent.parent / "ceph_tpu/crush/_ln_tables.json"
+    out.write_text(json.dumps({"rh": vals[0::2], "lh": vals[1::2], "ll": ll}))
+    print(f"wrote {out}")
+
+
+def main():
+    extract_ln_tables()
+    src = REF / "src"
+    assert (src / "crush/mapper.c").exists(), f"reference not at {REF}"
+    with tempfile.TemporaryDirectory() as td:
+        exe = pathlib.Path(td) / "gen_golden"
+        # reference expects a configure-generated acconfig.h
+        (pathlib.Path(td) / "acconfig.h").write_text(
+            "#define HAVE_INTTYPES_H 1\n"
+            "#define HAVE_STDINT_H 1\n"
+            "#define HAVE_LINUX_TYPES_H 1\n")
+        cmd = [
+            "gcc", "-O1", "-o", str(exe), "-I", td,
+            str(HERE / "gen_golden.c"),
+            str(src / "crush/builder.c"),
+            str(src / "crush/crush.c"),
+            str(src / "crush/hash.c"),
+            "-I", str(src),
+            "-I", str(src / "crush"),
+            f"-DMAPPER_C_PATH=\"{src}/crush/mapper.c\"",
+            "-lm",
+        ]
+        subprocess.run(cmd, check=True)
+        out = subprocess.run([str(exe)], check=True, capture_output=True)
+        data = json.loads(out.stdout)
+    path = HERE / "crush_golden.json"
+    path.write_text(json.dumps(data))
+    print(f"wrote {path} ({path.stat().st_size} bytes, "
+          f"{len(data['scenarios'])} scenarios)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,241 @@
+"""Bit-exactness of the host CRUSH implementation against golden vectors
+generated from the reference C (tests/golden/generate.py).
+
+Scenario construction here mirrors tests/golden/gen_golden.c exactly,
+including the LCG weight streams, so mapping outputs must match verbatim.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from ceph_tpu.crush import builder
+from ceph_tpu.crush.constants import (BUCKET_LIST, BUCKET_STRAW,
+                                      BUCKET_STRAW2, BUCKET_TREE,
+                                      BUCKET_UNIFORM, RULE_CHOOSE_FIRSTN,
+                                      RULE_CHOOSELEAF_FIRSTN,
+                                      RULE_CHOOSELEAF_INDEP, RULE_EMIT,
+                                      RULE_TAKE)
+from ceph_tpu.crush import hashfn
+from ceph_tpu.crush.lntable import crush_ln, ln_u16_table
+from ceph_tpu.crush.mapper import do_rule
+from ceph_tpu.crush.types import CrushMap, Rule, RuleStep
+
+GOLDEN = json.loads(
+    (pathlib.Path(__file__).parent / "golden/crush_golden.json").read_text())
+
+
+class LCG:
+    """Mirror of gen_golden.c's lcg()."""
+
+    def __init__(self, seed=12345):
+        self.state = seed
+
+    def __call__(self):
+        self.state = (self.state * 1103515245 + 12345) & 0xFFFFFFFF
+        return (self.state >> 16) & 0x7FFF
+
+
+def test_hash_vectors():
+    for i, row in enumerate(GOLDEN["hash"]):
+        a = (i * 2654435761) & 0xFFFFFFFF
+        b = (i * 40503 + 7) & 0xFFFFFFFF
+        c = (i + 0xDEADBEEF) & 0xFFFFFFFF
+        d = (i * 97) & 0xFFFFFFFF
+        e = (i * 1000003) & 0xFFFFFFFF
+        assert hashfn.hash32(a) == row[0]
+        assert hashfn.hash32_2(a, b) == row[1]
+        assert hashfn.hash32_3(a, b, c) == row[2]
+        assert hashfn.hash32_4(a, b, c, d) == row[3]
+        assert hashfn.hash32_5(a, b, c, d, e) == row[4]
+
+
+def test_np_hash_matches_scalar():
+    import numpy as np
+    a = np.arange(100, dtype=np.uint32) * np.uint32(2654435761)
+    b = np.arange(100, dtype=np.uint32)
+    c = np.full(100, 7, np.uint32)
+    got = hashfn.np_hash32_3(a, b, c)
+    for i in range(100):
+        assert int(got[i]) == hashfn.hash32_3(int(a[i]), int(b[i]), int(c[i]))
+    got2 = hashfn.np_hash32_2(a, b)
+    for i in range(100):
+        assert int(got2[i]) == hashfn.hash32_2(int(a[i]), int(b[i]))
+
+
+def test_crush_ln_sparse_samples():
+    samples = GOLDEN["ln_samples"]
+    for j, val in enumerate(samples):
+        u = j * 509
+        assert crush_ln(u) == val, f"crush_ln({u})"
+
+
+def test_crush_ln_full_range_checksum():
+    tbl = ln_u16_table()
+    fnv = 1469598103934665603
+    for u in range(0x10000):
+        fnv = ((fnv ^ int(tbl[u])) * 1099511628211) & (2**64 - 1)
+    assert fnv == GOLDEN["ln_fnv"]
+
+
+# -- scenario builders (mirror gen_golden.c) ---------------------------------
+
+def scen_a():
+    m = CrushMap()
+    m.set_tunables_profile("jewel")
+    items = list(range(12))
+    w = [(i + 1) * 0x8000 for i in range(12)]
+    root = builder.make_bucket(m, BUCKET_STRAW2, 10, items, w)
+    r = Rule(0, 1, 1, 10, [RuleStep(RULE_TAKE, root.id),
+                           RuleStep(RULE_CHOOSE_FIRSTN, 0, 0),
+                           RuleStep(RULE_EMIT)])
+    m.add_rule(r)
+    weight = [0x10000] * 12
+    weight[3] = 0
+    weight[5] = 0x8000
+    return m, [(0, 3, weight, 256)]
+
+
+def _two_level(lcg):
+    m = CrushMap()
+    m.set_tunables_profile("jewel")
+    hosts = []
+    osd = 0
+    for h in range(5):
+        n = 2 + (h % 3)
+        items = list(range(osd, osd + n))
+        osd += n
+        w = [0x10000 + (lcg() % 0x10000) for _ in range(n)]
+        hosts.append(builder.make_bucket(m, BUCKET_STRAW2, 1, items, w))
+    root = builder.make_bucket(m, BUCKET_STRAW2, 10,
+                               [h.id for h in hosts],
+                               [h.weight for h in hosts])
+    return m, root
+
+
+def scen_bc():
+    lcg = LCG()
+    m, root = _two_level(lcg)
+    m.add_rule(Rule(0, 1, 1, 10, [RuleStep(RULE_TAKE, root.id),
+                                  RuleStep(RULE_CHOOSELEAF_FIRSTN, 0, 1),
+                                  RuleStep(RULE_EMIT)]))
+    m.add_rule(Rule(1, 3, 1, 10, [RuleStep(RULE_TAKE, root.id),
+                                  RuleStep(RULE_CHOOSELEAF_INDEP, 0, 1),
+                                  RuleStep(RULE_EMIT)]))
+    weight = [0x10000] * 14
+    weight[2] = 0
+    weight[7] = 0xC000
+    return m, [(0, 3, weight, 256), (1, 4, weight, 256)], lcg
+
+
+def scen_d(lcg):
+    m = CrushMap()
+    m.set_tunables_profile("jewel")
+    algs = [BUCKET_UNIFORM, BUCKET_LIST, BUCKET_TREE, BUCKET_STRAW,
+            BUCKET_STRAW2]
+    hosts = []
+    osd = 0
+    for h in range(5):
+        n = 3 + (h % 2)
+        items = list(range(osd, osd + n))
+        osd += n
+        if algs[h] == BUCKET_UNIFORM:
+            w = [0x10000] * n
+        else:
+            w = [0x8000 + (lcg() % 0x18000) for _ in range(n)]
+        hosts.append(builder.make_bucket(m, algs[h], 1, items, w))
+    root = builder.make_bucket(m, BUCKET_STRAW2, 10,
+                               [h.id for h in hosts],
+                               [h.weight for h in hosts])
+    m.add_rule(Rule(0, 1, 1, 10, [RuleStep(RULE_TAKE, root.id),
+                                  RuleStep(RULE_CHOOSE_FIRSTN, 0, 1),
+                                  RuleStep(RULE_CHOOSE_FIRSTN, 1, 0),
+                                  RuleStep(RULE_EMIT)]))
+    weight = [0x10000] * osd
+    weight[1] = 0x4000
+    return m, [(0, 4, weight, 256)]
+
+
+def scen_e(lcg):
+    m = CrushMap()
+    m.set_tunables_profile("legacy")
+    hosts = []
+    osd = 0
+    for h in range(4):
+        items = list(range(osd, osd + 3))
+        osd += 3
+        w = [0x10000 + (lcg() % 0x20000) for _ in range(3)]
+        hosts.append(builder.make_bucket(m, BUCKET_STRAW, 1, items, w))
+    root = builder.make_bucket(m, BUCKET_STRAW, 10,
+                               [h.id for h in hosts],
+                               [h.weight for h in hosts])
+    m.add_rule(Rule(0, 1, 1, 10, [RuleStep(RULE_TAKE, root.id),
+                                  RuleStep(RULE_CHOOSELEAF_FIRSTN, 0, 1),
+                                  RuleStep(RULE_EMIT)]))
+    weight = [0x10000] * 12
+    weight[4] = 0
+    return m, [(0, 3, weight, 256)]
+
+
+def scen_f():
+    m = CrushMap()
+    m.set_tunables_profile("jewel")
+    hosts = []
+    osd = 0
+    for h in range(32):
+        items = list(range(osd, osd + 4))
+        osd += 4
+        hosts.append(builder.make_bucket(m, BUCKET_STRAW2, 1, items,
+                                         [0x10000] * 4))
+    root = builder.make_bucket(m, BUCKET_STRAW2, 10,
+                               [h.id for h in hosts],
+                               [h.weight for h in hosts])
+    m.add_rule(Rule(0, 1, 1, 10, [RuleStep(RULE_TAKE, root.id),
+                                  RuleStep(RULE_CHOOSELEAF_FIRSTN, 0, 1),
+                                  RuleStep(RULE_EMIT)]))
+    m.add_rule(Rule(1, 3, 1, 16, [RuleStep(RULE_TAKE, root.id),
+                                  RuleStep(RULE_CHOOSELEAF_INDEP, 0, 1),
+                                  RuleStep(RULE_EMIT)]))
+    weight = [0x10000] * osd
+    weight[10] = 0
+    weight[50] = 0
+    weight[77] = 0x8000
+    return m, [(0, 3, weight, 512), (1, 12, weight, 512)]
+
+
+def all_runs():
+    """Yield (scenario_index, map, ruleno, result_max, weight, nx)."""
+    runs = []
+    m, rr = scen_a()
+    for r in rr:
+        runs.append((m, *r))
+    m, rr, lcg = scen_bc()
+    for r in rr:
+        runs.append((m, *r))
+    m, rr = scen_d(lcg)
+    for r in rr:
+        runs.append((m, *r))
+    m, rr = scen_e(lcg)
+    for r in rr:
+        runs.append((m, *r))
+    m, rr = scen_f()
+    for r in rr:
+        runs.append((m, *r))
+    return runs
+
+
+NAMES = ["A:flat-straw2", "B:chooseleaf-firstn", "C:chooseleaf-indep",
+         "D:all-algs", "E:legacy-straw", "F:32x4-repl", "F:32x4-ec-indep"]
+
+
+@pytest.mark.parametrize("idx", range(7), ids=NAMES)
+def test_do_rule_matches_reference(idx):
+    runs = all_runs()
+    m, ruleno, result_max, weight, nx = runs[idx]
+    expect = GOLDEN["scenarios"][idx]
+    assert len(expect) == nx
+    for x in range(nx):
+        got = do_rule(m, ruleno, x, result_max, weight)
+        assert got == expect[x], (
+            f"scenario {NAMES[idx]} x={x}: got {got} want {expect[x]}")
