@@ -186,6 +186,62 @@ def reweight_item(map_: CrushMap, b: Bucket, item: int, weight: int) -> None:
                                map_.tunables.straw_calc_version)
 
 
+def make_replicated_rule(map_: CrushMap, name: str, root_name: str = "default",
+                         failure_domain: str = "host") -> int:
+    """take root; chooseleaf_firstn 0 <domain>; emit — what
+    CrushWrapper::add_simple_ruleset builds (CrushWrapper.cc)."""
+    from ceph_tpu.crush.constants import (RULE_CHOOSELEAF_FIRSTN, RULE_EMIT,
+                                          RULE_TAKE)
+    from ceph_tpu.crush.types import Rule, RuleStep
+    root_id = _find_name(map_, root_name)
+    dom = _find_type(map_, failure_domain)
+    rule = Rule(ruleset=len(map_.rules), type=1, min_size=1, max_size=10,
+                steps=[RuleStep(RULE_TAKE, root_id),
+                       RuleStep(RULE_CHOOSELEAF_FIRSTN, 0, dom),
+                       RuleStep(RULE_EMIT)])
+    rid = map_.add_rule(rule)
+    map_.rule_name_map[rid] = name
+    return rid
+
+
+def make_erasure_rule(map_: CrushMap, name: str, size: int,
+                      failure_domain: str = "host",
+                      root_name: str = "default") -> int:
+    """take root; chooseleaf_indep <size> <domain>; emit — positionally
+    stable placement for EC (ErasureCodeInterface create_ruleset role,
+    /root/reference/src/erasure-code/ErasureCodeInterface.h:181)."""
+    from ceph_tpu.crush.constants import (RULE_CHOOSELEAF_INDEP, RULE_EMIT,
+                                          RULE_SET_CHOOSELEAF_TRIES,
+                                          RULE_SET_CHOOSE_TRIES, RULE_TAKE)
+    from ceph_tpu.crush.types import Rule, RuleStep
+    root_id = _find_name(map_, root_name)
+    dom = _find_type(map_, failure_domain)
+    rule = Rule(ruleset=len(map_.rules), type=3, min_size=3,
+                max_size=max(size, 3),
+                steps=[RuleStep(RULE_SET_CHOOSELEAF_TRIES, 5),
+                       RuleStep(RULE_SET_CHOOSE_TRIES, 100),
+                       RuleStep(RULE_TAKE, root_id),
+                       RuleStep(RULE_CHOOSELEAF_INDEP, size, dom),
+                       RuleStep(RULE_EMIT)])
+    rid = map_.add_rule(rule)
+    map_.rule_name_map[rid] = name
+    return rid
+
+
+def _find_name(map_: CrushMap, name: str) -> int:
+    for iid, n in map_.name_map.items():
+        if n == name:
+            return iid
+    raise KeyError(f"no crush item named {name!r}")
+
+
+def _find_type(map_: CrushMap, type_name: str) -> int:
+    for tid, n in map_.type_map.items():
+        if n == type_name:
+            return tid
+    raise KeyError(f"no crush type named {type_name!r}")
+
+
 def build_hierarchy(map_: CrushMap, n_osds: int, osds_per_host: int,
                     alg: int = BUCKET_STRAW2, hosts_per_rack: int = 0,
                     osd_weight: int = 0x10000, root_name: str = "default"

@@ -1,1 +1,18 @@
+"""Erasure-code engine: GF(2^8) codecs lowered to MXU matmuls.
 
+Reference parity map:
+  interface.py  <- erasure-code/ErasureCodeInterface.h, ErasureCode.cc
+  registry.py   <- erasure-code/ErasureCodePlugin.cc (dlopen registry)
+  rs.py         <- jerasure + isa plugins (matrix techniques)
+  lrc.py        <- lrc plugin (layered local repair)
+  shec.py       <- shec plugin (shingled parities)
+  gf256.py      <- gf-complete/jerasure matrix prep, isa gf_gen_* matrices
+  kernel.py     <- isa-l x86 GF(2^8) asm kernels -> GF(2) MXU matmul
+"""
+
+from ceph_tpu.ec.interface import (CHUNK_ALIGN, ErasureCode,
+                                   ErasureCodeError)
+from ceph_tpu.ec.registry import factory, plugin_names, register
+
+__all__ = ["CHUNK_ALIGN", "ErasureCode", "ErasureCodeError", "factory",
+           "plugin_names", "register"]
