@@ -1,0 +1,152 @@
+// ceph_tpu native runtime kernels (C ABI, loaded via ctypes).
+//
+// TPU-native framework's host-side native layer, standing in for the
+// reference's native pieces that remain CPU-resident:
+//   * crc32c (castagnoli, slicing-by-8) — reference src/common/crc32c*.cc
+//     (sctp_crc32 software path; the HW-accel dispatch is an impl detail)
+//   * rjenkins hash batch — reference src/crush/hash.c:12-90, used to
+//     accelerate host-side placement fallback paths
+//   * GF(2^8) region encode (poly 0x11d, log/exp tables) — the scalar CPU
+//     equivalent of the reference's jerasure/ISA-L kernels
+//     (src/erasure-code/isa/isa-l/erasure_code/*.asm.s); serves as the
+//     measured CPU baseline in bench.py and as a no-jax fallback
+//   * region xor — reference src/erasure-code/isa/xor_op.cc (m=1 path)
+//
+// Build: g++ -O3 -march=native -shared -fPIC (ceph_tpu/native/__init__.py).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------- crc32c --
+static uint32_t crc32c_table[8][256];
+static bool crc32c_ready = false;
+
+static void crc32c_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+    crc32c_table[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = crc32c_table[0][i];
+    for (int s = 1; s < 8; s++) {
+      c = crc32c_table[0][c & 0xff] ^ (c >> 8);
+      crc32c_table[s][i] = c;
+    }
+  }
+  crc32c_ready = true;
+}
+
+uint32_t ceph_crc32c(uint32_t crc, const uint8_t* data, uint64_t len) {
+  if (!crc32c_ready) crc32c_init();
+  crc = ~crc;
+  while (len && ((uintptr_t)data & 7)) {
+    crc = crc32c_table[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+    len--;
+  }
+  while (len >= 8) {
+    uint64_t v;
+    memcpy(&v, data, 8);
+    v ^= crc;
+    crc = crc32c_table[7][v & 0xff] ^ crc32c_table[6][(v >> 8) & 0xff] ^
+          crc32c_table[5][(v >> 16) & 0xff] ^ crc32c_table[4][(v >> 24) & 0xff] ^
+          crc32c_table[3][(v >> 32) & 0xff] ^ crc32c_table[2][(v >> 40) & 0xff] ^
+          crc32c_table[1][(v >> 48) & 0xff] ^ crc32c_table[0][(v >> 56) & 0xff];
+    data += 8;
+    len -= 8;
+  }
+  while (len--) crc = crc32c_table[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+  return ~crc;
+}
+
+// ------------------------------------------------------------- rjenkins --
+#define crush_hashmix(a, b, c) do {            \
+    a = (uint32_t)(a - b); a -= c; a ^= (c >> 13); \
+    b = (uint32_t)(b - c); b -= a; b ^= (a << 8);  \
+    c = (uint32_t)(c - a); c -= b; c ^= (b >> 13); \
+    a = (uint32_t)(a - b); a -= c; a ^= (c >> 12); \
+    b = (uint32_t)(b - c); b -= a; b ^= (a << 16); \
+    c = (uint32_t)(c - a); c -= b; c ^= (b >> 5);  \
+    a = (uint32_t)(a - b); a -= c; a ^= (c >> 3);  \
+    b = (uint32_t)(b - c); b -= a; b ^= (a << 10); \
+    c = (uint32_t)(c - a); c -= b; c ^= (b >> 15); \
+  } while (0)
+
+static const uint32_t crush_hash_seed = 1315423911u;
+
+uint32_t ceph_rjenkins3(uint32_t a, uint32_t b, uint32_t c) {
+  uint32_t hash = crush_hash_seed ^ a ^ b ^ c;
+  uint32_t x = 231232, y = 1232;
+  crush_hashmix(a, b, hash);
+  crush_hashmix(c, x, hash);
+  crush_hashmix(y, a, hash);
+  crush_hashmix(b, x, hash);
+  crush_hashmix(y, c, hash);
+  return hash;
+}
+
+void ceph_rjenkins3_batch(const uint32_t* a, uint32_t b, uint32_t c,
+                          uint32_t* out, uint64_t n) {
+  for (uint64_t i = 0; i < n; i++) out[i] = ceph_rjenkins3(a[i], b, c);
+}
+
+// ---------------------------------------------------------------- gf256 --
+static uint8_t gf_exp[512];
+static uint8_t gf_log[256];
+static bool gf_ready = false;
+
+static void gf_init() {
+  int x = 1;
+  for (int i = 0; i < 255; i++) {
+    gf_exp[i] = (uint8_t)x;
+    gf_log[x] = (uint8_t)i;
+    x <<= 1;
+    if (x & 0x100) x ^= 0x11d;
+  }
+  for (int i = 255; i < 510; i++) gf_exp[i] = gf_exp[i - 255];
+  gf_ready = true;
+}
+
+// out[r][L] = mat(r x k) * chunks(k x L) over GF(2^8).  The CPU baseline:
+// per-coefficient 256-byte product tables + xor sweep, what jerasure's
+// non-SIMD path does.
+void ceph_gf_matrix_apply(const uint8_t* mat, int r, int k,
+                          const uint8_t* chunks, uint8_t* out, uint64_t L) {
+  if (!gf_ready) gf_init();
+  uint8_t table[256];
+  for (int i = 0; i < r; i++) {
+    uint8_t* dst = out + (uint64_t)i * L;
+    memset(dst, 0, L);
+    for (int j = 0; j < k; j++) {
+      uint8_t c = mat[i * k + j];
+      if (!c) continue;
+      const uint8_t* src = chunks + (uint64_t)j * L;
+      if (c == 1) {
+        for (uint64_t t = 0; t < L; t++) dst[t] ^= src[t];
+        continue;
+      }
+      int lc = gf_log[c];
+      table[0] = 0;
+      for (int b = 1; b < 256; b++) table[b] = gf_exp[lc + gf_log[b]];
+      for (uint64_t t = 0; t < L; t++) dst[t] ^= table[src[t]];
+    }
+  }
+}
+
+void ceph_region_xor(const uint8_t* a, const uint8_t* b, uint8_t* out,
+                     uint64_t len) {
+  uint64_t i = 0;
+  for (; i + 8 <= len; i += 8) {
+    uint64_t va, vb;
+    memcpy(&va, a + i, 8);
+    memcpy(&vb, b + i, 8);
+    va ^= vb;
+    memcpy(out + i, &va, 8);
+  }
+  for (; i < len; i++) out[i] = a[i] ^ b[i];
+}
+
+}  // extern "C"

@@ -6,11 +6,18 @@ run on real TPU when available (bench.py / driver).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The ambient env routes jax at the real TPU (JAX_PLATFORMS=axon via the
+# sitecustomize in /root/.axon_site, which overrides jax_platforms at the
+# CONFIG level, beating any env var).  Tests must be hermetic on a virtual
+# 8-device CPU mesh, so force both the flag and the config.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
