@@ -124,3 +124,39 @@ def np_hash32_2(a, b):
     x, a, h = _np_mix(x, a, h)
     b, y, h = _np_mix(b, y, h)
     return h
+
+
+def ceph_str_hash_rjenkins(data: bytes) -> int:
+    """Jenkins string hash for object-name -> placement seed.
+
+    Reference parity: common/ceph_hash.cc ceph_str_hash_rjenkins — golden
+    ratio init, 12-byte mixing blocks, length folded into c.  Bit-exact.
+    """
+    length = len(data)
+    a = b = 0x9E3779B9
+    c = 0
+    k = 0
+    rem = length
+    while rem >= 12:
+        a = (a + (data[k] | data[k+1] << 8 | data[k+2] << 16
+                  | data[k+3] << 24)) & M32
+        b = (b + (data[k+4] | data[k+5] << 8 | data[k+6] << 16
+                  | data[k+7] << 24)) & M32
+        c = (c + (data[k+8] | data[k+9] << 8 | data[k+10] << 16
+                  | data[k+11] << 24)) & M32
+        a, b, c = _mix(a, b, c)
+        k += 12
+        rem -= 12
+    c = (c + length) & M32
+    # trailing bytes; first byte of c is reserved for the length
+    for idx, sh in ((10, 24), (9, 16), (8, 8)):
+        if rem >= idx + 1:
+            c = (c + (data[k + idx] << sh)) & M32
+    for idx, sh in ((7, 24), (6, 16), (5, 8), (4, 0)):
+        if rem >= idx + 1:
+            b = (b + (data[k + idx] << sh)) & M32
+    for idx, sh in ((3, 24), (2, 16), (1, 8), (0, 0)):
+        if rem >= idx + 1:
+            a = (a + (data[k + idx] << sh)) & M32
+    a, b, c = _mix(a, b, c)
+    return c

@@ -1,1 +1,19 @@
+"""Local persistence layer: ObjectStore backends + KeyValueDB.
 
+Reference parity: src/os/ (ObjectStore/Transaction, MemStore, FileStore
+journal) and src/kv/ (KeyValueDB over leveldb/rocksdb).
+"""
+
+from ceph_tpu.store.kv import FileDB, KeyValueDB, KVTransaction, MemDB
+from ceph_tpu.store.memstore import MemStore
+from ceph_tpu.store.filestore import FileStore
+from ceph_tpu.store.objectstore import (
+    NoSuchCollection, NoSuchObject, ObjectStore, StoreError, Transaction,
+)
+from ceph_tpu.store.types import SNAP_DIR, SNAP_HEAD, CollectionId, ObjectId
+
+__all__ = [
+    "CollectionId", "FileDB", "FileStore", "KVTransaction", "KeyValueDB",
+    "MemDB", "MemStore", "NoSuchCollection", "NoSuchObject", "ObjectId",
+    "ObjectStore", "SNAP_DIR", "SNAP_HEAD", "StoreError", "Transaction",
+]

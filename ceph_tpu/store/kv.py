@@ -1,0 +1,247 @@
+"""KeyValueDB: transactional ordered key-value store abstraction.
+
+Reference parity: kv/KeyValueDB.h (abstract kv with batched transactions and
+prefix iterators; backends LevelDBStore/RocksDBStore/MemDB).  Redesigned with
+two backends, no external deps:
+
+- MemDB      — sorted in-memory map (tests, MemStore omap).
+- FileDB     — log-structured file backend: append-only WAL of committed
+               batches + periodic compacted snapshot, replayed on open.
+               This is the durability substrate for the monitor store and
+               FileStore metadata, playing the role rocksdb plays in the
+               reference (kv/RocksDBStore.cc) with a deliberately simple
+               single-writer design.
+
+Keys are namespaced by a string prefix like the reference
+(``prefix`` + 0x00 + key ordering), values are bytes.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from bisect import bisect_left
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ceph_tpu.store.wal import WriteAheadLog, fsync_dir
+
+_SEP = b"\x00"
+
+
+def _full_key(prefix: str, key: bytes) -> bytes:
+    return prefix.encode("utf-8") + _SEP + key
+
+
+class KVTransaction:
+    """Batched mutations applied atomically by ``KeyValueDB.submit``."""
+
+    __slots__ = ("ops",)
+
+    SET, RM, RM_PREFIX = 0, 1, 2
+
+    def __init__(self):
+        self.ops: List[Tuple[int, bytes, bytes]] = []
+
+    def set(self, prefix: str, key, value: bytes) -> "KVTransaction":
+        if isinstance(key, str):
+            key = key.encode("utf-8")
+        self.ops.append((self.SET, _full_key(prefix, key), bytes(value)))
+        return self
+
+    def rmkey(self, prefix: str, key) -> "KVTransaction":
+        if isinstance(key, str):
+            key = key.encode("utf-8")
+        self.ops.append((self.RM, _full_key(prefix, key), b""))
+        return self
+
+    def rmkeys_by_prefix(self, prefix: str) -> "KVTransaction":
+        self.ops.append((self.RM_PREFIX, prefix.encode("utf-8") + _SEP, b""))
+        return self
+
+    def encode(self) -> bytes:
+        out = bytearray(struct.pack("<I", len(self.ops)))
+        for op, k, v in self.ops:
+            out += struct.pack("<BI", op, len(k)) + k
+            out += struct.pack("<I", len(v)) + v
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "KVTransaction":
+        t = cls()
+        off = 4
+        (n,) = struct.unpack_from("<I", data, 0)
+        for _ in range(n):
+            op, klen = struct.unpack_from("<BI", data, off)
+            off += 5
+            k = data[off:off + klen]; off += klen
+            (vlen,) = struct.unpack_from("<I", data, off)
+            off += 4
+            v = data[off:off + vlen]; off += vlen
+            t.ops.append((op, k, v))
+        return t
+
+
+class KeyValueDB:
+    """Abstract ordered kv store."""
+
+    def create_transaction(self) -> KVTransaction:
+        return KVTransaction()
+
+    def submit(self, txn: KVTransaction, sync: bool = True) -> None:
+        raise NotImplementedError
+
+    def get(self, prefix: str, key) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def iterate(self, prefix: str, start=b"", end=None
+                ) -> Iterator[Tuple[bytes, bytes]]:
+        """Yield (key, value) within prefix, key >= start (< end if given),
+        in key order."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    # conveniences
+    def exists(self, prefix: str, key) -> bool:
+        return self.get(prefix, key) is not None
+
+    def keys(self, prefix: str) -> List[bytes]:
+        return [k for k, _ in self.iterate(prefix)]
+
+
+class MemDB(KeyValueDB):
+    """Sorted in-memory backend (reference kv/MemDB analog)."""
+
+    def __init__(self):
+        self._keys: List[bytes] = []          # sorted full keys
+        self._map: Dict[bytes, bytes] = {}
+
+    def _insert(self, k: bytes, v: bytes):
+        if k not in self._map:
+            self._keys.insert(bisect_left(self._keys, k), k)
+        self._map[k] = v
+
+    def _remove(self, k: bytes):
+        if k in self._map:
+            del self._map[k]
+            i = bisect_left(self._keys, k)
+            del self._keys[i]
+
+    def _remove_prefix(self, p: bytes):
+        lo = bisect_left(self._keys, p)
+        hi = lo
+        while hi < len(self._keys) and self._keys[hi].startswith(p):
+            del self._map[self._keys[hi]]
+            hi += 1
+        del self._keys[lo:hi]
+
+    def submit(self, txn: KVTransaction, sync: bool = True) -> None:
+        for op, k, v in txn.ops:
+            if op == KVTransaction.SET:
+                self._insert(k, v)
+            elif op == KVTransaction.RM:
+                self._remove(k)
+            else:
+                self._remove_prefix(k)
+
+    def get(self, prefix: str, key) -> Optional[bytes]:
+        if isinstance(key, str):
+            key = key.encode("utf-8")
+        return self._map.get(_full_key(prefix, key))
+
+    def iterate(self, prefix: str, start=b"", end=None):
+        if isinstance(start, str):
+            start = start.encode("utf-8")
+        if isinstance(end, str):
+            end = end.encode("utf-8")
+        p = prefix.encode("utf-8") + _SEP
+        lo = bisect_left(self._keys, p + start)
+        for k in self._keys[lo:]:
+            if not k.startswith(p):
+                break
+            short = k[len(p):]
+            if end is not None and short >= end:
+                break
+            yield short, self._map[k]
+
+
+class FileDB(MemDB):
+    """Durable log-structured backend.
+
+    Layout in ``path/``:
+      - ``snapshot`` — compacted full state at some committed seq
+                       (atomic-rename replaced).
+      - ``wal``      — checksummed append log of KVTransactions since the
+                       snapshot; replayed on open; truncated by compact().
+
+    Crash semantics: submit(sync=True) returns only after the WAL record is
+    fsync'd — the reference's journal-ahead rule (os/filestore/FileJournal).
+    A torn tail record (bad crc / short read) is discarded and truncated on
+    replay (wal.WriteAheadLog), exactly like the reference journal replay.
+    """
+
+    COMPACT_BYTES = 8 << 20
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.seq = 0
+        self._load_snapshot()
+        self._wal = WriteAheadLog(self._wal_path())
+        for seq, payload in self._wal.replay():
+            if seq > self.seq:
+                super().submit(KVTransaction.decode(payload))
+                self.seq = seq
+
+    # --- persistence ---
+    def _snap_path(self):
+        return os.path.join(self.path, "snapshot")
+
+    def _wal_path(self):
+        return os.path.join(self.path, "wal")
+
+    def _load_snapshot(self):
+        try:
+            with open(self._snap_path(), "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return
+        (self.seq, n) = struct.unpack_from("<QI", data, 0)
+        off = 12
+        for _ in range(n):
+            (klen,) = struct.unpack_from("<I", data, off); off += 4
+            k = data[off:off + klen]; off += klen
+            (vlen,) = struct.unpack_from("<I", data, off); off += 4
+            v = data[off:off + vlen]; off += vlen
+            self._insert(k, v)
+
+    def submit(self, txn: KVTransaction, sync: bool = True) -> None:
+        payload = txn.encode()
+        self._wal.append(self.seq + 1, payload, sync=sync)
+        self.seq += 1   # only after the record is durable
+        super().submit(txn)
+        if self._wal.size() > self.COMPACT_BYTES:
+            self.compact()
+
+    def compact(self) -> None:
+        out = bytearray(struct.pack("<QI", self.seq, len(self._keys)))
+        for k in self._keys:
+            v = self._map[k]
+            out += struct.pack("<I", len(k)) + k
+            out += struct.pack("<I", len(v)) + v
+        tmp = self._snap_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(out)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path())
+        fsync_dir(self.path)   # rename must hit disk before the WAL empties
+        self._wal.rotate()
+
+    def close(self) -> None:
+        if not self._wal.closed:
+            if self._wal.size() > 0:   # nothing new since last snapshot?
+                self.compact()
+            self._wal.close()
