@@ -1,1 +1,19 @@
+"""Wire/communication layer (reference: src/msg/ + src/messages/).
 
+Control plane: asyncio TCP messenger with typed messages and
+lossy/lossless peer policies.  Data plane for co-located shards rides
+JAX collectives instead (ceph_tpu/parallel/).
+"""
+
+from ceph_tpu.msg.message import (
+    Message, MPing, PRIO_DEFAULT, PRIO_HIGH, PRIO_HIGHEST, PRIO_LOW,
+    message_class, register_message,
+)
+from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
+from ceph_tpu.msg.types import EntityAddr, EntityName
+
+__all__ = [
+    "Connection", "Dispatcher", "EntityAddr", "EntityName", "MPing",
+    "Message", "Messenger", "PRIO_DEFAULT", "PRIO_HIGH", "PRIO_HIGHEST",
+    "PRIO_LOW", "Policy", "message_class", "register_message",
+]

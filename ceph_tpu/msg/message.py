@@ -1,0 +1,87 @@
+"""Message base class + type registry.
+
+Reference parity: msg/Message.h (header with type/priority/seq/source, crc'd
+encode; ~170 concrete M* classes in src/messages/ decoded by a type-code
+switch in Message::decode_message).  Redesigned: messages are Encodables
+registered by integer type code with a decorator; the messenger frames them
+with [type u16][header][payload] and verifies a crc32 per frame.  Typed
+messages live next to the subsystem that owns them (osd/messages.py,
+mon/messages.py, …) and register themselves on import.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+from ceph_tpu.common.encoding import Decoder, Encodable, Encoder
+from ceph_tpu.msg.types import EntityAddr, EntityName
+
+# priorities (msg/Message.h CEPH_MSG_PRIO_*)
+PRIO_LOW = 64
+PRIO_DEFAULT = 127
+PRIO_HIGH = 196
+PRIO_HIGHEST = 255
+
+_REGISTRY: Dict[int, Type["Message"]] = {}
+
+
+def register_message(cls: Type["Message"]) -> Type["Message"]:
+    code = cls.TYPE
+    if code in _REGISTRY and _REGISTRY[code] is not cls:
+        raise ValueError(
+            f"message type {code} already registered to "
+            f"{_REGISTRY[code].__name__}")
+    _REGISTRY[code] = cls
+    return cls
+
+
+def message_class(code: int) -> Optional[Type["Message"]]:
+    return _REGISTRY.get(code)
+
+
+class Message(Encodable):
+    """Base message.  Subclasses set TYPE (unique u16) and implement
+    encode_payload/decode_payload.  Transport fields (seq, src_*) are
+    stamped by the messenger, not encoded by the payload."""
+
+    TYPE = 0
+    PRIORITY = PRIO_DEFAULT
+
+    def __init__(self):
+        # stamped on send / receive by the messenger
+        self.seq = 0
+        self.src_name: Optional[EntityName] = None
+        self.src_addr: Optional[EntityAddr] = None
+        self.recv_stamp = 0.0
+        self.connection = None   # receiving Connection (for replies)
+
+    def encode_payload(self, enc: Encoder) -> None:  # default: no body
+        pass
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "Message":
+        return cls()
+
+    def get_type(self) -> int:
+        return self.TYPE
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(seq={self.seq}, "
+                f"src={self.src_name})")
+
+
+@register_message
+class MPing(Message):
+    """Liveness probe (messages/MPing.h)."""
+    TYPE = 2
+
+    def __init__(self, note: str = ""):
+        super().__init__()
+        self.note = note
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.string(self.note)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPing":
+        return cls(dec.string())

@@ -1,0 +1,420 @@
+"""Asyncio messenger: the control-plane transport between daemons.
+
+Reference parity: msg/Messenger.h (factory :164, send_message :466,
+dispatcher chain, lossy-client vs lossless-peer policies) and the
+AsyncMessenger event-loop transport (msg/async/AsyncMessenger.cc,
+AsyncConnection.cc state machine).  Redesigned for asyncio instead of
+epoll threads, with one deliberate simplification of the hardest part of
+the reference (Pipe.cc's simultaneous-connect races): each DIRECTION of a
+peer pair is its own TCP connection owned by its sender.  Lossless
+delivery then needs no connection-takeover protocol — the sender replays
+un-acked messages on its own reconnect, and the receiver dedupes by
+(peer nonce, seq) learned from the banner.  Semantics preserved:
+per-peer FIFO, at-most-once delivery to dispatchers, reset callbacks,
+message-count fault injection (ms_inject_socket_failures).
+
+Wire format: banner = [u32 len][EntityName][EntityAddr] once per
+connection, then frames [u8 tag][u32 len][payload]:
+  MSG  payload = [u64 seq][u16 type][u32 crc(body)][body]
+  ACK  payload = [u64 seq]      (cumulative)
+
+The data plane deliberately does NOT ride this path on co-located shards:
+bulk chunk movement is JAX collectives over ICI/DCN
+(ceph_tpu/parallel/layout.py); the messenger carries maps, consensus,
+heartbeats and per-op control as in SURVEY §2.4's TPU-native mapping.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import struct
+import time
+import zlib
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from ceph_tpu.common.encoding import Decoder, Encoder
+from ceph_tpu.msg.message import Message, message_class
+from ceph_tpu.msg.types import EntityAddr, EntityName
+
+TAG_MSG = 1
+TAG_ACK = 2
+TAG_KEEPALIVE = 3
+
+_FRAME_HDR = struct.Struct("<BI")       # tag, len
+_MSG_HDR = struct.Struct("<QHI")        # seq, type, crc
+
+
+class Policy:
+    """Per-peer-type delivery policy (Messenger::Policy, msg/Messenger.h).
+
+    lossy: on failure drop the queue and report a reset — the higher layer
+    (Objecter, MonClient) owns resend.  lossless: reconnect forever and
+    replay un-acked messages in order (daemon↔daemon)."""
+
+    def __init__(self, lossy: bool):
+        self.lossy = lossy
+
+    @classmethod
+    def lossy_client(cls) -> "Policy":
+        return cls(lossy=True)
+
+    @classmethod
+    def lossless_peer(cls) -> "Policy":
+        return cls(lossy=False)
+
+
+class Dispatcher:
+    """Receiver interface (msg/Dispatcher.h).  ms_dispatch returns True if
+    the message was handled; the messenger tries each dispatcher in
+    registration order (Messenger::ms_deliver_dispatch)."""
+
+    def ms_dispatch(self, msg: Message) -> bool:
+        return False
+
+    def ms_handle_reset(self, addr: EntityAddr) -> None:
+        """A lossy session to addr dropped its queue."""
+
+    def ms_handle_remote_reset(self, addr: EntityAddr) -> None:
+        """Peer at addr restarted (new nonce observed)."""
+
+
+class Connection:
+    """Outgoing logical channel to one peer address (sender-owned)."""
+
+    def __init__(self, msgr: "Messenger", addr: EntityAddr, policy: Policy):
+        self.msgr = msgr
+        self.addr = addr
+        self.policy = policy
+        # identifies THIS logical connection across its tcp reconnects;
+        # a fresh Connection (e.g. after mark_down) gets a fresh seq space
+        self.conn_id = random.getrandbits(63)
+        self.out_q: Deque[Message] = deque()
+        self.unacked: Deque[Tuple[int, bytes]] = deque()  # (seq, frame)
+        self.out_seq = 0
+        self.acked_seq = 0
+        self._kick = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._broken = False   # peer hung up (ack stream EOF)
+        self.closed = False
+
+    def send(self, msg: Message) -> None:
+        self.out_q.append(msg)
+        self._kick.set()
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    # --- writer loop ---
+    async def _run(self) -> None:
+        backoff = self.msgr.cfg["ms_initial_backoff"]
+        while not self.closed:
+            try:
+                reader, writer = await asyncio.open_connection(
+                    self.addr.host, self.addr.port)
+            except OSError:
+                if self.policy.lossy:
+                    self._fail_lossy()
+                    return
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, self.msgr.cfg["ms_max_backoff"])
+                continue
+            backoff = self.msgr.cfg["ms_initial_backoff"]
+            self._writer = writer
+            self._broken = False
+            ack_task = asyncio.get_running_loop().create_task(
+                self._read_acks(reader))
+            try:
+                await self._send_banner(writer)
+                # replay everything not yet acked, oldest first
+                for _, frame in list(self.unacked):
+                    writer.write(frame)
+                await writer.drain()
+                await self._pump(writer)
+            except (OSError, asyncio.IncompleteReadError,
+                    ConnectionError):
+                pass
+            finally:
+                ack_task.cancel()
+                self._writer = None
+                writer.close()
+            if self.closed:
+                return
+            if self.policy.lossy:
+                self._fail_lossy()
+                return
+
+    def _fail_lossy(self) -> None:
+        self.out_q.clear()
+        self.unacked.clear()
+        self.closed = True
+        self.msgr._drop_connection(self)
+        for d in self.msgr.dispatchers:
+            d.ms_handle_reset(self.addr)
+
+    async def _send_banner(self, writer: asyncio.StreamWriter) -> None:
+        enc = Encoder()
+        enc.struct(self.msgr.name).struct(self.msgr.addr)
+        enc.u64(self.conn_id)
+        b = enc.getvalue()
+        writer.write(struct.pack("<I", len(b)) + b)
+        await writer.drain()
+
+    async def _pump(self, writer: asyncio.StreamWriter) -> None:
+        while not self.closed:
+            if self._broken:
+                # peer hung up: writes to the dead socket would buffer
+                # silently (half-open TCP), so force the reconnect path —
+                # un-acked frames replay there
+                raise ConnectionError("peer closed ack stream")
+            while self.out_q:
+                msg = self.out_q.popleft()
+                self.out_seq += 1
+                msg.seq = self.out_seq
+                frame = self._frame(msg)
+                self.unacked.append((self.out_seq, frame))
+                if self.msgr._inject_failure():
+                    writer.transport.abort()   # hard drop, like a RST
+                    raise ConnectionError("injected socket failure")
+                writer.write(frame)
+            await writer.drain()
+            self._kick.clear()
+            if not self.out_q and not self._broken:
+                await self._kick.wait()
+
+    def _frame(self, msg: Message) -> bytes:
+        body = msg.to_bytes()
+        payload = _MSG_HDR.pack(msg.seq, msg.TYPE, zlib.crc32(body)) + body
+        return _FRAME_HDR.pack(TAG_MSG, len(payload)) + payload
+
+    async def _read_acks(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                hdr = await reader.readexactly(_FRAME_HDR.size)
+                tag, ln = _FRAME_HDR.unpack(hdr)
+                payload = await reader.readexactly(ln)
+                if tag == TAG_ACK:
+                    (seq,) = struct.unpack("<Q", payload)
+                    self.acked_seq = max(self.acked_seq, seq)
+                    while self.unacked and self.unacked[0][0] <= seq:
+                        self.unacked.popleft()
+        except asyncio.CancelledError:
+            return
+        except (OSError, asyncio.IncompleteReadError, ConnectionError):
+            self._broken = True
+            self._kick.set()   # wake _pump so it reconnects
+
+    async def close(self) -> None:
+        self.closed = True
+        self._kick.set()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+
+class Messenger:
+    """One per process endpoint (daemons bind; clients stay unbound)."""
+
+    def __init__(self, ctx, name: EntityName,
+                 default_policy: Optional[Policy] = None):
+        self.ctx = ctx
+        self.cfg = ctx.config
+        self.log = ctx.logger("ms")
+        self.name = name
+        self.nonce = random.getrandbits(48)
+        self.addr = EntityAddr("", 0, self.nonce)
+        self.dispatchers: List[Dispatcher] = []
+        if default_policy is None:
+            # clients default lossy (their stacks own resend); daemons
+            # default lossless peer links (Messenger policy defaults)
+            default_policy = (Policy.lossy_client() if name.is_client()
+                              else Policy.lossless_peer())
+        self.default_policy = default_policy
+        self.policies: Dict[str, Policy] = {}   # peer entity type -> policy
+        self.conns: Dict[Tuple[str, int], Connection] = {}
+        # receive-side dedupe: (peer nonce, conn id) -> last delivered seq
+        self._in_seq: Dict[Tuple[int, int], int] = {}
+        self._peer_nonce: Dict[Tuple[str, int], int] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._in_tasks: set = set()
+        self._msgs_sent = 0
+        self._msgs_received = 0
+
+    # --- setup ---
+    def add_dispatcher(self, d: Dispatcher) -> None:
+        self.dispatchers.append(d)
+
+    def set_policy(self, entity_type: str, policy: Policy) -> None:
+        """Delivery policy for connections TO peers of entity_type
+        (Messenger::set_policy); overwrites any earlier setting."""
+        self.policies[entity_type] = policy
+
+    def _policy_for(self, peer_type: Optional[str]) -> Policy:
+        if peer_type is not None and peer_type in self.policies:
+            return self.policies[peer_type]
+        return self.default_policy
+
+    async def bind(self, host: str = "127.0.0.1", port: int = 0) -> EntityAddr:
+        self._server = await asyncio.start_server(
+            self._handle_incoming, host, port)
+        sock = self._server.sockets[0]
+        bound_host, bound_port = sock.getsockname()[:2]
+        self.addr = EntityAddr(bound_host, bound_port, self.nonce)
+        self.log.debug(f"{self.name} bound at {self.addr}")
+        return self.addr
+
+    # --- send path ---
+    def send_message(self, msg: Message, addr: EntityAddr,
+                     peer_type: Optional[str] = None) -> None:
+        """Queue msg for addr; never blocks (Messenger.h:466 contract).
+        peer_type selects the delivery policy for a NEW connection (e.g.
+        "client" when replying to a lossy client); existing connections
+        keep the policy they were created with."""
+        key = addr.without_nonce()
+        conn = self.conns.get(key)
+        if conn is None or conn.closed:
+            conn = Connection(self, addr, self._policy_for(peer_type))
+            self.conns[key] = conn
+            conn.start()
+        self._msgs_sent += 1
+        conn.send(msg)
+
+    def get_connection(self, addr: EntityAddr) -> Optional[Connection]:
+        return self.conns.get(addr.without_nonce())
+
+    def mark_down(self, addr: EntityAddr) -> None:
+        """Tear down the session to addr (Messenger::mark_down)."""
+        conn = self.conns.pop(addr.without_nonce(), None)
+        if conn is not None:
+            conn.closed = True
+            conn._kick.set()
+
+    def _drop_connection(self, conn: Connection) -> None:
+        cur = self.conns.get(conn.addr.without_nonce())
+        if cur is conn:
+            del self.conns[conn.addr.without_nonce()]
+
+    def _inject_failure(self) -> bool:
+        n = self.cfg["ms_inject_socket_failures"]
+        return n > 0 and random.randrange(n) == 0
+
+    # --- receive path ---
+    async def _handle_incoming(self, reader: asyncio.StreamReader,
+                               writer: asyncio.StreamWriter) -> None:
+        self._in_tasks.add(asyncio.current_task())
+        try:
+            await self._serve_peer(reader, writer)
+        finally:
+            self._in_tasks.discard(asyncio.current_task())
+
+    async def _serve_peer(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        try:
+            (blen,) = struct.unpack("<I",
+                                    await reader.readexactly(4))
+            dec = Decoder(await reader.readexactly(blen))
+            peer_name = dec.struct(EntityName)
+            peer_addr = dec.struct(EntityAddr)
+            conn_id = dec.u64()
+        except (OSError, asyncio.IncompleteReadError, ConnectionError):
+            writer.close()
+            return
+        # restart detection only applies to BOUND peers: distinct unbound
+        # clients all advertise ("", 0) and must not alias each other
+        if not peer_addr.is_blank():
+            pkey = peer_addr.without_nonce()
+            old_nonce = self._peer_nonce.get(pkey)
+            if old_nonce is not None and old_nonce != peer_addr.nonce:
+                # peer restarted: its seq spaces reset (remote reset event)
+                for k in [k for k in self._in_seq if k[0] == old_nonce]:
+                    del self._in_seq[k]
+                for d in self.dispatchers:
+                    d.ms_handle_remote_reset(peer_addr)
+            if peer_addr.nonce:
+                self._peer_nonce[pkey] = peer_addr.nonce
+        try:
+            while True:
+                hdr = await reader.readexactly(_FRAME_HDR.size)
+                tag, ln = _FRAME_HDR.unpack(hdr)
+                payload = await reader.readexactly(ln)
+                if tag == TAG_MSG:
+                    self._handle_msg_frame(payload, peer_name, peer_addr,
+                                           conn_id, writer)
+                elif tag == TAG_KEEPALIVE:
+                    pass
+        except (OSError, asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    def _handle_msg_frame(self, payload: bytes, peer_name: EntityName,
+                          peer_addr: EntityAddr, conn_id: int,
+                          writer: asyncio.StreamWriter) -> None:
+        seq, mtype, crc = _MSG_HDR.unpack_from(payload, 0)
+        body = payload[_MSG_HDR.size:]
+        if zlib.crc32(body) != crc:
+            self.log.warning(f"crc mismatch on {mtype} from {peer_name}")
+            raise ConnectionError("bad crc")
+        # ack first (cumulative), then dedupe replays
+        if not writer.is_closing():
+            ack = struct.pack("<Q", seq)
+            writer.write(_FRAME_HDR.pack(TAG_ACK, len(ack)) + ack)
+        skey = (peer_addr.nonce, conn_id)
+        if seq <= self._in_seq.get(skey, 0):
+            return   # replayed duplicate after sender reconnect
+        cls = message_class(mtype)
+        if cls is None:
+            # undecodable deterministically: consume the seq (replaying the
+            # same bytes can never succeed) but keep the transport alive
+            self.log.warning(f"unknown message type {mtype}")
+            self._in_seq[skey] = seq
+            return
+        try:
+            msg = cls.from_bytes(body)
+        except Exception as e:
+            self.log.warning(f"decode of {cls.__name__} failed: {e!r}")
+            self._in_seq[skey] = seq
+            return
+        self._in_seq[skey] = seq   # delivered at-most-once from here on
+        msg.seq = seq
+        msg.src_name = peer_name
+        msg.src_addr = peer_addr
+        msg.recv_stamp = time.monotonic()
+        self._msgs_received += 1
+        for d in self.dispatchers:
+            try:
+                if d.ms_dispatch(msg):
+                    return
+            except Exception:
+                # a buggy dispatcher must not kill the peer transport
+                self.log.exception(f"dispatcher {d} failed on {msg}")
+                return
+        self.log.warning(f"unhandled message {msg}")
+
+    # --- teardown ---
+    async def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        # cancel live peer handlers instead of wait_closed(): waiting would
+        # deadlock two messengers shutting down in sequence (each handler
+        # only exits when the OTHER side closes its sending socket)
+        for t in list(self._in_tasks):
+            t.cancel()
+        for t in list(self._in_tasks):
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        for conn in list(self.conns.values()):
+            await conn.close()
+        self.conns.clear()

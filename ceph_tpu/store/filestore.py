@@ -19,7 +19,7 @@ from ceph_tpu.common.encoding import Decoder, Encoder
 from ceph_tpu.store.memstore import MemStore, Obj
 from ceph_tpu.store.objectstore import StoreError, Transaction
 from ceph_tpu.store.types import CollectionId, ObjectId
-from ceph_tpu.store.wal import WriteAheadLog, fsync_dir
+from ceph_tpu.store.wal import WriteAheadLog, atomic_snapshot
 
 _MAGIC = b"CTFS\x01"
 
@@ -103,13 +103,7 @@ class FileStore(MemStore):
                 enc.map_(o.omap, lambda e, k: e.bytes_(k),
                          lambda e, v: e.bytes_(v))
                 enc.bytes_(o.omap_header)
-        tmp = self._ckpt_path() + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(enc.getvalue())
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._ckpt_path())
-        fsync_dir(self.path)   # rename must hit disk before the WAL empties
+        atomic_snapshot(self._ckpt_path(), enc.getvalue())
         if self._wal is None:
             self._wal = WriteAheadLog(self._wal_path())
             self._wal.open()

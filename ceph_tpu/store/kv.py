@@ -23,7 +23,7 @@ import struct
 from bisect import bisect_left
 from typing import Dict, Iterator, List, Optional, Tuple
 
-from ceph_tpu.store.wal import WriteAheadLog, fsync_dir
+from ceph_tpu.store.wal import WriteAheadLog, atomic_snapshot
 
 _SEP = b"\x00"
 
@@ -157,7 +157,8 @@ class MemDB(KeyValueDB):
             end = end.encode("utf-8")
         p = prefix.encode("utf-8") + _SEP
         lo = bisect_left(self._keys, p + start)
-        for k in self._keys[lo:]:
+        for i in range(lo, len(self._keys)):   # no tail copy
+            k = self._keys[i]
             if not k.startswith(p):
                 break
             short = k[len(p):]
@@ -231,13 +232,7 @@ class FileDB(MemDB):
             v = self._map[k]
             out += struct.pack("<I", len(k)) + k
             out += struct.pack("<I", len(v)) + v
-        tmp = self._snap_path() + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(out)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._snap_path())
-        fsync_dir(self.path)   # rename must hit disk before the WAL empties
+        atomic_snapshot(self._snap_path(), bytes(out))
         self._wal.rotate()
 
     def close(self) -> None:

@@ -107,3 +107,16 @@ class WriteAheadLog:
     def close(self) -> None:
         if self._f is not None and not self._f.closed:
             self._f.close()
+
+
+def atomic_snapshot(path: str, data: bytes) -> None:
+    """Atomically replace ``path`` with data, durably: write sidecar tmp,
+    fsync it, rename over, fsync the directory (rename must hit disk
+    before the caller empties its WAL — the snapshot+log crash rule)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path) or ".")
