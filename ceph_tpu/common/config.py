@@ -250,6 +250,11 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("mon_lease", "float", 5.0, "paxos lease seconds (mon/Paxos.h:912)"),
     Option("mon_tick_interval", "float", 5.0, "monitor tick"),
     Option("mon_election_timeout", "float", 5.0, "elector timeout"),
+    Option("mon_osd_min_down_reporters", "int", 1,
+           "distinct failure reporters to mark an osd down"),
+    Option("mon_osd_down_out_interval", "float", 300.0,
+           "seconds down before auto-out (config_opts.h)"),
+    Option("mon_data", "str", "", "monitor store path"),
     Option("mon_paxos_batch_interval", "float", 0.05,
            "pending-proposal batching window (PaxosService)"),
     Option("osd_heartbeat_interval", "float", 1.0, "osd/OSD.cc:4223"),

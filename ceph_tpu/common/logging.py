@@ -42,6 +42,7 @@ class LogSystem:
         self.root = logging.getLogger(name)
         self.root.setLevel(logging.DEBUG)
         self.root.propagate = False
+        self.root.handlers.clear()   # re-created Context: don't stack sinks
         self._lock = threading.Lock()
         self._levels: Dict[str, int] = {}
         self.ring = _RingHandler(max_recent)

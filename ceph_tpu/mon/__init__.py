@@ -1,1 +1,15 @@
+"""Monitor layer: consensus, cluster maps, command surface.
 
+Reference parity: src/mon/ — Monitor, Elector, Paxos, PaxosService
+(OSDMonitor), MonMap, MonClient.
+"""
+
+from ceph_tpu.mon.client import CommandError, MonClient
+from ceph_tpu.mon.elector import Elector
+from ceph_tpu.mon.monitor import Monitor, PaxosService
+from ceph_tpu.mon.monmap import MonMap
+from ceph_tpu.mon.osd_monitor import OSDMonitor
+from ceph_tpu.mon.paxos import Paxos
+
+__all__ = ["CommandError", "Elector", "MonClient", "MonMap", "Monitor",
+           "OSDMonitor", "Paxos", "PaxosService"]

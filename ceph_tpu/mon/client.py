@@ -1,0 +1,156 @@
+"""MonClient: the client-side monitor session.
+
+Reference parity: mon/MonClient.{h,cc} — command proxy with retry,
+map subscriptions, hunting for a live/leader mon.  Auth (cephx) is out
+of scope this round; sessions are implicit in the messenger.  Commands
+follow the leader hint a non-leader mon returns (-EAGAIN + rank),
+replacing MonClient's forwarding dance with an explicit redirect.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+from typing import Callable, Dict, List, Optional
+
+from ceph_tpu.msg.message import Message
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
+from ceph_tpu.mon.messages import (
+    MMonCommand, MMonCommandAck, MMonMap, MMonSubscribe, MMonSubscribeAck,
+    MOSDMap,
+)
+from ceph_tpu.mon.monmap import MonMap
+from ceph_tpu.osd.osdmap import Incremental, OSDMap
+
+
+class CommandError(Exception):
+    def __init__(self, retcode: int, outs: str):
+        super().__init__(f"rc={retcode}: {outs}")
+        self.retcode = retcode
+        self.outs = outs
+
+
+class MonClient(Dispatcher):
+    def __init__(self, ctx, messenger: Messenger, monmap: MonMap):
+        self.ctx = ctx
+        self.cfg = ctx.config
+        self.log = ctx.logger("mon")
+        self.messenger = messenger
+        messenger.add_dispatcher(self)
+        self.monmap = monmap
+        self.cur_mon = 0                     # rank we currently talk to
+        self.osdmap: Optional[OSDMap] = None
+        self._osdmap_waiters: List[asyncio.Event] = []
+        self._map_cb: List[Callable[[OSDMap], None]] = []
+        self._tid = 0
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._subs: Dict[str, int] = {}
+        self._sub_task: Optional[asyncio.Task] = None
+
+    # ---------------------------------------------------------- dispatch
+    def ms_dispatch(self, m: Message) -> bool:
+        if isinstance(m, MMonCommandAck):
+            fut = self._pending.pop(m.tid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(m)
+            return True
+        if isinstance(m, MOSDMap):
+            self._handle_osdmap(m)
+            return True
+        if isinstance(m, MMonMap):
+            self.monmap = MonMap.from_bytes(m.monmap_bytes)
+            return True
+        if isinstance(m, MMonSubscribeAck):
+            return True
+        return False
+
+    def _handle_osdmap(self, m: MOSDMap) -> None:
+        if m.fulls:
+            e = max(m.fulls)
+            if self.osdmap is None or e > self.osdmap.epoch:
+                self.osdmap = OSDMap.from_bytes(m.fulls[e])
+        for e in sorted(m.incrementals):
+            if self.osdmap is None:
+                continue
+            if e == self.osdmap.epoch + 1:
+                self.osdmap.apply_incremental(
+                    Incremental.from_bytes(m.incrementals[e]))
+        if self.osdmap is not None:
+            self._subs["osdmap"] = self.osdmap.epoch + 1
+            self.log.debug(f"got osdmap {self.osdmap.summary()}")
+            for ev in self._osdmap_waiters:
+                ev.set()
+            for cb in self._map_cb:
+                cb(self.osdmap)
+
+    def on_osdmap(self, cb: Callable[[OSDMap], None]) -> None:
+        self._map_cb.append(cb)
+
+    # ------------------------------------------------------------- session
+    def sub_want(self, what: str, start: int = 0) -> None:
+        self._subs[what] = start
+        self._renew_subs()
+
+    def _renew_subs(self, rank: Optional[int] = None) -> None:
+        subs = {k: v for k, v in self._subs.items()}
+        if not subs:
+            return
+        self.messenger.send_message(
+            MMonSubscribe(subs),
+            self.monmap.addr_of_rank(rank if rank is not None
+                                     else self.cur_mon),
+            peer_type="mon")
+
+    async def wait_for_osdmap(self, timeout: float = 30.0) -> OSDMap:
+        if self.osdmap is not None:
+            return self.osdmap
+        if "osdmap" not in self._subs:
+            self.sub_want("osdmap", 0)
+        ev = asyncio.Event()
+        self._osdmap_waiters.append(ev)
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        finally:
+            self._osdmap_waiters.remove(ev)
+        return self.osdmap
+
+    # ------------------------------------------------------------ commands
+    async def command(self, cmd: dict, inbl: bytes = b"",
+                      timeout: float = 30.0) -> MMonCommandAck:
+        """Send a command, following leader hints and hunting across mons.
+        Raises CommandError on a negative retcode."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        rank = self.cur_mon
+        tried = 0
+        while True:
+            self._tid += 1
+            tid = self._tid
+            fut = asyncio.get_running_loop().create_future()
+            self._pending[tid] = fut
+            self.messenger.send_message(
+                MMonCommand(cmd, tid, inbl),
+                self.monmap.addr_of_rank(rank), peer_type="mon")
+            step = min(3.0, max(0.1,
+                                deadline - asyncio.get_running_loop().time()))
+            try:
+                ack: MMonCommandAck = await asyncio.wait_for(fut, step)
+            except asyncio.TimeoutError:
+                self._pending.pop(tid, None)
+                tried += 1
+                rank = (rank + 1) % self.monmap.size()   # hunt
+                if asyncio.get_running_loop().time() >= deadline:
+                    raise CommandError(-errno.ETIMEDOUT,
+                                       f"mon command timeout: {cmd}")
+                continue
+            if ack.retcode == -errno.EAGAIN:
+                # not leader / recovering: follow the hint after a beat
+                if ack.leader_hint >= 0:
+                    rank = ack.leader_hint
+                await asyncio.sleep(0.1)
+                if asyncio.get_running_loop().time() >= deadline:
+                    raise CommandError(ack.retcode, ack.outs)
+                continue
+            self.cur_mon = rank
+            if ack.retcode < 0:
+                raise CommandError(ack.retcode, ack.outs)
+            return ack

@@ -1,0 +1,281 @@
+"""Monitor daemon: quorum membership, consensus driver, map services.
+
+Reference parity: mon/Monitor.{h,cc} (state machine electing→leader/peon,
+command dispatch, session subscriptions), mon/PaxosService.{h,cc}
+(pending-proposal batching).  Redesigned: asyncio single-loop daemon; a
+non-leader answers commands with a leader hint instead of transparently
+forwarding (the MonClient follows the hint — simpler than the
+forward/route machinery of Monitor.cc, same observable behavior).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import json
+import time
+from typing import Callable, Dict, List, Optional
+
+from ceph_tpu.msg.message import Message, MPing
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
+from ceph_tpu.msg.types import EntityAddr, EntityName
+from ceph_tpu.mon.elector import Elector
+from ceph_tpu.mon.messages import (
+    MMonCommand, MMonCommandAck, MMonElection, MMonGetMap, MMonMap,
+    MMonPaxos, MMonSubscribe, MMonSubscribeAck, MOSDAlive, MOSDBoot,
+    MOSDFailure, MOSDMap, MPGTemp,
+)
+from ceph_tpu.mon.monmap import MonMap
+from ceph_tpu.mon.paxos import Paxos
+from ceph_tpu.store.kv import KeyValueDB, KVTransaction
+
+STATE_ELECTING = "electing"
+STATE_LEADER = "leader"
+STATE_PEON = "peon"
+
+
+class PaxosService:
+    """Interface for map services (mon/PaxosService.cc): committed state
+    lives in the store; mutations accumulate in a pending structure that
+    ``propose_pending`` serializes into one paxos value."""
+
+    def __init__(self, mon: "Monitor", name: str):
+        self.mon = mon
+        self.name = name
+
+    def refresh(self) -> None:
+        """Reload committed state after a paxos commit."""
+
+    def on_active(self) -> None:
+        """Called when this mon becomes leader with recovered paxos."""
+
+    def encode_pending(self, txn: KVTransaction) -> bool:
+        """Serialize pending changes; return False if nothing to propose."""
+        return False
+
+    def propose_pending(self, done: Optional[Callable] = None) -> None:
+        raise NotImplementedError
+
+
+class Monitor(Dispatcher):
+    def __init__(self, ctx, name: str, monmap: MonMap, store: KeyValueDB,
+                 messenger: Messenger):
+        from ceph_tpu.mon.osd_monitor import OSDMonitor
+        self.ctx = ctx
+        self.cfg = ctx.config
+        self.log = ctx.logger("mon")
+        self.name = name                      # mon id, e.g. "a"
+        self.monmap = monmap
+        self.store = store
+        self.messenger = messenger
+        messenger.add_dispatcher(self)
+        self.rank = monmap.rank_of(name)
+        self.state = STATE_ELECTING
+        self.quorum: List[int] = []
+        self.election_epoch = 0
+        self.elector = Elector(self)
+        self.paxos = Paxos(self)
+        self.osdmon = OSDMonitor(self)
+        self.services: List[PaxosService] = [self.osdmon]
+        # subscriptions: session key -> {"_addr": addr, what: next_epoch}
+        self.subs: Dict[tuple, Dict] = {}
+        self._tick_task: Optional[asyncio.Task] = None
+        self.running = False
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> None:
+        if self.messenger.addr.is_blank():   # tests may pre-bind
+            addr = self.monmap.addr_of(self.name)
+            await self.messenger.bind(addr.host, addr.port)
+        self.elector.load_epoch()
+        self.paxos.load()
+        for s in self.services:
+            s.refresh()
+        self.running = True
+        self._tick_task = asyncio.get_running_loop().create_task(
+            self._tick())
+        self.bootstrap()
+        self.log.info(f"mon.{self.name} rank {self.rank} started "
+                      f"({self.monmap})")
+
+    def bootstrap(self) -> None:
+        self.state = STATE_ELECTING
+        self.quorum = []
+        self.paxos.peon_init()
+        self.elector.start()
+
+    async def shutdown(self) -> None:
+        self.running = False
+        if self._tick_task:
+            self._tick_task.cancel()
+        self.elector.shutdown()
+        self.paxos.shutdown()
+        await self.messenger.shutdown()
+        self.store.close()
+
+    async def _tick(self) -> None:
+        while self.running:
+            await asyncio.sleep(self.cfg["mon_tick_interval"])
+            try:
+                if self.is_leader():
+                    self.osdmon.tick()
+            except Exception:
+                self.log.exception("tick failed")
+
+    # ------------------------------------------------------------ elections
+    def is_leader(self) -> bool:
+        return self.state == STATE_LEADER
+
+    def win_election(self, epoch: int, quorum: List[int]) -> None:
+        self.state = STATE_LEADER
+        self.election_epoch = epoch
+        self.quorum = quorum
+        self.paxos.leader_init()
+        # services activate when paxos reaches ACTIVE (refresh_from_paxos)
+
+    def lose_election(self, epoch: int, leader: int,
+                      quorum: List[int]) -> None:
+        self.state = STATE_PEON
+        self.election_epoch = epoch
+        self.quorum = quorum
+        self.paxos.peon_init()
+        self.log.info(f"mon.{self.name} peon in e{epoch}, "
+                      f"leader rank {leader}")
+
+    def refresh_from_paxos(self) -> None:
+        for s in self.services:
+            s.refresh()
+        if self.is_leader() and self.paxos.state == "active":
+            for s in self.services:
+                s.on_active()
+        self.publish_maps()
+
+    # ------------------------------------------------------------ transport
+    def send_mon(self, rank: int, msg: Message) -> None:
+        if rank == self.rank:
+            return
+        self.messenger.send_message(msg, self.monmap.addr_of_rank(rank),
+                                    peer_type="mon")
+
+    def send_mon_addr(self, addr: EntityAddr, msg: Message) -> None:
+        self.messenger.send_message(msg, addr, peer_type="mon")
+
+    def rank_of_addr(self, addr: EntityAddr, name: EntityName) -> int:
+        if name is not None and name.type == "mon":
+            return self.monmap.rank_of(name.id)
+        for r in range(self.monmap.size()):
+            if self.monmap.addr_of_rank(r).without_nonce() \
+                    == addr.without_nonce():
+                return r
+        return -1
+
+    def reply(self, req: Message, msg: Message) -> None:
+        peer_type = req.src_name.type if req.src_name else None
+        self.messenger.send_message(msg, req.src_addr, peer_type=peer_type)
+
+    # ------------------------------------------------------------- dispatch
+    def ms_dispatch(self, m: Message) -> bool:
+        try:
+            if isinstance(m, MMonElection):
+                self.elector.dispatch(m)
+            elif isinstance(m, MMonPaxos):
+                self.paxos.dispatch(m)
+            elif isinstance(m, MMonCommand):
+                self.handle_command(m)
+            elif isinstance(m, MMonSubscribe):
+                self.handle_subscribe(m)
+            elif isinstance(m, MMonGetMap):
+                self.reply(m, MMonMap(self.monmap.to_bytes()))
+            elif isinstance(m, (MOSDBoot, MOSDFailure, MOSDAlive, MPGTemp)):
+                self.osdmon.dispatch(m)
+            elif isinstance(m, MPing):
+                pass
+            else:
+                return False
+            return True
+        except Exception:
+            self.log.exception(f"dispatch of {m} failed")
+            return True
+
+    # --------------------------------------------------------- subscriptions
+    def handle_subscribe(self, m: MMonSubscribe) -> None:
+        key = (m.src_addr.host, m.src_addr.port, m.src_addr.nonce)
+        sub = self.subs.setdefault(key, {"_addr": m.src_addr,
+                                         "_type": (m.src_name.type
+                                                   if m.src_name else None)})
+        sub.update(m.what)
+        self.reply(m, MMonSubscribeAck())
+        self._push_maps_to(sub)
+
+    def publish_maps(self) -> None:
+        for sub in self.subs.values():
+            self._push_maps_to(sub)
+
+    def _push_maps_to(self, sub: Dict) -> None:
+        if "osdmap" in sub:
+            cur = self.osdmon.osdmap.epoch
+            start = sub["osdmap"]
+            if start <= cur:
+                msg = self.osdmon.build_osdmap_msg(start, cur)
+                self.messenger.send_message(msg, sub["_addr"],
+                                            peer_type=sub.get("_type"))
+                sub["osdmap"] = cur + 1
+        if "monmap" in sub and sub["monmap"] <= self.monmap.epoch:
+            self.messenger.send_message(MMonMap(self.monmap.to_bytes()),
+                                        sub["_addr"],
+                                        peer_type=sub.get("_type"))
+            sub["monmap"] = self.monmap.epoch + 1
+
+    # ------------------------------------------------------------- commands
+    def handle_command(self, m: MMonCommand) -> None:
+        if not self.is_leader():
+            leader = self.quorum[0] if self.quorum else -1
+            self.reply(m, MMonCommandAck(
+                m.tid, -errno.EAGAIN, "not leader", leader_hint=leader))
+            return
+        if not self.paxos.is_readable():
+            self.reply(m, MMonCommandAck(
+                m.tid, -errno.EAGAIN, "paxos recovering",
+                leader_hint=self.rank))
+            return
+        prefix = m.cmd.get("prefix", "")
+        try:
+            if prefix in ("status", "health"):
+                out = {
+                    "fsid": self.monmap.fsid,
+                    "election_epoch": self.election_epoch,
+                    "quorum": self.quorum,
+                    "monmap_epoch": self.monmap.epoch,
+                    "osdmap": self.osdmon.osdmap.summary(),
+                }
+                self.reply(m, MMonCommandAck(m.tid, 0, json.dumps(out)))
+            elif prefix == "mon dump":
+                self.reply(m, MMonCommandAck(
+                    m.tid, 0, repr(self.monmap),
+                    outbl=self.monmap.to_bytes()))
+            elif prefix == "quorum_status":
+                out = {"election_epoch": self.election_epoch,
+                       "quorum": self.quorum,
+                       "quorum_names": [self.monmap.name_of_rank(r)
+                                        for r in self.quorum]}
+                self.reply(m, MMonCommandAck(m.tid, 0, json.dumps(out)))
+            elif prefix.startswith("osd"):
+                self.osdmon.handle_command(m)
+            else:
+                self.reply(m, MMonCommandAck(
+                    m.tid, -errno.EINVAL, f"unknown command {prefix!r}"))
+        except Exception as e:
+            self.log.exception(f"command {prefix!r} failed")
+            self.reply(m, MMonCommandAck(m.tid, -errno.EIO, repr(e)))
+
+    # ---------------------------------------------------------------- store
+    def store_get(self, prefix: str, key) -> Optional[bytes]:
+        return self.store.get(prefix, key)
+
+    def store_put(self, prefix: str, key, value: bytes) -> None:
+        txn = KVTransaction()
+        txn.set(prefix, key, value)
+        self.store.submit(txn)
+
+    def store_submit(self, txn: KVTransaction) -> None:
+        self.store.submit(txn)

@@ -1,0 +1,363 @@
+"""OSDMonitor: the OSDMap service on paxos.
+
+Reference parity: mon/OSDMonitor.{h,cc} — osd boot/failure handling
+(prepare_failure :1427, can_mark_down :1666 safeguards), pool and crush
+commands, pg_temp requests, up_thru (alive) assertions, down→out aging.
+Committed state: full + incremental OSDMap per epoch in the "osdmap"
+store prefix; mutations accumulate in pending_inc and commit through
+Paxos as one transaction per epoch.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import time
+from typing import Dict, Optional
+
+from ceph_tpu.crush.builder import (build_hierarchy, make_erasure_rule,
+                                    make_replicated_rule)
+from ceph_tpu.crush.types import CrushMap
+from ceph_tpu.mon.messages import (
+    MMonCommand, MMonCommandAck, MOSDAlive, MOSDBoot, MOSDFailure, MOSDMap,
+    MPGTemp,
+)
+from ceph_tpu.osd.osdmap import Incremental, OSDMap
+from ceph_tpu.mon.monitor import PaxosService
+from ceph_tpu.osd.types import (
+    OSD_IN_WEIGHT, OSD_UP, PGPool, POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED,
+)
+from ceph_tpu.store.kv import KVTransaction
+
+
+class OSDMonitor(PaxosService):
+    def __init__(self, mon):
+        super().__init__(mon, "osdmap")
+        self.log = mon.log
+        self.osdmap = OSDMap()
+        self.pending_inc = Incremental(1)
+        # failure tracking: target osd -> {reporter osd: monotonic stamp}
+        self.failure_reports: Dict[int, Dict[int, float]] = {}
+        self.down_stamp: Dict[int, float] = {}
+
+    # ----------------------------------------------------------- state io
+    def refresh(self) -> None:
+        v = self.mon.store_get("osdmap", "last_committed")
+        last = int.from_bytes(v, "little") if v else 0
+        if last > self.osdmap.epoch:
+            full = self.mon.store_get("osdmap", f"full_{last}")
+            self.osdmap = OSDMap.from_bytes(full)
+            self.log.info(f"osdmap {self.osdmap.summary()}")
+        if self.pending_inc.epoch <= self.osdmap.epoch:
+            self.pending_inc = Incremental(self.osdmap.epoch + 1)
+        elif self.pending_inc.epoch > self.osdmap.epoch + 1:
+            # mutations that arrived while a proposal was in flight were
+            # pre-assigned a later epoch; realign so they stay proposable
+            self.pending_inc.epoch = self.osdmap.epoch + 1
+        # changes accumulated while the previous proposal was in flight
+        # must be proposed now or they'd sit until the next trigger
+        if (self.mon.is_leader() and self._pending_dirty()
+                and self.mon.paxos.is_writeable()):
+            self.propose_pending()
+
+    def _pending_dirty(self) -> bool:
+        inc = self.pending_inc
+        return bool(inc.new_pools or inc.new_pool_names or inc.old_pools
+                    or inc.new_up or inc.new_state or inc.new_weight
+                    or inc.new_primary_affinity or inc.new_up_thru
+                    or inc.new_pg_temp or inc.new_primary_temp
+                    or inc.new_crush is not None or inc.new_max_osd >= 0
+                    or inc.fsid)
+
+    def on_active(self) -> None:
+        if self.osdmap.epoch == 0:
+            self.create_initial()
+
+    def create_initial(self) -> None:
+        """First map: empty, fsid only (OSDMonitor::create_initial)."""
+        self.pending_inc = Incremental(1)
+        self.pending_inc.fsid = self.mon.monmap.fsid
+        self.pending_inc.new_max_osd = 0
+        self.propose_pending()
+
+    def encode_pending(self, txn: KVTransaction) -> bool:
+        inc = self.pending_inc
+        if inc.epoch != self.osdmap.epoch + 1:
+            return False
+        nm = OSDMap.from_bytes(self.osdmap.to_bytes()) \
+            if self.osdmap.epoch else OSDMap()
+        nm.apply_incremental(inc)
+        nm.modified = time.time()
+        e = inc.epoch
+        txn.set("osdmap", f"inc_{e}", inc.to_bytes())
+        txn.set("osdmap", f"full_{e}", nm.to_bytes())
+        txn.set("osdmap", "last_committed", e.to_bytes(8, "little"))
+        return True
+
+    def propose_pending(self, done=None) -> None:
+        txn = KVTransaction()
+        try:
+            ok = self.encode_pending(txn)
+        except Exception:
+            # a poisoned pending_inc (e.g. a mutation for an osd id the
+            # map rejects) must never wedge the service: drop it
+            self.log.exception("encode_pending failed; "
+                               "discarding pending incremental")
+            self.pending_inc = Incremental(self.osdmap.epoch + 1)
+            ok = False
+        if not ok:
+            if done:
+                done(False)
+            return
+        self.pending_inc = Incremental(self.pending_inc.epoch + 1)
+        self.mon.paxos.propose_new_value(txn.encode(), done)
+
+    def build_osdmap_msg(self, start: int, end: int) -> MOSDMap:
+        """Incrementals [start..end]; falls back to a full map when the
+        range predates start or is trimmed."""
+        msg = MOSDMap()
+        if end < 1:
+            return msg   # nothing committed yet
+        if start == 0 or start <= self.osdmap.epoch - 100:
+            full = self.mon.store_get("osdmap", f"full_{end}")
+            if full is not None:
+                msg.fulls[end] = full
+            return msg
+        for e in range(start, end + 1):
+            inc = self.mon.store_get("osdmap", f"inc_{e}")
+            if inc is None:
+                full = self.mon.store_get("osdmap", f"full_{end}")
+                if full is not None:
+                    msg.fulls[end] = full
+                return msg
+            msg.incrementals[e] = inc
+        return msg
+
+    # ------------------------------------------------------------ reports
+    def dispatch(self, m) -> None:
+        if not self.mon.is_leader():
+            return   # reports go to the leader; clients retry via hints
+        if isinstance(m, MOSDBoot):
+            self.prepare_boot(m)
+        elif isinstance(m, MOSDFailure):
+            self.prepare_failure(m)
+        elif isinstance(m, MOSDAlive):
+            self.prepare_alive(m)
+        elif isinstance(m, MPGTemp):
+            self.prepare_pgtemp(m)
+
+    def prepare_boot(self, m: MOSDBoot) -> None:
+        osd = m.osd_id
+        if osd >= (self.pending_inc.new_max_osd
+                   if self.pending_inc.new_max_osd >= 0
+                   else self.osdmap.max_osd):
+            self.pending_inc.new_max_osd = osd + 1
+        self.pending_inc.new_up[osd] = m.addr
+        if not self.osdmap.exists(osd) or self.osdmap.osd_weight[osd] == 0:
+            # new or previously-out osd boots in (mon_osd_auto_mark_in)
+            self.pending_inc.new_weight[osd] = OSD_IN_WEIGHT
+        self.failure_reports.pop(osd, None)
+        self.down_stamp.pop(osd, None)
+        self.log.info(f"osd.{osd} boot from {m.addr}")
+        self.propose_pending()
+
+    def prepare_failure(self, m: MOSDFailure) -> None:
+        target = m.target_osd
+        reporter = int(m.src_name.id) if m.src_name else -1
+        if not m.is_failed:
+            reps = self.failure_reports.get(target)
+            if reps:
+                reps.pop(reporter, None)
+            return
+        if not self.osdmap.exists(target) or self.osdmap.is_down(target):
+            return
+        if self.pending_inc.new_state.get(target, 0) & OSD_UP:
+            return   # down-mark already queued: a second XOR would undo it
+        reps = self.failure_reports.setdefault(target, {})
+        reps[reporter] = time.monotonic()
+        if len(reps) >= self.mon.cfg["mon_osd_min_down_reporters"]:
+            # can_mark_down safeguard: never take down the last up osd
+            # via reports (OSDMonitor.cc:1666 up-ratio check distilled)
+            if self.osdmap.count_up() <= 1:
+                self.log.warning(f"refusing to mark osd.{target} down: "
+                                 "last one standing")
+                return
+            self.log.info(f"marking osd.{target} down "
+                          f"({len(reps)} reporters)")
+            self.pending_inc.new_state[target] = \
+                self.pending_inc.new_state.get(target, 0) | OSD_UP
+            self.failure_reports.pop(target, None)
+            self.down_stamp[target] = time.monotonic()
+            self.propose_pending()
+
+    def prepare_alive(self, m: MOSDAlive) -> None:
+        if not self.osdmap.exists(m.osd_id):
+            return   # stray daemon: a bad id would poison the incremental
+        # grant up_thru = the pending epoch (>= the osd's want_epoch)
+        self.pending_inc.new_up_thru[m.osd_id] = self.pending_inc.epoch
+        self.propose_pending()
+
+    def prepare_pgtemp(self, m: MPGTemp) -> None:
+        changed = False
+        for pg, osds in m.pg_temp.items():
+            if self.osdmap.pg_temp.get(pg, []) != osds:
+                self.pending_inc.new_pg_temp[pg] = osds
+                changed = True
+        if changed:
+            self.propose_pending()
+
+    def tick(self) -> None:
+        """Leader periodic work: age down osds to out."""
+        now = time.monotonic()
+        grace = self.mon.cfg["mon_osd_down_out_interval"]
+        dirty = False
+        for osd in range(self.osdmap.max_osd):
+            if (self.osdmap.exists(osd) and self.osdmap.is_down(osd)
+                    and self.osdmap.is_in(osd)):
+                stamp = self.down_stamp.setdefault(osd, now)
+                if grace and now - stamp > grace:
+                    self.log.info(f"osd.{osd} down > {grace}s: marking out")
+                    self.pending_inc.new_weight[osd] = 0
+                    dirty = True
+        if dirty:
+            self.propose_pending()
+
+    # ------------------------------------------------------------ commands
+    def handle_command(self, m: MMonCommand) -> None:
+        cmd = m.cmd
+        prefix = cmd.get("prefix", "")
+        ack = lambda rc, outs="", outbl=b"": self.mon.reply(
+            m, MMonCommandAck(m.tid, rc, outs, outbl))
+
+        if prefix == "osd dump":
+            ack(0, self.osdmap.summary(), self.osdmap.to_bytes())
+        elif prefix == "osd getmap":
+            e = int(cmd.get("epoch", self.osdmap.epoch))
+            full = self.mon.store_get("osdmap", f"full_{e}")
+            if full is None:
+                ack(-errno.ENOENT, f"no osdmap epoch {e}")
+            else:
+                ack(0, f"osdmap e{e}", full)
+        elif prefix == "osd stat":
+            ack(0, self.osdmap.summary())
+        elif prefix == "osd tree":
+            ack(0, json.dumps(self._tree()))
+        elif prefix == "osd setmaxosd":
+            self.pending_inc.new_max_osd = int(cmd["num"])
+            self._propose_and_ack(m)
+        elif prefix == "osd pool create":
+            self._cmd_pool_create(m)
+        elif prefix == "osd pool delete":
+            pid = self.osdmap.lookup_pool(cmd["pool"])
+            if pid < 0:
+                ack(-errno.ENOENT, f"no pool {cmd['pool']!r}")
+                return
+            self.pending_inc.old_pools.append(pid)
+            self._propose_and_ack(m)
+        elif prefix == "osd pool ls":
+            ack(0, json.dumps(sorted(self.osdmap.pool_names.values())))
+        elif prefix == "osd out":
+            self._cmd_weight(m, int(cmd["id"]), 0)
+        elif prefix == "osd in":
+            self._cmd_weight(m, int(cmd["id"]), OSD_IN_WEIGHT)
+        elif prefix == "osd down":
+            osd = int(cmd["id"])
+            if self.osdmap.is_up(osd) and not \
+                    (self.pending_inc.new_state.get(osd, 0) & OSD_UP):
+                self.pending_inc.new_state[osd] = \
+                    self.pending_inc.new_state.get(osd, 0) | OSD_UP
+            self._propose_and_ack(m)
+        elif prefix == "osd primary-affinity":
+            osd = int(cmd["id"])
+            if not self.osdmap.exists(osd):
+                ack(-errno.ENOENT, f"osd.{osd} dne")
+                return
+            w = float(cmd["weight"])
+            self.pending_inc.new_primary_affinity[osd] = \
+                int(w * 0x10000) & 0x1FFFF
+            self._propose_and_ack(m)
+        elif prefix == "osd crush set-map":
+            self.pending_inc.new_crush = CrushMap.from_bytes(m.inbl)
+            self._propose_and_ack(m)
+        elif prefix == "osd crush build-simple":
+            # convenience: hierarchy for n osds (vstart / tests)
+            crush = CrushMap()
+            n = int(cmd["num_osds"])
+            per_host = int(cmd.get("osds_per_host", 1))
+            crush.max_devices = max(n, self.osdmap.max_osd)
+            build_hierarchy(crush, n, per_host)
+            make_replicated_rule(crush, "replicated_rule")
+            self.pending_inc.new_crush = crush
+            if n > self.osdmap.max_osd:
+                self.pending_inc.new_max_osd = n
+            self._propose_and_ack(m)
+        else:
+            ack(-errno.EINVAL, f"unknown osd command {prefix!r}")
+
+    def _cmd_pool_create(self, m: MMonCommand) -> None:
+        cmd = m.cmd
+        name = cmd["pool"]
+        if self.osdmap.lookup_pool(name) >= 0 or \
+                name in self.pending_inc.new_pool_names.values():
+            self.mon.reply(m, MMonCommandAck(m.tid, 0,
+                                             f"pool {name!r} exists"))
+            return
+        pg_num = int(cmd.get("pg_num",
+                             self.mon.cfg["osd_pool_default_pg_num"]))
+        pool_type = cmd.get("pool_type", "replicated")
+        pid = max([0] + list(self.osdmap.pools)
+                  + list(self.pending_inc.new_pools)) + 1
+        crush = self.pending_inc.new_crush or self.osdmap.crush
+        if pool_type == "erasure":
+            profile = cmd.get("erasure_code_profile", "default")
+            k = int(cmd.get("k", 4))
+            mm = int(cmd.get("m", 2))
+            size = k + mm
+            # each EC pool gets its own indep rule (create_ruleset role)
+            newc = CrushMap.from_bytes(crush.to_bytes())
+            rule_name = f"ec_{name}"
+            existing = [rid for rid, rn in newc.rule_name_map.items()
+                        if rn == rule_name]
+            if existing:
+                rule = existing[0]
+            else:
+                rule = make_erasure_rule(newc, rule_name, size)
+                self.pending_inc.new_crush = newc
+            pool = PGPool(POOL_TYPE_ERASURE, size=size,
+                          min_size=k + 1, crush_ruleset=rule,
+                          pg_num=pg_num, ec_profile=profile)
+            pool.stripe_width = k * 4096
+        else:
+            size = int(cmd.get("size",
+                               self.mon.cfg["osd_pool_default_size"]))
+            rule = 0
+            pool = PGPool(POOL_TYPE_REPLICATED, size=size,
+                          crush_ruleset=rule, pg_num=pg_num)
+        self.pending_inc.new_pools[pid] = pool
+        self.pending_inc.new_pool_names[pid] = name
+        self._propose_and_ack(m, outs=f"pool {name!r} created (id {pid})")
+
+    def _cmd_weight(self, m: MMonCommand, osd: int, w: int) -> None:
+        if not self.osdmap.exists(osd):
+            self.mon.reply(m, MMonCommandAck(m.tid, -errno.ENOENT,
+                                             f"osd.{osd} dne"))
+            return
+        self.pending_inc.new_weight[osd] = w
+        self._propose_and_ack(m)
+
+    def _propose_and_ack(self, m: MMonCommand, outs: str = "") -> None:
+        def done(ok):
+            self.mon.reply(m, MMonCommandAck(
+                m.tid, 0 if ok else -errno.EAGAIN,
+                outs or f"osdmap e{self.osdmap.epoch}"))
+        self.propose_pending(done)
+
+    def _tree(self) -> list:
+        out = []
+        for o in range(self.osdmap.max_osd):
+            if self.osdmap.exists(o):
+                out.append({"id": o,
+                            "up": self.osdmap.is_up(o),
+                            "in": self.osdmap.is_in(o),
+                            "weight": self.osdmap.osd_weight[o] / 0x10000})
+        return out

@@ -1,0 +1,231 @@
+"""Monitor tests: election, paxos commit/recovery, commands, subscriptions.
+
+Models the reference's mon test strategy (test/mon/*.sh: single and
+multi-mon clusters, leader kill, command behavior) in-process with
+asyncio + MemDB-backed stores.
+"""
+
+import asyncio
+import errno
+
+import pytest
+
+from ceph_tpu.common.context import Context
+from ceph_tpu.mon import CommandError, MonClient, Monitor
+from ceph_tpu.mon.messages import MOSDBoot, MOSDFailure
+from ceph_tpu.mon.monmap import MonMap
+from ceph_tpu.msg.messenger import Messenger
+from ceph_tpu.msg.types import EntityAddr, EntityName
+from ceph_tpu.store.kv import MemDB
+
+FAST_CFG = {
+    "mon_election_timeout": 0.3,
+    "mon_lease": 1.0,
+    "mon_tick_interval": 0.5,
+    "ms_initial_backoff": 0.02,
+}
+
+
+async def start_mons(n, stores=None):
+    """Boot an n-mon cluster on ephemeral ports; returns (monmap, mons)."""
+    monmap = MonMap()
+    monmap.fsid = "fsid-test"
+    msgrs = []
+    for i in range(n):
+        name = chr(ord("a") + i)
+        ctx = Context(f"mon.{name}")
+        for k, v in FAST_CFG.items():
+            ctx.config.set(k, v)
+        msgr = Messenger(ctx, EntityName("mon", name))
+        addr = await msgr.bind()
+        monmap.add(name, addr)
+        msgrs.append((ctx, name, msgr))
+    mons = []
+    for i, (ctx, name, msgr) in enumerate(msgrs):
+        store = stores[i] if stores else MemDB()
+        mon = Monitor(ctx, name, monmap, store, msgr)
+        await mon.start()
+        mons.append(mon)
+    return monmap, mons
+
+
+async def wait_quorum(mons, timeout=15.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        leaders = [m for m in mons if m.is_leader()
+                   and m.paxos.state == "active"]
+        if leaders and len(leaders) == 1:
+            return leaders[0]
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError(
+                f"no quorum: {[(m.name, m.state, m.paxos.state) for m in mons]}")
+        await asyncio.sleep(0.05)
+
+
+async def make_client(monmap):
+    ctx = Context("client.admin")
+    for k, v in FAST_CFG.items():
+        ctx.config.set(k, v)
+    msgr = Messenger(ctx, EntityName("client", "admin"))
+    await msgr.bind()   # bound so the mon can push maps back
+    return MonClient(ctx, msgr, monmap), msgr
+
+
+async def stop_all(mons, extra_msgrs=()):
+    for m in mons:
+        await m.shutdown()
+    for ms in extra_msgrs:
+        await ms.shutdown()
+
+
+def test_single_mon_bootstrap_and_commands():
+    async def run():
+        monmap, mons = await start_mons(1)
+        leader = await wait_quorum(mons)
+        assert leader.osdmon.osdmap.epoch >= 1   # create_initial committed
+        client, cmsgr = await make_client(monmap)
+        ack = await client.command({"prefix": "status"})
+        assert "fsid-test" in ack.outs
+        ack = await client.command({"prefix": "osd crush build-simple",
+                                    "num_osds": 4, "osds_per_host": 2})
+        ack = await client.command({"prefix": "osd pool create",
+                                    "pool": "data", "pg_num": 8})
+        assert "created" in ack.outs
+        ack = await client.command({"prefix": "osd pool ls"})
+        assert "data" in ack.outs
+        ack = await client.command({"prefix": "osd dump"})
+        from ceph_tpu.osd.osdmap import OSDMap
+        m = OSDMap.from_bytes(ack.outbl)
+        assert m.lookup_pool("data") >= 0
+        assert m.max_osd == 4
+        with pytest.raises(CommandError):
+            await client.command({"prefix": "bogus"})
+        await stop_all(mons, [cmsgr])
+    asyncio.run(run())
+
+
+def test_osd_boot_failure_and_subscription():
+    async def run():
+        monmap, mons = await start_mons(1)
+        leader = await wait_quorum(mons)
+        client, cmsgr = await make_client(monmap)
+        await client.command({"prefix": "osd crush build-simple",
+                              "num_osds": 3, "osds_per_host": 1})
+        # osd.0..2 boot (as osd entities)
+        osd_msgrs = []
+        for i in range(3):
+            ctx = Context(f"osd.{i}")
+            for k, v in FAST_CFG.items():
+                ctx.config.set(k, v)
+            om = Messenger(ctx, EntityName("osd", str(i)))
+            addr = await om.bind()
+            om.send_message(MOSDBoot(i, addr), monmap.addr_of_rank(0),
+                            peer_type="mon")
+            osd_msgrs.append(om)
+        # client learns the new map via subscription
+        deadline = asyncio.get_event_loop().time() + 10
+        while True:
+            m = await client.wait_for_osdmap()
+            if m.count_up() == 3:
+                break
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        assert m.is_up(0) and m.is_up(1) and m.is_up(2)
+        # failure report from osd.1 against osd.2
+        osd_msgrs[1].send_message(
+            MOSDFailure(target_osd=2, epoch=m.epoch),
+            monmap.addr_of_rank(0), peer_type="mon")
+        deadline = asyncio.get_event_loop().time() + 10
+        while client.osdmap.is_up(2):
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        assert client.osdmap.is_in(2)   # down but not yet out
+        await stop_all(mons, osd_msgrs + [cmsgr])
+    asyncio.run(run())
+
+
+def test_three_mon_election_and_commit():
+    async def run():
+        monmap, mons = await start_mons(3)
+        leader = await wait_quorum(mons)
+        assert leader.rank == 0     # lowest rank wins
+        peons = [m for m in mons if m is not leader]
+        assert all(m.state == "peon" for m in peons)
+        client, cmsgr = await make_client(monmap)
+        await client.command({"prefix": "osd crush build-simple",
+                              "num_osds": 2, "osds_per_host": 1})
+        await client.command({"prefix": "osd pool create", "pool": "p3",
+                              "pg_num": 4})
+        # peons replicate the committed state
+        deadline = asyncio.get_event_loop().time() + 10
+        while True:
+            if all(m.osdmon.osdmap.lookup_pool("p3") >= 0 for m in peons):
+                break
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        await stop_all(mons, [cmsgr])
+    asyncio.run(run())
+
+
+def test_command_to_peon_redirects():
+    async def run():
+        monmap, mons = await start_mons(3)
+        await wait_quorum(mons)
+        client, cmsgr = await make_client(monmap)
+        client.cur_mon = 2          # deliberately talk to a peon first
+        ack = await client.command({"prefix": "status"})
+        assert client.cur_mon == 0  # followed the leader hint
+        await stop_all(mons, [cmsgr])
+    asyncio.run(run())
+
+
+def test_leader_failover():
+    async def run():
+        monmap, mons = await start_mons(3)
+        leader = await wait_quorum(mons)
+        client, cmsgr = await make_client(monmap)
+        await client.command({"prefix": "osd crush build-simple",
+                              "num_osds": 2, "osds_per_host": 1})
+        await client.command(
+            {"prefix": "osd pool create", "pool": "before", "pg_num": 4})
+        # kill the leader
+        await leader.shutdown()
+        rest = [m for m in mons if m is not leader]
+        # surviving mons elect rank 1; wait for an active new leader
+        new_leader = await wait_quorum(rest, timeout=30)
+        assert new_leader.rank == 1
+        assert new_leader.osdmon.osdmap.lookup_pool("before") >= 0
+        # cluster still serves writes
+        ack = await client.command(
+            {"prefix": "osd pool create", "pool": "after", "pg_num": 4},
+            timeout=30)
+        assert "created" in ack.outs
+        await stop_all(rest, [cmsgr])
+    asyncio.run(run())
+
+
+def test_mon_restart_preserves_state():
+    async def run():
+        stores = [MemDB()]
+        monmap, mons = await start_mons(1, stores=stores)
+        await wait_quorum(mons)
+        client, cmsgr = await make_client(monmap)
+        await client.command({"prefix": "osd crush build-simple",
+                              "num_osds": 2, "osds_per_host": 1})
+        await client.command({"prefix": "osd pool create",
+                              "pool": "persist", "pg_num": 4})
+        epoch_before = mons[0].osdmon.osdmap.epoch
+        await mons[0].shutdown()
+
+        # restart with same store + same monmap address
+        ctx = Context("mon.a")
+        for k, v in FAST_CFG.items():
+            ctx.config.set(k, v)
+        msgr = Messenger(ctx, EntityName("mon", "a"))
+        mon2 = Monitor(ctx, "a", monmap, stores[0], msgr)
+        await mon2.start()
+        leader = await wait_quorum([mon2])
+        assert leader.osdmon.osdmap.epoch >= epoch_before
+        assert leader.osdmon.osdmap.lookup_pool("persist") >= 0
+        await stop_all([mon2], [cmsgr])
+    asyncio.run(run())

@@ -1,0 +1,274 @@
+"""OSDMap tests: placement pipeline, incrementals, overrides.
+
+Models reference test/osd/TestOSDMap.cc: build a map, map pgs, kill osds,
+check up/acting behavior for replicated (shifting) and EC (positional)
+pools, pg_temp overrides, primary affinity, encode round-trips.
+"""
+
+import pytest
+
+from ceph_tpu.crush.builder import (build_hierarchy, make_erasure_rule,
+                                    make_replicated_rule)
+from ceph_tpu.crush.constants import CRUSH_ITEM_NONE
+from ceph_tpu.crush.types import CrushMap
+from ceph_tpu.msg.types import EntityAddr
+from ceph_tpu.osd.osdmap import Incremental, OSDMap
+from ceph_tpu.osd.types import (
+    OSD_IN_WEIGHT, OSD_UP, ObjectLocator, PGId, PGPool,
+    POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED, ceph_stable_mod,
+)
+
+N_OSDS = 12
+OSDS_PER_HOST = 2
+
+
+def build_map(n_osds=N_OSDS) -> OSDMap:
+    m = OSDMap()
+    m.fsid = "test-fsid"
+    crush = CrushMap()
+    crush.max_devices = n_osds
+    build_hierarchy(crush, n_osds, OSDS_PER_HOST)
+    rep_rule = make_replicated_rule(crush, "replicated_rule")
+    ec_rule = make_erasure_rule(crush, "ec_rule", size=6)
+    m.crush = crush
+    m.set_max_osd(n_osds)
+    inc = Incremental(1)
+    for o in range(n_osds):
+        inc.new_up[o] = EntityAddr("127.0.0.1", 6800 + o, o + 1)
+        inc.new_weight[o] = OSD_IN_WEIGHT
+    m.apply_incremental(inc)
+    m.pools[1] = PGPool(POOL_TYPE_REPLICATED, size=3,
+                        crush_ruleset=rep_rule, pg_num=32)
+    m.pool_names[1] = "rbd"
+    m.pools[2] = PGPool(POOL_TYPE_ERASURE, size=6, min_size=5,
+                        crush_ruleset=ec_rule, pg_num=32,
+                        ec_profile="k4m2")
+    m.pool_names[2] = "ecpool"
+    return m
+
+
+def mark_down(m: OSDMap, osd: int) -> None:
+    inc = Incremental(m.epoch + 1)
+    inc.new_state[osd] = OSD_UP
+    m.apply_incremental(inc)
+
+
+def host_of(osd: int) -> int:
+    return osd // OSDS_PER_HOST
+
+
+def test_stable_mod():
+    # include/rados.h:84 semantics
+    assert ceph_stable_mod(11, 12, 15) == 11
+    assert ceph_stable_mod(13, 12, 15) == 5
+    for x in range(200):
+        v = ceph_stable_mod(x, 12, 15)
+        assert 0 <= v < 12
+
+
+def test_basic_state():
+    m = build_map()
+    assert m.epoch == 1
+    assert m.count_up() == N_OSDS
+    assert all(m.is_in(o) for o in range(N_OSDS))
+    assert m.get_addr(3).port == 6803
+    mark_down(m, 3)
+    assert not m.is_up(3)
+    assert m.is_in(3)       # down but still in
+    assert m.exists(3)
+    assert m.osd_info[3].down_at == m.epoch
+
+
+def test_replicated_placement_properties():
+    m = build_map()
+    seen = set()
+    for pg in m.pg_ids(1):
+        up, upp, acting, actp = m.pg_to_up_acting_osds(pg)
+        assert len(up) == 3
+        assert len(set(up)) == 3
+        # chooseleaf: one osd per host
+        assert len({host_of(o) for o in up}) == 3
+        assert upp == up[0] and actp == acting[0]
+        assert acting == up      # no overrides yet
+        seen.update(up)
+    assert len(seen) > N_OSDS // 2   # spread across the cluster
+
+
+def test_placement_deterministic_and_stable():
+    m = build_map()
+    a = [m.pg_to_up_acting_osds(pg) for pg in m.pg_ids(1)]
+    b = [m.pg_to_up_acting_osds(pg) for pg in m.pg_ids(1)]
+    assert a == b
+    m2 = OSDMap.from_bytes(build_map().to_bytes())
+    c = [m2.pg_to_up_acting_osds(pg) for pg in m2.pg_ids(1)]
+    assert a == c
+
+
+def test_object_to_pg_mapping():
+    m = build_map()
+    loc = ObjectLocator(pool=1)
+    pg, acting, primary = m.object_to_acting("myobject", loc)
+    assert pg.pool == 1 and 0 <= pg.seed < 32
+    assert primary == acting[0]
+    # locator key overrides object name
+    loc_k = ObjectLocator(pool=1, key="myobject")
+    pg2, _, _ = m.object_to_acting("othername", loc_k)
+    assert pg2 == pg
+    # namespace changes the hash
+    loc_ns = ObjectLocator(pool=1, namespace="ns1")
+    pg3, _, _ = m.object_to_acting("myobject", loc_ns)
+    assert (pg3.seed != pg.seed) or True  # may collide, but computed path
+
+
+def test_replicated_osd_down_then_out():
+    m = build_map()
+    target = m.pg_ids(1)[0]
+    up0, _, _, _ = m.pg_to_up_acting_osds(target)
+    victim = up0[1]
+    # down-but-in: crush still maps to it; the up set just shrinks
+    # (reference: _raw_to_up_osds filters down osds; remap waits for OUT)
+    mark_down(m, victim)
+    up1, _, _, _ = m.pg_to_up_acting_osds(target)
+    assert victim not in up1
+    assert up1 == [o for o in up0 if o != victim]
+    # marking it OUT makes crush reject it and find a replacement
+    inc = Incremental(m.epoch + 1)
+    inc.new_weight[victim] = 0
+    m.apply_incremental(inc)
+    up2, _, _, _ = m.pg_to_up_acting_osds(target)
+    assert victim not in up2
+    assert len(up2) == 3
+    assert set(up0) - {victim} <= set(up2)   # survivors keep membership
+
+
+def test_ec_down_is_positional():
+    m = build_map()
+    for pg in m.pg_ids(2)[:8]:
+        up0, _, _, _ = m.pg_to_up_acting_osds(pg)
+        assert len(up0) == 6 and CRUSH_ITEM_NONE not in up0
+        victim_pos = 2
+        victim = up0[victim_pos]
+        mark_down(m, victim)
+        up1, _, _, _ = m.pg_to_up_acting_osds(pg)
+        assert len(up1) == 6
+        # indep: non-failed positions unchanged
+        for i in range(6):
+            if i != victim_pos:
+                assert up1[i] == up0[i], (pg, i, up0, up1)
+        assert up1[victim_pos] != victim
+        # bring back for next iteration
+        inc = Incremental(m.epoch + 1)
+        inc.new_up[victim] = EntityAddr("127.0.0.1", 6800 + victim,
+                                        victim + 100)
+        m.apply_incremental(inc)
+
+
+def test_out_osd_gets_nothing():
+    m = build_map()
+    inc = Incremental(m.epoch + 1)
+    inc.new_weight[5] = 0    # reweight out
+    m.apply_incremental(inc)
+    assert m.is_out(5)
+    for pool in (1, 2):
+        for pg in m.pg_ids(pool):
+            up, _, _, _ = m.pg_to_up_acting_osds(pg)
+            assert 5 not in up
+
+
+def test_pg_temp_override():
+    m = build_map()
+    pg = m.pg_ids(1)[3]
+    up, upp, acting0, _ = m.pg_to_up_acting_osds(pg)
+    override = [o for o in range(N_OSDS) if o not in up][:3]
+    inc = Incremental(m.epoch + 1)
+    inc.new_pg_temp[pg] = override
+    m.apply_incremental(inc)
+    up1, _, acting1, actp1 = m.pg_to_up_acting_osds(pg)
+    assert up1 == up            # up unchanged
+    assert acting1 == override  # acting overridden
+    assert actp1 == override[0]
+    # removal restores crush mapping
+    inc2 = Incremental(m.epoch + 1)
+    inc2.new_pg_temp[pg] = []
+    m.apply_incremental(inc2)
+    _, _, acting2, _ = m.pg_to_up_acting_osds(pg)
+    assert acting2 == acting0
+
+
+def test_primary_temp_override():
+    m = build_map()
+    pg = m.pg_ids(1)[4]
+    _, _, acting, _ = m.pg_to_up_acting_osds(pg)
+    inc = Incremental(m.epoch + 1)
+    inc.new_primary_temp[pg] = acting[2]
+    m.apply_incremental(inc)
+    _, _, _, actp = m.pg_to_up_acting_osds(pg)
+    assert actp == acting[2]
+
+
+def test_primary_affinity_zero_demotes():
+    m = build_map()
+    # find a pg where osd 0 is primary
+    pgs = [pg for pg in m.pg_ids(1)
+           if m.pg_to_up_acting_osds(pg)[1] == 0]
+    assert pgs, "osd 0 should be primary somewhere in 32 pgs"
+    inc = Incremental(m.epoch + 1)
+    inc.new_primary_affinity[0] = 0
+    m.apply_incremental(inc)
+    for pg in pgs:
+        up, upp, _, _ = m.pg_to_up_acting_osds(pg)
+        assert upp != 0          # fully demoted
+        assert 0 in up           # still serves as replica
+        assert upp == up[0]      # replicated pools shift primary to front
+
+
+def test_pool_delete():
+    m = build_map()
+    inc = Incremental(m.epoch + 1)
+    inc.old_pools.append(1)
+    m.apply_incremental(inc)
+    assert m.get_pool(1) is None
+    assert m.lookup_pool("rbd") == -1
+    assert m.pg_to_up_acting_osds(PGId(1, 0)) == ([], -1, [], -1)
+
+
+def test_osdmap_roundtrip():
+    m = build_map()
+    mark_down(m, 7)
+    inc = Incremental(m.epoch + 1)
+    inc.new_pg_temp[PGId(1, 5)] = [0, 2, 4]
+    inc.new_primary_affinity[1] = 0x8000
+    m.apply_incremental(inc)
+    m2 = OSDMap.from_bytes(m.to_bytes())
+    assert m2.epoch == m.epoch
+    assert m2.summary() == m.summary()
+    for pool in (1, 2):
+        for pg in m.pg_ids(pool):
+            assert (m2.pg_to_up_acting_osds(pg)
+                    == m.pg_to_up_acting_osds(pg))
+
+
+def test_incremental_roundtrip():
+    inc = Incremental(5)
+    inc.new_pools[9] = PGPool(POOL_TYPE_ERASURE, size=6, pg_num=64,
+                              ec_profile="p")
+    inc.new_pool_names[9] = "x"
+    inc.new_up[3] = EntityAddr("10.0.0.1", 6801, 44)
+    inc.new_state[2] = OSD_UP
+    inc.new_weight[2] = 1234
+    inc.new_pg_temp[PGId(9, 1)] = [1, 2, 3]
+    inc.new_primary_temp[PGId(9, 2)] = 7
+    inc.new_up_thru[3] = 4
+    inc2 = Incremental.from_bytes(inc.to_bytes())
+    assert inc2.epoch == 5
+    assert inc2.new_pools[9].pg_num == 64
+    assert inc2.new_up[3].port == 6801
+    assert inc2.new_pg_temp[PGId(9, 1)] == [1, 2, 3]
+    assert inc2.new_primary_temp[PGId(9, 2)] == 7
+    assert inc2.new_up_thru[3] == 4
+
+
+def test_epoch_ordering_enforced():
+    m = build_map()
+    with pytest.raises(AssertionError):
+        m.apply_incremental(Incremental(m.epoch + 2))
