@@ -1,1 +1,6 @@
+"""Client I/O stack (reference: src/osdc/ Objecter + src/librados/)."""
 
+from ceph_tpu.client.objecter import ObjectOperationError, Objecter
+from ceph_tpu.client.rados import IoCtx, Rados
+
+__all__ = ["IoCtx", "ObjectOperationError", "Objecter", "Rados"]

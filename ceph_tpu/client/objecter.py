@@ -1,0 +1,125 @@
+"""Objecter: the RADOS client op engine.
+
+Reference parity: osdc/Objecter.cc — op_submit (:2167) → _calc_target
+(:2661, object_locator_to_pg + pg→acting via the SAME placement pipeline
+the OSDs run) → _send_op; resend on map change (:1974 handle_osd_map
+scan) and on EAGAIN from an OSD that saw a stale mapping.  Linger
+(watch) ops are out of scope this round.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.msg.message import Message
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
+from ceph_tpu.mon.client import MonClient
+from ceph_tpu.osd.messages import MOSDOp, MOSDOpReply, OSDOp
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.types import ObjectLocator, PGId
+
+
+class ObjectOperationError(Exception):
+    def __init__(self, retcode: int, what: str = ""):
+        super().__init__(f"rc={retcode} {what}")
+        self.retcode = retcode
+
+
+class _InFlight:
+    __slots__ = ("tid", "oid", "loc", "ops", "fut", "attempts")
+
+    def __init__(self, tid, oid, loc, ops, fut):
+        self.tid = tid
+        self.oid = oid
+        self.loc = loc
+        self.ops = ops
+        self.fut = fut
+        self.attempts = 0
+
+
+class Objecter(Dispatcher):
+    def __init__(self, ctx, messenger: Messenger, monc: MonClient):
+        self.ctx = ctx
+        self.log = ctx.logger("objecter")
+        self.messenger = messenger
+        messenger.add_dispatcher(self)
+        self.monc = monc
+        monc.on_osdmap(self._on_osdmap)
+        self._tid = 0
+        self._inflight: Dict[int, _InFlight] = {}
+
+    @property
+    def osdmap(self) -> Optional[OSDMap]:
+        return self.monc.osdmap
+
+    # ------------------------------------------------------------ dispatch
+    def ms_dispatch(self, m: Message) -> bool:
+        if isinstance(m, MOSDOpReply):
+            op = self._inflight.get(m.tid)
+            if op is None:
+                return True
+            if m.result == -errno.EAGAIN:
+                # osd saw a stale/foreign mapping: refresh map + resend
+                self.monc.sub_want("osdmap",
+                                   max(m.map_epoch,
+                                       self.osdmap.epoch if self.osdmap
+                                       else 0))
+                asyncio.get_running_loop().create_task(
+                    self._resend_later(op))
+                return True
+            del self._inflight[m.tid]
+            if not op.fut.done():
+                op.fut.set_result(m)
+            return True
+        return False
+
+    async def _resend_later(self, op: _InFlight) -> None:
+        op.attempts += 1
+        await asyncio.sleep(min(0.05 * (2 ** min(op.attempts, 6)), 2.0))
+        if op.tid in self._inflight and not op.fut.done():
+            self._send(op)
+
+    def _on_osdmap(self, osdmap: OSDMap) -> None:
+        # reference handle_osd_map: rescan + resend everything in flight
+        # whose target may have changed; we simply resend all (idempotent
+        # at-most-once completion via tid matching)
+        for op in list(self._inflight.values()):
+            self._send(op)
+
+    # ------------------------------------------------------------- submit
+    def _calc_target(self, oid: str, loc: ObjectLocator
+                     ) -> Tuple[PGId, int]:
+        m = self.osdmap
+        pg, acting, primary = m.object_to_acting(oid, loc)
+        return pg, primary
+
+    def _send(self, op: _InFlight) -> None:
+        pg, primary = self._calc_target(op.oid, op.loc)
+        if primary < 0:
+            return   # no primary yet: next map triggers a resend
+        addr = self.osdmap.get_addr(primary)
+        if addr is None:
+            return
+        reqid = f"{self.messenger.nonce:x}.{op.tid}"
+        self.messenger.send_message(
+            MOSDOp(pg, op.oid, op.loc, op.ops, op.tid,
+                   self.osdmap.epoch, reqid), addr, peer_type="osd")
+
+    async def op_submit(self, oid: str, loc: ObjectLocator,
+                        ops: List[OSDOp], timeout: float = 30.0
+                        ) -> MOSDOpReply:
+        if self.osdmap is None:
+            await self.monc.wait_for_osdmap()
+        self._tid += 1
+        tid = self._tid
+        fut = asyncio.get_running_loop().create_future()
+        op = _InFlight(tid, oid, loc, ops, fut)
+        self._inflight[tid] = op
+        self._send(op)
+        try:
+            reply = await asyncio.wait_for(fut, timeout)
+        finally:
+            self._inflight.pop(tid, None)
+        return reply

@@ -1,0 +1,178 @@
+"""librados-style public client API.
+
+Reference parity: librados/librados.cc (Rados/IoCtx C++ API) →
+RadosClient (connect/maps) + IoCtxImpl (per-pool ops) — asyncio-native
+here: every data op is a coroutine; the CLI wraps them in asyncio.run.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+from typing import Dict, List, Optional
+
+from ceph_tpu.client.objecter import ObjectOperationError, Objecter
+from ceph_tpu.common.context import Context
+from ceph_tpu.mon.client import MonClient
+from ceph_tpu.mon.monmap import MonMap
+from ceph_tpu.msg.messenger import Messenger
+from ceph_tpu.msg.types import EntityName
+from ceph_tpu.osd.messages import (
+    OSDOp, OP_CREATE, OP_DELETE, OP_GETXATTR, OP_OMAP_GET_VALS,
+    OP_OMAP_SET, OP_PGLS, OP_READ, OP_SETXATTR, OP_STAT, OP_WRITE,
+    OP_WRITEFULL,
+)
+from ceph_tpu.osd.types import ObjectLocator, PGId
+
+
+class Rados:
+    """Cluster handle (librados::Rados)."""
+
+    def __init__(self, ctx: Optional[Context] = None,
+                 monmap: Optional[MonMap] = None,
+                 name: str = "client.admin"):
+        self.ctx = ctx or Context(name)
+        self.monmap = monmap
+        self.messenger: Optional[Messenger] = None
+        self.monc: Optional[MonClient] = None
+        self.objecter: Optional[Objecter] = None
+        self.connected = False
+
+    @classmethod
+    def from_monmap_file(cls, path: str, **kw) -> "Rados":
+        with open(path, "rb") as f:
+            return cls(monmap=MonMap.from_bytes(f.read()), **kw)
+
+    async def connect(self) -> "Rados":
+        assert self.monmap is not None, "monmap required"
+        self.messenger = Messenger(
+            self.ctx, EntityName.parse(self.ctx.name))
+        await self.messenger.bind()   # clients bind too: maps/replies
+        self.monc = MonClient(self.ctx, self.messenger, self.monmap)
+        self.objecter = Objecter(self.ctx, self.messenger, self.monc)
+        self.monc.sub_want("osdmap", 0)
+        await self.monc.wait_for_osdmap()
+        self.connected = True
+        return self
+
+    async def shutdown(self) -> None:
+        if self.messenger is not None:
+            await self.messenger.shutdown()
+        self.connected = False
+
+    async def mon_command(self, cmd: dict, inbl: bytes = b"",
+                          timeout: float = 30.0):
+        return await self.monc.command(cmd, inbl, timeout)
+
+    async def pool_create(self, name: str, pg_num: int = 0, **kw) -> None:
+        cmd = {"prefix": "osd pool create", "pool": name}
+        if pg_num:
+            cmd["pg_num"] = pg_num
+        cmd.update(kw)
+        await self.mon_command(cmd)
+        # wait until the local map shows the pool
+        while self.monc.osdmap.lookup_pool(name) < 0:
+            await asyncio.sleep(0.05)
+
+    async def pool_delete(self, name: str) -> None:
+        await self.mon_command({"prefix": "osd pool delete", "pool": name})
+
+    def pool_list(self) -> List[str]:
+        return sorted(self.monc.osdmap.pool_names.values())
+
+    def open_ioctx(self, pool_name: str) -> "IoCtx":
+        pool_id = self.monc.osdmap.lookup_pool(pool_name)
+        if pool_id < 0:
+            raise ObjectOperationError(-errno.ENOENT,
+                                       f"no pool {pool_name!r}")
+        return IoCtx(self, pool_id, pool_name)
+
+
+class IoCtx:
+    """Per-pool I/O context (librados::IoCtx / IoCtxImpl)."""
+
+    def __init__(self, rados: Rados, pool_id: int, pool_name: str):
+        self.rados = rados
+        self.objecter = rados.objecter
+        self.pool_id = pool_id
+        self.pool_name = pool_name
+        self.namespace = ""
+        self.locator_key = ""
+
+    def _loc(self) -> ObjectLocator:
+        return ObjectLocator(self.pool_id, self.locator_key, self.namespace)
+
+    async def _op(self, oid: str, ops: List[OSDOp], timeout=30.0):
+        reply = await self.objecter.op_submit(oid, self._loc(), ops,
+                                              timeout)
+        if reply.result < 0:
+            raise ObjectOperationError(reply.result, oid)
+        return reply
+
+    # ---- data ops ----
+    async def write_full(self, oid: str, data: bytes) -> None:
+        await self._op(oid, [OSDOp(OP_WRITEFULL, length=len(data),
+                                   data=data)])
+
+    async def write(self, oid: str, data: bytes, offset: int = 0) -> None:
+        await self._op(oid, [OSDOp(OP_WRITE, offset=offset,
+                                   length=len(data), data=data)])
+
+    async def read(self, oid: str, length: int = 0,
+                   offset: int = 0) -> bytes:
+        reply = await self._op(oid, [OSDOp(OP_READ, offset=offset,
+                                           length=length)])
+        op = reply.ops[0]
+        if op.rval < 0:
+            raise ObjectOperationError(op.rval, oid)
+        return op.outdata
+
+    async def remove(self, oid: str) -> None:
+        await self._op(oid, [OSDOp(OP_DELETE)])
+
+    async def create(self, oid: str) -> None:
+        await self._op(oid, [OSDOp(OP_CREATE)])
+
+    async def stat(self, oid: str) -> int:
+        reply = await self._op(oid, [OSDOp(OP_STAT)])
+        if reply.ops[0].rval < 0:
+            raise ObjectOperationError(reply.ops[0].rval, oid)
+        return int(reply.ops[0].outdata)
+
+    async def getxattr(self, oid: str, name: str) -> bytes:
+        reply = await self._op(oid, [OSDOp(OP_GETXATTR, name=name)])
+        if reply.ops[0].rval < 0:
+            raise ObjectOperationError(reply.ops[0].rval, oid)
+        return reply.ops[0].outdata
+
+    async def setxattr(self, oid: str, name: str, value: bytes) -> None:
+        await self._op(oid, [OSDOp(OP_SETXATTR, name=name, data=value)])
+
+    async def omap_set(self, oid: str, kv: Dict[bytes, bytes]) -> None:
+        await self._op(oid, [OSDOp(OP_OMAP_SET, kv=kv)])
+
+    async def omap_get(self, oid: str,
+                       keys: Optional[List[bytes]] = None
+                       ) -> Dict[bytes, bytes]:
+        reply = await self._op(oid, [OSDOp(OP_OMAP_GET_VALS,
+                                           keys=keys or [])])
+        op = reply.ops[0]
+        if op.rval < 0:
+            raise ObjectOperationError(op.rval, oid)
+        from ceph_tpu.common.encoding import Decoder
+        return Decoder(op.outdata).map_(lambda d: d.bytes_(),
+                                        lambda d: d.bytes_())
+
+    async def list_objects(self) -> List[str]:
+        """Scan every pg of the pool (ObjectLister / pgls)."""
+        m = self.rados.monc.osdmap
+        pool = m.get_pool(self.pool_id)
+        names: List[str] = []
+        for ps in range(pool.pg_num):
+            loc = ObjectLocator(self.pool_id, hash_pos=ps)
+            reply = await self.objecter.op_submit(
+                f"pgls-{ps}", loc, [OSDOp(OP_PGLS)])
+            if reply.result == 0 and reply.ops[0].outdata:
+                names.extend(n.decode()
+                             for n in reply.ops[0].outdata.split(b"\x00"))
+        return sorted(names)

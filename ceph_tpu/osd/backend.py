@@ -1,0 +1,625 @@
+"""PGBackend strategies: primary-copy replication and erasure coding.
+
+Reference parity: osd/PGBackend.h (strategy interface),
+osd/ReplicatedBackend.cc (submit_transaction :592 → issue_op :633 →
+sub_op_modify :205 → acks :714), osd/ECBackend.cc (submit_transaction
+:1344 → ECTransaction encode → MOSDECSubOpWrite; handle_sub_write :827,
+handle_sub_read :890; reads :1927 gather k shards → ECUtil::decode;
+recovery :484 via minimum_to_decode), osd/ECUtil.cc (stripe math).
+
+EC redesign (TPU-first): a full-object write is encoded in ONE shot —
+the object is split into k data chunks and parity computed by the
+GF(2^8) MXU kernel (ceph_tpu/ec/kernel.py), then per-shard transactions
+fan out.  Chunk streams are linear over GF(2^8), so recovery decodes
+whole shard streams at once instead of looping stripes.  Omap is
+rejected on EC pools like the reference; xattrs replicate to all shards.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ceph_tpu.osd.messages import (
+    EVersion, MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
+    MOSDECSubOpWriteReply, MOSDOp, MOSDRepOp, MOSDRepOpReply, MPGPush,
+    OSDOp,
+    OP_APPEND, OP_CREATE, OP_DELETE, OP_GETXATTR, OP_GETXATTRS,
+    OP_OMAP_GET_HEADER, OP_OMAP_GET_VALS, OP_OMAP_RM_KEYS, OP_OMAP_SET,
+    OP_OMAP_SET_HEADER, OP_PGLS, OP_READ, OP_RMXATTR, OP_SETXATTR,
+    OP_STAT, OP_TRUNCATE, OP_WRITE, OP_WRITEFULL, OP_ZERO,
+)
+from ceph_tpu.crush.constants import CRUSH_ITEM_NONE
+from ceph_tpu.osd.pglog import LOG_DELETE, LOG_MODIFY, LogEntry
+from ceph_tpu.store.objectstore import (
+    NoSuchCollection, NoSuchObject, Transaction,
+)
+
+SIZE_XATTR = "_size"       # EC: original object length (hinfo role)
+
+
+class PGBackend:
+    def __init__(self, pg):
+        self.pg = pg
+        self.osd = pg.osd
+        self.log_ = pg.log_
+        # in-flight rep ops: tid -> (pending peer set, future)
+        self._inflight: Dict[int, Tuple[set, asyncio.Future]] = {}
+
+    # --- shared helpers ---
+    def _ack_init(self, tid: int, peers: set) -> asyncio.Future:
+        fut = asyncio.get_running_loop().create_future()
+        if not peers:
+            fut.set_result(True)
+        else:
+            self._inflight[tid] = (set(peers), fut)
+        return fut
+
+    def _ack_rx(self, tid: int, frm) -> None:
+        ent = self._inflight.get(tid)
+        if ent is None:
+            return
+        pending, fut = ent
+        pending.discard(frm)
+        if not pending:
+            del self._inflight[tid]
+            if not fut.done():
+                fut.set_result(True)
+
+    async def _await_acks(self, fut: asyncio.Future, timeout=20.0) -> bool:
+        try:
+            await asyncio.wait_for(fut, timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def apply_push(self, m: MPGPush) -> None:
+        """Install a pushed object (recovery receive side)."""
+        pg = self.pg
+        oid = pg.object_id(m.oid)
+        txn = Transaction()
+        txn.remove(pg.cid, oid)
+        if not m.deleted:
+            txn.write(pg.cid, oid, 0, m.data)
+            if m.attrs:
+                txn.setattrs(pg.cid, oid, m.attrs)
+            if m.omap:
+                txn.omap_setkeys(pg.cid, oid, m.omap)
+            if m.omap_header:
+                txn.omap_setheader(pg.cid, oid, m.omap_header)
+        pg.save_meta(txn)
+        self.osd.store.apply_transaction(txn)
+
+    def push_object(self, peer: int, oid: str, at: EVersion) -> None:
+        """Send full object state to peer (fire-and-forget variant)."""
+        pg = self.pg
+        soid = pg.object_id(oid)
+        try:
+            data = self.osd.store.read(pg.cid, soid)
+            attrs = self.osd.store.getattrs(pg.cid, soid)
+            hdr, omap = self.osd.store.omap_get(pg.cid, soid)
+            msg = MPGPush(pg.pgid.with_shard(pg.shard_of(peer)), oid, at,
+                          data, attrs, omap, hdr, self.osd.whoami)
+        except (NoSuchObject, NoSuchCollection):
+            msg = MPGPush(pg.pgid.with_shard(pg.shard_of(peer)), oid, at,
+                          from_osd=self.osd.whoami, deleted=True)
+        self.osd.send_osd(peer, msg)
+
+    async def _push_and_wait(self, peer: int, oid: str) -> None:
+        fut = asyncio.get_running_loop().create_future()
+        self.pg._push_acks[(peer, oid)] = fut
+        try:
+            self.push_object(peer, oid, self.pg.info.last_update)
+            await asyncio.wait_for(fut, 20.0)
+        finally:
+            self.pg._push_acks.pop((peer, oid), None)
+
+    # --- interface ---
+    async def submit_client_write(self, m: MOSDOp) -> int: ...
+    async def do_reads(self, m: MOSDOp) -> int: ...
+    async def handle_sub_message(self, m) -> None: ...
+
+    def handle_reply(self, m) -> None:
+        """Ack-type messages resolve futures the PG worker is awaiting —
+        they MUST bypass the op queue (the worker is blocked on them)."""
+        if isinstance(m, (MOSDRepOpReply, MOSDECSubOpWriteReply)):
+            self._ack_rx(m.tid, m.from_osd)
+        elif isinstance(m, MOSDECSubOpReadReply):
+            ent = self._inflight.pop(m.tid, None)
+            if ent is not None and not ent[1].done():
+                ent[1].set_result(m)
+
+    async def recover_object(self, peer: int, oid: str) -> None:
+        await self._push_and_wait(peer, oid)
+
+    async def pull_object(self, peer: int, oid: str, epoch: int) -> None:
+        """Primary self-heal during peering: fetch our copy from the
+        authoritative peer (whole-object for replicated; ECBackend
+        overrides to reconstruct its own shard)."""
+        await self.pg.pull_object_via_push(peer, oid, epoch)
+
+
+# ===================================================================== util
+
+def execute_read_op(store, cid, soid, op: OSDOp) -> int:
+    """One read-class op against committed state; fills rval/outdata."""
+    try:
+        if op.op == OP_READ:
+            length = op.length if op.length else -1
+            op.outdata = store.read(cid, soid, op.offset, length)
+            op.rval = len(op.outdata)
+        elif op.op == OP_STAT:
+            st = store.stat(cid, soid)
+            op.outdata = str(st["size"]).encode()
+            op.rval = 0
+        elif op.op == OP_GETXATTR:
+            op.outdata = store.getattr(cid, soid, op.name)
+            op.rval = len(op.outdata)
+        elif op.op == OP_GETXATTRS:
+            attrs = store.getattrs(cid, soid)
+            from ceph_tpu.common.encoding import Encoder
+            enc = Encoder()
+            enc.map_({k.encode(): v for k, v in attrs.items()},
+                     lambda e, k: e.bytes_(k), lambda e, v: e.bytes_(v))
+            op.outdata = enc.getvalue()
+            op.rval = 0
+        elif op.op == OP_OMAP_GET_VALS:
+            _, omap = store.omap_get(cid, soid)
+            want = op.keys or sorted(omap)
+            from ceph_tpu.common.encoding import Encoder
+            enc = Encoder()
+            enc.map_({k: omap[k] for k in want if k in omap},
+                     lambda e, k: e.bytes_(k), lambda e, v: e.bytes_(v))
+            op.outdata = enc.getvalue()
+            op.rval = 0
+        elif op.op == OP_OMAP_GET_HEADER:
+            op.outdata = store.omap_get(cid, soid)[0]
+            op.rval = 0
+        else:
+            op.rval = -errno.EOPNOTSUPP
+    except (NoSuchObject, NoSuchCollection):
+        op.rval = -errno.ENOENT
+    return op.rval
+
+
+def build_write_txn(store, cid, soid, ops: List[OSDOp],
+                    txn: Transaction) -> Tuple[int, bool]:
+    """Translate write-class ops into store txn ops (do_osd_ops write
+    side).  Returns (result, deletes_object)."""
+    deleted = False
+    for op in ops:
+        if not op.is_write():
+            continue
+        if op.op == OP_WRITE:
+            txn.write(cid, soid, op.offset, op.data)
+            deleted = False
+        elif op.op == OP_WRITEFULL:
+            txn.truncate(cid, soid, 0)
+            txn.write(cid, soid, 0, op.data)
+            deleted = False
+        elif op.op == OP_APPEND:
+            try:
+                size = store.stat(cid, soid)["size"]
+            except (NoSuchObject, NoSuchCollection):
+                size = 0
+            txn.write(cid, soid, size, op.data)
+        elif op.op == OP_TRUNCATE:
+            txn.truncate(cid, soid, op.offset)
+        elif op.op == OP_ZERO:
+            txn.zero(cid, soid, op.offset, op.length)
+        elif op.op == OP_CREATE:
+            txn.touch(cid, soid)
+        elif op.op == OP_DELETE:
+            txn.remove(cid, soid)
+            deleted = True
+        elif op.op == OP_SETXATTR:
+            txn.setattr(cid, soid, op.name, op.data)
+        elif op.op == OP_RMXATTR:
+            txn.rmattr(cid, soid, op.name)
+        elif op.op == OP_OMAP_SET:
+            txn.omap_setkeys(cid, soid, op.kv)
+        elif op.op == OP_OMAP_RM_KEYS:
+            txn.omap_rmkeys(cid, soid, op.keys)
+        elif op.op == OP_OMAP_SET_HEADER:
+            txn.omap_setheader(cid, soid, op.data)
+        else:
+            return -errno.EOPNOTSUPP, deleted
+    return 0, deleted
+
+
+# ============================================================== replicated
+
+class ReplicatedBackend(PGBackend):
+    """Primary-copy replication (osd/ReplicatedBackend.cc)."""
+
+    async def submit_client_write(self, m: MOSDOp) -> int:
+        pg = self.pg
+        soid = pg.object_id(m.oid)
+        # read-class ops in the batch see pre-write state
+        for op in m.ops:
+            if not op.is_write():
+                if op.op == OP_PGLS:
+                    self._do_pgls(op)
+                else:
+                    execute_read_op(self.osd.store, pg.cid, soid, op)
+        txn = Transaction()
+        result, deletes = build_write_txn(self.osd.store, pg.cid, soid,
+                                          m.ops, txn)
+        if result < 0:
+            return result
+        version = pg.next_version()
+        entry = LogEntry(LOG_DELETE if deletes else LOG_MODIFY, m.oid,
+                         version, pg.info.last_update, m.reqid)
+        pg.append_log(txn, entry)
+        txn_bytes = txn.to_bytes()
+        # local apply first (the primary is always shard 0 of the data)
+        self.osd.store.apply_transaction(txn)
+        peers = {o for o in pg.acting
+                 if o != self.osd.whoami and o != CRUSH_ITEM_NONE}
+        tid = self.osd.next_tid()
+        fut = self._ack_init(tid, peers)
+        for p in peers:
+            self.osd.send_osd(p, MOSDRepOp(
+                pg.pgid, tid, txn_bytes, entry.to_bytes(), version,
+                self.osd.osdmap.epoch))
+        if not await self._await_acks(fut):
+            self._inflight.pop(tid, None)
+            return -errno.EAGAIN   # interval change in flight: client resends
+        return 0
+
+    async def do_reads(self, m: MOSDOp) -> int:
+        pg = self.pg
+        soid = pg.object_id(m.oid)
+        result = 0
+        for op in m.ops:
+            if op.op == OP_PGLS:
+                self._do_pgls(op)
+            else:
+                rv = execute_read_op(self.osd.store, pg.cid, soid, op)
+                if rv < 0 and result == 0:
+                    result = rv
+        return result
+
+    def _do_pgls(self, op: OSDOp) -> None:
+        names = [o.name for o in
+                 self.osd.store.collection_list(self.pg.cid)
+                 if o.name != self.pg.meta_oid.name]
+        op.outdata = b"\x00".join(n.encode() for n in names)
+        op.rval = len(names)
+
+    async def handle_sub_message(self, m) -> None:
+        pg = self.pg
+        if isinstance(m, MOSDRepOp):
+            txn = Transaction.from_bytes(m.txn_bytes)
+            entry = LogEntry.from_bytes(m.log_bytes)
+            if pg.log.head < entry.version:
+                pg.log.append(entry)
+                pg.note_reqid(entry)
+                pg.info.last_update = entry.version
+                pg.info.last_complete = entry.version
+            pg.save_meta(txn)
+            self.osd.store.apply_transaction(txn)
+            self.osd.send_osd(int(m.src_name.id), MOSDRepOpReply(
+                pg.pgid, m.tid, 0, True, self.osd.whoami))
+
+
+# ================================================================= erasure
+
+class ECBackend(PGBackend):
+    """Erasure-coded strategy (osd/ECBackend.cc) with one-shot TPU encode.
+
+    Append-only like the reference at this version (ECBackend.cc:1418):
+    supported object writes are full-object replace, create, delete and
+    xattrs; partial overwrites and omap return -EOPNOTSUPP
+    (ReplicatedPG rejects omap on EC pools too)."""
+
+    def __init__(self, pg):
+        super().__init__(pg)
+        from ceph_tpu.ec.registry import factory
+        profile = dict(
+            self.osd.osdmap.ec_profiles.get(pg.pool.ec_profile, {}))
+        profile.setdefault("k", str(max(1, pg.pool.size - 2)))
+        profile.setdefault("m", str(pg.pool.size
+                                    - int(profile.get("k"))))
+        # Inline per-op encodes use the vectorized HOST GF kernel: object
+        # sizes vary per op, and paying an XLA compile + device dispatch
+        # per 4KiB-class op stalls the event loop (SURVEY §7 hard part —
+        # "a 4KiB-chunk op can't pay a dispatch each").  The TPU kernel
+        # serves the batched paths (bench.py, batch collector) where one
+        # dispatch covers many fixed-shape stripes.
+        profile.setdefault("backend", "host")
+        plugin = profile.pop("plugin", "rs")
+        self.codec = factory(plugin, profile)
+        self.k = self.codec.get_data_chunk_count()
+        self.n = self.codec.get_chunk_count()
+
+    @property
+    def my_shard(self) -> int:
+        return self.pg.pgid.shard
+
+    # ------------------------------------------------------------- writes
+    async def submit_client_write(self, m: MOSDOp) -> int:
+        pg = self.pg
+        soid = pg.object_id(m.oid)
+        for op in m.ops:
+            if not op.is_write():
+                rv = await self._read_op(m.oid, op)
+                if rv < 0:
+                    return rv
+        writes = [op for op in m.ops if op.is_write()]
+        unsupported = {OP_WRITE, OP_APPEND, OP_ZERO, OP_OMAP_SET,
+                       OP_OMAP_RM_KEYS, OP_OMAP_SET_HEADER}
+        if any(op.op in unsupported for op in writes):
+            return -errno.EOPNOTSUPP
+        version = pg.next_version()
+        deletes = any(op.op == OP_DELETE for op in writes)
+        # one txn PER SHARD, addressed at that shard's own collection
+        # (each shard osd stores under <pool>.<seed>s<shard>_head);
+        # full-object data is encoded in one TPU shot
+        from ceph_tpu.store.types import CollectionId
+        cids = {i: CollectionId.pg(pg.pool_id, pg.pgid.seed, i)
+                for i in range(self.n)}
+        shard_txns: Dict[int, Transaction] = {
+            i: Transaction() for i in range(self.n)}
+        for op in writes:
+            if op.op == OP_WRITEFULL:
+                chunks = self.codec.encode(set(range(self.n)), op.data)
+                for i in range(self.n):
+                    t = shard_txns[i]
+                    t.truncate(cids[i], soid, 0)
+                    t.write(cids[i], soid, 0, chunks[i].tobytes())
+                    t.setattr(cids[i], soid, SIZE_XATTR,
+                              str(len(op.data)).encode())
+            elif op.op == OP_CREATE:
+                for i, t in shard_txns.items():
+                    t.touch(cids[i], soid)
+                    t.setattr(cids[i], soid, SIZE_XATTR, b"0")
+            elif op.op == OP_DELETE:
+                for i, t in shard_txns.items():
+                    t.remove(cids[i], soid)
+            elif op.op == OP_TRUNCATE and op.offset == 0:
+                for i, t in shard_txns.items():
+                    t.truncate(cids[i], soid, 0)
+                    t.setattr(cids[i], soid, SIZE_XATTR, b"0")
+            elif op.op in (OP_SETXATTR,):
+                for i, t in shard_txns.items():
+                    t.setattr(cids[i], soid, op.name, op.data)
+            elif op.op in (OP_RMXATTR,):
+                for i, t in shard_txns.items():
+                    t.rmattr(cids[i], soid, op.name)
+            else:
+                return -errno.EOPNOTSUPP
+        entry = LogEntry(LOG_DELETE if deletes else LOG_MODIFY, m.oid,
+                         version, pg.info.last_update, m.reqid)
+        entry_bytes = entry.to_bytes()
+        # local shard applies directly
+        my = self.my_shard
+        local_txn = shard_txns.get(my, Transaction())
+        pg.append_log(local_txn, entry)
+        self.osd.store.apply_transaction(local_txn)
+        # fan out to the other shards
+        tid = self.osd.next_tid()
+        peers = set()
+        sends = []
+        for i, osd_id in enumerate(pg.acting):
+            if i == my or osd_id == CRUSH_ITEM_NONE:
+                continue
+            peers.add(osd_id)
+            sends.append((osd_id, MOSDECSubOpWrite(
+                pg.pgid.with_shard(i), tid, shard_txns[i].to_bytes(),
+                entry_bytes, version, self.osd.osdmap.epoch)))
+        fut = self._ack_init(tid, peers)
+        for osd_id, msg in sends:
+            self.osd.send_osd(osd_id, msg)
+        if not await self._await_acks(fut):
+            self._inflight.pop(tid, None)
+            return -errno.EAGAIN
+        return 0
+
+    # -------------------------------------------------------------- reads
+    async def do_reads(self, m: MOSDOp) -> int:
+        result = 0
+        for op in m.ops:
+            if op.op == OP_PGLS:
+                names = [o.name for o in
+                         self.osd.store.collection_list(self.pg.cid)
+                         if o.name != self.pg.meta_oid.name]
+                op.outdata = b"\x00".join(n.encode() for n in names)
+                op.rval = len(names)
+                continue
+            rv = await self._read_op(m.oid, op)
+            if rv < 0 and result == 0:
+                result = rv
+        return result
+
+    async def _read_op(self, oid: str, op: OSDOp) -> int:
+        pg = self.pg
+        soid = pg.object_id(oid)
+        if op.op in (OP_GETXATTR, OP_GETXATTRS, OP_STAT):
+            # xattrs are replicated on every shard; size is in SIZE_XATTR
+            if op.op == OP_STAT:
+                try:
+                    op.outdata = self.osd.store.getattr(pg.cid, soid,
+                                                        SIZE_XATTR)
+                    op.rval = 0
+                except (NoSuchObject, NoSuchCollection):
+                    op.rval = -errno.ENOENT
+                return op.rval
+            return execute_read_op(self.osd.store, pg.cid, soid, op)
+        if op.op != OP_READ:
+            op.rval = -errno.EOPNOTSUPP
+            return op.rval
+        try:
+            size = int(self.osd.store.getattr(pg.cid, soid, SIZE_XATTR))
+        except (NoSuchObject, NoSuchCollection):
+            op.rval = -errno.ENOENT
+            return op.rval
+        whole = await self._read_object(oid, size)
+        if whole is None:
+            op.rval = -errno.EIO
+            return op.rval
+        length = op.length if op.length else size - op.offset
+        op.outdata = whole[op.offset:op.offset + length]
+        op.rval = len(op.outdata)
+        return op.rval
+
+    def _stale_shards(self, oid: str) -> Set[int]:
+        """Acting positions whose osd still misses this object (recovery
+        window): their on-disk chunk predates the object's version and
+        must not feed a decode."""
+        pg = self.pg
+        out = set()
+        for i, osd_id in enumerate(pg.acting):
+            pm = pg.peer_missing.get(osd_id)
+            if pm is not None and oid in pm:
+                out.add(i)
+        return out
+
+    async def _gather_shards(self, oid: str,
+                             exclude: Set[int] = frozenset()
+                             ) -> Optional[Tuple[Dict[int, np.ndarray],
+                                                 Dict[str, bytes]]]:
+        """Collect >=k shard streams (minimum_to_decode role): local read
+        for our shard, sub-op reads for the rest.  Returns (streams,
+        attrs-from-any-shard) or None."""
+        pg = self.pg
+        soid = pg.object_id(oid)
+        streams: Dict[int, np.ndarray] = {}
+        attrs: Dict[str, bytes] = {}
+        exclude = set(exclude) | self._stale_shards(oid)
+        my = self.my_shard
+        candidates: List[int] = []
+        for i, osd_id in enumerate(pg.acting):
+            if osd_id == CRUSH_ITEM_NONE or i in exclude:
+                continue
+            if i == my:
+                try:
+                    streams[i] = np.frombuffer(
+                        self.osd.store.read(pg.cid, soid), np.uint8)
+                    attrs = self.osd.store.getattrs(pg.cid, soid)
+                except (NoSuchObject, NoSuchCollection):
+                    pass
+            else:
+                candidates.append(i)
+        need = self.k - len(streams)
+        for i in candidates:
+            if need <= 0:
+                break
+            osd_id = pg.acting[i]
+            tid = self.osd.next_tid()
+            fut = asyncio.get_running_loop().create_future()
+            self._inflight[tid] = ({osd_id}, fut)
+            self.osd.send_osd(osd_id, MOSDECSubOpRead(
+                pg.pgid.with_shard(i), tid, [(oid, 0, -1)]))
+            try:
+                reply: MOSDECSubOpReadReply = \
+                    await asyncio.wait_for(fut, 15.0)
+            except asyncio.TimeoutError:
+                self._inflight.pop(tid, None)
+                continue
+            if reply.result == 0 and reply.data:
+                streams[i] = np.frombuffer(reply.data[0], np.uint8)
+                if reply.attrs:
+                    attrs = reply.attrs
+                need -= 1
+        if len(streams) < self.k:
+            return None
+        return streams, attrs
+
+    async def _read_object(self, oid: str, size: int) -> Optional[bytes]:
+        got = await self._gather_shards(oid)
+        if got is None:
+            return None
+        streams, _ = got
+        from ceph_tpu.ec.interface import ErasureCodeError
+        try:
+            data = self.codec.decode_concat(streams)
+        except (ErasureCodeError, ValueError):
+            # ValueError: mixed-generation chunk lengths — undecodable
+            return None
+        return data[:size]
+
+    # ----------------------------------------------------------- recovery
+    async def recover_object(self, peer: int, oid: str) -> None:
+        """Rebuild the peer's shard from k others and push it
+        (continue_recovery_op / minimum_to_decode role)."""
+        pg = self.pg
+        target = pg.shard_of(peer)
+        soid = pg.object_id(oid)
+        # object deleted? push tombstone
+        try:
+            attrs = self.osd.store.getattrs(pg.cid, soid)
+        except (NoSuchObject, NoSuchCollection):
+            await self._push_and_wait(peer, oid)   # pushes deleted=True
+            return
+        got = await self._gather_shards(oid, exclude={target})
+        if got is None:
+            raise RuntimeError(f"{pg.pgid}: cannot reconstruct {oid} "
+                               f"for shard {target}: insufficient shards")
+        streams, _ = got
+        rebuilt = self.codec.decode({target}, streams)[target]
+        fut = asyncio.get_running_loop().create_future()
+        pg._push_acks[(peer, oid)] = fut
+        try:
+            self.osd.send_osd(peer, MPGPush(
+                pg.pgid.with_shard(target), oid, pg.info.last_update,
+                rebuilt.tobytes(), attrs, {}, b"", self.osd.whoami))
+            await asyncio.wait_for(fut, 20.0)
+        finally:
+            pg._push_acks.pop((peer, oid), None)
+
+    async def pull_object(self, peer: int, oid: str, epoch: int) -> None:
+        """Primary self-heal: reconstruct OUR OWN shard from k peers.
+        A whole-object pull would install the peer's (foreign) shard
+        bytes as ours and silently corrupt every later decode."""
+        pg = self.pg
+        my = self.my_shard
+        soid = pg.object_id(oid)
+        got = await self._gather_shards(oid, exclude={my})
+        if got is None:
+            # peers have no data: the object was deleted
+            self.osd.store.apply_transaction(
+                Transaction().remove(pg.cid, soid))
+            return
+        streams, attrs = got
+        rebuilt = self.codec.decode({my}, streams)[my]
+        txn = Transaction()
+        txn.remove(pg.cid, soid)
+        txn.write(pg.cid, soid, 0, rebuilt.tobytes())
+        if attrs:
+            txn.setattrs(pg.cid, soid, attrs)
+        pg.save_meta(txn)
+        self.osd.store.apply_transaction(txn)
+
+    # ------------------------------------------------------------ sub-ops
+    async def handle_sub_message(self, m) -> None:
+        pg = self.pg
+        if isinstance(m, MOSDECSubOpWrite):
+            txn = Transaction.from_bytes(m.txn_bytes)
+            entry = LogEntry.from_bytes(m.log_bytes)
+            if pg.log.head < entry.version:
+                pg.log.append(entry)
+                pg.note_reqid(entry)
+                pg.info.last_update = entry.version
+                pg.info.last_complete = entry.version
+            pg.save_meta(txn)
+            self.osd.store.apply_transaction(txn)
+            self.osd.send_osd(int(m.src_name.id), MOSDECSubOpWriteReply(
+                pg.pgid, m.tid, 0, self.my_shard, self.osd.whoami))
+        elif isinstance(m, MOSDECSubOpRead):
+            data, attrs = [], {}
+            result = 0
+            for oid, off, ln in m.reads:
+                soid = pg.object_id(oid)
+                try:
+                    data.append(self.osd.store.read(
+                        pg.cid, soid, off, ln if ln >= 0 else -1))
+                    attrs = self.osd.store.getattrs(pg.cid, soid)
+                except (NoSuchObject, NoSuchCollection):
+                    result = -errno.ENOENT
+                    data.append(b"")
+            self.osd.send_osd(int(m.src_name.id), MOSDECSubOpReadReply(
+                pg.pgid, m.tid, self.my_shard, result, data, attrs))

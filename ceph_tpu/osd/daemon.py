@@ -1,0 +1,298 @@
+"""OSD daemon: boot, maps, heartbeats, op routing.
+
+Reference parity: osd/OSD.{h,cc} — boot handshake with the mon
+(MOSDBoot), osdmap subscription + per-PG advance
+(handle_osd_map/advance_pg), fast dispatch of client ops to PG queues
+(ms_fast_dispatch :6003 → enqueue_op :8598 → ShardedOpWQ :8790 — here
+each PG's asyncio worker), osd↔osd heartbeats (:4223 heartbeat,
+:4009 handle_osd_ping) with failure reports to the mon
+(mon/OSDMonitor.cc prepare_failure).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import time
+from typing import Dict, List, Optional
+
+from ceph_tpu.msg.message import Message
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
+from ceph_tpu.msg.types import EntityAddr, EntityName
+from ceph_tpu.mon.client import MonClient
+from ceph_tpu.mon.messages import MOSDAlive, MOSDBoot, MOSDFailure
+from ceph_tpu.mon.monmap import MonMap
+from ceph_tpu.osd.messages import (
+    MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
+    MOSDECSubOpWriteReply, MOSDOp, MOSDOpReply, MOSDPing, MOSDRepOp,
+    MOSDRepOpReply, MPGLog, MPGLogRequest, MPGNotify, MPGPush,
+    MPGPushReply, MPGQuery,
+)
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.pg import PG
+from ceph_tpu.osd.types import NO_SHARD, PGId
+from ceph_tpu.crush.constants import CRUSH_ITEM_NONE
+from ceph_tpu.store.objectstore import ObjectStore
+
+
+class OSD(Dispatcher):
+    def __init__(self, ctx, whoami: int, store: ObjectStore,
+                 messenger: Messenger, monmap: MonMap):
+        self.ctx = ctx
+        self.cfg = ctx.config
+        self.logger = ctx.logger("osd")
+        self.whoami = whoami
+        self.store = store
+        self.messenger = messenger
+        messenger.add_dispatcher(self)
+        self.monc = MonClient(ctx, messenger, monmap)
+        self.osdmap = OSDMap()
+        self.pgs: Dict[PGId, PG] = {}
+        self._tid = 0
+        self._hb_last: Dict[int, float] = {}     # peer osd -> last reply
+        self._hb_task: Optional[asyncio.Task] = None
+        self._waiting_maps: List[Message] = []
+        self.running = False
+
+    def next_tid(self) -> int:
+        self._tid += 1
+        return self._tid
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> None:
+        self.store.mount()
+        if self.messenger.addr.is_blank():
+            await self.messenger.bind()
+        self.monc.on_osdmap(self._on_osdmap)
+        self.monc.sub_want("osdmap", 0)
+        self.monc.messenger.send_message(
+            MOSDBoot(self.whoami, self.messenger.addr),
+            self.monc.monmap.addr_of_rank(0), peer_type="mon")
+        self.running = True
+        self._hb_task = asyncio.get_running_loop().create_task(
+            self._heartbeat())
+        self.logger.info(f"osd.{self.whoami} starting at "
+                         f"{self.messenger.addr}")
+
+    async def wait_for_boot(self, timeout: float = 30.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while not (self.osdmap.epoch and self.osdmap.is_up(self.whoami)):
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(f"osd.{self.whoami} failed to boot")
+            await asyncio.sleep(0.05)
+
+    async def shutdown(self) -> None:
+        self.running = False
+        if self._hb_task:
+            self._hb_task.cancel()
+        for pg in self.pgs.values():
+            pg.stop()
+        await self.messenger.shutdown()
+        self.store.umount()
+
+    # ----------------------------------------------------------------- maps
+    def _on_osdmap(self, osdmap: OSDMap) -> None:
+        self.osdmap = osdmap
+        if (self.running and osdmap.exists(self.whoami)
+                and not osdmap.is_up(self.whoami)):
+            # falsely marked down (missed heartbeats during a stall):
+            # re-assert ourselves (OSD.cc "map says i am down" re-boot)
+            self.logger.warning(f"osd.{self.whoami} marked down in "
+                                f"e{osdmap.epoch} but alive; re-booting")
+            self.monc.messenger.send_message(
+                MOSDBoot(self.whoami, self.messenger.addr),
+                self.monc.monmap.addr_of_rank(self.monc.cur_mon),
+                peer_type="mon")
+        self._advance_pgs()
+        waiting, self._waiting_maps = self._waiting_maps, []
+        for m in waiting:
+            self.ms_dispatch(m)
+
+    def _advance_pgs(self) -> None:
+        """Instantiate/advance PGs this osd hosts (handle_osd_map role)."""
+        m = self.osdmap
+        wanted: Dict[PGId, int] = {}
+        for pool_id, pool in m.pools.items():
+            for ps in range(pool.pg_num):
+                pgid = PGId(pool_id, ps)
+                up, upp, acting, actp = m.pg_to_up_acting_osds(pgid)
+                if self.whoami in acting or self.whoami in up:
+                    shard = (acting.index(self.whoami)
+                             if pool.is_erasure()
+                             and self.whoami in acting else NO_SHARD)
+                    wanted[pgid.with_shard(shard)
+                           if shard != NO_SHARD else pgid] = pool_id
+        # drop PGs we no longer host (or whose EC shard moved); on-store
+        # data stays — a returning mapping reloads it and peering heals
+        for pgid in [p for p in self.pgs if p not in wanted]:
+            self.pgs.pop(pgid).stop()
+        for pgid, pool_id in wanted.items():
+            pg = self.pgs.get(pgid)
+            if pg is None:
+                pg = PG(self, pgid, pool_id, m.pools[pool_id])
+                pg.create_onstore()
+                pg.load_meta()
+                self.pgs[pgid] = pg
+                pg.start()
+            pg.pool = m.pools[pool_id]
+            pg.advance_map(m)
+
+    def note_pg_active(self, pg: PG) -> None:
+        """Primary finished peering: assert up_thru (MOSDAlive), once per
+        epoch (the reference batches this the same way)."""
+        if getattr(self, "_alive_epoch", 0) >= self.osdmap.epoch:
+            return
+        self._alive_epoch = self.osdmap.epoch
+        self.messenger.send_message(
+            MOSDAlive(self.whoami, self.osdmap.epoch),
+            self.monc.monmap.addr_of_rank(self.monc.cur_mon),
+            peer_type="mon")
+
+    # ------------------------------------------------------------- plumbing
+    def send_osd(self, osd_id: int, msg: Message) -> None:
+        addr = self.osdmap.get_addr(osd_id)
+        if addr is None:
+            self.logger.warning(f"no address for osd.{osd_id}; dropping "
+                                f"{type(msg).__name__}")
+            return
+        self.messenger.send_message(msg, addr, peer_type="osd")
+
+    def reply_to(self, req: Message, msg: Message) -> None:
+        peer_type = req.src_name.type if req.src_name else None
+        self.messenger.send_message(msg, req.src_addr, peer_type=peer_type)
+
+    def _pg_for(self, pgid: PGId) -> Optional[PG]:
+        pg = self.pgs.get(pgid)
+        if pg is None and pgid.shard != NO_SHARD:
+            pg = self.pgs.get(pgid.without_shard())
+        if pg is None:
+            # shard-agnostic lookup (EC peers address us by shard)
+            for p, inst in self.pgs.items():
+                if p.without_shard() == pgid.without_shard():
+                    return inst
+        return pg
+
+    # ------------------------------------------------------------- dispatch
+    def ms_dispatch(self, m: Message) -> bool:
+        if isinstance(m, MOSDOp):
+            self._handle_client_op(m)
+            return True
+        if isinstance(m, (MOSDRepOp, MOSDECSubOpWrite, MOSDECSubOpRead)):
+            pg = self._pg_for(m.pgid)
+            if pg is None:
+                self._waiting_maps.append(m)
+                return True
+            pg.queue_op(m)
+            return True
+        if isinstance(m, (MOSDRepOpReply, MOSDECSubOpWriteReply,
+                          MOSDECSubOpReadReply)):
+            # acks resolve futures the PG worker awaits: handle inline,
+            # never through the op queue the worker is blocked on
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.backend.handle_reply(m)
+            return True
+        if isinstance(m, MPGQuery):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_query(m)
+            else:
+                # we host nothing for this pg (yet): answer with an empty
+                # info rather than stalling the querier's peering — our
+                # own map advance will instantiate the PG if we belong
+                from ceph_tpu.osd.pglog import PGInfo
+                self.send_osd(m.from_osd, MPGNotify(
+                    m.pgid, m.epoch, PGInfo(m.pgid).to_bytes(),
+                    self.whoami))
+            return True
+        if isinstance(m, MPGNotify):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_notify(m)
+            return True
+        if isinstance(m, MPGLogRequest):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_log_request(m)
+            return True
+        if isinstance(m, MPGLog):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_pg_log(m)
+            else:
+                self._waiting_maps.append(m)
+            return True
+        if isinstance(m, MPGPush):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_push(m)
+            return True
+        if isinstance(m, MPGPushReply):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_push_reply(m)
+            return True
+        if isinstance(m, MOSDPing):
+            self._handle_ping(m)
+            return True
+        return False
+
+    def _handle_client_op(self, m: MOSDOp) -> None:
+        pg = self._pg_for(m.pgid)
+        if pg is None:
+            self.reply_to(m, MOSDOpReply(
+                m.tid, -errno.EAGAIN, map_epoch=self.osdmap.epoch))
+            return
+        pg.queue_op(m)
+
+    # ----------------------------------------------------------- heartbeats
+    def _hb_peers(self) -> List[int]:
+        peers = set()
+        for pg in self.pgs.values():
+            for o in pg.acting + pg.up:
+                if o != self.whoami and o != CRUSH_ITEM_NONE \
+                        and self.osdmap.is_up(o):
+                    peers.add(o)
+        return sorted(peers)
+
+    async def _heartbeat(self) -> None:
+        interval = self.cfg["osd_heartbeat_interval"]
+        grace = self.cfg["osd_heartbeat_grace"]
+        while self.running:
+            await asyncio.sleep(interval)
+            try:
+                now = time.monotonic()
+                peers = self._hb_peers()
+                stale = [p for p in peers
+                         if now - self._hb_last.get(p, now) > grace]
+                if peers and len(stale) > max(1, len(peers) // 2):
+                    # more than half the cluster "failed" at once: almost
+                    # certainly OUR event loop stalled, not them — reset
+                    # stamps instead of mass-reporting (clock-skew guard
+                    # role of the reference's heartbeat checks)
+                    for p in stale:
+                        self._hb_last[p] = now
+                for p in peers:
+                    self._hb_last.setdefault(p, now)
+                    self.send_osd(p, MOSDPing(
+                        MOSDPing.PING, self.whoami, self.osdmap.epoch, now))
+                    if now - self._hb_last[p] > grace:
+                        self.logger.warning(
+                            f"osd.{p} missed heartbeats for "
+                            f"{now - self._hb_last[p]:.1f}s; reporting")
+                        self.messenger.send_message(
+                            MOSDFailure(p, True, self.osdmap.epoch,
+                                        now - self._hb_last[p]),
+                            self.monc.monmap.addr_of_rank(self.monc.cur_mon),
+                            peer_type="mon")
+                        self._hb_last[p] = now  # rate-limit re-reports
+            except Exception:
+                self.logger.exception("heartbeat tick failed")
+
+    def _handle_ping(self, m: MOSDPing) -> None:
+        if m.op == MOSDPing.PING:
+            self.send_osd(m.from_osd, MOSDPing(
+                MOSDPing.PING_REPLY, self.whoami, self.osdmap.epoch,
+                m.stamp))
+        else:
+            self._hb_last[m.from_osd] = time.monotonic()

@@ -1,0 +1,525 @@
+"""OSD data-plane and peering messages.
+
+Reference parity: messages/MOSDOp.h, MOSDOpReply.h, MOSDRepOp{,Reply}.h,
+MOSDECSubOpWrite/Read{,Reply}.h, MOSDPing.h, MOSDPGQuery/Notify/Log/
+Info/Trim.h, MOSDPGPush/Pull.h.  Op payloads are op-code vectors like
+the reference's vector<OSDOp> (osd/osd_types.h OSDOp).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.common.encoding import Decoder, Encodable, Encoder
+from ceph_tpu.msg.message import Message, PRIO_HIGH, register_message
+from ceph_tpu.osd.types import ObjectLocator, PGId
+
+# client/op codes (include/rados.h CEPH_OSD_OP_*; subset the framework
+# implements — the interpreter is ReplicatedPG::do_osd_ops :4317)
+OP_READ = 1
+OP_STAT = 2
+OP_WRITE = 10
+OP_WRITEFULL = 11
+OP_APPEND = 12
+OP_TRUNCATE = 13
+OP_ZERO = 14
+OP_DELETE = 15
+OP_CREATE = 16
+OP_GETXATTR = 20
+OP_SETXATTR = 21
+OP_RMXATTR = 22
+OP_GETXATTRS = 23
+OP_OMAP_GET_VALS = 30
+OP_OMAP_SET = 31
+OP_OMAP_RM_KEYS = 32
+OP_OMAP_GET_HEADER = 33
+OP_OMAP_SET_HEADER = 34
+OP_PGLS = 40          # list objects in pg (rados ls)
+
+WRITE_OPS = {OP_WRITE, OP_WRITEFULL, OP_APPEND, OP_TRUNCATE, OP_ZERO,
+             OP_DELETE, OP_CREATE, OP_SETXATTR, OP_RMXATTR, OP_OMAP_SET,
+             OP_OMAP_RM_KEYS, OP_OMAP_SET_HEADER}
+
+
+class OSDOp(Encodable):
+    """One sub-op of a client request (osd_types.h OSDOp)."""
+
+    __slots__ = ("op", "offset", "length", "name", "data", "kv", "keys",
+                 "rval", "outdata")
+
+    def __init__(self, op: int, offset: int = 0, length: int = 0,
+                 name: str = "", data: bytes = b"",
+                 kv: Optional[Dict[bytes, bytes]] = None,
+                 keys: Optional[List[bytes]] = None):
+        self.op = op
+        self.offset = offset
+        self.length = length
+        self.name = name            # xattr name
+        self.data = data
+        self.kv = kv or {}
+        self.keys = keys or []
+        # result fields (filled by execution, encoded in replies)
+        self.rval = 0
+        self.outdata = b""
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u16(self.op).u64(self.offset).u64(self.length)
+        enc.string(self.name).bytes_(self.data)
+        enc.map_(self.kv, lambda e, k: e.bytes_(k), lambda e, v: e.bytes_(v))
+        enc.list_(self.keys, lambda e, k: e.bytes_(k))
+        enc.s32(self.rval).bytes_(self.outdata)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "OSDOp":
+        o = cls(dec.u16(), dec.u64(), dec.u64(), dec.string(), dec.bytes_(),
+                dec.map_(lambda d: d.bytes_(), lambda d: d.bytes_()),
+                dec.list_(lambda d: d.bytes_()))
+        o.rval = dec.s32()
+        o.outdata = dec.bytes_()
+        return o
+
+    def is_write(self) -> bool:
+        return self.op in WRITE_OPS
+
+
+class EVersion(Encodable):
+    """eversion_t: (epoch, version) — total order on pg log entries."""
+
+    __slots__ = ("epoch", "version")
+
+    def __init__(self, epoch: int = 0, version: int = 0):
+        self.epoch = epoch
+        self.version = version
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u32(self.epoch).u64(self.version)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "EVersion":
+        return cls(dec.u32(), dec.u64())
+
+    def key(self):
+        return (self.epoch, self.version)
+
+    def __lt__(self, other):
+        return self.key() < other.key()
+
+    def __le__(self, other):
+        return self.key() <= other.key()
+
+    def __eq__(self, other):
+        return isinstance(other, EVersion) and self.key() == other.key()
+
+    def __hash__(self):
+        return hash(self.key())
+
+    def __repr__(self):
+        return f"{self.epoch}'{self.version}"
+
+    @classmethod
+    def zero(cls):
+        return cls(0, 0)
+
+
+@register_message
+class MOSDOp(Message):
+    """Client -> primary OSD op (messages/MOSDOp.h)."""
+    TYPE = 200
+
+    def __init__(self, pgid: Optional[PGId] = None, oid: str = "",
+                 loc: Optional[ObjectLocator] = None,
+                 ops: Optional[List[OSDOp]] = None, tid: int = 0,
+                 map_epoch: int = 0, reqid: str = ""):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.oid = oid
+        self.loc = loc or ObjectLocator(0)
+        self.ops = ops or []
+        self.tid = tid
+        self.map_epoch = map_epoch
+        self.reqid = reqid      # osd_reqid_t: client-unique, resend-stable
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).string(self.oid).struct(self.loc)
+        enc.list_(self.ops, lambda e, o: e.struct(o))
+        enc.u64(self.tid).u32(self.map_epoch).string(self.reqid)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDOp":
+        return cls(dec.struct(PGId), dec.string(), dec.struct(ObjectLocator),
+                   dec.list_(lambda d: d.struct(OSDOp)), dec.u64(),
+                   dec.u32(), dec.string())
+
+
+@register_message
+class MOSDOpReply(Message):
+    TYPE = 201
+
+    def __init__(self, tid: int = 0, result: int = 0,
+                 ops: Optional[List[OSDOp]] = None, map_epoch: int = 0):
+        super().__init__()
+        self.tid = tid
+        self.result = result
+        self.ops = ops or []        # carry back per-op rval/outdata
+        self.map_epoch = map_epoch
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid).s32(self.result)
+        enc.list_(self.ops, lambda e, o: e.struct(o))
+        enc.u32(self.map_epoch)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDOpReply":
+        return cls(dec.u64(), dec.s32(),
+                   dec.list_(lambda d: d.struct(OSDOp)), dec.u32())
+
+
+@register_message
+class MOSDRepOp(Message):
+    """Primary -> replica transaction (messages/MOSDRepOp.h): the encoded
+    ObjectStore transaction + pg log entries to append."""
+    TYPE = 202
+    PRIORITY = PRIO_HIGH
+
+    def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
+                 txn_bytes: bytes = b"", log_bytes: bytes = b"",
+                 version: Optional[EVersion] = None, map_epoch: int = 0):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.tid = tid
+        self.txn_bytes = txn_bytes
+        self.log_bytes = log_bytes
+        self.version = version or EVersion()
+        self.map_epoch = map_epoch
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).u64(self.tid)
+        enc.bytes_(self.txn_bytes).bytes_(self.log_bytes)
+        enc.struct(self.version).u32(self.map_epoch)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDRepOp":
+        return cls(dec.struct(PGId), dec.u64(), dec.bytes_(), dec.bytes_(),
+                   dec.struct(EVersion), dec.u32())
+
+
+@register_message
+class MOSDRepOpReply(Message):
+    TYPE = 203
+    PRIORITY = PRIO_HIGH
+
+    def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
+                 result: int = 0, committed: bool = True,
+                 from_osd: int = -1):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.tid = tid
+        self.result = result
+        self.committed = committed
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).u64(self.tid).s32(self.result)
+        enc.boolean(self.committed).s32(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDRepOpReply":
+        return cls(dec.struct(PGId), dec.u64(), dec.s32(), dec.boolean(),
+                   dec.s32())
+
+
+@register_message
+class MOSDECSubOpWrite(Message):
+    """Primary -> EC shard write (messages/MOSDECSubOpWrite.h): the
+    per-shard transaction produced after the TPU encode."""
+    TYPE = 204
+    PRIORITY = PRIO_HIGH
+
+    def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
+                 txn_bytes: bytes = b"", log_bytes: bytes = b"",
+                 version: Optional[EVersion] = None, map_epoch: int = 0):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)   # includes target shard
+        self.tid = tid
+        self.txn_bytes = txn_bytes
+        self.log_bytes = log_bytes
+        self.version = version or EVersion()
+        self.map_epoch = map_epoch
+
+    encode_payload = MOSDRepOp.encode_payload
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int):
+        return cls(dec.struct(PGId), dec.u64(), dec.bytes_(), dec.bytes_(),
+                   dec.struct(EVersion), dec.u32())
+
+
+@register_message
+class MOSDECSubOpWriteReply(Message):
+    TYPE = 205
+    PRIORITY = PRIO_HIGH
+
+    def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
+                 result: int = 0, from_shard: int = -1, from_osd: int = -1):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.tid = tid
+        self.result = result
+        self.from_shard = from_shard
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).u64(self.tid).s32(self.result)
+        enc.s32(self.from_shard).s32(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int):
+        return cls(dec.struct(PGId), dec.u64(), dec.s32(), dec.s32(),
+                   dec.s32())
+
+
+@register_message
+class MOSDECSubOpRead(Message):
+    """Primary -> shard chunk read: (oid, off, len) list."""
+    TYPE = 206
+    PRIORITY = PRIO_HIGH
+
+    def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
+                 reads: Optional[List[Tuple[str, int, int]]] = None):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.tid = tid
+        self.reads = reads or []
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).u64(self.tid)
+        enc.list_(self.reads, lambda e, r: (e.string(r[0]), e.u64(r[1]),
+                                            e.s64(r[2])))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int):
+        return cls(dec.struct(PGId), dec.u64(),
+                   dec.list_(lambda d: (d.string(), d.u64(), d.s64())))
+
+
+@register_message
+class MOSDECSubOpReadReply(Message):
+    TYPE = 207
+    PRIORITY = PRIO_HIGH
+
+    def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
+                 from_shard: int = -1, result: int = 0,
+                 data: Optional[List[bytes]] = None,
+                 attrs: Optional[Dict[str, bytes]] = None):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.tid = tid
+        self.from_shard = from_shard
+        self.result = result
+        self.data = data or []
+        self.attrs = attrs or {}
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).u64(self.tid).s32(self.from_shard)
+        enc.s32(self.result)
+        enc.list_(self.data, lambda e, b: e.bytes_(b))
+        enc.map_(self.attrs, lambda e, k: e.string(k),
+                 lambda e, v: e.bytes_(v))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int):
+        return cls(dec.struct(PGId), dec.u64(), dec.s32(), dec.s32(),
+                   dec.list_(lambda d: d.bytes_()),
+                   dec.map_(lambda d: d.string(), lambda d: d.bytes_()))
+
+
+# ------------------------------------------------------------- heartbeats
+
+@register_message
+class MOSDPing(Message):
+    """osd <-> osd liveness (messages/MOSDPing.h)."""
+    TYPE = 208
+    PRIORITY = PRIO_HIGH
+
+    PING, PING_REPLY = 1, 2
+
+    def __init__(self, op: int = PING, from_osd: int = -1,
+                 map_epoch: int = 0, stamp: float = 0.0):
+        super().__init__()
+        self.op = op
+        self.from_osd = from_osd
+        self.map_epoch = map_epoch
+        self.stamp = stamp
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u8(self.op).s32(self.from_osd).u32(self.map_epoch)
+        enc.f64(self.stamp)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDPing":
+        return cls(dec.u8(), dec.s32(), dec.u32(), dec.f64())
+
+
+# ---------------------------------------------------------------- peering
+
+@register_message
+class MPGQuery(Message):
+    """Primary asks a peer for its pg_info (MOSDPGQuery)."""
+    TYPE = 210
+    PRIORITY = PRIO_HIGH
+
+    def __init__(self, pgid: Optional[PGId] = None, epoch: int = 0,
+                 from_osd: int = -1):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.epoch = epoch
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).u32(self.epoch).s32(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGQuery":
+        return cls(dec.struct(PGId), dec.u32(), dec.s32())
+
+
+@register_message
+class MPGNotify(Message):
+    """Peer replies with (or proactively sends) its pg_info bytes."""
+    TYPE = 211
+    PRIORITY = PRIO_HIGH
+
+    def __init__(self, pgid: Optional[PGId] = None, epoch: int = 0,
+                 info_bytes: bytes = b"", from_osd: int = -1):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.epoch = epoch
+        self.info_bytes = info_bytes
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).u32(self.epoch).bytes_(self.info_bytes)
+        enc.s32(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGNotify":
+        return cls(dec.struct(PGId), dec.u32(), dec.bytes_(), dec.s32())
+
+
+@register_message
+class MPGLogRequest(Message):
+    """Primary asks peer for log entries since a version (MOSDPGLog ask);
+    with want_object set it is instead a whole-object pull request
+    (MOSDPGPull role)."""
+    TYPE = 212
+    PRIORITY = PRIO_HIGH
+
+    def __init__(self, pgid: Optional[PGId] = None, epoch: int = 0,
+                 since: Optional[EVersion] = None, from_osd: int = -1,
+                 want_object: str = ""):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.epoch = epoch
+        self.since = since or EVersion()
+        self.from_osd = from_osd
+        self.want_object = want_object
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).u32(self.epoch).struct(self.since)
+        enc.s32(self.from_osd).string(self.want_object)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGLogRequest":
+        return cls(dec.struct(PGId), dec.u32(), dec.struct(EVersion),
+                   dec.s32(), dec.string())
+
+
+@register_message
+class MPGLog(Message):
+    """Log (+info) shipped to a peer (MOSDPGLog): activation / catch-up."""
+    TYPE = 213
+    PRIORITY = PRIO_HIGH
+
+    def __init__(self, pgid: Optional[PGId] = None, epoch: int = 0,
+                 info_bytes: bytes = b"", log_bytes: bytes = b"",
+                 from_osd: int = -1, activate: bool = False):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.epoch = epoch
+        self.info_bytes = info_bytes
+        self.log_bytes = log_bytes
+        self.from_osd = from_osd
+        self.activate = activate
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).u32(self.epoch).bytes_(self.info_bytes)
+        enc.bytes_(self.log_bytes).s32(self.from_osd)
+        enc.boolean(self.activate)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGLog":
+        return cls(dec.struct(PGId), dec.u32(), dec.bytes_(), dec.bytes_(),
+                   dec.s32(), dec.boolean())
+
+
+# --------------------------------------------------------------- recovery
+
+@register_message
+class MPGPush(Message):
+    """Recovery push: full object state to a peer (MOSDPGPush distilled:
+    whole-object pushes, no partial chunks)."""
+    TYPE = 214
+
+    def __init__(self, pgid: Optional[PGId] = None, oid: str = "",
+                 version: Optional[EVersion] = None, data: bytes = b"",
+                 attrs: Optional[Dict[str, bytes]] = None,
+                 omap: Optional[Dict[bytes, bytes]] = None,
+                 omap_header: bytes = b"", from_osd: int = -1,
+                 deleted: bool = False):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.oid = oid
+        self.version = version or EVersion()
+        self.data = data
+        self.attrs = attrs or {}
+        self.omap = omap or {}
+        self.omap_header = omap_header
+        self.from_osd = from_osd
+        self.deleted = deleted
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).string(self.oid).struct(self.version)
+        enc.bytes_(self.data)
+        enc.map_(self.attrs, lambda e, k: e.string(k),
+                 lambda e, v: e.bytes_(v))
+        enc.map_(self.omap, lambda e, k: e.bytes_(k),
+                 lambda e, v: e.bytes_(v))
+        enc.bytes_(self.omap_header).s32(self.from_osd)
+        enc.boolean(self.deleted)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGPush":
+        return cls(dec.struct(PGId), dec.string(), dec.struct(EVersion),
+                   dec.bytes_(),
+                   dec.map_(lambda d: d.string(), lambda d: d.bytes_()),
+                   dec.map_(lambda d: d.bytes_(), lambda d: d.bytes_()),
+                   dec.bytes_(), dec.s32(), dec.boolean())
+
+
+@register_message
+class MPGPushReply(Message):
+    TYPE = 215
+
+    def __init__(self, pgid: Optional[PGId] = None, oid: str = "",
+                 from_osd: int = -1):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.oid = oid
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).string(self.oid).s32(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGPushReply":
+        return cls(dec.struct(PGId), dec.string(), dec.s32())

@@ -1,0 +1,459 @@
+"""PG: placement-group peering state machine + op execution.
+
+Reference parity: osd/PG.{h,cc} (peering statechart PG.h:1604-2019 —
+here an explicit async procedure: GetInfo → GetLog → recover-self →
+activate peers → Active), osd/ReplicatedPG.cc (do_request/do_op/
+execute_ctx op interpreter :1575,1716,3036,4317), with the strategy
+split behind PGBackend (osd/PGBackend.h) in backend.py.
+
+Redesign notes (vs the boost::statechart original):
+- Peering queries the CURRENT up∪acting peers for infos and adopts the
+  best (highest last_update, ties by longer log) as authoritative; the
+  primary first heals itself (log merge + whole-object pulls), then
+  ships logs and pushes missing objects to peers.  The reference's
+  past-interval walk (PriorSet) is collapsed into this: correctness
+  holds whenever some member of the last active interval is reachable,
+  which min_size-gated writes guarantee.
+- Divergent local entries are rewound (PGLog.rewind_to) and the objects
+  re-pulled from the authoritative peer — the reference's
+  rewind_divergent_log.
+- Writes to an object still missing on some replica trigger
+  recover-before-write, like the reference's is_missing_object wait.
+- Per-PG ordering comes from one asyncio worker per PG consuming an op
+  queue — the ShardedOpWQ role (osd/OSD.h:1748); batching across PGs
+  for the TPU happens in the EC backend.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+from typing import Dict, List, Optional, Set, Tuple
+
+from ceph_tpu.crush.constants import CRUSH_ITEM_NONE
+from ceph_tpu.osd.messages import (
+    EVersion, MOSDOp, MOSDOpReply, MPGLog, MPGLogRequest, MPGNotify,
+    MPGPush, MPGPushReply, MPGQuery,
+)
+from ceph_tpu.osd.pglog import LogEntry, MissingSet, PGInfo, PGLog
+from ceph_tpu.osd.types import NO_SHARD, PGId, PGPool
+from ceph_tpu.store.objectstore import Transaction
+from ceph_tpu.store.types import CollectionId, ObjectId
+
+STATE_RESET = "reset"
+STATE_PEERING = "peering"
+STATE_ACTIVE = "active"
+
+
+class PG:
+    def __init__(self, osd, pgid: PGId, pool_id: int, pool: PGPool):
+        self.osd = osd
+        self.log_ = osd.logger
+        self.pgid = pgid                    # includes our shard for EC
+        self.pool_id = pool_id
+        self.pool = pool
+        self.cid = CollectionId.pg(pool_id, pgid.seed, pgid.shard)
+        self.meta_oid = ObjectId("_pgmeta_", pool=pool_id)
+        self.info = PGInfo(pgid)
+        self.log = PGLog()
+        self.reqids: Dict[str, EVersion] = {}   # dup-write detection
+        self.missing = MissingSet()
+        self.peer_info: Dict[int, PGInfo] = {}
+        self.peer_missing: Dict[int, MissingSet] = {}
+        # current mapping
+        self.up: List[int] = []
+        self.acting: List[int] = []
+        self.primary = -1
+        self.role = -1                      # index in acting, -1 = stray
+        self.state = STATE_RESET
+        self.interval_epoch = 0
+        self._active_event = asyncio.Event()
+        self._peering_task: Optional[asyncio.Task] = None
+        self._op_queue: asyncio.Queue = asyncio.Queue()
+        self._worker_task: Optional[asyncio.Task] = None
+        # request/reply matching for peering + recovery
+        self._notify_waiters: Dict[int, asyncio.Future] = {}
+        self._log_waiters: Dict[int, asyncio.Future] = {}
+        self._pull_waiters: Dict[str, asyncio.Future] = {}
+        self._push_acks: Dict[Tuple[int, str], asyncio.Future] = {}
+        from ceph_tpu.osd.backend import ECBackend, ReplicatedBackend
+        self.backend = (ECBackend(self) if pool.is_erasure()
+                        else ReplicatedBackend(self))
+
+    # ----------------------------------------------------------- utilities
+    def is_primary(self) -> bool:
+        return self.osd.whoami == self.primary
+
+    def actual_peers(self) -> List[int]:
+        """Live members of up∪acting besides ourselves."""
+        peers = []
+        for o in set(self.up) | set(self.acting):
+            if o != self.osd.whoami and o >= 0 and o != CRUSH_ITEM_NONE \
+                    and self.osd.osdmap.is_up(o):
+                peers.append(o)
+        return sorted(peers)
+
+    def shard_of(self, osd_id: int) -> int:
+        """Acting position of osd_id (EC shard); NO_SHARD for replicated."""
+        if not self.pool.is_erasure():
+            return NO_SHARD
+        for i, o in enumerate(self.acting):
+            if o == osd_id:
+                return i
+        return NO_SHARD
+
+    def describe(self) -> str:
+        return (f"pg {self.pgid} {self.state} role {self.role} "
+                f"up {self.up} acting {self.acting} "
+                f"lu {self.info.last_update}")
+
+    # --------------------------------------------------------- persistence
+    def save_meta(self, txn: Transaction) -> None:
+        txn.touch(self.cid, self.meta_oid)
+        txn.omap_setkeys(self.cid, self.meta_oid, {
+            b"info": self.info.to_bytes(),
+            b"log": self.log.to_bytes(),
+        })
+
+    def load_meta(self) -> None:
+        try:
+            _, omap = self.osd.store.omap_get(self.cid, self.meta_oid)
+        except Exception:
+            return
+        if b"info" in omap:
+            self.info = PGInfo.from_bytes(omap[b"info"])
+        if b"log" in omap:
+            self.log = PGLog.from_bytes(omap[b"log"])
+            self.reqids = self.log.reqids()
+
+    def create_onstore(self) -> None:
+        if not self.osd.store.collection_exists(self.cid):
+            txn = Transaction().create_collection(self.cid)
+            self.save_meta(txn)
+            self.osd.store.apply_transaction(txn)
+
+    # ------------------------------------------------------------ mapping
+    def start(self) -> None:
+        if self._worker_task is None:
+            self._worker_task = asyncio.get_running_loop().create_task(
+                self._worker())
+
+    def advance_map(self, osdmap) -> None:
+        """New osdmap: recompute role; new interval restarts peering
+        (PG::handle_advance_map)."""
+        up, up_primary, acting, acting_primary = \
+            osdmap.pg_to_up_acting_osds(self.pgid.without_shard())
+        interval_changed = (acting != self.acting or up != self.up
+                            or acting_primary != self.primary)
+        self.up, self.acting, self.primary = up, acting, acting_primary
+        me = self.osd.whoami
+        self.role = self.acting.index(me) if me in self.acting else -1
+        if interval_changed:
+            self.info.same_interval_since = osdmap.epoch
+            self.interval_epoch = osdmap.epoch
+            self.state = STATE_PEERING
+            self._active_event.clear()
+            if self._peering_task is not None:
+                self._peering_task.cancel()
+                self._peering_task = None
+            if self.is_primary():
+                self._peering_task = \
+                    asyncio.get_running_loop().create_task(self._peer())
+            # non-primaries wait for the primary's MPGLog(activate)
+
+    def stop(self) -> None:
+        for t in (self._peering_task, self._worker_task):
+            if t is not None:
+                t.cancel()
+        self._peering_task = self._worker_task = None
+
+    # ------------------------------------------------------------- peering
+    async def _peer(self) -> None:
+        epoch = self.interval_epoch
+        try:
+            await self._peer_inner(epoch)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.log_.exception(f"peering failed for {self.pgid}; retrying")
+            await asyncio.sleep(1.0)
+            if epoch == self.interval_epoch:
+                self._peering_task = asyncio.get_running_loop().create_task(
+                    self._peer())
+
+    async def _peer_inner(self, epoch: int) -> None:
+        # GetInfo: query every live peer of this interval
+        self.peer_info.clear()
+        self.peer_missing.clear()
+        peers = self.actual_peers()
+        self.log_.debug(f"{self.pgid} peering e{epoch}: peers {peers}")
+        infos: Dict[int, PGInfo] = {}
+        if peers:
+            futs = {}
+            for p in peers:
+                fut = asyncio.get_running_loop().create_future()
+                self._notify_waiters[p] = fut
+                futs[p] = fut
+                self.osd.send_osd(p, MPGQuery(
+                    self.pgid.with_shard(self.shard_of(p)), epoch,
+                    self.osd.whoami))
+            for p, fut in futs.items():
+                try:
+                    infos[p] = await asyncio.wait_for(fut, 10.0)
+                except asyncio.TimeoutError:
+                    self.log_.warning(f"{self.pgid}: no info from osd.{p}")
+                finally:
+                    self._notify_waiters.pop(p, None)
+        self.peer_info = infos
+
+        # GetLog: adopt the best log (PG::choose_acting/GetLog)
+        best_osd, best_info = self.osd.whoami, self.info
+        for p, pi in infos.items():
+            if (pi.last_update, pi.last_epoch_started) > \
+                    (best_info.last_update, best_info.last_epoch_started):
+                best_osd, best_info = p, pi
+        if best_osd != self.osd.whoami \
+                and best_info.last_update != self.info.last_update:
+            await self._catch_up_from(best_osd, best_info, epoch)
+
+        # compute peer missing + activate peers
+        await self._activate(epoch)
+
+    async def _catch_up_from(self, peer: int, pinfo: PGInfo,
+                             epoch: int) -> None:
+        """Merge the authoritative log; rewind divergence; pull objects."""
+        fut = asyncio.get_running_loop().create_future()
+        self._log_waiters[peer] = fut
+        since = self.info.last_update
+        self.osd.send_osd(peer, MPGLogRequest(
+            self.pgid.with_shard(self.shard_of(peer)), epoch, since,
+            self.osd.whoami))
+        try:
+            info_b, log_b = await asyncio.wait_for(fut, 15.0)
+        finally:
+            self._log_waiters.pop(peer, None)
+        auth_info = PGInfo.from_bytes(info_b)
+        auth_log = PGLog.from_bytes(log_b)
+        # divergent local branch? (we have entries the auth log lacks)
+        if auth_info.last_update < self.info.last_update:
+            for e in self.log.rewind_to(auth_info.last_update):
+                self.missing.add(e.oid, EVersion.zero())
+        added = self.log.merge_from(auth_log, self.info.last_update)
+        for e in added:
+            self.missing.add(e.oid, e.version)
+        self.reqids = self.log.reqids()
+        self.info.last_update = self.log.head
+        # heal every missing object: deletions apply directly, the rest
+        # are pulled (replicated: whole-object push from the auth peer;
+        # EC: reconstruct OUR shard from k peers — a foreign shard's
+        # bytes must never be installed as ours)
+        for oid in list(self.missing.items):
+            latest = self.log.latest_entry_for(oid)
+            if latest is not None and latest.is_delete():
+                t = Transaction().remove(self.cid, self.object_id(oid))
+                self.osd.store.apply_transaction(t)
+            else:
+                await self.backend.pull_object(peer, oid, epoch)
+        self.missing = MissingSet()
+        self.info.last_complete = self.info.last_update
+        txn = Transaction()
+        self.save_meta(txn)
+        self.osd.store.apply_transaction(txn)
+
+    async def pull_object_via_push(self, peer: int, oid: str,
+                                   epoch: int) -> None:
+        """Whole-object pull: ask peer to push its copy (replicated)."""
+        fut = asyncio.get_running_loop().create_future()
+        self._pull_waiters[oid] = fut
+        self.osd.send_osd(peer, MPGLogRequest(
+            self.pgid.with_shard(self.shard_of(peer)), epoch,
+            EVersion.zero(), self.osd.whoami, want_object=oid))
+        try:
+            await asyncio.wait_for(fut, 15.0)
+        finally:
+            self._pull_waiters.pop(oid, None)
+
+    async def _activate(self, epoch: int) -> None:
+        """Ship logs to peers, compute their missing sets, go active."""
+        me = self.osd.whoami
+        for p, pi in self.peer_info.items():
+            if p not in self.acting and p not in self.up:
+                continue
+            pm = MissingSet()
+            if not pi.is_empty() and \
+                    self.log.can_catch_up_from(pi.last_update):
+                for oid, e in self.log.objects_since(pi.last_update).items():
+                    if not e.is_delete():
+                        pm.add(oid, e.version)
+            else:
+                # too far behind: full resync (Backfill role)
+                for soid in self.osd.store.collection_list(self.cid):
+                    if soid.name != self.meta_oid.name:
+                        pm.add(soid.name, self.info.last_update)
+            self.peer_missing[p] = pm
+            self.osd.send_osd(p, MPGLog(
+                self.pgid.with_shard(self.shard_of(p)), epoch,
+                self.info.to_bytes(), self.log.to_bytes(), me,
+                activate=True))
+        if epoch != self.interval_epoch:
+            return   # superseded meanwhile
+        self.info.last_epoch_started = epoch
+        self.state = STATE_ACTIVE
+        self._active_event.set()
+        txn = Transaction()
+        self.save_meta(txn)
+        self.osd.store.apply_transaction(txn)
+        self.osd.note_pg_active(self)
+        self.log_.info(f"{self.describe()} (activated "
+                       f"{len(self.peer_info)} peers)")
+        # background recovery of peer missing objects
+        if any(self.peer_missing.values()):
+            asyncio.get_running_loop().create_task(self._recover(epoch))
+
+    async def _recover(self, epoch: int) -> None:
+        """Push missing objects to peers (ReplicatedPG recovery WQ /
+        ECBackend::continue_recovery_op role)."""
+        try:
+            for p, pm in list(self.peer_missing.items()):
+                for oid in list(pm.items):
+                    if epoch != self.interval_epoch:
+                        return
+                    await self.backend.recover_object(p, oid)
+                    pm.items.pop(oid, None)
+            self.log_.debug(f"{self.pgid} recovery complete")
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.log_.exception(f"{self.pgid} recovery failed")
+
+    async def _recover_object_everywhere(self, oid: str) -> None:
+        # snapshot: re-peering may mutate peer_missing across the awaits
+        for p, pm in list(self.peer_missing.items()):
+            if oid in pm:
+                await self.backend.recover_object(p, oid)
+                pm.items.pop(oid, None)
+
+    # --------------------------------------------- peering message handlers
+    def on_query(self, m: MPGQuery) -> None:
+        self.osd.send_osd(m.from_osd, MPGNotify(
+            m.pgid, m.epoch, self.info.to_bytes(), self.osd.whoami))
+
+    def on_notify(self, m: MPGNotify) -> None:
+        fut = self._notify_waiters.get(m.from_osd)
+        if fut is not None and not fut.done():
+            fut.set_result(PGInfo.from_bytes(m.info_bytes))
+
+    def on_log_request(self, m: MPGLogRequest) -> None:
+        if m.want_object:
+            self.backend.push_object(m.from_osd, m.want_object,
+                                     self.info.last_update)
+            return
+        self.osd.send_osd(m.from_osd, MPGLog(
+            m.pgid, m.epoch, self.info.to_bytes(), self.log.to_bytes(),
+            self.osd.whoami, activate=False))
+
+    def on_pg_log(self, m: MPGLog) -> None:
+        if m.activate:
+            # primary activated us: adopt info/log (replica path)
+            self.info = PGInfo.from_bytes(m.info_bytes)
+            self.info.pgid = self.pgid
+            self.log = PGLog.from_bytes(m.log_bytes)
+            self.reqids = self.log.reqids()
+            self.state = STATE_ACTIVE
+            self._active_event.set()
+            txn = Transaction()
+            self.save_meta(txn)
+            self.osd.store.apply_transaction(txn)
+            self.log_.debug(f"{self.pgid} activated by osd.{m.from_osd}")
+        else:
+            fut = self._log_waiters.get(m.from_osd)
+            if fut is not None and not fut.done():
+                fut.set_result((m.info_bytes, m.log_bytes))
+
+    def on_push(self, m: MPGPush) -> None:
+        self.backend.apply_push(m)
+        self.osd.send_osd(m.from_osd, MPGPushReply(
+            m.pgid, m.oid, self.osd.whoami))
+        fut = self._pull_waiters.get(m.oid)
+        if fut is not None and not fut.done():
+            fut.set_result(True)
+
+    def on_push_reply(self, m: MPGPushReply) -> None:
+        fut = self._push_acks.get((m.from_osd, m.oid))
+        if fut is not None and not fut.done():
+            fut.set_result(True)
+
+    # ------------------------------------------------------------- op path
+    def queue_op(self, m) -> None:
+        self._op_queue.put_nowait(m)
+
+    async def _worker(self) -> None:
+        while True:
+            m = await self._op_queue.get()
+            try:
+                if isinstance(m, MOSDOp):
+                    await self._do_client_op(m)
+                else:
+                    await self.backend.handle_sub_message(m)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.log_.exception(f"{self.pgid} op failed: {m}")
+
+    async def _do_client_op(self, m: MOSDOp) -> None:
+        """ReplicatedPG::do_op/execute_ctx distilled."""
+        if not self.is_primary():
+            # stale client mapping: tell it to refresh + resend
+            self.osd.reply_to(m, MOSDOpReply(
+                m.tid, -errno.EAGAIN, map_epoch=self.osd.osdmap.epoch))
+            return
+        if self.state != STATE_ACTIVE:
+            try:
+                await asyncio.wait_for(self._active_event.wait(), 30.0)
+            except asyncio.TimeoutError:
+                self.osd.reply_to(m, MOSDOpReply(
+                    m.tid, -errno.EAGAIN, map_epoch=self.osd.osdmap.epoch))
+                return
+        has_write = any(o.is_write() for o in m.ops)
+        if has_write and len(
+                [o for o in self.acting if o != CRUSH_ITEM_NONE]) \
+                < self.pool.min_size:
+            self.osd.reply_to(m, MOSDOpReply(
+                m.tid, -errno.EAGAIN, map_epoch=self.osd.osdmap.epoch))
+            return
+        if has_write and m.reqid and m.reqid in self.reqids:
+            # duplicate of an already-applied write (client resend after a
+            # map change / lost reply): ack success without re-executing
+            self.osd.reply_to(m, MOSDOpReply(
+                m.tid, 0, m.ops, self.osd.osdmap.epoch))
+            return
+        if has_write:
+            # recover-before-write: peers must have the current object
+            # before a mutation lands on top of it
+            await self._recover_object_everywhere(m.oid)
+            result = await self.backend.submit_client_write(m)
+        else:
+            result = await self.backend.do_reads(m)
+        self.osd.reply_to(m, MOSDOpReply(
+            m.tid, result, m.ops, self.osd.osdmap.epoch))
+
+    # ---------------------------------------------------- version plumbing
+    def next_version(self) -> EVersion:
+        return EVersion(self.osd.osdmap.epoch,
+                        self.info.last_update.version + 1)
+
+    def append_log(self, txn: Transaction, entry: LogEntry) -> None:
+        self.log.append(entry)
+        self.note_reqid(entry)
+        self.info.last_update = entry.version
+        self.info.last_complete = entry.version
+        self.save_meta(txn)
+
+    def note_reqid(self, entry: LogEntry) -> None:
+        if entry.reqid:
+            self.reqids[entry.reqid] = entry.version
+            if len(self.reqids) > 2 * PGLog.MAX_ENTRIES:
+                self.reqids = self.log.reqids()   # rebound to the log
+
+    def object_id(self, oid: str) -> ObjectId:
+        return ObjectId(oid, pool=self.pool_id)

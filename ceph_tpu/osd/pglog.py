@@ -1,0 +1,196 @@
+"""PG log + info: per-PG op history for divergence detection and catch-up.
+
+Reference parity: osd/PGLog.h (log entries bounding log-based recovery vs
+backfill), osd/osd_types.h pg_info_t / pg_log_entry_t.  Redesign note:
+recovery here pushes whole objects (MPGPush), so the missing set is
+{oid -> need version}; the reference's byte-granular pulls and have
+versions collapse into that.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.common.encoding import Decoder, Encodable, Encoder
+from ceph_tpu.osd.messages import EVersion
+from ceph_tpu.osd.types import PGId
+
+LOG_MODIFY = 1
+LOG_DELETE = 2
+
+
+class LogEntry(Encodable):
+    """Includes the client reqid (osd_reqid_t role) so a re-sent write is
+    recognized as already-applied instead of executed twice."""
+
+    __slots__ = ("op", "oid", "version", "prior_version", "reqid")
+
+    def __init__(self, op: int = LOG_MODIFY, oid: str = "",
+                 version: Optional[EVersion] = None,
+                 prior_version: Optional[EVersion] = None,
+                 reqid: str = ""):
+        self.op = op
+        self.oid = oid
+        self.version = version or EVersion()
+        self.prior_version = prior_version or EVersion()
+        self.reqid = reqid
+
+    def is_delete(self) -> bool:
+        return self.op == LOG_DELETE
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u8(self.op).string(self.oid)
+        enc.struct(self.version).struct(self.prior_version)
+        enc.string(self.reqid)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "LogEntry":
+        return cls(dec.u8(), dec.string(), dec.struct(EVersion),
+                   dec.struct(EVersion), dec.string())
+
+    def __repr__(self):
+        return (f"{'del' if self.is_delete() else 'mod'} "
+                f"{self.oid}@{self.version}")
+
+
+class PGInfo(Encodable):
+    """pg_info_t distilled: identity + log bounds + interval history."""
+
+    __slots__ = ("pgid", "last_update", "last_complete", "log_tail",
+                 "last_epoch_started", "same_interval_since")
+
+    def __init__(self, pgid: Optional[PGId] = None):
+        self.pgid = pgid or PGId(0, 0)
+        self.last_update = EVersion()      # newest log entry
+        self.last_complete = EVersion()    # everything <= this is local
+        self.log_tail = EVersion()         # oldest log entry we hold
+        self.last_epoch_started = 0        # last epoch the pg went active
+        self.same_interval_since = 0       # epoch the acting set last changed
+
+    def is_empty(self) -> bool:
+        return self.last_update == EVersion.zero()
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).struct(self.last_update)
+        enc.struct(self.last_complete).struct(self.log_tail)
+        enc.u32(self.last_epoch_started).u32(self.same_interval_since)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "PGInfo":
+        i = cls(dec.struct(PGId))
+        i.last_update = dec.struct(EVersion)
+        i.last_complete = dec.struct(EVersion)
+        i.log_tail = dec.struct(EVersion)
+        i.last_epoch_started = dec.u32()
+        i.same_interval_since = dec.u32()
+        return i
+
+    def __repr__(self):
+        return (f"PGInfo({self.pgid} lu={self.last_update} "
+                f"les={self.last_epoch_started} "
+                f"sis={self.same_interval_since})")
+
+
+class PGLog(Encodable):
+    """Bounded in-order entry list (osd/PGLog.h)."""
+
+    MAX_ENTRIES = 3000    # osd_max_pg_log_entries flavor
+
+    def __init__(self):
+        self.entries: List[LogEntry] = []
+        self.tail = EVersion()    # version before the first entry
+
+    @property
+    def head(self) -> EVersion:
+        return self.entries[-1].version if self.entries else self.tail
+
+    def append(self, e: LogEntry) -> None:
+        assert self.head < e.version, (self.head, e.version)
+        self.entries.append(e)
+        if len(self.entries) > self.MAX_ENTRIES:
+            drop = len(self.entries) - self.MAX_ENTRIES
+            self.tail = self.entries[drop - 1].version
+            del self.entries[:drop]
+
+    def entries_since(self, v: EVersion) -> List[LogEntry]:
+        """Entries with version > v; requires v >= tail (else caller must
+        backfill)."""
+        return [e for e in self.entries if v < e.version]
+
+    def can_catch_up_from(self, v: EVersion) -> bool:
+        return self.tail <= v
+
+    def objects_since(self, v: EVersion) -> Dict[str, LogEntry]:
+        """Newest entry per object touched after v."""
+        out: Dict[str, LogEntry] = {}
+        for e in self.entries_since(v):
+            out[e.oid] = e
+        return out
+
+    def latest_entry_for(self, oid: str) -> Optional[LogEntry]:
+        for e in reversed(self.entries):
+            if e.oid == oid:
+                return e
+        return None
+
+    def reqids(self) -> Dict[str, EVersion]:
+        """reqid -> version for duplicate-op detection (PGLog dup index)."""
+        return {e.reqid: e.version for e in self.entries if e.reqid}
+
+    def merge_from(self, other: "PGLog", since: EVersion) -> List[LogEntry]:
+        """Append other's entries newer than ``since`` (== our head when
+        catching up); returns the appended entries."""
+        added = []
+        for e in other.entries:
+            if self.head < e.version and since < e.version:
+                self.append(e)
+                added.append(e)
+        return added
+
+    def rewind_to(self, v: EVersion) -> List[LogEntry]:
+        """Drop entries newer than v (divergent branch after an
+        authoritative log chose a shorter history); returns the dropped
+        entries, newest first — their objects need recovery."""
+        dropped = []
+        while self.entries and v < self.entries[-1].version:
+            dropped.append(self.entries.pop())
+        return dropped
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.tail)
+        enc.list_(self.entries, lambda e, x: e.struct(x))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "PGLog":
+        log = cls()
+        log.tail = dec.struct(EVersion)
+        log.entries = dec.list_(lambda d: d.struct(LogEntry))
+        return log
+
+
+class MissingSet:
+    """oid -> version needed (pg_missing_t distilled to whole-object
+    granularity; see module docstring)."""
+
+    def __init__(self):
+        self.items: Dict[str, EVersion] = {}
+
+    def add(self, oid: str, need: EVersion) -> None:
+        self.items[oid] = need
+
+    def rm(self, oid: str, at: EVersion) -> None:
+        cur = self.items.get(oid)
+        if cur is not None and cur <= at:
+            del self.items[oid]
+
+    def __contains__(self, oid: str) -> bool:
+        return oid in self.items
+
+    def __len__(self):
+        return len(self.items)
+
+    def __bool__(self):
+        return bool(self.items)
+
+    def __repr__(self):
+        return f"Missing({self.items})"
