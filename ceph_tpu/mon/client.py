@@ -104,15 +104,29 @@ class MonClient(Dispatcher):
     async def wait_for_osdmap(self, timeout: float = 30.0) -> OSDMap:
         if self.osdmap is not None:
             return self.osdmap
-        if "osdmap" not in self._subs:
-            self.sub_want("osdmap", 0)
+        self._subs.setdefault("osdmap", 0)
         ev = asyncio.Event()
         self._osdmap_waiters.append(ev)
+        deadline = asyncio.get_running_loop().time() + timeout
+        rank = self.cur_mon
         try:
-            await asyncio.wait_for(ev.wait(), timeout)
+            while True:
+                # client->mon links are lossy: re-send the subscription
+                # while hunting across mons until one answers (MonClient
+                # hunting role) — a single send can race the mon's boot
+                self._renew_subs(rank)
+                remain = deadline - asyncio.get_running_loop().time()
+                try:
+                    await asyncio.wait_for(ev.wait(),
+                                           max(0.0, min(1.0, remain)))
+                    self.cur_mon = rank
+                    return self.osdmap
+                except asyncio.TimeoutError:
+                    if asyncio.get_running_loop().time() >= deadline:
+                        raise
+                    rank = (rank + 1) % self.monmap.size()
         finally:
             self._osdmap_waiters.remove(ev)
-        return self.osdmap
 
     # ------------------------------------------------------------ commands
     async def command(self, cmd: dict, inbl: bytes = b"",

@@ -1,1 +1,10 @@
+"""Operator CLIs (reference: src/ceph.in, src/tools/).
 
+- daemons:      ceph-mon / ceph-osd process mains
+- vstart:       dev-cluster launcher (vstart.sh / ceph-helpers.sh)
+- ceph:         mon command CLI
+- rados:        object I/O + bench (obj_bencher)
+- crushtool:    build/inspect/test crush maps
+- osdmaptool:   --test-map-pgs bulk placement harness
+- ec_benchmark: ceph_erasure_code_benchmark contract
+"""

@@ -1,0 +1,99 @@
+"""ceph CLI: mon command front-end.
+
+Reference parity: src/ceph.in (python CLI driving mon commands,
+ceph.in:98-145).  Commands map 1:1 onto the monitor's command table:
+
+    python -m ceph_tpu.tools.ceph --dir DIR status
+    ... osd dump | osd tree | osd stat | osd pool ls | quorum_status
+    ... osd pool create <name> [pg_num] [--type erasure --k 4 --m 2]
+    ... osd pool delete <name>
+    ... osd out|in|down <id>
+    ... osd getmap [epoch] --out FILE
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+from ceph_tpu.tools.daemons import load_monmap
+
+
+async def run(args, extra) -> int:
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.common.context import Context
+    ctx = Context("client.admin")
+    monmap = load_monmap(args.dir)
+    r = Rados(ctx, monmap)
+    await r.connect()
+    try:
+        cmd = build_command(args, extra)
+        ack = await r.mon_command(cmd, timeout=args.timeout)
+        if args.out and ack.outbl:
+            with open(args.out, "wb") as f:
+                f.write(ack.outbl)
+            print(f"wrote {len(ack.outbl)} bytes to {args.out}")
+        if ack.outs:
+            print(ack.outs)
+        return 0
+    finally:
+        await r.shutdown()
+
+
+def build_command(args, extra) -> dict:
+    words = args.command
+    cmd = {"prefix": " ".join(words)}
+    if words[0] in ("status", "health", "quorum_status", "mon"):
+        return cmd
+    if words[0] == "osd" and len(words) > 1:
+        if words[1] == "pool" and len(words) > 3:
+            cmd = {"prefix": f"osd pool {words[2]}", "pool": words[3]}
+            if len(words) > 4 and words[4].isdigit():
+                cmd["pg_num"] = int(words[4])
+            if args.type:
+                cmd["pool_type"] = args.type
+            if args.k:
+                cmd["k"] = args.k
+            if args.m:
+                cmd["m"] = args.m
+            if args.size:
+                cmd["size"] = args.size
+        elif words[1] in ("out", "in", "down") and len(words) > 2:
+            cmd = {"prefix": f"osd {words[1]}", "id": int(words[2])}
+        elif words[1] == "getmap":
+            cmd = {"prefix": "osd getmap"}
+            if len(words) > 2:
+                cmd["epoch"] = int(words[2])
+        elif words[1] == "setmaxosd" and len(words) > 2:
+            cmd = {"prefix": "osd setmaxosd", "num": int(words[2])}
+        elif words[1] == "crush" and len(words) > 3 \
+                and words[2] == "build-simple":
+            cmd = {"prefix": "osd crush build-simple",
+                   "num_osds": int(words[3]),
+                   "osds_per_host": int(words[4]) if len(words) > 4 else 1}
+        else:
+            cmd = {"prefix": " ".join(words)}
+    for kv in extra:
+        k, _, v = kv.partition("=")
+        cmd[k.lstrip("-")] = v
+    return cmd
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ceph")
+    ap.add_argument("--dir", default="./vcluster", help="cluster dir")
+    ap.add_argument("--out", default="", help="write outbl to file")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--type", default="", help="pool type for create")
+    ap.add_argument("--k", type=int, default=0)
+    ap.add_argument("--m", type=int, default=0)
+    ap.add_argument("--size", type=int, default=0)
+    ap.add_argument("command", nargs="+")
+    args, extra = ap.parse_known_args(argv)
+    return asyncio.run(run(args, extra))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,131 @@
+"""crushtool: build / inspect / test crush maps offline.
+
+Reference parity: src/tools/crushtool.cc (--build/--test/-d) and
+src/crush/CrushTester.h (mapping distribution + timing).
+
+    python -m ceph_tpu.tools.crushtool --build N [--osds-per-host H] -o F
+    python -m ceph_tpu.tools.crushtool -d F
+    python -m ceph_tpu.tools.crushtool --test F --num-rep 3 \
+        [--min-x 0 --max-x 1023] [--rule 0] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from collections import Counter
+
+from ceph_tpu.crush.builder import (build_hierarchy, make_erasure_rule,
+                                    make_replicated_rule)
+from ceph_tpu.crush.mapper import do_rule
+from ceph_tpu.crush.types import CrushMap
+
+
+def cmd_build(args) -> int:
+    m = CrushMap()
+    m.max_devices = args.build
+    build_hierarchy(m, args.build, args.osds_per_host)
+    make_replicated_rule(m, "replicated_rule")
+    make_erasure_rule(m, "erasure_rule", size=args.ec_size)
+    data = m.to_bytes()
+    with open(args.output, "wb") as f:
+        f.write(data)
+    print(f"built crush map: {args.build} osds, "
+          f"{args.osds_per_host}/host, {len(data)} bytes -> {args.output}")
+    return 0
+
+
+def cmd_decompile(args) -> int:
+    with open(args.decompile, "rb") as f:
+        m = CrushMap.from_bytes(f.read())
+    print(f"# devices: {m.max_devices}")
+    print(f"# tunables: {vars(m.tunables)}")
+    for b in m.buckets:
+        if b is None:
+            continue
+        t = m.type_map.get(b.type, str(b.type))
+        print(f"bucket {m.name_of(b.id)} id {b.id} type {t} alg {b.alg} "
+              f"weight {b.weight / 0x10000:.3f}")
+        for it, w in zip(b.items, b.item_weights):
+            print(f"    item {m.name_of(it)} weight {w / 0x10000:.3f}")
+    for rid, r in enumerate(m.rules):
+        if r is None:
+            continue
+        name = m.rule_name_map.get(rid, f"rule{rid}")
+        print(f"rule {name} id {rid} ruleset {r.ruleset} type {r.type} "
+              f"size [{r.min_size},{r.max_size}] steps {len(r.steps)}")
+    return 0
+
+
+def cmd_test(args) -> int:
+    with open(args.test, "rb") as f:
+        m = CrushMap.from_bytes(f.read())
+    weights = [0x10000] * m.max_devices
+    ruleno = args.rule
+    n = args.max_x - args.min_x + 1
+    per_osd = Counter()
+    sizes = Counter()
+    t0 = time.perf_counter()
+    for x in range(args.min_x, args.max_x + 1):
+        out = do_rule(m, ruleno, x, args.num_rep, weights)
+        sizes[len(out)] += 1
+        for o in out:
+            per_osd[o] += 1
+    dt = time.perf_counter() - t0
+    expected = n * args.num_rep / max(1, m.max_devices)
+    report = {
+        "inputs": n,
+        "num_rep": args.num_rep,
+        "rule": ruleno,
+        "result_size_histogram": dict(sizes),
+        "mappings_per_sec": round(n / dt, 1),
+        "seconds": round(dt, 4),
+        "device_utilization": {
+            "expected_per_osd": round(expected, 1),
+            "min": min(per_osd.values()) if per_osd else 0,
+            "max": max(per_osd.values()) if per_osd else 0,
+        },
+    }
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(f"rule {ruleno}, x = {args.min_x}..{args.max_x}, "
+              f"numrep {args.num_rep}")
+        for sz, cnt in sorted(sizes.items()):
+            print(f"rule {ruleno} num_rep {args.num_rep} "
+                  f"result size == {sz}:\t{cnt}/{n}")
+        print(f"timing: {dt:.4f}s ({n / dt:.0f} mappings/s)")
+        print(f"device utilization: expected {expected:.1f} "
+              f"min {report['device_utilization']['min']} "
+              f"max {report['device_utilization']['max']}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="crushtool")
+    ap.add_argument("--build", type=int, help="build simple map: N osds")
+    ap.add_argument("--osds-per-host", type=int, default=1)
+    ap.add_argument("--ec-size", type=int, default=6)
+    ap.add_argument("-o", "--output", default="crushmap.bin")
+    ap.add_argument("-d", "--decompile", help="print a map")
+    ap.add_argument("--test", help="map inputs through a rule")
+    ap.add_argument("--rule", type=int, default=0)
+    ap.add_argument("--num-rep", type=int, default=3)
+    ap.add_argument("--min-x", type=int, default=0)
+    ap.add_argument("--max-x", type=int, default=1023)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    if args.build:
+        return cmd_build(args)
+    if args.decompile:
+        return cmd_decompile(args)
+    if args.test:
+        return cmd_test(args)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+"""Daemon entrypoints: ceph-mon / ceph-osd process mains.
+
+Reference parity: src/ceph_mon.cc, src/ceph_osd.cc — global_init, store
+open/mkfs, daemon construction, run forever.  Launched by vstart.py as
+real subprocesses (multi-node-without-a-cluster, qa/ceph-helpers.sh
+run_mon/run_osd role).
+
+    python -m ceph_tpu.tools.daemons mon --id a --dir DIR
+    python -m ceph_tpu.tools.daemons osd --id 0 --dir DIR
+
+DIR must contain monmap.bin (written by vstart/`ceph-tpu mon mkmap`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import sys
+
+from ceph_tpu.common.context import Context
+from ceph_tpu.mon.monmap import MonMap
+from ceph_tpu.msg.messenger import Messenger
+from ceph_tpu.msg.types import EntityName
+
+
+def load_monmap(cluster_dir: str) -> MonMap:
+    with open(os.path.join(cluster_dir, "monmap.bin"), "rb") as f:
+        return MonMap.from_bytes(f.read())
+
+
+def apply_conf(ctx: Context, cluster_dir: str) -> None:
+    conf = os.path.join(cluster_dir, "ceph.conf")
+    if os.path.exists(conf):
+        ctx.config.parse_file(conf)
+
+
+async def run_mon(args) -> None:
+    from ceph_tpu.mon.monitor import Monitor
+    from ceph_tpu.store.kv import FileDB
+    ctx = Context(f"mon.{args.id}")
+    apply_conf(ctx, args.dir)
+    monmap = load_monmap(args.dir)
+    store = FileDB(os.path.join(args.dir, f"mon.{args.id}"))
+    msgr = Messenger(ctx, EntityName("mon", args.id))
+    mon = Monitor(ctx, args.id, monmap, store, msgr)
+    await mon.start()
+    await _run_until_signal()
+    await mon.shutdown()
+
+
+async def run_osd(args) -> None:
+    from ceph_tpu.osd.daemon import OSD
+    from ceph_tpu.store.filestore import FileStore
+    ctx = Context(f"osd.{args.id}")
+    apply_conf(ctx, args.dir)
+    monmap = load_monmap(args.dir)
+    path = os.path.join(args.dir, f"osd.{args.id}")
+    store = FileStore(path)
+    if not os.path.exists(os.path.join(path, "fsid")):
+        store.mkfs()
+    msgr = Messenger(ctx, EntityName("osd", args.id))
+    osd = OSD(ctx, int(args.id), store, msgr, monmap)
+    await osd.start()
+    await _run_until_signal()
+    await osd.shutdown()
+
+
+async def _run_until_signal() -> None:
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ceph-tpu-daemon")
+    ap.add_argument("kind", choices=["mon", "osd"])
+    ap.add_argument("--id", required=True)
+    ap.add_argument("--dir", required=True, help="cluster directory")
+    args = ap.parse_args(argv)
+    runner = run_mon if args.kind == "mon" else run_osd
+    asyncio.run(runner(args))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,130 @@
+"""rados CLI: object I/O + the bench harness.
+
+Reference parity: src/tools/rados/rados.cc (put/get/rm/ls/stat
+:102 usage) and src/common/obj_bencher.h:62 (bench write|seq|rand with
+throughput/latency stats — the cluster-level BASELINE harness).
+
+    python -m ceph_tpu.tools.rados --dir DIR -p pool put NAME FILE
+    ... get NAME FILE | rm NAME | ls | stat NAME
+    ... bench SECONDS write|seq|rand [-b SIZE] [-t CONCURRENCY]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+from ceph_tpu.tools.daemons import load_monmap
+
+
+async def bench(io, seconds: int, mode: str, block: int,
+                concurrency: int) -> dict:
+    """obj_bencher distilled: timed closed-loop with N writers."""
+    payload = bytes(range(256)) * (block // 256 + 1)
+    payload = payload[:block]
+    stats = {"ops": 0, "bytes": 0, "lat_sum": 0.0, "lat_max": 0.0}
+    stop_at = time.monotonic() + seconds
+    written: list = []
+
+    async def worker(wid: int):
+        n = 0
+        while time.monotonic() < stop_at:
+            name = f"bench_{wid}_{n}"
+            t0 = time.monotonic()
+            if mode == "write":
+                await io.write_full(name, payload)
+                written.append(name)
+            else:
+                if not written:
+                    return
+                target = written[(wid * 7919 + n) % len(written)]
+                await io.read(target)
+            dt = time.monotonic() - t0
+            stats["ops"] += 1
+            stats["bytes"] += block
+            stats["lat_sum"] += dt
+            stats["lat_max"] = max(stats["lat_max"], dt)
+            n += 1
+
+    if mode in ("seq", "rand"):
+        # seed objects to read back
+        for i in range(concurrency * 4):
+            name = f"bench_seed_{i}"
+            await io.write_full(name, payload)
+            written.append(name)
+        stop_at = time.monotonic() + seconds
+    t0 = time.monotonic()
+    await asyncio.gather(*(worker(i) for i in range(concurrency)))
+    wall = time.monotonic() - t0
+    ops = stats["ops"] or 1
+    return {
+        "mode": mode,
+        "seconds": round(wall, 3),
+        "ops": stats["ops"],
+        "bytes": stats["bytes"],
+        "mb_per_sec": round(stats["bytes"] / wall / 1e6, 3),
+        "iops": round(stats["ops"] / wall, 1),
+        "avg_lat_ms": round(1000 * stats["lat_sum"] / ops, 3),
+        "max_lat_ms": round(1000 * stats["lat_max"], 3),
+    }
+
+
+async def run(args) -> int:
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.common.context import Context
+    r = Rados(Context("client.admin"), load_monmap(args.dir))
+    await r.connect()
+    try:
+        if args.op == "lspools":
+            print("\n".join(r.pool_list()))
+            return 0
+        io = r.open_ioctx(args.pool)
+        if args.op == "put":
+            with open(args.args[1], "rb") as f:
+                await io.write_full(args.args[0], f.read())
+        elif args.op == "get":
+            data = await io.read(args.args[0])
+            if len(args.args) > 1 and args.args[1] != "-":
+                with open(args.args[1], "wb") as f:
+                    f.write(data)
+            else:
+                sys.stdout.buffer.write(data)
+        elif args.op == "rm":
+            await io.remove(args.args[0])
+        elif args.op == "stat":
+            size = await io.stat(args.args[0])
+            print(f"{args.pool}/{args.args[0]} size {size}")
+        elif args.op == "ls":
+            for name in await io.list_objects():
+                print(name)
+        elif args.op == "bench":
+            seconds = int(args.args[0])
+            mode = args.args[1] if len(args.args) > 1 else "write"
+            out = await bench(io, seconds, mode, args.block_size,
+                              args.concurrent)
+            print(json.dumps(out))
+        else:
+            print(f"unknown op {args.op}", file=sys.stderr)
+            return 2
+        return 0
+    finally:
+        await r.shutdown()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="rados")
+    ap.add_argument("--dir", default="./vcluster")
+    ap.add_argument("-p", "--pool", default="rbd")
+    ap.add_argument("-b", "--block-size", type=int, default=4 << 20)
+    ap.add_argument("-t", "--concurrent", type=int, default=16)
+    ap.add_argument("op", help="put|get|rm|ls|stat|bench|lspools")
+    ap.add_argument("args", nargs="*")
+    args = ap.parse_args(argv)
+    return asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
