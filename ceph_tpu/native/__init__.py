@@ -26,7 +26,7 @@ _tried = False
 def _build() -> bool:
     try:
         subprocess.run(
-            ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+            ["g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
              "-o", str(_SO), str(_SRC)],
             check=True, capture_output=True, timeout=120)
         return True
@@ -62,6 +62,14 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ceph_gf_matrix_apply.argtypes = [
             u8p, ctypes.c_int, ctypes.c_int, u8p, u8p, ctypes.c_uint64]
         lib.ceph_region_xor.argtypes = [u8p, u8p, u8p, ctypes.c_uint64]
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.ceph_straw2_winner_rows.argtypes = [
+            i32p, i64p, ctypes.c_int64, ctypes.c_int32, u32p, u32p, i64p,
+            i32p]
+        lib.ceph_straw2_winner_shared.argtypes = [
+            i32p, i64p, ctypes.c_int32, u32p, u32p, ctypes.c_int64, i64p,
+            i32p]
         _lib = lib
         return _lib
 
@@ -125,3 +133,51 @@ def region_xor(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     out = np.empty_like(a)
     lib.ceph_region_xor(_u8p(a), _u8p(b), _u8p(out), a.size)
     return out
+
+
+def straw2_winner_rows(items: np.ndarray, weights: np.ndarray,
+                       xs: np.ndarray, rs: np.ndarray,
+                       ln_tab: np.ndarray) -> np.ndarray:
+    """Row-wise batched straw2 argmax (the CPU engine of the batched
+    placement kernel, ops/crush_kernel.py).  items/weights [X, I],
+    xs/rs [X], ln_tab [65536] int64 -> winning index [X]."""
+    lib = _load()
+    assert lib is not None
+    items = np.ascontiguousarray(items, np.int32)
+    weights = np.ascontiguousarray(weights, np.int64)
+    xs = np.ascontiguousarray(xs, np.uint32)
+    rs = np.ascontiguousarray(rs, np.uint32)
+    ln_tab = np.ascontiguousarray(ln_tab, np.int64)
+    X, I = items.shape
+    out = np.empty(X, np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.ceph_straw2_winner_rows(
+        items.ctypes.data_as(i32p), weights.ctypes.data_as(i64p),
+        X, I, xs.ctypes.data_as(u32p), rs.ctypes.data_as(u32p),
+        ln_tab.ctypes.data_as(i64p), out.ctypes.data_as(i32p))
+    return out.astype(np.int64)
+
+
+def straw2_winner_shared(items: np.ndarray, weights: np.ndarray,
+                         xs: np.ndarray, rs: np.ndarray,
+                         ln_tab: np.ndarray) -> np.ndarray:
+    """Shared-bucket batched straw2 argmax: items/weights [I] drawn by
+    every lane (root-bucket case) — no [X, I] materialization."""
+    lib = _load()
+    assert lib is not None
+    items = np.ascontiguousarray(items, np.int32)
+    weights = np.ascontiguousarray(weights, np.int64)
+    xs = np.ascontiguousarray(xs, np.uint32)
+    rs = np.ascontiguousarray(rs, np.uint32)
+    ln_tab = np.ascontiguousarray(ln_tab, np.int64)
+    out = np.empty(len(xs), np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.ceph_straw2_winner_shared(
+        items.ctypes.data_as(i32p), weights.ctypes.data_as(i64p),
+        items.size, xs.ctypes.data_as(u32p), rs.ctypes.data_as(u32p),
+        len(xs), ln_tab.ctypes.data_as(i64p), out.ctypes.data_as(i32p))
+    return out.astype(np.int64)

@@ -149,4 +149,71 @@ void ceph_region_xor(const uint8_t* a, const uint8_t* b, uint8_t* out,
   for (; i < len; i++) out[i] = a[i] ^ b[i];
 }
 
-}  // extern "C"
+
+// -------------------------------------------------- batched straw2 choose --
+// Row-wise straw2 winner: for each lane i, argmax over I items of
+// draw = div64(crush_ln(hash(x_i, item, r_i) & 0xffff) - 2^48, weight).
+// The ln table (65536 int64 entries, crush_ln(u) for u in [0,0xffff]) is
+// passed in from python so the table stays single-sourced
+// (ceph_tpu/crush/lntable.py <- reference crush_ln_table.h).
+// Mirrors bucket_straw2_choose (reference src/crush/mapper.c:300-344).
+void ceph_straw2_winner_rows(const int32_t* items,    // [X*I]
+                             const int64_t* weights,  // [X*I]
+                             int64_t X, int32_t I,
+                             const uint32_t* xs,      // [X]
+                             const uint32_t* rs,      // [X]
+                             const int64_t* ln_tab,   // [65536]
+                             int32_t* out_idx) {      // [X]
+#pragma omp parallel for schedule(static) if (X > 4096)
+  for (int64_t i = 0; i < X; i++) {
+    const int32_t* it = items + i * I;
+    const int64_t* w = weights + i * I;
+    uint32_t xi = xs[i], ri = rs[i];
+    int32_t high = 0;
+    int64_t high_draw = 0;
+    for (int32_t j = 0; j < I; j++) {
+      int64_t draw;
+      if (w[j] > 0) {
+        uint32_t u = ceph_rjenkins3(xi, (uint32_t)it[j], ri) & 0xffffu;
+        int64_t ln = ln_tab[u] - 0x1000000000000LL;
+        // div64_s64 truncates toward zero; ln <= 0, w > 0
+        draw = -((-ln) / w[j]);
+      } else {
+        draw = INT64_MIN;
+      }
+      if (j == 0 || draw > high_draw) { high = j; high_draw = draw; }
+    }
+    out_idx[i] = high;
+  }
+}
+
+
+// Shared-bucket variant: every lane draws from the SAME item list (the
+// root bucket case) — avoids materializing [X, I] copies in python.
+void ceph_straw2_winner_shared(const int32_t* items,   // [I]
+                               const int64_t* weights, // [I]
+                               int32_t I, const uint32_t* xs,
+                               const uint32_t* rs, int64_t X,
+                               const int64_t* ln_tab,
+                               int32_t* out_idx) {
+#pragma omp parallel for schedule(static) if (X > 4096)
+  for (int64_t i = 0; i < X; i++) {
+    uint32_t xi = xs[i], ri = rs[i];
+    int32_t high = 0;
+    int64_t high_draw = 0;
+    for (int32_t j = 0; j < I; j++) {
+      int64_t draw;
+      if (weights[j] > 0) {
+        uint32_t u = ceph_rjenkins3(xi, (uint32_t)items[j], ri) & 0xffffu;
+        int64_t ln = ln_tab[u] - 0x1000000000000LL;
+        draw = -((-ln) / weights[j]);
+      } else {
+        draw = INT64_MIN;
+      }
+      if (j == 0 || draw > high_draw) { high = j; high_draw = draw; }
+    }
+    out_idx[i] = high;
+  }
+}
+
+}  // extern C

@@ -1,0 +1,412 @@
+"""Batched CRUSH placement kernel: one launch maps N pgs at once.
+
+Reference parity: crush/mapper.c — bucket_straw2_choose (:300-344),
+crush_choose_firstn (:414-593), crush_choose_indep (:600-781),
+crush_do_rule (:793-999).  This module is SURVEY §7 step 2's "batched
+kernel": the data-dependent retry/collision loops are reformulated as
+masked fixed-trip rounds over dense arrays — each round computes a
+candidate for every still-unresolved input and commits the first valid
+one, which provably follows the sequential semantics because round k
+evaluates exactly the (rep, ftotal=k) candidate the scalar loop would.
+
+Scope: the canonical topology + rules (what CrushCompiler/our builder
+emit and production maps overwhelmingly use):
+  - two-level hierarchy: root -> failure domains -> osd leaves,
+    all straw2 buckets;
+  - rules [TAKE root, CHOOSELEAF_FIRSTN 0 dom, EMIT] and
+    [SET_*, TAKE root, CHOOSELEAF_INDEP n dom, EMIT];
+  - default tunables (vary_r=1, stable=1, no local retries).
+`compile_rule` returns None for anything else and callers fall back to
+the scalar host mapper (ceph_tpu/crush/mapper.py) — same answers,
+slower.  Bit-exactness vs the host mapper is enforced by
+tests/test_crush_batch.py across weights/outage/fractional-reweight
+grids.
+
+The same integer pipeline (jenkins hash -> 16-bit ln table gather ->
+int64 division -> argmax) runs in two interchangeable engines:
+numpy (host) and jax.numpy under jit (TPU), selected per call.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ceph_tpu.crush.constants import (
+    BUCKET_STRAW2, CRUSH_ITEM_NONE, RULE_CHOOSELEAF_FIRSTN,
+    RULE_CHOOSELEAF_INDEP, RULE_EMIT, RULE_SET_CHOOSELEAF_TRIES,
+    RULE_SET_CHOOSE_TRIES, RULE_TAKE,
+)
+from ceph_tpu.crush.hashfn import np_hash32_2, np_hash32_3
+from ceph_tpu.crush.lntable import ln_u16_table
+from ceph_tpu.crush.types import CrushMap
+
+S64_MIN = -(2**63)
+
+
+class CompiledRule:
+    """Dense-array form of (map, rule) for vectorized descent."""
+
+    def __init__(self, firstn: bool, numrep_arg: int, choose_tries: int,
+                 leaf_tries: int, root_items: np.ndarray,
+                 root_weights: np.ndarray, dom_items: np.ndarray,
+                 dom_weights: np.ndarray, dom_index: dict,
+                 max_devices: int):
+        self.firstn = firstn
+        self.numrep_arg = numrep_arg          # 0 = use result_max
+        self.choose_tries = choose_tries
+        self.leaf_tries = leaf_tries
+        self.root_items = root_items          # [H] bucket ids (negative)
+        self.root_weights = root_weights      # [H]
+        self.dom_items = dom_items            # [H, Imax] osd ids (pad -1)
+        self.dom_weights = dom_weights        # [H, Imax] fixed weights
+        self.dom_index = dom_index            # bucket id -> row in dom_*
+        self.max_devices = max_devices
+        # id -> row lookup as an array over -1-id
+        n = max(-i for i in dom_index) + 1
+        self.dom_row = np.full(n, -1, np.int64)
+        for bid, row in dom_index.items():
+            self.dom_row[-1 - bid] = row
+
+
+def compile_rule(map_: CrushMap, ruleno: int) -> Optional[CompiledRule]:
+    """Flatten if the rule/topology fits the vectorizable shape."""
+    t = map_.tunables
+    if not (t.chooseleaf_vary_r == 1 and t.chooseleaf_stable == 1
+            and t.choose_local_tries == 0
+            and t.choose_local_fallback_tries == 0):
+        return None
+    if not (0 <= ruleno < len(map_.rules)) or map_.rules[ruleno] is None:
+        return None
+    rule = map_.rules[ruleno]
+    choose_tries = t.choose_total_tries + 1
+    leaf_tries = 0
+    root_id = None
+    choose_step = None
+    for step in rule.steps:
+        if step.op == RULE_SET_CHOOSE_TRIES:
+            if step.arg1 > 0:
+                choose_tries = step.arg1
+        elif step.op == RULE_SET_CHOOSELEAF_TRIES:
+            if step.arg1 > 0:
+                leaf_tries = step.arg1
+        elif step.op == RULE_TAKE:
+            if root_id is not None:
+                return None     # multi-take rules: fall back
+            root_id = step.arg1
+        elif step.op in (RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP):
+            if choose_step is not None:
+                return None
+            choose_step = step
+        elif step.op == RULE_EMIT:
+            pass
+        else:
+            return None
+    if root_id is None or choose_step is None or root_id >= 0:
+        return None
+    root = map_.bucket(root_id)
+    if root is None or root.alg != BUCKET_STRAW2 or root.size == 0:
+        return None
+    dom_type = choose_step.arg2
+    doms = []
+    for item in root.items:
+        if item >= 0:
+            return None
+        b = map_.bucket(item)
+        if (b is None or b.alg != BUCKET_STRAW2 or b.type != dom_type
+                or b.size == 0 or any(i < 0 for i in b.items)):
+            return None
+    imax = max(map_.bucket(i).size for i in root.items)
+    H = root.size
+    dom_items = np.full((H, imax), -1, np.int64)
+    dom_weights = np.zeros((H, imax), np.int64)
+    dom_index = {}
+    for h, bid in enumerate(root.items):
+        b = map_.bucket(bid)
+        dom_items[h, :b.size] = b.items
+        dom_weights[h, :b.size] = b.item_weights
+        dom_index[bid] = h
+    firstn = choose_step.op == RULE_CHOOSELEAF_FIRSTN
+    if leaf_tries == 0:
+        # do_rule recurse_tries defaults: descend_once -> 1 for firstn
+        # (mapper.c:934 flavor); indep always defaults to 1
+        leaf_tries = (1 if (not firstn or t.chooseleaf_descend_once)
+                      else choose_tries)
+    return CompiledRule(
+        firstn, choose_step.arg1, choose_tries, leaf_tries,
+        np.asarray(root.items, np.int64),
+        np.asarray(root.item_weights, np.int64),
+        dom_items, dom_weights, dom_index, map_.max_devices)
+
+
+# ------------------------------------------------------------ numpy engine
+
+_LN = None
+
+
+def _ln():
+    global _LN
+    if _LN is None:
+        _LN = np.asarray(ln_u16_table(), np.int64)
+    return _LN
+
+
+_native_mod = None
+
+
+def _native():
+    global _native_mod
+    if _native_mod is None:
+        from ceph_tpu import native
+        _native_mod = native if native.available() else False
+    return _native_mod
+
+
+def _straw2_draw(items, weights, x, r):
+    """Vectorized bucket_straw2_choose: returns winning index along the
+    last axis.  items/weights [I] (shared bucket) or [X, I] (per-lane);
+    x/r [X].  Dispatches to the native C kernels when built (the C-speed
+    host engine); pure numpy otherwise — identical results."""
+    x = np.asarray(x)
+    r = np.asarray(r)
+    nat = _native()
+    if nat and x.ndim == 1:
+        rr = np.broadcast_to(r, x.shape)
+        if items.ndim == 1:
+            return nat.straw2_winner_shared(items, weights, x, rr, _ln())
+        return nat.straw2_winner_rows(items, weights, x, rr, _ln())
+    u = np_hash32_3(x[..., None],
+                    (items & 0xFFFFFFFF).astype(np.uint32),
+                    r[..., None]).astype(np.int64) & 0xFFFF
+    ln = _ln()[u] - 0x1000000000000          # <= 0
+    draw = np.where(weights > 0, -((-ln) // np.maximum(weights, 1)),
+                    S64_MIN)
+    return np.argmax(draw, axis=-1)
+
+
+def _is_out(weights_vec: np.ndarray, item: np.ndarray,
+            x: np.ndarray) -> np.ndarray:
+    """Vectorized is_out (mapper.c:378-392)."""
+    w = np.where((item >= 0) & (item < len(weights_vec)),
+                 weights_vec[np.clip(item, 0, len(weights_vec) - 1)], 0)
+    out = np.where(w >= 0x10000, False,
+                   np.where(w == 0, True,
+                            (np_hash32_2(x.astype(np.uint32),
+                                         item.astype(np.uint32))
+                             .astype(np.int64) & 0xFFFF) >= w))
+    return out | (item < 0) | (item >= len(weights_vec))
+
+
+def _leaf_choose(cr: CompiledRule, hrow: np.ndarray, x: np.ndarray,
+                 parent_r: np.ndarray, r_step: int, tries: int,
+                 weights_vec: np.ndarray, osds_out: np.ndarray,
+                 valid_cols: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Inner chooseleaf descent into the selected domain.
+
+    firstn (stable=1): r' = parent_r + ftotal2        (r_step=1)
+    indep:             r' = rep + parent_r + n*ftotal2 (caller folds rep
+                       into parent_r; r_step=numrep)
+    Rejection: is_out, plus collision against osds already in osds_out
+    within valid_cols (firstn semantics; indep passes an empty mask).
+    Returns (osd, ok) arrays over the x batch.
+    """
+    items = cr.dom_items[hrow]          # [X, I]
+    weights = cr.dom_weights[hrow]
+    osd = np.full(x.shape, -1, np.int64)
+    ok = np.zeros(x.shape, bool)
+    active = np.ones(x.shape, bool)
+    for f2 in range(tries):
+        if not active.any():
+            break
+        r = parent_r + r_step * f2
+        idx = _straw2_draw(items, weights, x, r)
+        cand = np.take_along_axis(items, idx[:, None], 1)[:, 0]
+        reject = _is_out(weights_vec, cand, x)
+        if osds_out.shape[1]:
+            coll = ((osds_out == cand[:, None]) & valid_cols).any(axis=1)
+            reject = reject | coll
+        good = active & ~reject
+        osd = np.where(good, cand, osd)
+        ok = ok | good
+        active = active & reject
+    return osd, ok
+
+
+def map_firstn(cr: CompiledRule, xs: np.ndarray, numrep: int,
+               weights_vec: Sequence[int]
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Batched crush_choose_firstn+chooseleaf.  Returns (osds [X, numrep]
+    with -1 padding, counts [X])."""
+    xs = np.asarray(xs, np.int64)
+    wv = np.asarray(weights_vec, np.int64)
+    X = len(xs)
+    hosts_out = np.full((X, numrep), np.iinfo(np.int64).min, np.int64)
+    osds_out = np.full((X, numrep), -1, np.int64)
+    outpos = np.zeros(X, np.int64)
+    col = np.arange(numrep)
+    for rep in range(numrep):
+        # lanes still looking for this rep's pick; later rounds run only
+        # on the (rapidly shrinking) unresolved subset
+        lanes = np.arange(X)
+        for ftotal in range(cr.choose_tries):
+            if lanes.size == 0:
+                break
+            r = rep + ftotal
+            xsub = xs[lanes]
+            hidx = _straw2_draw(cr.root_items, cr.root_weights, xsub,
+                                np.full(lanes.size, r))
+            host = cr.root_items[hidx]
+            valid = col[None, :] < outpos[lanes, None]
+            collide = ((hosts_out[lanes] == host[:, None])
+                       & valid).any(axis=1)
+            hrow = cr.dom_row[-1 - host]
+            # vary_r=1: sub_r = r >> 0 = r
+            osd, leaf_ok = _leaf_choose(
+                cr, hrow, xsub, np.full(lanes.size, r), 1, cr.leaf_tries,
+                wv, osds_out[lanes], valid)
+            good = ~collide & leaf_ok
+            if good.any():
+                rows = lanes[good]
+                pos = outpos[rows]
+                hosts_out[rows, pos] = host[good]
+                osds_out[rows, pos] = osd[good]
+                outpos[rows] = pos + 1
+            lanes = lanes[~good]
+    return osds_out, outpos
+
+
+def map_indep(cr: CompiledRule, xs: np.ndarray, numrep: int,
+              weights_vec: Sequence[int]) -> np.ndarray:
+    """Batched crush_choose_indep+chooseleaf: positionally-stable result
+    [X, numrep] with CRUSH_ITEM_NONE holes."""
+    xs = np.asarray(xs, np.int64)
+    wv = np.asarray(weights_vec, np.int64)
+    X = len(xs)
+    UNDEF = np.int64(np.iinfo(np.int64).min)
+    hosts_out = np.full((X, numrep), UNDEF, np.int64)
+    osds_out = np.full((X, numrep), UNDEF, np.int64)
+    all_cols = np.ones((X, numrep), bool)
+    empty_valid = np.zeros((X, 0), bool)
+    empty_osds = np.zeros((X, 0), np.int64)
+    for ftotal in range(cr.choose_tries):
+        undef = hosts_out == UNDEF
+        if not undef.any():
+            break
+        for rep in range(numrep):
+            lanes = np.nonzero(undef[:, rep])[0]
+            if lanes.size == 0:
+                continue
+            r = rep + numrep * ftotal     # straw2 root: non-uniform path
+            xsub = xs[lanes]
+            hidx = _straw2_draw(cr.root_items, cr.root_weights, xsub,
+                                np.full(lanes.size, r))
+            host = cr.root_items[hidx]
+            collide = ((hosts_out[lanes] == host[:, None])
+                       & all_cols[lanes]).any(axis=1)
+            hrow = cr.dom_row[-1 - host]
+            # inner indep: r' = rep + r_outer + numrep*ftotal2; its own
+            # collision scope is just this slot (never fires)
+            osd, leaf_ok = _leaf_choose(
+                cr, hrow, xsub, np.full(lanes.size, rep + r), numrep,
+                cr.leaf_tries, wv, empty_osds[lanes],
+                empty_valid[lanes])
+            good = ~collide & leaf_ok
+            rows = lanes[good]
+            hosts_out[rows, rep] = host[good]
+            osds_out[rows, rep] = osd[good]
+    osds_out = np.where(osds_out == UNDEF, CRUSH_ITEM_NONE, osds_out)
+    return osds_out
+
+
+def batch_do_rule(map_: CrushMap, ruleno: int, xs: Sequence[int],
+                  result_max: int, weights_vec: Sequence[int]
+                  ) -> List[List[int]]:
+    """Drop-in batched do_rule: vectorized when compilable, scalar host
+    fallback otherwise.  Output matches [do_rule(x) for x in xs]."""
+    cr = compile_rule(map_, ruleno)
+    if cr is None:
+        from ceph_tpu.crush.mapper import do_rule
+        return [do_rule(map_, ruleno, int(x), result_max, weights_vec)
+                for x in xs]
+    # mapper.c choose-step numrep: arg <= 0 means result_max + arg
+    numrep = cr.numrep_arg
+    if numrep <= 0:
+        numrep += result_max
+        if numrep <= 0:
+            return [[] for _ in xs]
+    if cr.firstn:
+        osds, counts = map_firstn(cr, np.asarray(xs), numrep, weights_vec)
+        return [[int(o) for o in osds[i, :counts[i]]]
+                for i in range(len(xs))]
+    osds = map_indep(cr, np.asarray(xs), numrep, weights_vec)
+    return [[int(o) for o in row] for row in osds]
+
+
+# -------------------------------------------------------------- jax engine
+
+def jax_straw2_winners(items, weights, xs, rs):
+    """TPU-jittable straw2 winner grid.
+
+    items/weights: [B] bucket contents; xs: [X] inputs; rs: [R] draw
+    indices.  Returns [X, R] winning ITEM ids.  Same integer pipeline as
+    the numpy engine (jenkins mix in uint32, 16-bit ln gather in int64,
+    truncating division, first-max argmax), jitted so XLA fuses the
+    hash arithmetic and tiles the argmax reduction.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    with jax.enable_x64():   # straw2 needs 2^48-scale fixed-point ints
+        return _jax_winners_x64(jax, jnp, items, weights, xs, rs)
+
+
+def _jax_winners_x64(jax, jnp, items, weights, xs, rs):
+    ln_tab = jnp.asarray(ln_u16_table(), jnp.int64)
+    items_u = jnp.asarray(np.asarray(items, np.int64) & 0xFFFFFFFF,
+                          jnp.uint32)
+    items_i = jnp.asarray(items, jnp.int64)
+    w = jnp.asarray(weights, jnp.int64)
+    xs = jnp.asarray(np.asarray(xs, np.int64) & 0xFFFFFFFF, jnp.uint32)
+    rs = jnp.asarray(np.asarray(rs, np.int64) & 0xFFFFFFFF, jnp.uint32)
+
+    def mix(a, b, c):
+        # crush_hashmix (hash.c:12-30) in uint32 wraparound arithmetic
+        a = (a - b) - c; a = a ^ (c >> 13)
+        b = (b - c) - a; b = b ^ (a << 8)
+        c = (c - a) - b; c = c ^ (b >> 13)
+        a = (a - b) - c; a = a ^ (c >> 12)
+        b = (b - c) - a; b = b ^ (a << 16)
+        c = (c - a) - b; c = c ^ (b >> 5)
+        a = (a - b) - c; a = a ^ (c >> 3)
+        b = (b - c) - a; b = b ^ (a << 10)
+        c = (c - a) - b; c = c ^ (b >> 15)
+        return a, b, c
+
+    @jax.jit
+    def winners(xs, rs):
+        # crush_hash32_3(a=x, b=item, c=r): same mix schedule as
+        # hashfn.np_hash32_3 — h = seed^a^b^c, then (a,b,h) (c,x,h)
+        # (y,a,h) (b,x,h) (y,c,h) with x=231232, y=1232
+        a = jnp.broadcast_to(xs[:, None, None],
+                             (xs.shape[0], rs.shape[0],
+                              items_u.shape[0])).astype(jnp.uint32)
+        b = jnp.broadcast_to(items_u[None, None, :], a.shape)
+        c = jnp.broadcast_to(rs[None, :, None], a.shape)
+        h = jnp.uint32(1315423911) ^ a ^ b ^ c
+        x = jnp.full(a.shape, 231232, jnp.uint32)
+        y = jnp.full(a.shape, 1232, jnp.uint32)
+        a, b, h = mix(a, b, h)
+        c, x, h = mix(c, x, h)
+        y, a, h = mix(y, a, h)
+        b, x, h = mix(b, x, h)
+        y, c, h = mix(y, c, h)
+        u = (h & jnp.uint32(0xFFFF)).astype(jnp.int32)
+        ln = ln_tab[u] - jnp.int64(0x1000000000000)
+        draw = jnp.where(w[None, None, :] > 0,
+                         -((-ln) // jnp.maximum(w[None, None, :], 1)),
+                         jnp.int64(S64_MIN))
+        idx = jnp.argmax(draw, axis=-1)
+        return items_i[idx]
+
+    return np.asarray(winners(xs, rs))

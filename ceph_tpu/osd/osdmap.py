@@ -284,6 +284,42 @@ class OSDMap(Encodable):
         _, _, acting, primary = self.pg_to_up_acting_osds(pg)
         return acting, primary
 
+    def _finish_mapping(self, pool: PGPool, raw_pg: PGId, raw: List[int]
+                        ) -> Tuple[List[int], int, List[int], int]:
+        """Everything after the crush call: nonexistent removal, up
+        derivation, affinity, temp overrides (shared by the scalar and
+        batched paths)."""
+        if pool.can_shift_osds():
+            raw = [o for o in raw if self.exists(o)]
+        else:
+            raw = [o if self.exists(o) else CRUSH_ITEM_NONE for o in raw]
+        up, up_primary = self._raw_to_up_osds(pool, raw)
+        up, up_primary = self._apply_primary_affinity(
+            raw_pg.seed, pool, up, up_primary)
+        temp, temp_primary = self._get_temp_osds(pool, raw_pg)
+        acting = temp if temp else list(up)
+        acting_primary = temp_primary if (temp or temp_primary != -1) \
+            else up_primary
+        return up, up_primary, acting, acting_primary
+
+    def map_pgs_batch(self, pool_id: int
+                      ) -> List[Tuple[PGId, List[int], int, List[int], int]]:
+        """Map EVERY pg of a pool in one batched kernel launch
+        (osdmaptool --test-map-pgs hot path; ops/crush_kernel.py).
+        Returns [(pg, up, up_primary, acting, acting_primary)]."""
+        from ceph_tpu.ops.crush_kernel import batch_do_rule
+        pool = self.pools[pool_id]
+        pgs = self.pg_ids(pool_id)
+        pps = [pool.raw_pg_to_pps(pg) for pg in pgs]
+        ruleno = self.crush.find_rule(pool.crush_ruleset, pool.type,
+                                      pool.size)
+        if ruleno < 0:
+            return [(pg, [], -1, [], -1) for pg in pgs]
+        raws = batch_do_rule(self.crush, ruleno, pps, pool.size,
+                             self.osd_weight)
+        return [(pg,) + self._finish_mapping(pool, pg, raw)
+                for pg, raw in zip(pgs, raws)]
+
     def object_to_acting(self, name: str, loc: ObjectLocator
                          ) -> Tuple[PGId, List[int], int]:
         raw = self.object_locator_to_pg(name, loc)

@@ -19,8 +19,8 @@ from collections import Counter
 
 from ceph_tpu.crush.builder import (build_hierarchy, make_erasure_rule,
                                     make_replicated_rule)
-from ceph_tpu.crush.mapper import do_rule
 from ceph_tpu.crush.types import CrushMap
+from ceph_tpu.ops.crush_kernel import batch_do_rule
 
 
 def cmd_build(args) -> int:
@@ -68,12 +68,14 @@ def cmd_test(args) -> int:
     per_osd = Counter()
     sizes = Counter()
     t0 = time.perf_counter()
-    for x in range(args.min_x, args.max_x + 1):
-        out = do_rule(m, ruleno, x, args.num_rep, weights)
+    results = batch_do_rule(m, ruleno,
+                            list(range(args.min_x, args.max_x + 1)),
+                            args.num_rep, weights)
+    dt = time.perf_counter() - t0
+    for out in results:
         sizes[len(out)] += 1
         for o in out:
             per_osd[o] += 1
-    dt = time.perf_counter() - t0
     expected = n * args.num_rep / max(1, m.max_devices)
     report = {
         "inputs": n,

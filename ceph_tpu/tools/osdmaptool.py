@@ -43,8 +43,7 @@ def cmd_test_map_pgs(m: OSDMap, as_json: bool) -> int:
     sizes = Counter()
     t0 = time.perf_counter()
     for pid in sorted(m.pools):
-        for pg in m.pg_ids(pid):
-            up, upp, acting, actp = m.pg_to_up_acting_osds(pg)
+        for pg, up, upp, acting, actp in m.map_pgs_batch(pid):
             total += 1
             sizes[len([o for o in up if o != CRUSH_ITEM_NONE])] += 1
             for o in up:
