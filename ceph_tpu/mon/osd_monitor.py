@@ -276,6 +276,54 @@ class OSDMonitor(PaxosService):
             self.pending_inc.new_primary_affinity[osd] = \
                 int(w * 0x10000) & 0x1FFFF
             self._propose_and_ack(m)
+        elif prefix == "osd erasure-code-profile set":
+            # OSDMonitor.cc erasure-code-profile set: name + k=v pairs.
+            # k/m are always materialized so every consumer reads the
+            # same geometry
+            name = cmd["name"]
+            prof = {kk: str(vv) for kk, vv in cmd.get("profile", {}).items()}
+            prof.setdefault("k", "4")
+            prof.setdefault("m", "2")
+            existing = self.osdmap.ec_profiles.get(name)
+            if existing == prof:
+                ack(0, f"profile {name!r} unchanged")
+                return
+            if existing is not None:
+                used = [pn for pid, pn in self.osdmap.pool_names.items()
+                        if self.osdmap.pools[pid].ec_profile == name]
+                if used:
+                    # changing geometry under live pools makes every
+                    # existing object undecodable — never allowed
+                    ack(-errno.EBUSY,
+                        f"profile {name!r} in use by {used}")
+                    return
+                if not cmd.get("force"):
+                    ack(-errno.EPERM,
+                        f"profile {name!r} exists with different params; "
+                        f"use force to overwrite")
+                    return
+            self.pending_inc.new_ec_profiles[name] = prof
+            self._propose_and_ack(m, outs=f"profile {name!r} set")
+        elif prefix == "osd erasure-code-profile get":
+            prof = self.osdmap.ec_profiles.get(cmd["name"])
+            if prof is None:
+                ack(-errno.ENOENT, f"no profile {cmd['name']!r}")
+            else:
+                ack(0, json.dumps(prof))
+        elif prefix == "osd erasure-code-profile ls":
+            ack(0, json.dumps(sorted(self.osdmap.ec_profiles)))
+        elif prefix == "osd erasure-code-profile rm":
+            name = cmd["name"]
+            used = [pn for pid, pn in self.osdmap.pool_names.items()
+                    if self.osdmap.pools[pid].ec_profile == name]
+            if used:
+                ack(-errno.EBUSY, f"profile {name!r} in use by {used}")
+                return
+            if name not in self.osdmap.ec_profiles:
+                ack(0, f"no profile {name!r}")
+                return
+            self.pending_inc.old_ec_profiles.append(name)
+            self._propose_and_ack(m, outs=f"profile {name!r} removed")
         elif prefix == "osd crush set-map":
             self.pending_inc.new_crush = CrushMap.from_bytes(m.inbl)
             self._propose_and_ack(m)
@@ -310,8 +358,31 @@ class OSDMonitor(PaxosService):
         crush = self.pending_inc.new_crush or self.osdmap.crush
         if pool_type == "erasure":
             profile = cmd.get("erasure_code_profile", "default")
-            k = int(cmd.get("k", 4))
-            mm = int(cmd.get("m", 2))
+            stored = self.osdmap.ec_profiles.get(
+                profile, self.pending_inc.new_ec_profiles.get(profile))
+            if stored is not None:
+                # profile wins; explicit k/m must not contradict it
+                k = int(stored.get("k", 4))
+                mm = int(stored.get("m", 2))
+                for key, have, want in (("k", k, cmd.get("k")),
+                                        ("m", mm, cmd.get("m"))):
+                    if want is not None and int(want) != have:
+                        self.mon.reply(m, MMonCommandAck(
+                            m.tid, -errno.EINVAL,
+                            f"{key}={want} contradicts profile "
+                            f"{profile!r} ({key}={have})"))
+                        return
+            else:
+                # persist the effective profile in the map so every
+                # ECBackend reads the same k/m (OSDMap
+                # erasure_code_profiles; ADVICE r1: never derive from
+                # pool size)
+                k = int(cmd.get("k", 4))
+                mm = int(cmd.get("m", 2))
+                prof = {"k": str(k), "m": str(mm)}
+                if cmd.get("plugin"):
+                    prof["plugin"] = str(cmd["plugin"])
+                self.pending_inc.new_ec_profiles[profile] = prof
             size = k + mm
             # each EC pool gets its own indep rule (create_ruleset role)
             newc = CrushMap.from_bytes(crush.to_bytes())

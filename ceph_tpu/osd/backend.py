@@ -41,6 +41,11 @@ from ceph_tpu.store.objectstore import (
 SIZE_XATTR = "_size"       # EC: original object length (hinfo role)
 
 
+class PGIntervalChanged(Exception):
+    """The PG's acting set changed while an op was in flight; the op must
+    abort promptly (client retries against the new mapping)."""
+
+
 class PGBackend:
     def __init__(self, pg):
         self.pg = pg
@@ -48,6 +53,20 @@ class PGBackend:
         self.log_ = pg.log_
         # in-flight rep ops: tid -> (pending peer set, future)
         self._inflight: Dict[int, Tuple[set, asyncio.Future]] = {}
+
+    def on_interval_change(self) -> None:
+        """Fail every in-flight ack/read/push future: replies from the
+        old acting set may never arrive, and waiting out the 20s timeout
+        would freeze this PG's whole op queue (ReplicatedPG::do_request
+        re-checks on every map)."""
+        exc = PGIntervalChanged(f"pg {self.pg.pgid} interval changed")
+        for _, fut in self._inflight.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._inflight.clear()
+        for fut in self.pg._push_acks.values():
+            if not fut.done():
+                fut.set_exception(exc)
 
     # --- shared helpers ---
     def _ack_init(self, tid: int, peers: set) -> asyncio.Future:
@@ -73,7 +92,7 @@ class PGBackend:
         try:
             await asyncio.wait_for(fut, timeout)
             return True
-        except asyncio.TimeoutError:
+        except (asyncio.TimeoutError, PGIntervalChanged):
             return False
 
     def apply_push(self, m: MPGPush) -> None:
@@ -319,11 +338,18 @@ class ECBackend(PGBackend):
     def __init__(self, pg):
         super().__init__(pg)
         from ceph_tpu.ec.registry import factory
-        profile = dict(
-            self.osd.osdmap.ec_profiles.get(pg.pool.ec_profile, {}))
-        profile.setdefault("k", str(max(1, pg.pool.size - 2)))
-        profile.setdefault("m", str(pg.pool.size
-                                    - int(profile.get("k"))))
+        stored = self.osd.osdmap.ec_profiles.get(pg.pool.ec_profile)
+        if stored is None:
+            # a silently-defaulted k/m would run with different fault
+            # tolerance than the admin configured (ADVICE r1) — refuse
+            raise RuntimeError(
+                f"pg {pg.pgid}: EC profile {pg.pool.ec_profile!r} not in "
+                f"osdmap e{self.osd.osdmap.epoch} ec_profiles")
+        profile = dict(stored)
+        # same defaults the monitor materializes at profile-set/pool-create
+        # time, so geometry can never disagree across daemons
+        profile.setdefault("k", "4")
+        profile.setdefault("m", "2")
         # Inline per-op encodes use the vectorized HOST GF kernel: object
         # sizes vary per op, and paying an XLA compile + device dispatch
         # per 4KiB-class op stalls the event loop (SURVEY §7 hard part —
@@ -520,6 +546,11 @@ class ECBackend(PGBackend):
             except asyncio.TimeoutError:
                 self._inflight.pop(tid, None)
                 continue
+            except PGIntervalChanged:
+                # don't degrade the gather to EIO — abort the whole op
+                # so the caller retries under the new acting set
+                self._inflight.pop(tid, None)
+                raise
             if reply.result == 0 and reply.data:
                 streams[i] = np.frombuffer(reply.data[0], np.uint8)
                 if reply.attrs:

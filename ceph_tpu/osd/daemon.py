@@ -25,8 +25,8 @@ from ceph_tpu.mon.monmap import MonMap
 from ceph_tpu.osd.messages import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDOp, MOSDOpReply, MOSDPing, MOSDRepOp,
-    MOSDRepOpReply, MPGLog, MPGLogRequest, MPGNotify, MPGPush,
-    MPGPushReply, MPGQuery,
+    MOSDRepOpReply, MPGLog, MPGLogRequest, MPGNotify, MPGObjectList,
+    MPGPush, MPGPushReply, MPGQuery,
 )
 from ceph_tpu.osd.osdmap import OSDMap
 from ceph_tpu.osd.pg import PG
@@ -231,6 +231,11 @@ class OSD(Dispatcher):
             pg = self._pg_for(m.pgid)
             if pg is not None:
                 pg.on_push_reply(m)
+            return True
+        if isinstance(m, MPGObjectList):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_object_list(m)
             return True
         if isinstance(m, MOSDPing):
             self._handle_ping(m)

@@ -416,22 +416,25 @@ class MPGLogRequest(Message):
 
     def __init__(self, pgid: Optional[PGId] = None, epoch: int = 0,
                  since: Optional[EVersion] = None, from_osd: int = -1,
-                 want_object: str = ""):
+                 want_object: str = "", want_list: bool = False):
         super().__init__()
         self.pgid = pgid or PGId(0, 0)
         self.epoch = epoch
         self.since = since or EVersion()
         self.from_osd = from_osd
         self.want_object = want_object
+        # ask for the peer's full object listing (backfill scan role)
+        self.want_list = want_list
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid).u32(self.epoch).struct(self.since)
         enc.s32(self.from_osd).string(self.want_object)
+        enc.boolean(self.want_list)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGLogRequest":
         return cls(dec.struct(PGId), dec.u32(), dec.struct(EVersion),
-                   dec.s32(), dec.string())
+                   dec.s32(), dec.string(), dec.boolean())
 
 
 @register_message
@@ -442,7 +445,8 @@ class MPGLog(Message):
 
     def __init__(self, pgid: Optional[PGId] = None, epoch: int = 0,
                  info_bytes: bytes = b"", log_bytes: bytes = b"",
-                 from_osd: int = -1, activate: bool = False):
+                 from_osd: int = -1, activate: bool = False,
+                 full_resync: bool = False, backfill_done: bool = False):
         super().__init__()
         self.pgid = pgid or PGId(0, 0)
         self.epoch = epoch
@@ -450,16 +454,23 @@ class MPGLog(Message):
         self.log_bytes = log_bytes
         self.from_osd = from_osd
         self.activate = activate
+        # backfill-style resync: receiver must drop objects the primary
+        # doesn't know about (they will all be re-pushed)
+        self.full_resync = full_resync
+        # primary confirms every object was pushed — receiver may now
+        # persist backfill_complete
+        self.backfill_done = backfill_done
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid).u32(self.epoch).bytes_(self.info_bytes)
         enc.bytes_(self.log_bytes).s32(self.from_osd)
-        enc.boolean(self.activate)
+        enc.boolean(self.activate).boolean(self.full_resync)
+        enc.boolean(self.backfill_done)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGLog":
         return cls(dec.struct(PGId), dec.u32(), dec.bytes_(), dec.bytes_(),
-                   dec.s32(), dec.boolean())
+                   dec.s32(), dec.boolean(), dec.boolean(), dec.boolean())
 
 
 # --------------------------------------------------------------- recovery
@@ -523,3 +534,28 @@ class MPGPushReply(Message):
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGPushReply":
         return cls(dec.struct(PGId), dec.string(), dec.s32())
+
+
+@register_message
+class MPGObjectList(Message):
+    """Peer's full object listing — the backfill both-sides scan
+    (reference BackfillInterval, osd/PG.h:1911)."""
+    TYPE = 216
+    PRIORITY = PRIO_HIGH
+
+    def __init__(self, pgid: Optional[PGId] = None,
+                 names: Optional[list] = None, from_osd: int = -1):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.names = names or []
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid)
+        enc.list_(self.names, lambda e, v: e.string(v))
+        enc.s32(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGObjectList":
+        return cls(dec.struct(PGId), dec.list_(lambda d: d.string()),
+                   dec.s32())

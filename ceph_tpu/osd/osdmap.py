@@ -29,7 +29,7 @@ from ceph_tpu.osd.types import (
 class Incremental(Encodable):
     """OSDMap::Incremental — the delta the monitor commits per epoch."""
 
-    STRUCT_V = 1
+    STRUCT_V = 2
 
     def __init__(self, epoch: int = 0):
         self.epoch = epoch
@@ -46,6 +46,10 @@ class Incremental(Encodable):
         self.new_pg_temp: Dict[PGId, List[int]] = {}  # [] = remove
         self.new_primary_temp: Dict[PGId, int] = {}   # -1 = remove
         self.new_crush: Optional[CrushMap] = None
+        # name -> {k,m,plugin,...}; reference OSDMap::Incremental
+        # new_erasure_code_profiles / old_erasure_code_profiles
+        self.new_ec_profiles: Dict[str, Dict[str, str]] = {}
+        self.old_ec_profiles: List[str] = []
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u32(self.epoch).string(self.fsid).s32(self.new_max_osd)
@@ -70,6 +74,10 @@ class Incremental(Encodable):
         for pg in sorted(self.new_primary_temp):
             enc.struct(pg).s32(self.new_primary_temp[pg])
         enc.opt_struct(self.new_crush)
+        enc.map_(self.new_ec_profiles, lambda e, k: e.string(k),
+                 lambda e, v: e.map_(v, lambda e2, k2: e2.string(k2),
+                                     lambda e2, v2: e2.string(v2)))
+        enc.list_(self.old_ec_profiles, lambda e, v: e.string(v))
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "Incremental":
@@ -95,6 +103,12 @@ class Incremental(Encodable):
             pg = dec.struct(PGId)
             inc.new_primary_temp[pg] = dec.s32()
         inc.new_crush = dec.opt_struct(CrushMap)
+        if struct_v >= 2:
+            inc.new_ec_profiles = dec.map_(
+                lambda d: d.string(),
+                lambda d: d.map_(lambda d2: d2.string(),
+                                 lambda d2: d2.string()))
+            inc.old_ec_profiles = dec.list_(lambda d: d.string())
         return inc
 
 
@@ -375,6 +389,10 @@ class OSDMap(Encodable):
                 self.primary_temp[pg] = p
             else:
                 self.primary_temp.pop(pg, None)
+        for name, prof in inc.new_ec_profiles.items():
+            self.ec_profiles[name] = dict(prof)
+        for name in inc.old_ec_profiles:
+            self.ec_profiles.pop(name, None)
 
     # ----------------------------------------------------------- encoding
     def encode_payload(self, enc: Encoder) -> None:

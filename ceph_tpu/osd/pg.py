@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from ceph_tpu.crush.constants import CRUSH_ITEM_NONE
 from ceph_tpu.osd.messages import (
     EVersion, MOSDOp, MOSDOpReply, MPGLog, MPGLogRequest, MPGNotify,
-    MPGPush, MPGPushReply, MPGQuery,
+    MPGObjectList, MPGPush, MPGPushReply, MPGQuery,
 )
 from ceph_tpu.osd.pglog import LogEntry, MissingSet, PGInfo, PGLog
 from ceph_tpu.osd.types import NO_SHARD, PGId, PGPool
@@ -60,6 +60,7 @@ class PG:
         self.missing = MissingSet()
         self.peer_info: Dict[int, PGInfo] = {}
         self.peer_missing: Dict[int, MissingSet] = {}
+        self._backfilling: Set[int] = set()   # peers mid-full-resync
         # current mapping
         self.up: List[int] = []
         self.acting: List[int] = []
@@ -74,6 +75,7 @@ class PG:
         # request/reply matching for peering + recovery
         self._notify_waiters: Dict[int, asyncio.Future] = {}
         self._log_waiters: Dict[int, asyncio.Future] = {}
+        self._list_waiters: Dict[int, asyncio.Future] = {}
         self._pull_waiters: Dict[str, asyncio.Future] = {}
         self._push_acks: Dict[Tuple[int, str], asyncio.Future] = {}
         from ceph_tpu.osd.backend import ECBackend, ReplicatedBackend
@@ -153,6 +155,11 @@ class PG:
             self.interval_epoch = osdmap.epoch
             self.state = STATE_PEERING
             self._active_event.clear()
+            # acks from the old acting set can never complete: fail
+            # in-flight futures now so writes abort with EAGAIN instead
+            # of riding out their timeout (ReplicatedPG::do_request
+            # epoch re-checks; ADVICE r1)
+            self.backend.on_interval_change()
             if self._peering_task is not None:
                 self._peering_task.cancel()
                 self._peering_task = None
@@ -206,14 +213,20 @@ class PG:
                     self._notify_waiters.pop(p, None)
         self.peer_info = infos
 
-        # GetLog: adopt the best log (PG::choose_acting/GetLog)
+        # GetLog: adopt the best log (PG::choose_acting/GetLog).  A
+        # half-backfilled copy claims its auth donor's last_update but is
+        # missing objects — it must never outrank a complete copy
+        # (reference find_best_info excludes last_backfill < MAX peers)
+        def rank(pi: PGInfo):
+            return (pi.backfill_complete, pi.last_update,
+                    pi.last_epoch_started)
         best_osd, best_info = self.osd.whoami, self.info
         for p, pi in infos.items():
-            if (pi.last_update, pi.last_epoch_started) > \
-                    (best_info.last_update, best_info.last_epoch_started):
+            if rank(pi) > rank(best_info):
                 best_osd, best_info = p, pi
-        if best_osd != self.osd.whoami \
-                and best_info.last_update != self.info.last_update:
+        if best_osd != self.osd.whoami and (
+                best_info.last_update != self.info.last_update
+                or not self.info.backfill_complete):
             await self._catch_up_from(best_osd, best_info, epoch)
 
         # compute peer missing + activate peers
@@ -238,6 +251,13 @@ class PG:
         if auth_info.last_update < self.info.last_update:
             for e in self.log.rewind_to(auth_info.last_update):
                 self.missing.add(e.oid, EVersion.zero())
+        if not self.info.backfill_complete or \
+                not auth_log.can_catch_up_from(self.info.last_update):
+            # the auth log's window has closed over our position (or our
+            # own last resync never finished): log merge would silently
+            # lose every object older than the window — full self-resync
+            await self._full_resync_from(peer, auth_info, auth_log, epoch)
+            return
         added = self.log.merge_from(auth_log, self.info.last_update)
         for e in added:
             self.missing.add(e.oid, e.version)
@@ -260,6 +280,54 @@ class PG:
         self.save_meta(txn)
         self.osd.store.apply_transaction(txn)
 
+    async def _full_resync_from(self, peer: int, auth_info: PGInfo,
+                                auth_log: PGLog, epoch: int) -> None:
+        """Primary self-backfill: scan the auth peer's object list, drop
+        local objects it doesn't have, pull the rest, only then declare
+        ourselves complete (reference backfill, PG.h:1911 — both-sides
+        scan with a progress marker surviving interruption)."""
+        self.log_.info(f"{self.pgid}: full self-resync from osd.{peer} "
+                       f"(auth tail {auth_log.tail} > our "
+                       f"{self.info.last_update} or resync unfinished)")
+        # mark incomplete FIRST: a crash mid-resync must retry, not trust
+        self.info.backfill_complete = False
+        txn = Transaction()
+        self.save_meta(txn)
+        self.osd.store.apply_transaction(txn)
+        # both-sides scan: fetch the auth peer's object listing
+        fut = asyncio.get_running_loop().create_future()
+        self._list_waiters[peer] = fut
+        self.osd.send_osd(peer, MPGLogRequest(
+            self.pgid.with_shard(self.shard_of(peer)), epoch,
+            EVersion.zero(), self.osd.whoami, want_list=True))
+        try:
+            names = await asyncio.wait_for(fut, 15.0)
+        finally:
+            self._list_waiters.pop(peer, None)
+        keep = set(names)
+        txn = Transaction()
+        for soid in self.osd.store.collection_list(self.cid):
+            if soid.name != self.meta_oid.name and soid.name not in keep:
+                txn.remove(self.cid, soid)
+        # adopt the authoritative log/info wholesale
+        self.log = auth_log
+        self.reqids = self.log.reqids()
+        self.info.last_update = auth_info.last_update
+        self.info.last_complete = auth_info.last_update
+        self.save_meta(txn)
+        self.osd.store.apply_transaction(txn)
+        for oid in names:
+            if epoch != self.interval_epoch:
+                return    # superseded; backfill_complete stays False
+            await self.backend.pull_object(peer, oid, epoch)
+        self.missing = MissingSet()
+        self.info.backfill_complete = True
+        txn = Transaction()
+        self.save_meta(txn)
+        self.osd.store.apply_transaction(txn)
+        self.log_.info(f"{self.pgid}: self-resync complete "
+                       f"({len(names)} objects)")
+
     async def pull_object_via_push(self, peer: int, oid: str,
                                    epoch: int) -> None:
         """Whole-object pull: ask peer to push its copy (replicated)."""
@@ -276,27 +344,49 @@ class PG:
     async def _activate(self, epoch: int) -> None:
         """Ship logs to peers, compute their missing sets, go active."""
         me = self.osd.whoami
+        self._backfilling.clear()
         for p, pi in self.peer_info.items():
             if p not in self.acting and p not in self.up:
                 continue
             pm = MissingSet()
-            if not pi.is_empty() and \
-                    self.log.can_catch_up_from(pi.last_update):
+            # a peer is in sync if it is empty along with us (initial
+            # activation), or backfill-complete and within the log window
+            in_sync = ((pi.is_empty() and self.info.is_empty())
+                       or (not pi.is_empty() and pi.backfill_complete
+                           and self.log.can_catch_up_from(pi.last_update)))
+            full_resync = not in_sync
+            if not full_resync:
                 for oid, e in self.log.objects_since(pi.last_update).items():
                     if not e.is_delete():
                         pm.add(oid, e.version)
             else:
-                # too far behind: full resync (Backfill role)
+                # too far behind: full resync (Backfill role).  The peer
+                # drops its own objects first (full_resync flag) so
+                # anything deleted beyond the log window can't survive
+                # there and resurrect later (reference backfill scans
+                # both sides; ADVICE r1).
                 for soid in self.osd.store.collection_list(self.cid):
                     if soid.name != self.meta_oid.name:
                         pm.add(soid.name, self.info.last_update)
+                self._backfilling.add(p)
             self.peer_missing[p] = pm
             self.osd.send_osd(p, MPGLog(
                 self.pgid.with_shard(self.shard_of(p)), epoch,
                 self.info.to_bytes(), self.log.to_bytes(), me,
-                activate=True))
+                activate=True, full_resync=full_resync))
         if epoch != self.interval_epoch:
             return   # superseded meanwhile
+        if not self.info.backfill_complete:
+            # our own copy is mid-resync and no complete peer was
+            # reachable: serving would return ENOENT for objects we
+            # simply don't have yet — stay peering and retry
+            self.log_.warning(f"{self.pgid}: incomplete local copy, no "
+                              f"complete peer; retrying peering")
+            await asyncio.sleep(1.0)
+            if epoch == self.interval_epoch:
+                self._peering_task = asyncio.get_running_loop().create_task(
+                    self._peer())
+            return
         self.info.last_epoch_started = epoch
         self.state = STATE_ACTIVE
         self._active_event.set()
@@ -306,8 +396,10 @@ class PG:
         self.osd.note_pg_active(self)
         self.log_.info(f"{self.describe()} (activated "
                        f"{len(self.peer_info)} peers)")
-        # background recovery of peer missing objects
-        if any(self.peer_missing.values()):
+        # background recovery of peer missing objects; must also run when
+        # a backfilling peer has nothing to pull so its backfill_done
+        # confirmation still goes out
+        if any(self.peer_missing.values()) or self._backfilling:
             asyncio.get_running_loop().create_task(self._recover(epoch))
 
     async def _recover(self, epoch: int) -> None:
@@ -320,6 +412,16 @@ class PG:
                         return
                     await self.backend.recover_object(p, oid)
                     pm.items.pop(oid, None)
+                if p in self._backfilling and not pm.items \
+                        and epoch == self.interval_epoch:
+                    # every object pushed: the peer may now trust its copy
+                    self._backfilling.discard(p)
+                    if p in self.peer_info:
+                        self.peer_info[p].backfill_complete = True
+                    self.osd.send_osd(p, MPGLog(
+                        self.pgid.with_shard(self.shard_of(p)), epoch,
+                        self.info.to_bytes(), self.log.to_bytes(),
+                        self.osd.whoami, activate=True, backfill_done=True))
             self.log_.debug(f"{self.pgid} recovery complete")
         except asyncio.CancelledError:
             raise
@@ -344,6 +446,13 @@ class PG:
             fut.set_result(PGInfo.from_bytes(m.info_bytes))
 
     def on_log_request(self, m: MPGLogRequest) -> None:
+        if m.want_list:
+            names = [soid.name
+                     for soid in self.osd.store.collection_list(self.cid)
+                     if soid.name != self.meta_oid.name]
+            self.osd.send_osd(m.from_osd, MPGObjectList(
+                m.pgid, names, self.osd.whoami))
+            return
         if m.want_object:
             self.backend.push_object(m.from_osd, m.want_object,
                                      self.info.last_update)
@@ -355,16 +464,40 @@ class PG:
     def on_pg_log(self, m: MPGLog) -> None:
         if m.activate:
             # primary activated us: adopt info/log (replica path)
+            since = self.info.last_update
+            new_log = PGLog.from_bytes(m.log_bytes)
+            txn = Transaction()
+            if m.full_resync:
+                # drop everything we hold — the primary re-pushes its
+                # full object set; peer-only objects must not survive
+                for soid in self.osd.store.collection_list(self.cid):
+                    if soid.name != self.meta_oid.name:
+                        txn.remove(self.cid, soid)
+            else:
+                # apply log-window deletions: adopting the log alone
+                # would leave the object bytes in our store
+                for oid, e in new_log.objects_since(since).items():
+                    if e.is_delete():
+                        txn.remove(self.cid, self.object_id(oid))
+            prev_complete = self.info.backfill_complete
             self.info = PGInfo.from_bytes(m.info_bytes)
             self.info.pgid = self.pgid
-            self.log = PGLog.from_bytes(m.log_bytes)
+            # the adopted info carries the PRIMARY's backfill state; ours
+            # is: mid-resync until the primary confirms every push landed
+            if m.full_resync:
+                self.info.backfill_complete = False
+            elif m.backfill_done:
+                self.info.backfill_complete = True
+            else:
+                self.info.backfill_complete = prev_complete
+            self.log = new_log
             self.reqids = self.log.reqids()
             self.state = STATE_ACTIVE
             self._active_event.set()
-            txn = Transaction()
             self.save_meta(txn)
             self.osd.store.apply_transaction(txn)
-            self.log_.debug(f"{self.pgid} activated by osd.{m.from_osd}")
+            self.log_.debug(f"{self.pgid} activated by osd.{m.from_osd}"
+                            + (" (full resync)" if m.full_resync else ""))
         else:
             fut = self._log_waiters.get(m.from_osd)
             if fut is not None and not fut.done():
@@ -377,6 +510,11 @@ class PG:
         fut = self._pull_waiters.get(m.oid)
         if fut is not None and not fut.done():
             fut.set_result(True)
+
+    def on_object_list(self, m: MPGObjectList) -> None:
+        fut = self._list_waiters.get(m.from_osd)
+        if fut is not None and not fut.done():
+            fut.set_result(list(m.names))
 
     def on_push_reply(self, m: MPGPushReply) -> None:
         fut = self._push_acks.get((m.from_osd, m.oid))
@@ -427,13 +565,17 @@ class PG:
             self.osd.reply_to(m, MOSDOpReply(
                 m.tid, 0, m.ops, self.osd.osdmap.epoch))
             return
-        if has_write:
-            # recover-before-write: peers must have the current object
-            # before a mutation lands on top of it
-            await self._recover_object_everywhere(m.oid)
-            result = await self.backend.submit_client_write(m)
-        else:
-            result = await self.backend.do_reads(m)
+        from ceph_tpu.osd.backend import PGIntervalChanged
+        try:
+            if has_write:
+                # recover-before-write: peers must have the current object
+                # before a mutation lands on top of it
+                await self._recover_object_everywhere(m.oid)
+                result = await self.backend.submit_client_write(m)
+            else:
+                result = await self.backend.do_reads(m)
+        except PGIntervalChanged:
+            result = -errno.EAGAIN
         self.osd.reply_to(m, MOSDOpReply(
             m.tid, result, m.ops, self.osd.osdmap.epoch))
 

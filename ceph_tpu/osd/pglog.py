@@ -56,8 +56,11 @@ class LogEntry(Encodable):
 class PGInfo(Encodable):
     """pg_info_t distilled: identity + log bounds + interval history."""
 
+    STRUCT_V = 2
+
     __slots__ = ("pgid", "last_update", "last_complete", "log_tail",
-                 "last_epoch_started", "same_interval_since")
+                 "last_epoch_started", "same_interval_since",
+                 "backfill_complete")
 
     def __init__(self, pgid: Optional[PGId] = None):
         self.pgid = pgid or PGId(0, 0)
@@ -66,6 +69,11 @@ class PGInfo(Encodable):
         self.log_tail = EVersion()         # oldest log entry we hold
         self.last_epoch_started = 0        # last epoch the pg went active
         self.same_interval_since = 0       # epoch the acting set last changed
+        # full-resync progress marker (the last_backfill cursor role,
+        # PG.h:1911): False from the moment a full resync starts until
+        # the primary confirms every object was pushed, so an
+        # interrupted backfill is retried instead of trusted
+        self.backfill_complete = True
 
     def is_empty(self) -> bool:
         return self.last_update == EVersion.zero()
@@ -74,6 +82,7 @@ class PGInfo(Encodable):
         enc.struct(self.pgid).struct(self.last_update)
         enc.struct(self.last_complete).struct(self.log_tail)
         enc.u32(self.last_epoch_started).u32(self.same_interval_since)
+        enc.boolean(self.backfill_complete)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "PGInfo":
@@ -83,6 +92,8 @@ class PGInfo(Encodable):
         i.log_tail = dec.struct(EVersion)
         i.last_epoch_started = dec.u32()
         i.same_interval_since = dec.u32()
+        if struct_v >= 2:
+            i.backfill_complete = dec.boolean()
         return i
 
     def __repr__(self):

@@ -60,6 +60,22 @@ def build_command(args, extra) -> dict:
                 cmd["m"] = args.m
             if args.size:
                 cmd["size"] = args.size
+        elif words[1] == "erasure-code-profile" and len(words) > 2:
+            cmd = {"prefix": f"osd erasure-code-profile {words[2]}"}
+            if len(words) > 3:
+                cmd["name"] = words[3]
+            if words[2] == "set":
+                prof = {}
+                if args.k:
+                    prof["k"] = str(args.k)
+                if args.m:
+                    prof["m"] = str(args.m)
+                for kv in list(extra):
+                    k, eq, v = kv.partition("=")
+                    if eq:
+                        prof[k.lstrip("-")] = v
+                        extra.remove(kv)
+                cmd["profile"] = prof
         elif words[1] in ("out", "in", "down") and len(words) > 2:
             cmd = {"prefix": f"osd {words[1]}", "id": int(words[2])}
         elif words[1] == "getmap":

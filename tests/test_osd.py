@@ -294,3 +294,87 @@ def test_heartbeat_failure_reporting():
             await asyncio.sleep(0.1)
         await cl.stop()
     asyncio.run(run())
+
+
+def test_ec_profile_persisted_and_honored():
+    """ADVICE r1: a profile with m=3 must actually run 3 parity shards —
+    the k/m live in the osdmap's ec_profiles, never derived from size."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(6)
+        await admin.mon_command({
+            "prefix": "osd erasure-code-profile set", "name": "p33",
+            "profile": {"k": "3", "m": "3"}})
+        ack = await admin.mon_command(
+            {"prefix": "osd erasure-code-profile get", "name": "p33"})
+        assert ack.retcode == 0 and '"m": "3"' in ack.outs
+        # contradicting k/m at pool create is rejected
+        from ceph_tpu.mon.client import CommandError
+        with pytest.raises(CommandError):
+            await admin.mon_command({
+                "prefix": "osd pool create", "pool": "bad", "pg_num": 4,
+                "pool_type": "erasure", "erasure_code_profile": "p33",
+                "k": 4, "m": 2})
+        await admin.pool_create("ec33", pg_num=4, pool_type="erasure",
+                                erasure_code_profile="p33")
+        pid = admin.monc.osdmap.lookup_pool("ec33")
+        pool = admin.monc.osdmap.pools[pid]
+        assert pool.size == 6 and \
+            admin.monc.osdmap.ec_profiles["p33"]["m"] == "3"
+        io = admin.open_ioctx("ec33")
+        payload = bytes(range(256)) * 48   # 12 KiB -> 4 KiB chunks (k=3)
+        await io.write_full("obj", payload)
+        assert await io.read("obj") == payload
+        # 3 data + 3 parity shards on distinct osds
+        chunks = 0
+        for osd in cl.osds.values():
+            for cid in osd.store.list_collections():
+                for soid in osd.store.collection_list(cid):
+                    if soid.name == "obj":
+                        chunks += 1
+        assert chunks == 6
+        # in-use profile can't be removed
+        with pytest.raises(CommandError):
+            await admin.mon_command(
+                {"prefix": "osd erasure-code-profile rm", "name": "p33"})
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_full_resync_removes_peer_only_objects():
+    """ADVICE r1: an object deleted beyond the log window must not
+    survive on a peer that was down across the deletion (backfill scans
+    both sides in the reference)."""
+    from ceph_tpu.osd.pglog import PGLog
+
+    async def run():
+        old_max = PGLog.MAX_ENTRIES
+        PGLog.MAX_ENTRIES = 8    # force the catch-up window shut fast
+        try:
+            cl = Cluster()
+            admin = await cl.start(2)
+            await admin.pool_create("rep", pg_num=1, size=2)
+            io = admin.open_ioctx("rep")
+            await io.write_full("doomed", b"zombie" * 10)
+            await io.write_full("keep", b"alive")
+            store1 = await cl.kill_osd(1)
+            await cl.mark_down_and_wait(admin, 1)
+            await io.remove("doomed")
+            # push the delete out of the log window
+            for i in range(12):
+                await io.write_full(f"fill-{i}", bytes([i]) * 16)
+            # osd.1 comes back with its stale store -> full resync
+            await cl.start_osd(1, store=store1)
+            await cl.osds[1].wait_for_boot()
+            await asyncio.sleep(2.0)
+            osd1 = cl.osds[1]
+            names = set()
+            for cid in osd1.store.list_collections():
+                for soid in osd1.store.collection_list(cid):
+                    names.add(soid.name)
+            assert "doomed" not in names, "deleted object resurrected"
+            assert "keep" in names and "fill-5" in names
+            await cl.stop()
+        finally:
+            PGLog.MAX_ENTRIES = old_max
+    asyncio.run(run())
