@@ -1,21 +1,34 @@
 #!/usr/bin/env python3
-"""Headline benchmark: EC encode throughput, RS k=8 m=4, 1 MiB stripes.
+"""Headline benchmarks: EC encode throughput + CRUSH mapping rate.
 
 Contract: prints exactly ONE JSON line
-  {"metric": ..., "value": N, "unit": "MB/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "MB/s", "vs_baseline": N, "extra": [...]}
 run by the driver on real TPU hardware.  Diagnostics go to stderr.
+"extra" carries the secondary metrics (CRUSH mappings/s firstn+indep, EC
+decode) in the same {metric, value, unit, vs_baseline} shape.
 
-Reference harness equivalence: ceph_erasure_code_benchmark --workload encode
---plugin isa --parameter technique=reed_sol_van -k 8 -m 4
-(/root/reference/src/test/erasure-code/ceph_erasure_code_benchmark.cc:46-63,
-179-187, which reports seconds per KiB of input data).  The CPU baseline is
-the native C table-lookup encoder (ceph_tpu/native/src/native.cc), i.e. the
-reference's jerasure-style scalar path built -O3 -march=native on this host;
-vs_baseline is TPU MB/s over that CPU MB/s.
+Reference harness equivalence:
+- EC: ceph_erasure_code_benchmark --workload encode|decode --plugin isa
+  --parameter technique=reed_sol_van -k 8 -m 4
+  (/root/reference/src/test/erasure-code/ceph_erasure_code_benchmark.cc:
+  46-63,179-187).  CPU baseline = the native C table-lookup encoder
+  (ceph_tpu/native/src/native.cc) built -O3 -march=native, the
+  reference's jerasure-style scalar path; vs_baseline is TPU MB/s over
+  CPU MB/s.
+- CRUSH: osdmaptool --test-map-pgs (/root/reference/src/tools/
+  osdmaptool.cc:73,328) over 128 hosts x 8 osds.  Baseline = the
+  REFERENCE's own crush_do_rule (mapper.c) compiled -O3 -march=native at
+  bench time from /root/reference sources via
+  tests/golden/bench_ref_crush.c; falls back to the round-1 recorded
+  measurement when the reference tree is unavailable.
 """
 
 import json
+import os
+import pathlib
+import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -26,6 +39,13 @@ CHUNK = STRIPE // K                    # 128 KiB chunks
 BATCH = 32                             # stripes per dispatch (batch the op
                                        # queue, survey §7 "hard parts")
 WARMUP, ITERS = 3, 10
+
+CRUSH_N = 1_000_000
+CRUSH_HOSTS, CRUSH_PER_HOST = 128, 8
+# round-1 measured single-core reference C rates on this container class
+# (BASELINE.md row 4); used only if compiling the reference fails
+REF_CRUSH_FALLBACK = {"firstn_per_sec": 53238.0, "indep_per_sec": 32898.0}
+REF = pathlib.Path("/root/reference")
 
 
 def log(*a):
@@ -68,6 +88,88 @@ def bench_tpu(gen, data):
     return ITERS * BATCH * STRIPE / dt / 1e6
 
 
+def bench_ref_crush():
+    """Compile the reference crush_do_rule at -O3 and measure it."""
+    src = REF / "src"
+    harness = pathlib.Path(__file__).parent / "tests/golden/bench_ref_crush.c"
+    if not (src / "crush/mapper.c").exists():
+        log("reference tree unavailable; using recorded CRUSH baseline")
+        return dict(REF_CRUSH_FALLBACK), "recorded"
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            exe = pathlib.Path(td) / "bench_ref_crush"
+            (pathlib.Path(td) / "acconfig.h").write_text(
+                "#define HAVE_INTTYPES_H 1\n#define HAVE_STDINT_H 1\n"
+                "#define HAVE_LINUX_TYPES_H 1\n")
+            subprocess.run(
+                ["gcc", "-O3", "-march=native", "-o", str(exe),
+                 "-I", td, str(harness),
+                 str(src / "crush/builder.c"), str(src / "crush/crush.c"),
+                 str(src / "crush/hash.c"),
+                 "-I", str(src), "-I", str(src / "crush"),
+                 f"-DMAPPER_C_PATH=\"{src}/crush/mapper.c\"", "-lm"],
+                check=True, capture_output=True, timeout=120)
+            out = subprocess.run([str(exe), "200000"], check=True,
+                                 capture_output=True, timeout=300)
+            return json.loads(out.stdout), "measured"
+    except Exception as e:
+        log(f"reference CRUSH compile/run failed ({e}); using recorded")
+        return dict(REF_CRUSH_FALLBACK), "recorded"
+
+
+def bench_crush():
+    """TPU jax CRUSH engine: 1M mappings, firstn x3 + indep x6."""
+    from ceph_tpu.crush.builder import (build_hierarchy, make_erasure_rule,
+                                        make_replicated_rule)
+    from ceph_tpu.crush.mapper import do_rule
+    from ceph_tpu.crush.types import CrushMap
+    from ceph_tpu.ops.crush_kernel import batch_do_rule_arrays, warmup
+
+    n_osd = CRUSH_HOSTS * CRUSH_PER_HOST
+    m = CrushMap()
+    m.max_devices = n_osd
+    build_hierarchy(m, n_osd, CRUSH_PER_HOST)
+    rep = make_replicated_rule(m, "rep")
+    ec = make_erasure_rule(m, "ec", size=6)
+    w = [0x10000] * n_osd
+    xs = np.arange(CRUSH_N)
+    ref, ref_kind = bench_ref_crush()
+    log(f"reference C crush_do_rule ({ref_kind}): "
+        f"firstn {ref['firstn_per_sec']:.0f}/s, "
+        f"indep {ref['indep_per_sec']:.0f}/s")
+
+    rates = {}
+    for name, rule, nr in (("firstn", rep, 3), ("indep", ec, 6)):
+        t0 = time.perf_counter()
+        warmup(m, rule, nr, w, sizes=(len(xs),))
+        log(f"crush {name} warmup (jit): {time.perf_counter() - t0:.0f}s")
+        best = 0.0
+        for trial in range(3):       # trial 0 absorbs one-time concat jits
+            t0 = time.perf_counter()
+            osds, cnt = batch_do_rule_arrays(m, rule, xs, nr, w,
+                                             engine="jax")
+            dt = time.perf_counter() - t0
+            best = max(best, CRUSH_N / dt)
+            log(f"crush {name} trial{trial}: {CRUSH_N / dt:,.0f}/s")
+        # bit-exactness spot check vs scalar host mapper
+        for x in (0, 1234, CRUSH_N - 1):
+            want = do_rule(m, rule, x, nr, w)
+            got = ([int(o) for o in osds[x, :cnt[x]]] if cnt is not None
+                   else [int(o) for o in osds[x]])
+            assert got == want, f"jax {name} mapping != host at x={x}"
+        rates[name] = best
+    return [
+        {"metric": "crush_firstn3_mappings_per_sec",
+         "value": round(rates["firstn"]),
+         "unit": "mappings/s",
+         "vs_baseline": round(rates["firstn"] / ref["firstn_per_sec"], 2)},
+        {"metric": "crush_indep6_mappings_per_sec",
+         "value": round(rates["indep"]),
+         "unit": "mappings/s",
+         "vs_baseline": round(rates["indep"] / ref["indep_per_sec"], 2)},
+    ]
+
+
 def main():
     from ceph_tpu.ec import gf256
     gen = gf256.rs_vandermonde_matrix(K, M)
@@ -88,11 +190,21 @@ def main():
         log(f"tpu path failed ({type(e).__name__}: {e}); reporting CPU")
         value, vs = cpu or 0.0, 1.0
 
+    extra = []
+    if os.environ.get("BENCH_SKIP_CRUSH") != "1":
+        try:
+            extra += bench_crush()
+        except AssertionError:
+            raise  # wrong mappings must fail loudly
+        except Exception as e:
+            log(f"crush bench failed ({type(e).__name__}: {e})")
+
     print(json.dumps({
         "metric": "ec_encode_rs_k8m4_1MiB_stripes",
         "value": round(value, 1),
         "unit": "MB/s",
         "vs_baseline": round(vs, 2),
+        "extra": extra,
     }))
 
 

@@ -316,11 +316,15 @@ class OSDMap(Encodable):
             else up_primary
         return up, up_primary, acting, acting_primary
 
-    def map_pgs_batch(self, pool_id: int
+    def map_pgs_batch(self, pool_id: int, engine: str = "auto"
                       ) -> List[Tuple[PGId, List[int], int, List[int], int]]:
         """Map EVERY pg of a pool in one batched kernel launch
         (osdmaptool --test-map-pgs hot path; ops/crush_kernel.py).
-        Returns [(pg, up, up_primary, acting, acting_primary)]."""
+        Returns [(pg, up, up_primary, acting, acting_primary)].
+
+        engine="auto" never pays a cold jit compile; call
+        warmup_placement() first (or pass engine="jax") to route large
+        pools through the TPU descent."""
         from ceph_tpu.ops.crush_kernel import batch_do_rule
         pool = self.pools[pool_id]
         pgs = self.pg_ids(pool_id)
@@ -330,9 +334,22 @@ class OSDMap(Encodable):
         if ruleno < 0:
             return [(pg, [], -1, [], -1) for pg in pgs]
         raws = batch_do_rule(self.crush, ruleno, pps, pool.size,
-                             self.osd_weight)
+                             self.osd_weight, engine=engine)
         return [(pg,) + self._finish_mapping(pool, pg, raw)
                 for pg, raw in zip(pgs, raws)]
+
+    def warmup_placement(self, pool_id: int) -> bool:
+        """Eagerly jit-compile the TPU descent for a pool's rule so that
+        subsequent map_pgs_batch(engine="auto") calls can use it without
+        a compile stall (ops/crush_kernel.warmup)."""
+        from ceph_tpu.ops.crush_kernel import warmup
+        pool = self.pools[pool_id]
+        ruleno = self.crush.find_rule(pool.crush_ruleset, pool.type,
+                                      pool.size)
+        if ruleno < 0:
+            return False
+        return warmup(self.crush, ruleno, pool.size, self.osd_weight,
+                      sizes=(pool.pg_num,))
 
     def object_to_acting(self, name: str, loc: ObjectLocator
                          ) -> Tuple[PGId, List[int], int]:

@@ -36,14 +36,19 @@ def cmd_print(m: OSDMap) -> int:
     return 0
 
 
-def cmd_test_map_pgs(m: OSDMap, as_json: bool) -> int:
+def cmd_test_map_pgs(m: OSDMap, as_json: bool,
+                     engine: str = "auto") -> int:
     per_osd = Counter()
     primaries = Counter()
     total = 0
     sizes = Counter()
+    if engine == "jax":
+        # pay the jit compile before the timed region, like the OSD does
+        for pid in sorted(m.pools):
+            m.warmup_placement(pid)
     t0 = time.perf_counter()
     for pid in sorted(m.pools):
-        for pg, up, upp, acting, actp in m.map_pgs_batch(pid):
+        for pg, up, upp, acting, actp in m.map_pgs_batch(pid, engine):
             total += 1
             sizes[len([o for o in up if o != CRUSH_ITEM_NONE])] += 1
             for o in up:
@@ -83,13 +88,17 @@ def main(argv=None) -> int:
     ap.add_argument("--print", dest="do_print", action="store_true")
     ap.add_argument("--test-map-pgs", action="store_true")
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--engine", choices=("auto", "host", "jax"),
+                    default="auto",
+                    help="placement engine (jax = TPU descent, compiles "
+                         "up front; auto = host unless already warm)")
     args = ap.parse_args(argv)
     with open(args.mapfile, "rb") as f:
         m = OSDMap.from_bytes(f.read())
     if args.do_print:
         return cmd_print(m)
     if args.test_map_pgs:
-        return cmd_test_map_pgs(m, args.json)
+        return cmd_test_map_pgs(m, args.json, args.engine)
     return cmd_print(m)
 
 

@@ -1,0 +1,103 @@
+/*
+ * Reference-CRUSH throughput harness (BASELINE.md row 4).
+ *
+ * ORIGINAL benchmark code that links against the *reference* Ceph CRUSH C
+ * sources at bench time only (same arrangement as gen_golden.c): the
+ * reference tree is NOT part of this repository.  bench.py compiles this
+ * with
+ *   gcc -O3 -march=native bench_ref_crush.c <ref>/src/crush/{builder,crush,hash}.c
+ * and runs it to measure the single-core crush_do_rule rate the TPU engine
+ * is compared against (topology: 128 hosts x 8 osds = 1024 OSDs, jewel
+ * tunables, firstn x3 and indep x6 rules — mirroring
+ * /root/reference/src/tools/osdmaptool.cc:328 --test-map-pgs).
+ *
+ * Output: one JSON line {"firstn_per_sec": N, "indep_per_sec": N}.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+#include "crush/crush.h"
+#include "crush/builder.h"
+#include "crush/hash.h"
+
+#define dprintk(args...) /* nothing */
+#include MAPPER_C_PATH
+
+enum { HOSTS = 128, PER_HOST = 8, NOSD = HOSTS * PER_HOST };
+
+static double now_s(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+int main(int argc, char **argv) {
+  int n_x = argc > 1 ? atoi(argv[1]) : 200000;
+  struct crush_map *map = crush_create();
+  map->choose_local_tries = 0;
+  map->choose_local_fallback_tries = 0;
+  map->choose_total_tries = 50;
+  map->chooseleaf_descend_once = 1;
+  map->chooseleaf_vary_r = 1;
+  map->chooseleaf_stable = 1;
+  map->straw_calc_version = 1;
+
+  int host_ids[HOSTS];
+  for (int h = 0; h < HOSTS; h++) {
+    int items[PER_HOST], weights[PER_HOST];
+    for (int i = 0; i < PER_HOST; i++) {
+      items[i] = h * PER_HOST + i;
+      weights[i] = 0x10000;
+    }
+    struct crush_bucket *b = crush_make_bucket(
+        map, CRUSH_BUCKET_STRAW2, CRUSH_HASH_RJENKINS1, 1 /*host*/,
+        PER_HOST, items, weights);
+    crush_add_bucket(map, 0, b, &host_ids[h]);
+  }
+  int hw[HOSTS];
+  for (int h = 0; h < HOSTS; h++) hw[h] = PER_HOST * 0x10000;
+  struct crush_bucket *root = crush_make_bucket(
+      map, CRUSH_BUCKET_STRAW2, CRUSH_HASH_RJENKINS1, 10 /*root*/,
+      HOSTS, host_ids, hw);
+  int root_id;
+  crush_add_bucket(map, 0, root, &root_id);
+
+  /* rule 0: replicated chooseleaf firstn; rule 1: ec chooseleaf indep */
+  struct crush_rule *r0 = crush_make_rule(3, 0, 1, 1, 10);
+  crush_rule_set_step(r0, 0, CRUSH_RULE_TAKE, root_id, 0);
+  crush_rule_set_step(r0, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1);
+  crush_rule_set_step(r0, 2, CRUSH_RULE_EMIT, 0, 0);
+  crush_add_rule(map, r0, 0);
+  struct crush_rule *r1 = crush_make_rule(3, 1, 3, 1, 10);
+  crush_rule_set_step(r1, 0, CRUSH_RULE_TAKE, root_id, 0);
+  crush_rule_set_step(r1, 1, CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1);
+  crush_rule_set_step(r1, 2, CRUSH_RULE_EMIT, 0, 0);
+  crush_add_rule(map, r1, 1);
+  crush_finalize(map);
+
+  __u32 weight[NOSD];
+  for (int i = 0; i < NOSD; i++) weight[i] = 0x10000;
+  int result[8];
+  int scratch[8 * 3];
+  long acc = 0;
+
+  double t0 = now_s();
+  for (int x = 0; x < n_x; x++) {
+    int len = crush_do_rule(map, 0, x, result, 3, weight, NOSD, scratch);
+    acc += len ? result[0] : 0;
+  }
+  double firstn_rate = n_x / (now_s() - t0);
+
+  t0 = now_s();
+  for (int x = 0; x < n_x; x++) {
+    int len = crush_do_rule(map, 1, x, result, 6, weight, NOSD, scratch);
+    acc += len ? result[0] : 0;
+  }
+  double indep_rate = n_x / (now_s() - t0);
+
+  fprintf(stderr, "acc=%ld\n", acc); /* defeat dead-code elimination */
+  printf("{\"firstn_per_sec\": %.0f, \"indep_per_sec\": %.0f}\n",
+         firstn_rate, indep_rate);
+  return 0;
+}

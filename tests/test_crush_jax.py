@@ -1,0 +1,126 @@
+"""Direct engine="jax" bit-exactness tests (VERDICT r2 weak #1a).
+
+These call batch_do_rule(engine="jax") explicitly — no auto-routing — so
+the jitted descent itself is validated, on whatever backend the test
+host has (CPU under the conftest virtual mesh; the identical code path
+runs on TPU in bench.py).  Weight grids include degraded and fractional
+vectors where the retry paths fire, and a FAST_TRIES=1 variant forces
+lanes through the straggler FULL (while_loop) path.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.builder import (build_hierarchy, make_erasure_rule,
+                                    make_replicated_rule)
+from ceph_tpu.crush.mapper import do_rule
+from ceph_tpu.crush.types import CrushMap
+from ceph_tpu.ops import crush_kernel
+from ceph_tpu.ops.crush_kernel import (JaxEngine, batch_do_rule,
+                                       compile_rule, engine_is_warm,
+                                       warmup)
+
+N_X = 300   # deliberately NOT a chunk size: exercises padding
+
+
+def build(n_osds, per_host, ec_size=6):
+    m = CrushMap()
+    m.max_devices = n_osds
+    build_hierarchy(m, n_osds, per_host)
+    rep = make_replicated_rule(m, "rep")
+    ec = make_erasure_rule(m, "ec", size=ec_size)
+    return m, rep, ec
+
+
+def assert_jax_match(m, rule, numrep, weights, xs=None):
+    xs = xs if xs is not None else list(range(N_X))
+    got = batch_do_rule(m, rule, xs, numrep, weights, engine="jax")
+    want = [do_rule(m, rule, x, numrep, weights) for x in xs]
+    mism = [(x, w, g) for x, w, g in zip(xs, want, got) if w != g]
+    assert not mism, f"{len(mism)} mismatches, first: {mism[:3]}"
+
+
+WEIGHT_CASES = [
+    ("uniform", lambda n: [0x10000] * n),
+    ("degraded", lambda n: [0 if i % 4 == 0 else 0x10000
+                            for i in range(n)]),
+    ("fractional", lambda n: [(0x3000 + 0x1800 * (i % 7)) & 0xFFFF or
+                              0x10000 for i in range(n)]),
+    ("mixed", lambda n: [0 if i % 5 == 0 else
+                         (0x8000 if i % 3 == 0 else 0x10000)
+                         for i in range(n)]),
+]
+
+
+@pytest.mark.parametrize("wname,wfn", WEIGHT_CASES)
+def test_jax_firstn_bit_exact(wname, wfn):
+    m, rep, _ = build(12, 2)
+    for numrep in (1, 3):
+        assert_jax_match(m, rep, numrep, wfn(12))
+
+
+@pytest.mark.parametrize("wname,wfn", WEIGHT_CASES)
+def test_jax_indep_bit_exact(wname, wfn):
+    m, _, ec = build(12, 2, ec_size=6)
+    assert_jax_match(m, ec, 6, wfn(12))
+
+
+def test_jax_straggler_full_path(monkeypatch):
+    # FAST_TRIES=1 leaves every lane that needs a second try unresolved,
+    # forcing the compacted straggler batch through the FULL while_loop
+    # descent — results must still be bit-exact.
+    monkeypatch.setattr(JaxEngine, "FAST_TRIES", 1)
+    crush_kernel._engine_cache.clear()
+    m, rep, ec = build(12, 2, ec_size=6)
+    w = [0 if i % 3 == 0 else 0x10000 for i in range(12)]  # heavy outs
+    assert_jax_match(m, rep, 3, w)
+    assert_jax_match(m, ec, 6, w)
+    crush_kernel._engine_cache.clear()
+
+
+def test_jax_fuzz_weights_and_xs():
+    rng = np.random.default_rng(11)
+    m, rep, ec = build(16, 2, ec_size=6)
+    for _ in range(3):
+        w = rng.choice([0, 0x3000, 0x8000, 0xC000, 0x10000],
+                       size=16).tolist()
+        xs = rng.integers(0, 2**31, 200).tolist()
+        assert_jax_match(m, rep, 3, w, xs)
+        assert_jax_match(m, ec, 6, w, xs)
+
+
+def test_auto_routes_host_until_warm():
+    # engine="auto" must NEVER pay a cold jit compile: it stays on the
+    # host engine until warmup() has been called for the topology.
+    crush_kernel._engine_cache.clear()
+    m, rep, _ = build(8, 2)
+    w = [0x10000] * 8
+    cr = compile_rule(m, rep)
+    assert cr is not None
+    assert not engine_is_warm(cr, w, 3)
+    # auto on a big batch: host path (cache stays cold)
+    batch_do_rule(m, rep, list(range(5000)), 3, w, engine="auto")
+    assert not engine_is_warm(cr, w, 3)
+    assert warmup(m, rep, 3, w)
+    assert engine_is_warm(cr, w, 3)
+    got = batch_do_rule(m, rep, list(range(512)), 3, w, engine="jax")
+    want = [do_rule(m, rep, x, 3, w) for x in range(512)]
+    assert got == want
+
+
+def test_jax_reweight_reuses_compiled_fn():
+    # weights are traced args: a reweight must not grow the jit cache
+    m, rep, _ = build(12, 2)
+    eng = crush_kernel._jax_engine(compile_rule(m, rep), [0x10000] * 12)
+    assert_jax_match(m, rep, 3, [0x10000] * 12)
+    n_compiled = len(eng._fns)
+    assert_jax_match(m, rep, 3, [0x8000] * 12)     # reweighted
+    assert len(eng._fns) == n_compiled
+
+
+def test_jax_more_reps_than_hosts():
+    # impossible placements: firstn short sets, indep holes — the FULL
+    # path runs to try exhaustion without hanging
+    m, rep, ec = build(6, 2, ec_size=6)   # only 3 hosts
+    assert_jax_match(m, rep, 5, [0x10000] * 6)
+    assert_jax_match(m, ec, 6, [0x10000] * 6)
