@@ -51,6 +51,12 @@ def _load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(str(_SO))
         except OSError:
             return None
+        return _bind(lib)
+
+
+def _bind(lib: ctypes.CDLL) -> Optional[ctypes.CDLL]:
+    global _lib
+    try:
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u32p = ctypes.POINTER(ctypes.c_uint32)
         lib.ceph_crc32c.restype = ctypes.c_uint32
@@ -61,6 +67,10 @@ def _load() -> Optional[ctypes.CDLL]:
             u32p, ctypes.c_uint32, ctypes.c_uint32, u32p, ctypes.c_uint64]
         lib.ceph_gf_matrix_apply.argtypes = [
             u8p, ctypes.c_int, ctypes.c_int, u8p, u8p, ctypes.c_uint64]
+        lib.ceph_gf_matrix_apply_scalar.argtypes = \
+            lib.ceph_gf_matrix_apply.argtypes
+        lib.ceph_gf_simd_available.restype = ctypes.c_int
+        lib.ceph_gf_simd_available.argtypes = []
         lib.ceph_region_xor.argtypes = [u8p, u8p, u8p, ctypes.c_uint64]
         i32p = ctypes.POINTER(ctypes.c_int32)
         i64p = ctypes.POINTER(ctypes.c_int64)
@@ -70,8 +80,13 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ceph_straw2_winner_shared.argtypes = [
             i32p, i64p, ctypes.c_int32, u32p, u32p, ctypes.c_int64, i64p,
             i32p]
-        _lib = lib
-        return _lib
+    except AttributeError:
+        # stale prebuilt .so missing newer symbols (no compiler to
+        # rebuild): degrade to unavailable, never raise out of _load —
+        # callers rely on available() -> False for the pure-python paths
+        return None
+    _lib = lib
+    return _lib
 
 
 def available() -> bool:
@@ -111,8 +126,13 @@ def rjenkins3_batch(a: np.ndarray, b: int, c: int) -> np.ndarray:
     return out
 
 
-def gf_matrix_apply(mat: np.ndarray, chunks: np.ndarray) -> np.ndarray:
-    """CPU-baseline GF(2^8) matrix apply: out[r, L] = mat @ chunks."""
+def gf_matrix_apply(mat: np.ndarray, chunks: np.ndarray,
+                    force_scalar: bool = False) -> np.ndarray:
+    """CPU-baseline GF(2^8) matrix apply: out[r, L] = mat @ chunks.
+
+    Dispatches to the GFNI/AVX-512 kernel when the host supports it
+    (the isa-l-class SIMD baseline); force_scalar pins the jerasure-style
+    table sweep for comparison."""
     lib = _load()
     assert lib is not None, "native library unavailable"
     mat = np.ascontiguousarray(mat, np.uint8)
@@ -120,9 +140,16 @@ def gf_matrix_apply(mat: np.ndarray, chunks: np.ndarray) -> np.ndarray:
     r, k = mat.shape
     assert chunks.shape[0] == k
     out = np.empty((r, chunks.shape[1]), np.uint8)
-    lib.ceph_gf_matrix_apply(_u8p(mat), r, k, _u8p(chunks), _u8p(out),
-                             chunks.shape[1])
+    fn = (lib.ceph_gf_matrix_apply_scalar if force_scalar
+          else lib.ceph_gf_matrix_apply)
+    fn(_u8p(mat), r, k, _u8p(chunks), _u8p(out), chunks.shape[1])
     return out
+
+
+def gf_simd_available() -> bool:
+    """True when gf_matrix_apply runs the GFNI/AVX-512 SIMD kernel."""
+    lib = _load()
+    return bool(lib is not None and lib.ceph_gf_simd_available())
 
 
 def region_xor(a: np.ndarray, b: np.ndarray) -> np.ndarray:

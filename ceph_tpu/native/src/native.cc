@@ -17,6 +17,11 @@
 #include <cstdint>
 #include <cstring>
 
+#if defined(__GFNI__) && defined(__AVX512F__) && defined(__AVX512BW__)
+#define CEPH_TPU_GFNI512 1
+#include <immintrin.h>
+#endif
+
 extern "C" {
 
 // ---------------------------------------------------------------- crc32c --
@@ -110,11 +115,18 @@ static void gf_init() {
   gf_ready = true;
 }
 
-// out[r][L] = mat(r x k) * chunks(k x L) over GF(2^8).  The CPU baseline:
+static uint8_t gf_mul1(uint8_t a, uint8_t b) {
+  if (!a || !b) return 0;
+  return gf_exp[gf_log[a] + gf_log[b]];
+}
+
+// out[r][L] = mat(r x k) * chunks(k x L) over GF(2^8), scalar path:
 // per-coefficient 256-byte product tables + xor sweep, what jerasure's
-// non-SIMD path does.
-void ceph_gf_matrix_apply(const uint8_t* mat, int r, int k,
-                          const uint8_t* chunks, uint8_t* out, uint64_t L) {
+// non-SIMD path does.  Kept exported so bench.py can report both the
+// scalar and the SIMD CPU baselines.
+void ceph_gf_matrix_apply_scalar(const uint8_t* mat, int r, int k,
+                                 const uint8_t* chunks, uint8_t* out,
+                                 uint64_t L) {
   if (!gf_ready) gf_init();
   uint8_t table[256];
   for (int i = 0; i < r; i++) {
@@ -134,6 +146,123 @@ void ceph_gf_matrix_apply(const uint8_t* mat, int r, int k,
       for (uint64_t t = 0; t < L; t++) dst[t] ^= table[src[t]];
     }
   }
+}
+
+#ifdef CEPH_TPU_GFNI512
+// GFNI/AVX-512 path: multiplication by a constant c in GF(2^8)/0x11d is
+// linear over GF(2), i.e. an 8x8 bit-matrix — exactly what
+// vgf2p8affineqb applies to 64 bytes per instruction.  This is the
+// modern isa-l-class SIMD kernel (isa-l's gf_vect_dot_prod AVX512-GFNI
+// flavor works the same way); it serves as the honest "best CPU"
+// baseline the TPU kernel is measured against (BASELINE.md row 2).
+//
+// The affine qword's bit orientation (row order / column order) is
+// resolved EMPIRICALLY at init against the scalar log/exp product, so
+// no SDM bit-numbering assumption is baked in.
+static uint64_t gfni_mat[256];
+static bool gfni_ready = false;
+static int gfni_row_flip, gfni_col_flip;
+
+static uint64_t gfni_build(uint8_t c, int row_flip, int col_flip) {
+  // column j of the matrix = c * x^j  (the image of input bit j)
+  uint8_t col[8];
+  for (int j = 0; j < 8; j++) col[j] = gf_mul1(c, (uint8_t)(1u << j));
+  uint64_t q = 0;
+  for (int b = 0; b < 8; b++) {           // output bit b -> one row byte
+    uint8_t row = 0;
+    for (int j = 0; j < 8; j++)
+      if ((col[j] >> b) & 1) row |= (uint8_t)(1u << (col_flip ? 7 - j : j));
+    int byte_idx = row_flip ? 7 - b : b;
+    q |= (uint64_t)row << (8 * byte_idx);
+  }
+  return q;
+}
+
+static void gfni_init() {
+  if (!gf_ready) gf_init();
+  // pick the orientation that reproduces scalar gfmul for c=0x53
+  uint8_t probe[64];
+  for (int i = 0; i < 64; i++) probe[i] = (uint8_t)(i * 37 + 1);
+  __m512i v = _mm512_loadu_si512(probe);
+  bool found = false;
+  for (int rf = 0; rf < 2 && !found; rf++)
+    for (int cf = 0; cf < 2 && !found; cf++) {
+      __m512i m = _mm512_set1_epi64((long long)gfni_build(0x53, rf, cf));
+      uint8_t got[64];
+      _mm512_storeu_si512(got, _mm512_gf2p8affine_epi64_epi8(v, m, 0));
+      bool ok = true;
+      for (int i = 0; i < 64 && ok; i++)
+        ok = got[i] == gf_mul1(0x53, probe[i]);
+      if (ok) {
+        gfni_row_flip = rf;
+        gfni_col_flip = cf;
+        found = true;
+      }
+    }
+  if (!found) return;  // unexpected; caller falls back to scalar
+  for (int c = 0; c < 256; c++)
+    gfni_mat[c] = gfni_build((uint8_t)c, gfni_row_flip, gfni_col_flip);
+  // publish ONLY after the table is fully built: a concurrent caller
+  // that observes gfni_ready must never see a half-filled gfni_mat
+  // (ctypes releases the GIL, so two python threads can race here;
+  // double-init is idempotent and harmless)
+  __atomic_store_n(&gfni_ready, true, __ATOMIC_RELEASE);
+}
+
+static void gf_matrix_apply_gfni(const uint8_t* mat, int r, int k,
+                                 const uint8_t* chunks, uint8_t* out,
+                                 uint64_t L) {
+  const uint64_t BLK = 1 << 14;  // per-task block: L2-friendly, omp unit
+#pragma omp parallel for schedule(static)
+  for (uint64_t t0 = 0; t0 < L; t0 += BLK) {
+    uint64_t n = (L - t0) < BLK ? (L - t0) : BLK;
+    uint64_t vend = t0 + (n & ~63ULL);
+    for (int i = 0; i < r; i++) {
+      uint8_t* dst = out + (uint64_t)i * L;
+      const uint8_t* row = mat + (uint64_t)i * k;
+      for (uint64_t t = t0; t < vend; t += 64) {
+        __m512i acc = _mm512_setzero_si512();
+        for (int j = 0; j < k; j++) {
+          if (!row[j]) continue;
+          __m512i v = _mm512_loadu_si512(chunks + (uint64_t)j * L + t);
+          acc = _mm512_xor_si512(acc, _mm512_gf2p8affine_epi64_epi8(
+              v, _mm512_set1_epi64((long long)gfni_mat[row[j]]), 0));
+        }
+        _mm512_storeu_si512(dst + t, acc);
+      }
+      for (uint64_t t = vend; t < t0 + n; t++) {  // scalar tail
+        uint8_t acc = 0;
+        for (int j = 0; j < k; j++)
+          acc ^= gf_mul1(row[j], chunks[(uint64_t)j * L + t]);
+        dst[t] = acc;
+      }
+    }
+  }
+}
+#endif  // CEPH_TPU_GFNI512
+
+// Auto-dispatching GF(2^8) matrix apply: SIMD (GFNI/AVX-512) when the
+// host supports it, scalar table sweep otherwise.
+void ceph_gf_matrix_apply(const uint8_t* mat, int r, int k,
+                          const uint8_t* chunks, uint8_t* out, uint64_t L) {
+#ifdef CEPH_TPU_GFNI512
+  if (!gfni_ready) gfni_init();
+  if (gfni_ready) {
+    gf_matrix_apply_gfni(mat, r, k, chunks, out, L);
+    return;
+  }
+#endif
+  ceph_gf_matrix_apply_scalar(mat, r, k, chunks, out, L);
+}
+
+// 1 when the SIMD (GFNI/AVX-512) kernel is active.
+int ceph_gf_simd_available() {
+#ifdef CEPH_TPU_GFNI512
+  if (!gfni_ready) gfni_init();
+  return gfni_ready ? 1 : 0;
+#else
+  return 0;
+#endif
 }
 
 void ceph_region_xor(const uint8_t* a, const uint8_t* b, uint8_t* out,

@@ -416,11 +416,14 @@ def _jax_engine(cr: CompiledRule, weights_vec: Sequence[int]) -> "JaxEngine":
 def engine_is_warm(cr: CompiledRule, weights_vec: Sequence[int],
                    numrep: int, batch: int = 0) -> bool:
     """True when the jitted mappers for this topology+numrep exist AND
-    the chunk bucket a `batch`-sized call would use is compiled."""
+    the chunk bucket a `batch`-sized call would use is compiled AND the
+    straggler full-descent executable exists (degraded weights can need
+    it on any call, so auto-routing without it could still stall)."""
     eng = _engine_cache.get(_engine_key(cr, weights_vec))
     return (eng is not None and (numrep, cr.firstn) in eng._fns
             and (numrep, cr.firstn, _pick_chunk(batch))
-            in eng._warm_shapes)
+            in eng._warm_shapes
+            and (numrep, cr.firstn, "full") in eng._warm_shapes)
 
 
 def warmup(map_: CrushMap, ruleno: int, result_max: int,
@@ -456,6 +459,7 @@ def warmup(map_: CrushMap, ruleno: int, result_max: int,
             jax.block_until_ready(fast(xs, root_w, dom_w, wvj))
             if n == JaxEngine.STRAGGLER_CHUNK:
                 jax.block_until_ready(full(xs, root_w, dom_w, wvj))
+                eng._warm_shapes.add((numrep, cr.firstn, "full"))
             eng._warm_shapes.add((numrep, cr.firstn, n))
     return True
 
@@ -861,6 +865,8 @@ class JaxEngine:
             results = [fast(xs_p[i:i + chunk], root_w, dom_w, wvj)
                        for i in range(0, len(xs_p), chunk)]
             self._warm_shapes.add((numrep, firstn, chunk))
+            # NOTE: deliberately NOT marking "full" here — only warmup()
+            # compiles the straggler path; engine_is_warm requires both
             # Device↔host hops through the (tunneled) runtime carry real
             # per-transfer latency, so ship ONE packed int32 array per
             # call, concatenated on-device, instead of 2-3 small arrays

@@ -61,6 +61,23 @@ def test_gf_matrix_apply_matches_host():
                               gf256.host_apply(mat, chunks))
 
 
+def test_gf_simd_matches_scalar():
+    # GFNI/AVX-512 kernel (when the host has it) vs the table sweep —
+    # including the non-multiple-of-64 scalar tail path
+    from ceph_tpu.ec import gf256
+    if not native.gf_simd_available():
+        import pytest
+        pytest.skip("no GFNI/AVX-512 on this host")
+    rng = np.random.default_rng(2)
+    for (r, k, L) in [(4, 8, 1 << 16), (2, 8, 100001), (3, 5, 63)]:
+        mat = rng.integers(0, 256, (r, k)).astype(np.uint8)
+        chunks = rng.integers(0, 256, (k, L)).astype(np.uint8)
+        got = native.gf_matrix_apply(mat, chunks)
+        want = native.gf_matrix_apply(mat, chunks, force_scalar=True)
+        assert np.array_equal(got, want), (r, k, L)
+        assert np.array_equal(got, gf256.host_apply(mat, chunks))
+
+
 def test_region_xor():
     rng = np.random.default_rng(2)
     a = rng.integers(0, 256, 1000).astype(np.uint8)
