@@ -269,6 +269,12 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("osd_max_object_size", "size", "128m", ""),
     Option("osd_client_message_size_cap", "size", "500m", ""),
     Option("osd_scrub_interval", "float", 60.0, "light scrub cadence (test scale)"),
+    Option("osd_ec_batch_device", "str", "auto",
+           "EC encode device routing: auto (accelerator only), on, off"),
+    Option("osd_ec_batch_window_ms", "float", 2.0,
+           "batch-collector fill window before a device launch"),
+    Option("osd_ec_batch_min_bytes", "size", "64k",
+           "lone requests below this take the host SIMD kernel"),
     Option("objectstore", "str", "memstore", "backend: memstore|filestore"),
     Option("objectstore_path", "str", "", "data dir for filestore"),
     Option("filestore_journal_size", "size", "64m", "WAL size"),

@@ -117,14 +117,21 @@ class ErasureCode(ABC):
             "no chunks available")
 
     # -- data path -----------------------------------------------------------
+    def split_data(self, data: bytes) -> np.ndarray:
+        """Pad+split an object into its [k, chunk] data chunks — the ONE
+        place the stripe geometry is computed (reference
+        ErasureCode::encode padding; also used by the OSD device batch
+        queue so both encode paths pad identically)."""
+        chunk = self.get_chunk_size(len(data))
+        padded = np.zeros(chunk * self.k, np.uint8)
+        padded[:len(data)] = np.frombuffer(data, np.uint8)
+        return padded.reshape(self.k, chunk)
+
     def encode(self, want_to_encode: Set[int],
                data: bytes) -> Dict[int, np.ndarray]:
         """Pad+split into k chunks, compute parity, return wanted chunks
         (reference ErasureCode::encode -> encode_chunks)."""
-        chunk = self.get_chunk_size(len(data))
-        padded = np.zeros(chunk * self.k, np.uint8)
-        padded[:len(data)] = np.frombuffer(data, np.uint8)
-        chunks = padded.reshape(self.k, chunk)
+        chunks = self.split_data(data)
         coded = self.encode_chunks(chunks)
         all_chunks = {i: chunks[i] for i in range(self.k)}
         all_chunks.update({self.k + i: coded[i] for i in range(self.m)})

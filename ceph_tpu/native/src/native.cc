@@ -180,6 +180,13 @@ static uint64_t gfni_build(uint8_t c, int row_flip, int col_flip) {
 
 static void gfni_init() {
   if (!gf_ready) gf_init();
+  // Runtime CPUID gate: the .so may be prebuilt on a GFNI host and
+  // loaded on one without it — entering any 512-bit intrinsic there is
+  // SIGILL, so check before the probe.
+  if (!__builtin_cpu_supports("gfni") ||
+      !__builtin_cpu_supports("avx512f") ||
+      !__builtin_cpu_supports("avx512bw"))
+    return;
   // pick the orientation that reproduces scalar gfmul for c=0x53
   uint8_t probe[64];
   for (int i = 0; i < 64; i++) probe[i] = (uint8_t)(i * 37 + 1);

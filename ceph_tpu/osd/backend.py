@@ -350,17 +350,32 @@ class ECBackend(PGBackend):
         # time, so geometry can never disagree across daemons
         profile.setdefault("k", "4")
         profile.setdefault("m", "2")
-        # Inline per-op encodes use the vectorized HOST GF kernel: object
-        # sizes vary per op, and paying an XLA compile + device dispatch
-        # per 4KiB-class op stalls the event loop (SURVEY §7 hard part —
-        # "a 4KiB-chunk op can't pay a dispatch each").  The TPU kernel
-        # serves the batched paths (bench.py, batch collector) where one
-        # dispatch covers many fixed-shape stripes.
+        # The codec's own backend stays "host": direct codec calls happen
+        # inline in the event loop, where a per-op device dispatch would
+        # stall everything (SURVEY §7 hard part).  Device encodes instead
+        # ride the OSD-wide cross-PG batch collector (osd/ec_queue.py)
+        # via _encode_object/_decode_chunks below, which fold concurrent
+        # stripes into single launches.
         profile.setdefault("backend", "host")
         plugin = profile.pop("plugin", "rs")
         self.codec = factory(plugin, profile)
         self.k = self.codec.get_data_chunk_count()
         self.n = self.codec.get_chunk_count()
+
+    async def _encode_object(self, data: bytes) -> Dict[int, np.ndarray]:
+        """Full-object encode, batched across PGs on the device queue
+        when the codec exposes a plain generator matrix (rs/jerasure/isa
+        family); codec host path otherwise (lrc/shec layering)."""
+        gen = getattr(self.codec, "generator", None)
+        q = getattr(self.osd, "ec_queue", None)
+        if gen is None or q is None:
+            return self.codec.encode(set(range(self.n)), data)
+        chunks = self.codec.split_data(data)
+        parity = await q.apply(gen[self.k:], chunks)
+        out = {i: chunks[i] for i in range(self.k)}
+        out.update({self.k + i: parity[i]
+                    for i in range(self.n - self.k)})
+        return out
 
     @property
     def my_shard(self) -> int:
@@ -392,7 +407,7 @@ class ECBackend(PGBackend):
             i: Transaction() for i in range(self.n)}
         for op in writes:
             if op.op == OP_WRITEFULL:
-                chunks = self.codec.encode(set(range(self.n)), op.data)
+                chunks = await self._encode_object(op.data)
                 for i in range(self.n):
                     t = shard_txns[i]
                     t.truncate(cids[i], soid, 0)

@@ -53,6 +53,11 @@ class OSD(Dispatcher):
         self._hb_task: Optional[asyncio.Task] = None
         self._waiting_maps: List[Message] = []
         self.running = False
+        from ceph_tpu.osd.ec_queue import ECBatchQueue
+        self.ec_queue = ECBatchQueue(
+            ctx, mode=self.cfg["osd_ec_batch_device"],
+            window_ms=self.cfg["osd_ec_batch_window_ms"],
+            min_device_bytes=self.cfg["osd_ec_batch_min_bytes"])
 
     def next_tid(self) -> int:
         self._tid += 1
@@ -87,6 +92,7 @@ class OSD(Dispatcher):
             self._hb_task.cancel()
         for pg in self.pgs.values():
             pg.stop()
+        await self.ec_queue.stop()
         await self.messenger.shutdown()
         self.store.umount()
 
