@@ -269,6 +269,8 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("osd_max_object_size", "size", "128m", ""),
     Option("osd_client_message_size_cap", "size", "500m", ""),
     Option("osd_scrub_interval", "float", 60.0, "light scrub cadence (test scale)"),
+    Option("osd_deep_scrub_interval", "float", 300.0,
+           "deep scrub cadence (reads + recomputes every digest)"),
     Option("osd_ec_batch_device", "str", "auto",
            "EC encode device routing: auto (accelerator only), on, off"),
     Option("osd_ec_batch_window_ms", "float", 2.0,

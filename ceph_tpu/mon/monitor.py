@@ -259,7 +259,7 @@ class Monitor(Dispatcher):
                        "quorum_names": [self.monmap.name_of_rank(r)
                                         for r in self.quorum]}
                 self.reply(m, MMonCommandAck(m.tid, 0, json.dumps(out)))
-            elif prefix.startswith("osd"):
+            elif prefix.startswith("osd") or prefix.startswith("pg"):
                 self.osdmon.handle_command(m)
             else:
                 self.reply(m, MMonCommandAck(

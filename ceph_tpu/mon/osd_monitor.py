@@ -324,6 +324,30 @@ class OSDMonitor(PaxosService):
                 return
             self.pending_inc.old_ec_profiles.append(name)
             self._propose_and_ack(m, outs=f"profile {name!r} removed")
+        elif prefix in ("pg scrub", "pg deep-scrub"):
+            # route to the PG's acting primary (reference
+            # OSDMonitor/MOSDScrub path)
+            from ceph_tpu.osd.messages import MPGScrub
+            from ceph_tpu.osd.types import PGId
+            try:
+                # canonical "<pool>.<seed-hex>" grammar (PGId.__str__)
+                pgid = PGId.parse(str(cmd["pgid"])).without_shard()
+            except (KeyError, ValueError):
+                ack(-errno.EINVAL, f"bad pgid {cmd.get('pgid')!r}")
+                return
+            if pgid.pool not in self.osdmap.pools:
+                ack(-errno.ENOENT, f"no pool {pgid.pool}")
+                return
+            _, _, _, primary = self.osdmap.pg_to_up_acting_osds(pgid)
+            addr = self.osdmap.get_addr(primary) if primary >= 0 else None
+            if addr is None:
+                ack(-errno.EAGAIN, f"pg {cmd['pgid']} has no primary")
+                return
+            self.mon.messenger.send_message(
+                MPGScrub(pgid, deep=(prefix == "pg deep-scrub")),
+                addr, peer_type="osd")
+            ack(0, f"instructing pg {cmd['pgid']} on osd.{primary} to "
+                   f"{'deep-' if prefix == 'pg deep-scrub' else ''}scrub")
         elif prefix == "osd crush set-map":
             self.pending_inc.new_crush = CrushMap.from_bytes(m.inbl)
             self._propose_and_ack(m)

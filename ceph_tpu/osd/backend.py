@@ -151,13 +151,16 @@ class PGBackend:
             if ent is not None and not ent[1].done():
                 ent[1].set_result(m)
 
-    async def recover_object(self, peer: int, oid: str) -> None:
+    async def recover_object(self, peer: int, oid: str,
+                             exclude=frozenset()) -> None:
         await self._push_and_wait(peer, oid)
 
-    async def pull_object(self, peer: int, oid: str, epoch: int) -> None:
+    async def pull_object(self, peer: int, oid: str, epoch: int,
+                          exclude=frozenset()) -> None:
         """Primary self-heal during peering: fetch our copy from the
         authoritative peer (whole-object for replicated; ECBackend
-        overrides to reconstruct its own shard)."""
+        overrides to reconstruct its own shard).  `exclude` names shards
+        known-bad (scrub) that must not feed a reconstruction."""
         await self.pg.pull_object_via_push(peer, oid, epoch)
 
 
@@ -269,6 +272,21 @@ class ReplicatedBackend(PGBackend):
                                           m.ops, txn)
         if result < 0:
             return result
+        # object digest (data_digest role): full-object writes record the
+        # crc scrub verifies against; partial mutations invalidate it
+        # (empty marker) exactly like the reference drops data_digest
+        from ceph_tpu.common.crc import crc32c
+        from ceph_tpu.osd.scrub import CRC_XATTR
+        digest_ops = {OP_WRITEFULL: None, OP_WRITE: b"", OP_APPEND: b"",
+                      OP_TRUNCATE: b"", OP_ZERO: b""}
+        for op in m.ops:
+            if not op.is_write() or op.op not in digest_ops:
+                continue
+            if op.op == OP_WRITEFULL:
+                txn.setattr(pg.cid, soid, CRC_XATTR,
+                            str(crc32c(op.data)).encode())
+            else:
+                txn.setattr(pg.cid, soid, CRC_XATTR, b"")
         version = pg.next_version()
         entry = LogEntry(LOG_DELETE if deletes else LOG_MODIFY, m.oid,
                          version, pg.info.last_update, m.reqid)
@@ -405,19 +423,28 @@ class ECBackend(PGBackend):
                 for i in range(self.n)}
         shard_txns: Dict[int, Transaction] = {
             i: Transaction() for i in range(self.n)}
+        from ceph_tpu.common.crc import crc32c
+        from ceph_tpu.osd.scrub import CRC_XATTR
+        empty_crc = str(crc32c(b"")).encode()
         for op in writes:
             if op.op == OP_WRITEFULL:
                 chunks = await self._encode_object(op.data)
                 for i in range(self.n):
                     t = shard_txns[i]
+                    chunk_bytes = chunks[i].tobytes()
                     t.truncate(cids[i], soid, 0)
-                    t.write(cids[i], soid, 0, chunks[i].tobytes())
+                    t.write(cids[i], soid, 0, chunk_bytes)
                     t.setattr(cids[i], soid, SIZE_XATTR,
                               str(len(op.data)).encode())
+                    # per-shard digest (hinfo role, ECBackend.cc:1695):
+                    # scrub verifies stored bytes against this
+                    t.setattr(cids[i], soid, CRC_XATTR,
+                              str(crc32c(chunk_bytes)).encode())
             elif op.op == OP_CREATE:
                 for i, t in shard_txns.items():
                     t.touch(cids[i], soid)
                     t.setattr(cids[i], soid, SIZE_XATTR, b"0")
+                    t.setattr(cids[i], soid, CRC_XATTR, empty_crc)
             elif op.op == OP_DELETE:
                 for i, t in shard_txns.items():
                     t.remove(cids[i], soid)
@@ -425,6 +452,7 @@ class ECBackend(PGBackend):
                 for i, t in shard_txns.items():
                     t.truncate(cids[i], soid, 0)
                     t.setattr(cids[i], soid, SIZE_XATTR, b"0")
+                    t.setattr(cids[i], soid, CRC_XATTR, empty_crc)
             elif op.op in (OP_SETXATTR,):
                 for i, t in shard_txns.items():
                     t.setattr(cids[i], soid, op.name, op.data)
@@ -589,9 +617,11 @@ class ECBackend(PGBackend):
         return data[:size]
 
     # ----------------------------------------------------------- recovery
-    async def recover_object(self, peer: int, oid: str) -> None:
+    async def recover_object(self, peer: int, oid: str,
+                             exclude=frozenset()) -> None:
         """Rebuild the peer's shard from k others and push it
-        (continue_recovery_op / minimum_to_decode role)."""
+        (continue_recovery_op / minimum_to_decode role).  `exclude` adds
+        shards scrub found corrupt, kept out of the gather."""
         pg = self.pg
         target = pg.shard_of(peer)
         soid = pg.object_id(oid)
@@ -601,12 +631,18 @@ class ECBackend(PGBackend):
         except (NoSuchObject, NoSuchCollection):
             await self._push_and_wait(peer, oid)   # pushes deleted=True
             return
-        got = await self._gather_shards(oid, exclude={target})
+        got = await self._gather_shards(oid, exclude={target} | set(exclude))
         if got is None:
             raise RuntimeError(f"{pg.pgid}: cannot reconstruct {oid} "
                                f"for shard {target}: insufficient shards")
         streams, _ = got
         rebuilt = self.codec.decode({target}, streams)[target]
+        # the digest xattr is PER SHARD: the rebuilt chunk gets its own,
+        # never a copy of ours (scrub would flag it forever)
+        from ceph_tpu.common.crc import crc32c
+        from ceph_tpu.osd.scrub import CRC_XATTR
+        attrs = dict(attrs)
+        attrs[CRC_XATTR] = str(crc32c(rebuilt.tobytes())).encode()
         fut = asyncio.get_running_loop().create_future()
         pg._push_acks[(peer, oid)] = fut
         try:
@@ -617,14 +653,15 @@ class ECBackend(PGBackend):
         finally:
             pg._push_acks.pop((peer, oid), None)
 
-    async def pull_object(self, peer: int, oid: str, epoch: int) -> None:
+    async def pull_object(self, peer: int, oid: str, epoch: int,
+                          exclude=frozenset()) -> None:
         """Primary self-heal: reconstruct OUR OWN shard from k peers.
         A whole-object pull would install the peer's (foreign) shard
         bytes as ours and silently corrupt every later decode."""
         pg = self.pg
         my = self.my_shard
         soid = pg.object_id(oid)
-        got = await self._gather_shards(oid, exclude={my})
+        got = await self._gather_shards(oid, exclude={my} | set(exclude))
         if got is None:
             # peers have no data: the object was deleted
             self.osd.store.apply_transaction(
@@ -632,6 +669,10 @@ class ECBackend(PGBackend):
             return
         streams, attrs = got
         rebuilt = self.codec.decode({my}, streams)[my]
+        from ceph_tpu.common.crc import crc32c
+        from ceph_tpu.osd.scrub import CRC_XATTR
+        attrs = dict(attrs)
+        attrs[CRC_XATTR] = str(crc32c(rebuilt.tobytes())).encode()
         txn = Transaction()
         txn.remove(pg.cid, soid)
         txn.write(pg.cid, soid, 0, rebuilt.tobytes())

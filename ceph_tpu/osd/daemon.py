@@ -26,7 +26,7 @@ from ceph_tpu.osd.messages import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDOp, MOSDOpReply, MOSDPing, MOSDRepOp,
     MOSDRepOpReply, MPGLog, MPGLogRequest, MPGNotify, MPGObjectList,
-    MPGPush, MPGPushReply, MPGQuery,
+    MPGPush, MPGPushReply, MPGQuery, MPGScrub, MPGScrubMap, MPGScrubScan,
 )
 from ceph_tpu.osd.osdmap import OSDMap
 from ceph_tpu.osd.pg import PG
@@ -58,6 +58,11 @@ class OSD(Dispatcher):
             ctx, mode=self.cfg["osd_ec_batch_device"],
             window_ms=self.cfg["osd_ec_batch_window_ms"],
             min_device_bytes=self.cfg["osd_ec_batch_min_bytes"])
+        self.perf_scrub = ctx.perf.create("osd_scrub")
+        for key in ("scrubs_light", "scrubs_deep", "scrub_errors",
+                    "scrub_repaired"):
+            self.perf_scrub.add_u64(key)
+        self._scrub_task: Optional[asyncio.Task] = None
 
     def next_tid(self) -> int:
         self._tid += 1
@@ -76,6 +81,8 @@ class OSD(Dispatcher):
         self.running = True
         self._hb_task = asyncio.get_running_loop().create_task(
             self._heartbeat())
+        self._scrub_task = asyncio.get_running_loop().create_task(
+            self._scrub_scheduler())
         self.logger.info(f"osd.{self.whoami} starting at "
                          f"{self.messenger.addr}")
 
@@ -90,6 +97,8 @@ class OSD(Dispatcher):
         self.running = False
         if self._hb_task:
             self._hb_task.cancel()
+        if self._scrub_task:
+            self._scrub_task.cancel()
         for pg in self.pgs.values():
             pg.stop()
         await self.ec_queue.stop()
@@ -243,6 +252,19 @@ class OSD(Dispatcher):
             if pg is not None:
                 pg.on_object_list(m)
             return True
+        if isinstance(m, (MPGScrub, MPGScrubScan)):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.queue_op(m)        # serialize with writes
+            return True
+        if isinstance(m, MPGScrubMap):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                # the primary's scrub awaits this — bypass the op queue
+                fut = pg._scrub_map_waiters.get(m.tid)
+                if fut is not None and not fut.done():
+                    fut.set_result(m)
+            return True
         if isinstance(m, MOSDPing):
             self._handle_ping(m)
             return True
@@ -255,6 +277,36 @@ class OSD(Dispatcher):
                 m.tid, -errno.EAGAIN, map_epoch=self.osdmap.epoch))
             return
         pg.queue_op(m)
+
+    # ---------------------------------------------------------------- scrub
+    async def _scrub_scheduler(self) -> None:
+        """Periodic scrub: light every osd_scrub_interval, deep every
+        osd_deep_scrub_interval, per PG we lead (PG.cc:3300 sched_scrub
+        role; the `osd_scrub_interval` option finally does something)."""
+        import time as _time
+        light = self.cfg["osd_scrub_interval"]
+        deep = self.cfg["osd_deep_scrub_interval"]
+        poll = max(0.5, min(light, deep) / 4)
+        from ceph_tpu.osd.pg import STATE_ACTIVE
+        while self.running:
+            await asyncio.sleep(poll)
+            now = int(_time.time() * 1000)
+            for pg in list(self.pgs.values()):
+                if not pg.is_primary() or pg.state != STATE_ACTIVE:
+                    continue
+                info = pg.info
+                if info.last_scrub_stamp == 0:
+                    # fresh PG: activation counts as scrubbed (no boot
+                    # storm of deep scrubs on an empty cluster)
+                    info.last_scrub_stamp = now
+                    info.last_deep_scrub_stamp = now
+                    continue
+                if now - info.last_deep_scrub_stamp > deep * 1000:
+                    info.last_deep_scrub_stamp = now   # hold off requeues
+                    pg.queue_op(MPGScrub(pg.pgid, deep=True))
+                elif now - info.last_scrub_stamp > light * 1000:
+                    info.last_scrub_stamp = now
+                    pg.queue_op(MPGScrub(pg.pgid, deep=False))
 
     # ----------------------------------------------------------- heartbeats
     def _hb_peers(self) -> List[int]:

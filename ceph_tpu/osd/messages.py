@@ -559,3 +559,100 @@ class MPGObjectList(Message):
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGObjectList":
         return cls(dec.struct(PGId), dec.list_(lambda d: d.string()),
                    dec.s32())
+
+
+# ------------------------------------------------------------------ scrub
+
+class ScrubEntry(Encodable):
+    """Per-object scrub map row (reference ScrubMap::object,
+    osd/osd_types.h): stored size, the digest xattr the write path
+    recorded, and — deep scrub only — the crc32c recomputed from the
+    bytes on disk."""
+
+    __slots__ = ("size", "stored_crc", "computed_crc")
+
+    def __init__(self, size: int = 0, stored_crc: int = -1,
+                 computed_crc: int = -1):
+        self.size = size
+        self.stored_crc = stored_crc        # -1 = no/invalid digest xattr
+        self.computed_crc = computed_crc    # -1 = light scrub (not read)
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.size).s64(self.stored_crc).s64(self.computed_crc)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "ScrubEntry":
+        return cls(dec.u64(), dec.s64(), dec.s64())
+
+
+@register_message
+class MPGScrub(Message):
+    """Instruct a primary to scrub one PG (mon `ceph pg [deep-]scrub`
+    command path; reference PG::sched_scrub / MOSDScrub)."""
+    TYPE = 220
+
+    def __init__(self, pgid: Optional[PGId] = None, deep: bool = False,
+                 repair: bool = True):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.deep = deep
+        self.repair = repair
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).boolean(self.deep).boolean(self.repair)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGScrub":
+        return cls(dec.struct(PGId), dec.boolean(), dec.boolean())
+
+
+@register_message
+class MPGScrubScan(Message):
+    """Primary -> replica/shard: build and return your scrub map.
+    Flows through the PG op queue so it serializes with writes
+    (reference chunky-scrub write blocking)."""
+    TYPE = 221
+
+    def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
+                 deep: bool = False, from_osd: int = -1):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.tid = tid
+        self.deep = deep
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).u64(self.tid).boolean(self.deep)
+        enc.s32(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGScrubScan":
+        return cls(dec.struct(PGId), dec.u64(), dec.boolean(), dec.s32())
+
+
+@register_message
+class MPGScrubMap(Message):
+    """Replica's scrub map back to the primary (reference MOSDRepScrubMap)."""
+    TYPE = 222
+    PRIORITY = PRIO_HIGH
+
+    def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
+                 entries: Optional[Dict[str, "ScrubEntry"]] = None,
+                 from_osd: int = -1):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.tid = tid
+        self.entries = entries or {}
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).u64(self.tid)
+        enc.map_(self.entries, lambda e, k: e.string(k),
+                 lambda e, v: e.struct(v))
+        enc.s32(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGScrubMap":
+        return cls(dec.struct(PGId), dec.u64(),
+                   dec.map_(lambda d: d.string(),
+                            lambda d: d.struct(ScrubEntry)), dec.s32())

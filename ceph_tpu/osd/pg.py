@@ -78,6 +78,8 @@ class PG:
         self._list_waiters: Dict[int, asyncio.Future] = {}
         self._pull_waiters: Dict[str, asyncio.Future] = {}
         self._push_acks: Dict[Tuple[int, str], asyncio.Future] = {}
+        self._scrub_map_waiters: Dict[int, asyncio.Future] = {}
+        self.last_scrub_result: Optional[Dict] = None
         from ceph_tpu.osd.backend import ECBackend, ReplicatedBackend
         self.backend = (ECBackend(self) if pool.is_erasure()
                         else ReplicatedBackend(self))
@@ -526,11 +528,21 @@ class PG:
         self._op_queue.put_nowait(m)
 
     async def _worker(self) -> None:
+        from ceph_tpu.osd.messages import MPGScrub, MPGScrubScan
+        from ceph_tpu.osd import scrub as scrub_mod
         while True:
             m = await self._op_queue.get()
             try:
                 if isinstance(m, MOSDOp):
                     await self._do_client_op(m)
+                elif isinstance(m, MPGScrub):
+                    # scrub rides the op queue: no client write can
+                    # interleave with the scan (reference write blocking)
+                    if self.is_primary() and self.state == STATE_ACTIVE:
+                        self.last_scrub_result = await scrub_mod.scrub_pg(
+                            self, m.deep, m.repair)
+                elif isinstance(m, MPGScrubScan):
+                    scrub_mod.handle_scrub_scan(self, m)
                 else:
                     await self.backend.handle_sub_message(m)
             except asyncio.CancelledError:

@@ -56,11 +56,12 @@ class LogEntry(Encodable):
 class PGInfo(Encodable):
     """pg_info_t distilled: identity + log bounds + interval history."""
 
-    STRUCT_V = 2
+    STRUCT_V = 3
 
     __slots__ = ("pgid", "last_update", "last_complete", "log_tail",
                  "last_epoch_started", "same_interval_since",
-                 "backfill_complete")
+                 "backfill_complete", "last_scrub_stamp",
+                 "last_deep_scrub_stamp")
 
     def __init__(self, pgid: Optional[PGId] = None):
         self.pgid = pgid or PGId(0, 0)
@@ -74,6 +75,9 @@ class PGInfo(Encodable):
         # the primary confirms every object was pushed, so an
         # interrupted backfill is retried instead of trusted
         self.backfill_complete = True
+        # scrub history (pg_info_t history.last_scrub_stamp role), ms
+        self.last_scrub_stamp = 0
+        self.last_deep_scrub_stamp = 0
 
     def is_empty(self) -> bool:
         return self.last_update == EVersion.zero()
@@ -83,6 +87,7 @@ class PGInfo(Encodable):
         enc.struct(self.last_complete).struct(self.log_tail)
         enc.u32(self.last_epoch_started).u32(self.same_interval_since)
         enc.boolean(self.backfill_complete)
+        enc.u64(self.last_scrub_stamp).u64(self.last_deep_scrub_stamp)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "PGInfo":
@@ -94,6 +99,9 @@ class PGInfo(Encodable):
         i.same_interval_since = dec.u32()
         if struct_v >= 2:
             i.backfill_complete = dec.boolean()
+        if struct_v >= 3:
+            i.last_scrub_stamp = dec.u64()
+            i.last_deep_scrub_stamp = dec.u64()
         return i
 
     def __repr__(self):

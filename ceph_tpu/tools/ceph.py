@@ -47,6 +47,9 @@ def build_command(args, extra) -> dict:
     cmd = {"prefix": " ".join(words)}
     if words[0] in ("status", "health", "quorum_status", "mon"):
         return cmd
+    if words[0] == "pg" and len(words) > 2 \
+            and words[1] in ("scrub", "deep-scrub"):
+        return {"prefix": f"pg {words[1]}", "pgid": words[2]}
     if words[0] == "osd" and len(words) > 1:
         if words[1] == "pool" and len(words) > 3:
             cmd = {"prefix": f"osd pool {words[2]}", "pool": words[3]}

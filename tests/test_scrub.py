@@ -1,0 +1,220 @@
+"""Scrub: integrity detection + repair (osd/scrub.py).
+
+Reference strategy analog: test/osd/osd-scrub-repair.sh — corrupt a
+stored copy behind the cluster's back, scrub, prove detection and
+repair for replicated and EC pools.
+"""
+
+import asyncio
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from test_osd import Cluster  # noqa: E402
+
+from ceph_tpu.osd.messages import MPGScrub  # noqa: E402
+from ceph_tpu.store.objectstore import Transaction  # noqa: E402
+
+
+def find_copies(cl, name):
+    """[(osd, cid, soid)] for every stored copy/shard of object `name`."""
+    out = []
+    for osd in cl.osds.values():
+        for cid in osd.store.list_collections():
+            for soid in osd.store.collection_list(cid):
+                if soid.name == name:
+                    out.append((osd, cid, soid))
+    return out
+
+
+def corrupt(osd, cid, soid, flip=0):
+    """Flip one bit of the stored bytes WITHOUT touching xattrs —
+    simulated silent media bit-rot."""
+    data = bytearray(osd.store.read(cid, soid))
+    data[flip] ^= 0x40
+    osd.store.apply_transaction(
+        Transaction().write(cid, soid, 0, bytes(data)))
+
+
+def primary_pg(cl, pool_name, name):
+    """(pg-on-primary, primary-osd) for the PG holding `name`."""
+    for osd in cl.osds.values():
+        for pg in osd.pgs.values():
+            if not pg.is_primary():
+                continue
+            for soid in osd.store.collection_list(pg.cid):
+                if soid.name == name:
+                    return pg, osd
+    raise AssertionError(f"no primary pg holds {name}")
+
+
+async def run_scrub(pg, deep):
+    pg.last_scrub_result = None
+    pg.queue_op(MPGScrub(pg.pgid, deep=deep))
+    for _ in range(400):
+        if pg.last_scrub_result is not None:
+            return pg.last_scrub_result
+        await asyncio.sleep(0.05)
+    raise AssertionError("scrub did not complete")
+
+
+def test_deep_scrub_repairs_replica_bitrot():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("data", pg_num=4)
+        io = admin.open_ioctx("data")
+        payload = bytes(range(256)) * 32
+        await io.write_full("obj", payload)
+        pg, posd = primary_pg(cl, "data", "obj")
+        # rot a NON-primary copy
+        victims = [(o, c, s) for (o, c, s) in find_copies(cl, "obj")
+                   if o is not posd]
+        assert victims
+        vosd, vcid, vsoid = victims[0]
+        corrupt(vosd, vcid, vsoid)
+        assert vosd.store.read(vcid, vsoid) != payload
+        res = await run_scrub(pg, deep=True)
+        assert res["errors"] >= 1 and res["repaired"] >= 1
+        assert vosd.store.read(vcid, vsoid) == payload   # healed
+        # second scrub: clean
+        res = await run_scrub(pg, deep=True)
+        assert res["errors"] == 0
+        assert await io.read("obj") == payload
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_deep_scrub_repairs_primary_bitrot():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("data", pg_num=4)
+        io = admin.open_ioctx("data")
+        payload = b"primary-rot" * 500
+        await io.write_full("obj", payload)
+        pg, posd = primary_pg(cl, "data", "obj")
+        mine = [(o, c, s) for (o, c, s) in find_copies(cl, "obj")
+                if o is posd]
+        corrupt(*mine[0])
+        res = await run_scrub(pg, deep=True)
+        assert res["errors"] >= 1
+        assert posd.store.read(mine[0][1], mine[0][2]) == payload
+        assert await io.read("obj") == payload
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_light_scrub_repairs_missing_replica_object():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("data", pg_num=4)
+        io = admin.open_ioctx("data")
+        await io.write_full("obj", b"x" * 4096)
+        pg, posd = primary_pg(cl, "data", "obj")
+        victims = [(o, c, s) for (o, c, s) in find_copies(cl, "obj")
+                   if o is not posd]
+        vosd, vcid, vsoid = victims[0]
+        vosd.store.apply_transaction(Transaction().remove(vcid, vsoid))
+        res = await run_scrub(pg, deep=False)     # light finds absence
+        assert res["errors"] >= 1 and res["repaired"] >= 1
+        assert vosd.store.read(vcid, vsoid) == b"x" * 4096
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_deep_scrub_rebuilds_ec_shard():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(6)
+        await admin.pool_create("ecpool", pg_num=4, pool_type="erasure",
+                                k=4, m=2)
+        io = admin.open_ioctx("ecpool")
+        rng = np.random.default_rng(5)
+        payload = rng.integers(0, 256, 16384, dtype=np.uint8).tobytes()
+        await io.write_full("obj", payload)
+        pg, posd = primary_pg(cl, "ecpool", "obj")
+        victims = [(o, c, s) for (o, c, s) in find_copies(cl, "obj")
+                   if o is not posd]
+        vosd, vcid, vsoid = victims[0]
+        before = vosd.store.read(vcid, vsoid)
+        corrupt(vosd, vcid, vsoid, flip=7)
+        res = await run_scrub(pg, deep=True)
+        assert res["errors"] >= 1 and res["repaired"] >= 1
+        assert vosd.store.read(vcid, vsoid) == before    # shard rebuilt
+        assert await io.read("obj") == payload
+        res = await run_scrub(pg, deep=True)
+        assert res["errors"] == 0
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_deep_scrub_rebuilds_primary_own_ec_shard():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(6)
+        await admin.pool_create("ecpool", pg_num=4, pool_type="erasure",
+                                k=4, m=2)
+        io = admin.open_ioctx("ecpool")
+        payload = bytes(range(256)) * 64
+        await io.write_full("obj", payload)
+        pg, posd = primary_pg(cl, "ecpool", "obj")
+        mine = [(o, c, s) for (o, c, s) in find_copies(cl, "obj")
+                if o is posd]
+        before = posd.store.read(mine[0][1], mine[0][2])
+        corrupt(*mine[0], flip=3)
+        res = await run_scrub(pg, deep=True)
+        assert res["errors"] >= 1 and res["repaired"] >= 1
+        assert posd.store.read(mine[0][1], mine[0][2]) == before
+        assert await io.read("obj") == payload
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_pg_scrub_mon_command_path():
+    """Operator path: `ceph pg deep-scrub <pgid>` routed mon -> primary."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("data", pg_num=4)
+        io = admin.open_ioctx("data")
+        await io.write_full("obj", b"cmd-path" * 512)
+        pg, posd = primary_pg(cl, "data", "obj")
+        victims = [(o, c, s) for (o, c, s) in find_copies(cl, "obj")
+                   if o is not posd]
+        corrupt(*victims[0])
+        pg.last_scrub_result = None
+        ackm = await admin.mon_command(
+            {"prefix": "pg deep-scrub",
+             "pgid": str(pg.pgid.without_shard())})
+        assert ackm.retcode == 0, ackm.outs
+        for _ in range(400):
+            if pg.last_scrub_result is not None:
+                break
+            await asyncio.sleep(0.05)
+        assert pg.last_scrub_result is not None, "scrub never ran"
+        assert pg.last_scrub_result["repaired"] >= 1
+        assert victims[0][0].store.read(victims[0][1], victims[0][2]) \
+            == b"cmd-path" * 512
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_scrub_updates_info_stamps_and_perf():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("data", pg_num=4)
+        io = admin.open_ioctx("data")
+        await io.write_full("obj", b"stamps")
+        pg, posd = primary_pg(cl, "data", "obj")
+        assert pg.info.last_deep_scrub_stamp == 0
+        await run_scrub(pg, deep=True)
+        assert pg.info.last_deep_scrub_stamp > 0
+        assert pg.info.last_scrub_stamp > 0
+        assert posd.perf_scrub.dump()["scrubs_deep"] >= 1
+        await cl.stop()
+    asyncio.run(run())
