@@ -19,7 +19,8 @@ from ceph_tpu.msg.messenger import Messenger
 from ceph_tpu.msg.types import EntityName
 from ceph_tpu.osd.messages import (
     OSDOp, OP_CREATE, OP_DELETE, OP_GETXATTR, OP_OMAP_GET_VALS,
-    OP_OMAP_SET, OP_PGLS, OP_READ, OP_SETXATTR, OP_STAT, OP_WRITE,
+    OP_OMAP_RM_KEYS, OP_OMAP_SET, OP_PGLS, OP_READ, OP_SETXATTR,
+    OP_STAT, OP_WRITE,
     OP_WRITEFULL,
 )
 from ceph_tpu.osd.types import ObjectLocator, PGId
@@ -150,6 +151,9 @@ class IoCtx:
 
     async def omap_set(self, oid: str, kv: Dict[bytes, bytes]) -> None:
         await self._op(oid, [OSDOp(OP_OMAP_SET, kv=kv)])
+
+    async def omap_rm_keys(self, oid: str, keys: List[bytes]) -> None:
+        await self._op(oid, [OSDOp(OP_OMAP_RM_KEYS, keys=keys)])
 
     async def omap_get(self, oid: str,
                        keys: Optional[List[bytes]] = None

@@ -301,11 +301,13 @@ class OSD(Dispatcher):
                     info.last_scrub_stamp = now
                     info.last_deep_scrub_stamp = now
                     continue
+                if pg._scrub_queued:
+                    continue       # one in flight; stamp moves on completion
                 if now - info.last_deep_scrub_stamp > deep * 1000:
-                    info.last_deep_scrub_stamp = now   # hold off requeues
+                    pg._scrub_queued = True
                     pg.queue_op(MPGScrub(pg.pgid, deep=True))
                 elif now - info.last_scrub_stamp > light * 1000:
-                    info.last_scrub_stamp = now
+                    pg._scrub_queued = True
                     pg.queue_op(MPGScrub(pg.pgid, deep=False))
 
     # ----------------------------------------------------------- heartbeats

@@ -75,17 +75,33 @@ class ECBatchQueue:
             self.perf.add_u64(key)
         self.perf.add_avg("batch_fill")    # requests per device launch
         self._device_ok: Optional[bool] = None
+        self._probe_started = False
 
     # ------------------------------------------------------------- policy
     def device_available(self) -> bool:
         if self.mode == "off":
             return False
-        if self._device_ok is None:
-            if self.mode == "on":
-                self._device_ok = self._probe()
-            else:  # auto: only a real accelerator is worth the dispatch
-                self._device_ok = self._probe(require_accelerator=True)
-        return self._device_ok
+        if self._device_ok is not None:
+            return self._device_ok
+        if self.mode == "on":
+            self._device_ok = self._probe()
+            return self._device_ok
+        # auto: jax backend discovery can BLOCK for a long time (remote
+        # runtime init / a wedged device tunnel), and it must never stall
+        # the OSD event loop — probe in a daemon thread and serve the
+        # host path until the accelerator proves itself
+        if not self._probe_started:
+            self._probe_started = True
+            import threading
+            threading.Thread(target=self._bg_probe, daemon=True,
+                             name="ec-device-probe").start()
+        return False
+
+    def _bg_probe(self) -> None:
+        ok = self._probe(require_accelerator=True)
+        self._device_ok = ok
+        if ok:
+            self.logger.info("accelerator probe ok: EC batch device on")
 
     def _probe(self, require_accelerator: bool = False) -> bool:
         try:

@@ -80,6 +80,7 @@ class PG:
         self._push_acks: Dict[Tuple[int, str], asyncio.Future] = {}
         self._scrub_map_waiters: Dict[int, asyncio.Future] = {}
         self.last_scrub_result: Optional[Dict] = None
+        self._scrub_queued = False      # scheduler de-dup flag
         from ceph_tpu.osd.backend import ECBackend, ReplicatedBackend
         self.backend = (ECBackend(self) if pool.is_erasure()
                         else ReplicatedBackend(self))
@@ -537,10 +538,17 @@ class PG:
                     await self._do_client_op(m)
                 elif isinstance(m, MPGScrub):
                     # scrub rides the op queue: no client write can
-                    # interleave with the scan (reference write blocking)
-                    if self.is_primary() and self.state == STATE_ACTIVE:
-                        self.last_scrub_result = await scrub_mod.scrub_pg(
-                            self, m.deep, m.repair)
+                    # interleave with the scan (reference write blocking).
+                    # Stamps advance only when the scrub really ran — a
+                    # drop (re-peering) leaves the PG due for retry.
+                    try:
+                        if self.is_primary() and \
+                                self.state == STATE_ACTIVE:
+                            self.last_scrub_result = \
+                                await scrub_mod.scrub_pg(
+                                    self, m.deep, m.repair)
+                    finally:
+                        self._scrub_queued = False
                 elif isinstance(m, MPGScrubScan):
                     scrub_mod.handle_scrub_scan(self, m)
                 else:

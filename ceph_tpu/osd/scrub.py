@@ -164,14 +164,34 @@ async def _scrub_replicated(pg, maps, all_oids, deep, repair):
                 pg.log.latest_entry_for(oid).is_delete():
             continue
         entries = {o: maps[o].get(oid) for o in maps}
-        good = {o for o, e in entries.items() if e is not None
-                and entry_is_good(e, deep)}
-        if not good:
-            errors += 1
-            inconsistent.append(oid)
-            continue   # unrepairable: no copy proves itself
-        # authoritative copy: primary when good, else lowest good osd
-        auth = me if me in good else sorted(good)[0]
+        # copies that PROVE themselves (recomputed crc == stored digest)
+        proven = {o for o, e in entries.items() if e is not None
+                  and deep and e.stored_crc >= 0 and e.computed_crc >= 0
+                  and e.computed_crc == e.stored_crc}
+        if proven:
+            auth = me if me in proven else sorted(proven)[0]
+        else:
+            # digest-less objects (partial-write history): nothing
+            # self-verifies, so majority vote on (size, crc).  Trusting
+            # the primary unconditionally would push primary bit-rot
+            # over good replicas.
+            groups: Dict[tuple, set] = {}
+            for o, e in entries.items():
+                if e is not None:
+                    groups.setdefault((e.size, e.computed_crc),
+                                      set()).add(o)
+            if not groups:
+                errors += 1
+                inconsistent.append(oid)
+                continue
+            best = max(groups.values(), key=len)
+            n_copies = sum(len(g) for g in groups.values())
+            if len(groups) > 1 and len(best) * 2 <= n_copies:
+                # no strict majority: report, never guess a repair
+                errors += len(groups) - 1
+                inconsistent.append(oid)
+                continue
+            auth = me if me in best else sorted(best)[0]
         ref = entries[auth]
         bad = set()
         for o, e in entries.items():

@@ -1,1 +1,13 @@
+"""Services on RADOS (SURVEY §2.9): block images (rbd), striping.
 
+Each service builds purely on the librados-style client API
+(ceph_tpu/client/rados.py) the way the reference's librbd/libradosstriper
+build on librados.
+"""
+
+from ceph_tpu.services.rbd import RBD, Image, ImageExists, ImageNotFound
+from ceph_tpu.services.striper import (Extent, Layout, extents_by_object,
+                                       file_to_extents)
+
+__all__ = ["RBD", "Image", "ImageExists", "ImageNotFound", "Extent",
+           "Layout", "extents_by_object", "file_to_extents"]

@@ -1,0 +1,167 @@
+"""rbd CLI: block-image management + bench.
+
+Reference parity: src/tools/rbd (create/ls/info/rm/resize/bench-write,
+import/export) over the librbd-analog (ceph_tpu/services/rbd.py).
+
+    python -m ceph_tpu.tools.rbd --dir DIR -p pool create NAME --size 64M
+    ... ls | info NAME | rm NAME | resize NAME --size N
+    ... import FILE NAME | export NAME FILE
+    ... bench NAME --io-size 4096 --io-total 4M [--io-pattern rand]
+        [--workload write|read]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import sys
+import time
+
+from ceph_tpu.tools.daemons import load_monmap
+
+
+def parse_size(s: str) -> int:
+    s = str(s).strip().upper()
+    mult = 1
+    for suf, m in (("K", 1 << 10), ("M", 1 << 20), ("G", 1 << 30),
+                   ("T", 1 << 40)):
+        if s.endswith(suf):
+            s, mult = s[:-1], m
+            break
+    return int(float(s) * mult)
+
+
+async def bench(img, io_size: int, io_total: int, pattern: str,
+                workload: str, concurrency: int = 8) -> dict:
+    """rbd bench: closed-loop striped IO (reference rbd bench-write)."""
+    n_ops = max(1, io_total // io_size)
+    payload = bytes((i * 131 + 17) & 0xFF for i in range(io_size))
+    rng = random.Random(42)
+    max_off = max(img.size - io_size, 0)
+    offsets = [(rng.randrange(0, max_off + 1) if pattern == "rand"
+                else (i * io_size) % (max_off + 1))
+               for i in range(n_ops)]
+    stats = {"ops": 0, "lat_sum": 0.0, "lat_max": 0.0}
+    queue = asyncio.Queue()
+    for off in offsets:
+        queue.put_nowait(off)
+
+    async def worker():
+        while not queue.empty():
+            try:
+                off = queue.get_nowait()
+            except asyncio.QueueEmpty:
+                return
+            t0 = time.monotonic()
+            if workload == "write":
+                await img.write(off, payload)
+            else:
+                await img.read(off, io_size)
+            dt = time.monotonic() - t0
+            stats["ops"] += 1
+            stats["lat_sum"] += dt
+            stats["lat_max"] = max(stats["lat_max"], dt)
+
+    t0 = time.monotonic()
+    await asyncio.gather(*[worker() for _ in range(concurrency)])
+    wall = time.monotonic() - t0
+    ops = stats["ops"] or 1
+    return {
+        "workload": workload, "pattern": pattern,
+        "io_size": io_size, "ops": stats["ops"],
+        "seconds": round(wall, 3),
+        "mb_per_sec": round(stats["ops"] * io_size / wall / 1e6, 3),
+        "iops": round(stats["ops"] / wall, 1),
+        "avg_lat_ms": round(1000 * stats["lat_sum"] / ops, 3),
+        "max_lat_ms": round(1000 * stats["lat_max"], 3),
+    }
+
+
+async def run(args) -> int:
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.common.context import Context
+    from ceph_tpu.services.rbd import RBD, Image, RBDError
+    r = Rados(Context("client.admin"), load_monmap(args.dir))
+    await r.connect()
+    try:
+        io = r.open_ioctx(args.pool)
+        rbd = RBD(io)
+        if args.op == "create":
+            await rbd.create(args.args[0], parse_size(args.size),
+                             order=args.order,
+                             stripe_unit=parse_size(args.stripe_unit)
+                             if args.stripe_unit else 0,
+                             stripe_count=args.stripe_count)
+        elif args.op == "ls":
+            for name in await rbd.list():
+                print(name)
+        elif args.op == "info":
+            img = await Image.open(io, args.args[0])
+            st = img.stat()
+            print(f"rbd image '{img.name}':")
+            print(f"\tsize {st['size']} bytes in {st['num_objs']} objects")
+            print(f"\torder {st['order']} ({st['object_size']} B objects)")
+            print(f"\tstripe unit {st['stripe_unit']}, "
+                  f"count {st['stripe_count']}")
+        elif args.op == "rm":
+            await rbd.remove(args.args[0])
+        elif args.op == "resize":
+            img = await Image.open(io, args.args[0])
+            await img.resize(parse_size(args.size))
+        elif args.op == "import":
+            with open(args.args[0], "rb") as f:
+                data = f.read()
+            await rbd.create(args.args[1], len(data), order=args.order)
+            img = await Image.open(io, args.args[1])
+            step = 4 << 20
+            for off in range(0, len(data), step):
+                await img.write(off, data[off:off + step])
+        elif args.op == "export":
+            img = await Image.open(io, args.args[0])
+            step = 4 << 20
+            with open(args.args[1], "wb") as f:
+                for off in range(0, img.size, step):
+                    f.write(await img.read(off, min(step,
+                                                    img.size - off)))
+        elif args.op == "bench":
+            img = await Image.open(io, args.args[0])
+            out = await bench(img, parse_size(args.io_size),
+                              parse_size(args.io_total),
+                              args.io_pattern, args.workload)
+            print(json.dumps(out))
+        else:
+            print(f"unknown op {args.op}", file=sys.stderr)
+            return 2
+        return 0
+    except RBDError as e:
+        print(f"rbd: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    finally:
+        await r.shutdown()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="rbd")
+    ap.add_argument("--dir", default="./vcluster")
+    ap.add_argument("-p", "--pool", default="rbd")
+    ap.add_argument("--size", default="64M")
+    ap.add_argument("--order", type=int, default=22)
+    ap.add_argument("--stripe-unit", default=None)
+    ap.add_argument("--stripe-count", type=int, default=1)
+    ap.add_argument("--io-size", default="4096")
+    ap.add_argument("--io-total", default="4M")
+    ap.add_argument("--io-pattern", choices=("seq", "rand"),
+                    default="seq")
+    ap.add_argument("--workload", choices=("write", "read"),
+                    default="write")
+    ap.add_argument("op",
+                    help="create|ls|info|rm|resize|import|export|bench")
+    ap.add_argument("args", nargs="*")
+    args = ap.parse_args(argv)
+    return asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,7 +86,12 @@ class VCluster:
         p = self.procs.pop(name, None)
         if p is not None:
             p.send_signal(sig)
-            p.wait(timeout=10)
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                # daemon wedged (e.g. stuck device runtime init): escalate
+                p.kill()
+                p.wait(timeout=10)
 
     def restart_daemon(self, name: str) -> None:
         kind, id_ = name.split(".", 1)

