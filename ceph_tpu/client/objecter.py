@@ -28,15 +28,16 @@ class ObjectOperationError(Exception):
 
 
 class _InFlight:
-    __slots__ = ("tid", "oid", "loc", "ops", "fut", "attempts")
+    __slots__ = ("tid", "oid", "loc", "ops", "fut", "attempts", "snapid")
 
-    def __init__(self, tid, oid, loc, ops, fut):
+    def __init__(self, tid, oid, loc, ops, fut, snapid=0):
         self.tid = tid
         self.oid = oid
         self.loc = loc
         self.ops = ops
         self.fut = fut
         self.attempts = 0
+        self.snapid = snapid
 
 
 class Objecter(Dispatcher):
@@ -103,19 +104,29 @@ class Objecter(Dispatcher):
         if addr is None:
             return
         reqid = f"{self.messenger.nonce:x}.{op.tid}"
+        # snap context rides every write from the CURRENT map's pool
+        # snap state (Objecter::_op_submit snapc handling); reads carry
+        # the caller's snapid
+        pool = self.osdmap.pools.get(op.loc.pool)
+        snap_seq, snaps = 0, []
+        if pool is not None and any(o.is_write() for o in op.ops):
+            snap_seq = pool.snap_seq
+            snaps = sorted(pool.snaps, reverse=True)
         self.messenger.send_message(
             MOSDOp(pg, op.oid, op.loc, op.ops, op.tid,
-                   self.osdmap.epoch, reqid), addr, peer_type="osd")
+                   self.osdmap.epoch, reqid, snap_seq=snap_seq,
+                   snaps=snaps, snapid=op.snapid), addr,
+            peer_type="osd")
 
     async def op_submit(self, oid: str, loc: ObjectLocator,
-                        ops: List[OSDOp], timeout: float = 30.0
-                        ) -> MOSDOpReply:
+                        ops: List[OSDOp], timeout: float = 30.0,
+                        snapid: int = 0) -> MOSDOpReply:
         if self.osdmap is None:
             await self.monc.wait_for_osdmap()
         self._tid += 1
         tid = self._tid
         fut = asyncio.get_running_loop().create_future()
-        op = _InFlight(tid, oid, loc, ops, fut)
+        op = _InFlight(tid, oid, loc, ops, fut, snapid)
         self._inflight[tid] = op
         self._send(op)
         try:

@@ -15,13 +15,13 @@ from ceph_tpu.client.objecter import ObjectOperationError, Objecter
 from ceph_tpu.common.context import Context
 from ceph_tpu.mon.client import MonClient
 from ceph_tpu.mon.monmap import MonMap
-from ceph_tpu.msg.messenger import Messenger
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
 from ceph_tpu.msg.types import EntityName
 from ceph_tpu.osd.messages import (
-    OSDOp, OP_CREATE, OP_DELETE, OP_GETXATTR, OP_OMAP_GET_VALS,
-    OP_OMAP_RM_KEYS, OP_OMAP_SET, OP_PGLS, OP_READ, OP_SETXATTR,
-    OP_STAT, OP_WRITE,
-    OP_WRITEFULL,
+    OSDOp, OP_ASSERT_EXISTS, OP_CMPXATTR, OP_CREATE, OP_DELETE,
+    OP_GETXATTR, OP_LIST_SNAPS, OP_NOTIFY, OP_OMAP_GET_VALS,
+    OP_OMAP_RM_KEYS, OP_OMAP_SET, OP_PGLS, OP_READ, OP_ROLLBACK,
+    OP_SETXATTR, OP_STAT, OP_WATCH, OP_WRITE, OP_WRITEFULL,
 )
 from ceph_tpu.osd.types import ObjectLocator, PGId
 
@@ -38,6 +38,8 @@ class Rados:
         self.monc: Optional[MonClient] = None
         self.objecter: Optional[Objecter] = None
         self.connected = False
+        # (pool_id, oid) -> notify callback (librados watch2 registry)
+        self._watch_cbs: Dict[tuple, object] = {}
 
     @classmethod
     def from_monmap_file(cls, path: str, **kw) -> "Rados":
@@ -51,10 +53,36 @@ class Rados:
         await self.messenger.bind()   # clients bind too: maps/replies
         self.monc = MonClient(self.ctx, self.messenger, self.monmap)
         self.objecter = Objecter(self.ctx, self.messenger, self.monc)
+        self.messenger.add_dispatcher(_WatchDispatcher(self))
         self.monc.sub_want("osdmap", 0)
+        self.monc.on_osdmap(self._rewatch)
         await self.monc.wait_for_osdmap()
         self.connected = True
         return self
+
+    # -- watch plumbing (librados watch2: callbacks on notify) --
+    def register_watch(self, ioctx, oid: str, cb) -> None:
+        self._watch_cbs[(ioctx.pool_id, oid)] = cb
+
+    def unregister_watch(self, ioctx, oid: str) -> None:
+        self._watch_cbs.pop((ioctx.pool_id, oid), None)
+
+    def _rewatch(self, osdmap) -> None:
+        """Every map change re-registers watches with the (possibly new)
+        primary — watch state is primary-local, so a failover would
+        otherwise orphan us silently."""
+        from ceph_tpu.osd.messages import OSDOp, OP_WATCH
+        for (pool_id, oid) in list(self._watch_cbs):
+            loc = ObjectLocator(pool_id)
+
+            async def rewatch(oid=oid, loc=loc):
+                try:
+                    await self.objecter.op_submit(
+                        oid, loc, [OSDOp(OP_WATCH, offset=1)], 10.0)
+                except Exception:
+                    self.ctx.logger("rados").warning(
+                        f"re-watch {oid} failed")
+            asyncio.get_running_loop().create_task(rewatch())
 
     async def shutdown(self) -> None:
         if self.messenger is not None:
@@ -89,6 +117,33 @@ class Rados:
         return IoCtx(self, pool_id, pool_name)
 
 
+class _WatchDispatcher(Dispatcher):
+    """Client-side notify delivery: run the registered callback, ack the
+    OSD (the WatchNotifyInfo completion role)."""
+
+    def __init__(self, rados: Rados):
+        self.rados = rados
+
+    def ms_dispatch(self, m) -> bool:
+        from ceph_tpu.osd.messages import MWatchNotify, MWatchNotifyAck
+        if not isinstance(m, MWatchNotify):
+            return False
+        cb = getattr(self.rados, "_watch_cbs", {}).get(
+            (m.pgid.pool, m.oid))
+        reply = b""
+        if cb is not None:
+            try:
+                out = cb(m.oid, m.notify_id, m.payload)
+                if isinstance(out, bytes):
+                    reply = out
+            except Exception:
+                self.rados.ctx.logger("rados").exception("watch callback")
+        self.rados.messenger.send_message(
+            MWatchNotifyAck(m.pgid, m.oid, m.notify_id, reply),
+            m.src_addr, peer_type="osd")
+        return True
+
+
 class IoCtx:
     """Per-pool I/O context (librados::IoCtx / IoCtxImpl)."""
 
@@ -99,16 +154,92 @@ class IoCtx:
         self.pool_name = pool_name
         self.namespace = ""
         self.locator_key = ""
+        self.snap_read = 0        # 0 = head; set via set_snap_read
 
     def _loc(self) -> ObjectLocator:
         return ObjectLocator(self.pool_id, self.locator_key, self.namespace)
 
     async def _op(self, oid: str, ops: List[OSDOp], timeout=30.0):
         reply = await self.objecter.op_submit(oid, self._loc(), ops,
-                                              timeout)
+                                              timeout,
+                                              snapid=self.snap_read)
         if reply.result < 0:
             raise ObjectOperationError(reply.result, oid)
         return reply
+
+    # ---- snapshots (librados selfmanaged/pool-snap surface) ----
+    def set_snap_read(self, snapid: int) -> None:
+        """Subsequent reads target this snap (0 = head) —
+        librados set_read."""
+        self.snap_read = snapid
+
+    def snap_lookup(self, name: str) -> int:
+        pool = self.rados.monc.osdmap.pools[self.pool_id]
+        for sid, n in pool.snaps.items():
+            if n == name:
+                return sid
+        raise ObjectOperationError(-errno.ENOENT, f"snap {name!r}")
+
+    def snap_list(self) -> Dict[int, str]:
+        return dict(self.rados.monc.osdmap.pools[self.pool_id].snaps)
+
+    async def snap_create(self, name: str) -> None:
+        await self.rados.mon_command({"prefix": "osd pool mksnap",
+                                      "pool": self.pool_name,
+                                      "snap": name})
+        await self._wait_snap(lambda p: name in p.snaps.values())
+
+    async def snap_remove(self, name: str) -> None:
+        await self.rados.mon_command({"prefix": "osd pool rmsnap",
+                                      "pool": self.pool_name,
+                                      "snap": name})
+        await self._wait_snap(lambda p: name not in p.snaps.values())
+
+    async def _wait_snap(self, pred) -> None:
+        while not pred(self.rados.monc.osdmap.pools[self.pool_id]):
+            await asyncio.sleep(0.05)
+
+    async def rollback(self, oid: str, snap_name: str) -> None:
+        """Restore head from a pool snap (rados rollback)."""
+        sid = self.snap_lookup(snap_name)
+        await self._op(oid, [OSDOp(OP_ROLLBACK, offset=sid)])
+
+    async def list_snaps(self, oid: str) -> dict:
+        import json
+        reply = await self._op(oid, [OSDOp(OP_LIST_SNAPS)])
+        return json.loads(reply.ops[0].outdata)
+
+    # ---- guards ----
+    async def cmpxattr(self, oid: str, name: str, value: bytes) -> bool:
+        try:
+            await self._op(oid, [OSDOp(OP_CMPXATTR, name=name,
+                                       data=value)])
+            return True
+        except ObjectOperationError as e:
+            if e.retcode == -errno.ECANCELED:
+                return False
+            raise
+
+    async def assert_exists(self, oid: str) -> None:
+        await self._op(oid, [OSDOp(OP_ASSERT_EXISTS)])
+
+    # ---- watch/notify (librados watch2/notify2) ----
+    async def watch(self, oid: str, callback) -> None:
+        """Register `callback(oid, notify_id, payload)` for notifies on
+        `oid`.  Acks are sent automatically after the callback runs."""
+        self.rados.register_watch(self, oid, callback)
+        await self._op(oid, [OSDOp(OP_WATCH, offset=1)])
+
+    async def unwatch(self, oid: str) -> None:
+        await self._op(oid, [OSDOp(OP_WATCH, offset=0)])
+        self.rados.unregister_watch(self, oid)
+
+    async def notify(self, oid: str, payload: bytes = b"",
+                     timeout_ms: int = 5000) -> dict:
+        import json
+        reply = await self._op(oid, [OSDOp(OP_NOTIFY, data=payload,
+                                           length=timeout_ms)])
+        return json.loads(reply.ops[0].outdata)
 
     # ---- data ops ----
     async def write_full(self, oid: str, data: bytes) -> None:

@@ -324,6 +324,9 @@ class OSDMonitor(PaxosService):
                 return
             self.pending_inc.old_ec_profiles.append(name)
             self._propose_and_ack(m, outs=f"profile {name!r} removed")
+        elif prefix in ("osd pool mksnap", "osd pool rmsnap",
+                        "osd pool lssnap"):
+            self._cmd_pool_snap(m, prefix.rsplit(" ", 1)[1])
         elif prefix in ("pg scrub", "pg deep-scrub"):
             # route to the PG's acting primary (reference
             # OSDMonitor/MOSDScrub path)
@@ -365,6 +368,50 @@ class OSDMonitor(PaxosService):
             self._propose_and_ack(m)
         else:
             ack(-errno.EINVAL, f"unknown osd command {prefix!r}")
+
+    def _cmd_pool_snap(self, m: MMonCommand, verb: str) -> None:
+        """Pool snapshots (OSDMonitor mksnap/rmsnap; pg_pool_t snap
+        state rides the map so every OSD/client sees the same snapc)."""
+        import copy
+        import json as _json
+        cmd = m.cmd
+        name = cmd.get("pool", "")
+        pid = self.osdmap.lookup_pool(name)
+        if pid < 0:
+            self.mon.reply(m, MMonCommandAck(
+                m.tid, -errno.ENOENT, f"no pool {name!r}"))
+            return
+        pool = copy.deepcopy(self.pending_inc.new_pools.get(
+            pid, self.osdmap.pools[pid]))
+        snap = cmd.get("snap", "")
+        if verb == "lssnap":
+            self.mon.reply(m, MMonCommandAck(m.tid, 0, _json.dumps(
+                [{"id": sid, "name": n}
+                 for sid, n in sorted(pool.snaps.items())])))
+            return
+        if verb == "mksnap":
+            if snap in pool.snaps.values():
+                self.mon.reply(m, MMonCommandAck(
+                    m.tid, -errno.EEXIST, f"snap {snap!r} exists"))
+                return
+            pool.snap_seq += 1
+            pool.snaps[pool.snap_seq] = snap
+            self.pending_inc.new_pools[pid] = pool
+            self._propose_and_ack(
+                m, outs=f"created pool {name} snap {snap} "
+                        f"(id {pool.snap_seq})")
+        else:   # rmsnap
+            sid = next((i for i, n in pool.snaps.items() if n == snap),
+                       None)
+            if sid is None:
+                self.mon.reply(m, MMonCommandAck(
+                    m.tid, -errno.ENOENT, f"no snap {snap!r}"))
+                return
+            del pool.snaps[sid]
+            pool.removed_snaps.append(sid)
+            self.pending_inc.new_pools[pid] = pool
+            self._propose_and_ack(m, outs=f"removed pool {name} snap "
+                                          f"{snap}")
 
     def _cmd_pool_create(self, m: MMonCommand) -> None:
         cmd = m.cmd

@@ -27,10 +27,12 @@ from ceph_tpu.osd.messages import (
     EVersion, MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDOp, MOSDRepOp, MOSDRepOpReply, MPGPush,
     OSDOp,
-    OP_APPEND, OP_CREATE, OP_DELETE, OP_GETXATTR, OP_GETXATTRS,
+    OP_APPEND, OP_ASSERT_EXISTS, OP_CMPXATTR, OP_CREATE, OP_DELETE,
+    OP_GETXATTR, OP_GETXATTRS, OP_LIST_SNAPS, OP_NOTIFY,
     OP_OMAP_GET_HEADER, OP_OMAP_GET_VALS, OP_OMAP_RM_KEYS, OP_OMAP_SET,
-    OP_OMAP_SET_HEADER, OP_PGLS, OP_READ, OP_RMXATTR, OP_SETXATTR,
-    OP_STAT, OP_TRUNCATE, OP_WRITE, OP_WRITEFULL, OP_ZERO,
+    OP_OMAP_SET_HEADER, OP_PGLS, OP_READ, OP_RMXATTR, OP_ROLLBACK,
+    OP_SETXATTR, OP_STAT, OP_TRUNCATE, OP_WATCH, OP_WRITE, OP_WRITEFULL,
+    OP_ZERO,
 )
 from ceph_tpu.crush.constants import CRUSH_ITEM_NONE
 from ceph_tpu.osd.pglog import LOG_DELETE, LOG_MODIFY, LogEntry
@@ -166,10 +168,38 @@ class PGBackend:
 
 # ===================================================================== util
 
+def _list_snaps(pg, oid: str, op: OSDOp) -> int:
+    """OP_LIST_SNAPS: the object's SnapSet as json (librados
+    list_snaps / the snapdir listing role)."""
+    import json
+    from ceph_tpu.osd import snaps as snaps_mod
+    ss = snaps_mod.load_snapset(pg.osd.store, pg.cid, pg.meta_oid, oid)
+    if ss is None:
+        op.outdata = json.dumps({"seq": 0, "clones": []}).encode()
+        return 0
+    op.outdata = json.dumps({
+        "seq": ss.seq,
+        "clones": [{"id": c, "snaps": ss.clone_snaps.get(c, [])}
+                   for c in ss.clones]}).encode()
+    return 0
+
+
 def execute_read_op(store, cid, soid, op: OSDOp) -> int:
     """One read-class op against committed state; fills rval/outdata."""
     try:
-        if op.op == OP_READ:
+        if op.op == OP_ASSERT_EXISTS:
+            store.stat(cid, soid)
+            op.rval = 0
+        elif op.op == OP_CMPXATTR:
+            # guard: stored xattr equals op.data, else ECANCELED
+            # (reference do_osd_ops CEPH_OSD_OP_CMPXATTR)
+            store.stat(cid, soid)          # ENOENT if no object
+            try:
+                cur = store.getattr(cid, soid, op.name)
+            except (NoSuchObject, KeyError):
+                cur = None
+            op.rval = 0 if cur == op.data else -errno.ECANCELED
+        elif op.op == OP_READ:
             length = op.length if op.length else -1
             op.outdata = store.read(cid, soid, op.offset, length)
             op.rval = len(op.outdata)
@@ -260,16 +290,43 @@ class ReplicatedBackend(PGBackend):
     async def submit_client_write(self, m: MOSDOp) -> int:
         pg = self.pg
         soid = pg.object_id(m.oid)
-        # read-class ops in the batch see pre-write state
+        # watch registration is primary-local state, not a store txn
+        watch_ops = [op for op in m.ops if op.op == OP_WATCH]
+        if watch_ops:
+            for op in watch_ops:
+                pg.handle_watch(m, op)
+            if all(op.op == OP_WATCH for op in m.ops):
+                return 0
+        # read-class ops in the batch see pre-write state; guard ops
+        # (cmpxattr/assert-exists) abort the whole op on mismatch
         for op in m.ops:
             if not op.is_write():
                 if op.op == OP_PGLS:
                     self._do_pgls(op)
                 else:
-                    execute_read_op(self.osd.store, pg.cid, soid, op)
+                    rv = execute_read_op(self.osd.store, pg.cid, soid, op)
+                    if op.op in (OP_CMPXATTR, OP_ASSERT_EXISTS) and rv < 0:
+                        return rv
+        from ceph_tpu.osd import snaps as snaps_mod
         txn = Transaction()
-        result, deletes = build_write_txn(self.osd.store, pg.cid, soid,
-                                          m.ops, txn)
+        # clone-on-write BEFORE mutations: the clone op captures
+        # pre-write bytes (ReplicatedPG::make_writeable)
+        snaps_mod.prepare_cow(pg, m.oid, m.snap_seq, m.snaps,
+                              [(txn, pg.cid, soid)])
+        rollbacks = [op for op in m.ops if op.op == OP_ROLLBACK]
+        for op in rollbacks:
+            try:
+                src = snaps_mod.rollback_targets(pg, m.oid, soid,
+                                                 op.offset)
+            except KeyError:
+                return -errno.ENOENT
+            if src is not None:
+                txn.remove(pg.cid, soid)
+                txn.clone(pg.cid, src, soid)
+        result, deletes = build_write_txn(
+            self.osd.store, pg.cid, soid,
+            [op for op in m.ops if op.op not in (OP_ROLLBACK, OP_WATCH)],
+            txn)
         if result < 0:
             return result
         # object digest (data_digest role): full-object writes record the
@@ -309,11 +366,25 @@ class ReplicatedBackend(PGBackend):
 
     async def do_reads(self, m: MOSDOp) -> int:
         pg = self.pg
-        soid = pg.object_id(m.oid)
+        from ceph_tpu.osd import snaps as snaps_mod
+        head = pg.object_id(m.oid)
+        soid = head
+        if m.snapid:
+            soid = snaps_mod.resolve_read(pg, m.oid, head, m.snapid)
         result = 0
         for op in m.ops:
             if op.op == OP_PGLS:
                 self._do_pgls(op)
+            elif op.op == OP_NOTIFY:
+                op.rval = await pg.handle_notify(m, op)
+                if op.rval < 0 and result == 0:
+                    result = op.rval
+            elif op.op == OP_LIST_SNAPS:
+                op.rval = _list_snaps(pg, m.oid, op)
+            elif soid is None:
+                op.rval = -errno.ENOENT
+                if result == 0:
+                    result = op.rval
             else:
                 rv = execute_read_op(self.osd.store, pg.cid, soid, op)
                 if rv < 0 and result == 0:
@@ -323,7 +394,7 @@ class ReplicatedBackend(PGBackend):
     def _do_pgls(self, op: OSDOp) -> None:
         names = [o.name for o in
                  self.osd.store.collection_list(self.pg.cid)
-                 if o.name != self.pg.meta_oid.name]
+                 if o.name != self.pg.meta_oid.name and o.is_head()]
         op.outdata = b"\x00".join(n.encode() for n in names)
         op.rval = len(names)
 
@@ -403,12 +474,19 @@ class ECBackend(PGBackend):
     async def submit_client_write(self, m: MOSDOp) -> int:
         pg = self.pg
         soid = pg.object_id(m.oid)
+        watch_ops = [op for op in m.ops if op.op == OP_WATCH]
+        if watch_ops:
+            for op in watch_ops:
+                pg.handle_watch(m, op)
+            if all(op.op == OP_WATCH for op in m.ops):
+                return 0
         for op in m.ops:
             if not op.is_write():
-                rv = await self._read_op(m.oid, op)
+                rv = await self._read_op(m.oid, op, m.snapid)
                 if rv < 0:
                     return rv
-        writes = [op for op in m.ops if op.is_write()]
+        writes = [op for op in m.ops
+                  if op.is_write() and op.op != OP_WATCH]
         unsupported = {OP_WRITE, OP_APPEND, OP_ZERO, OP_OMAP_SET,
                        OP_OMAP_RM_KEYS, OP_OMAP_SET_HEADER}
         if any(op.op in unsupported for op in writes):
@@ -423,6 +501,23 @@ class ECBackend(PGBackend):
                 for i in range(self.n)}
         shard_txns: Dict[int, Transaction] = {
             i: Transaction() for i in range(self.n)}
+        # clone-on-write: every shard clones ITS OWN chunk object in its
+        # txn — no chunk bytes travel for the snapshot itself
+        from ceph_tpu.osd import snaps as snaps_mod
+        snaps_mod.prepare_cow(
+            pg, m.oid, m.snap_seq, m.snaps,
+            [(shard_txns[i], cids[i], soid) for i in range(self.n)])
+        for op in [o for o in writes if o.op == OP_ROLLBACK]:
+            try:
+                src = snaps_mod.rollback_targets(pg, m.oid, soid,
+                                                 op.offset)
+            except KeyError:
+                return -errno.ENOENT
+            if src is not None:
+                for i, t in shard_txns.items():
+                    t.remove(cids[i], soid)
+                    t.clone(cids[i], src, soid)
+        writes = [op for op in writes if op.op != OP_ROLLBACK]
         from ceph_tpu.common.crc import crc32c
         from ceph_tpu.osd.scrub import CRC_XATTR
         empty_crc = str(crc32c(b"")).encode()
@@ -495,19 +590,38 @@ class ECBackend(PGBackend):
             if op.op == OP_PGLS:
                 names = [o.name for o in
                          self.osd.store.collection_list(self.pg.cid)
-                         if o.name != self.pg.meta_oid.name]
+                         if o.name != self.pg.meta_oid.name
+                         and o.is_head()]
                 op.outdata = b"\x00".join(n.encode() for n in names)
                 op.rval = len(names)
                 continue
-            rv = await self._read_op(m.oid, op)
+            if op.op == OP_NOTIFY:
+                op.rval = await self.pg.handle_notify(m, op)
+                if op.rval < 0 and result == 0:
+                    result = op.rval
+                continue
+            if op.op == OP_LIST_SNAPS:
+                op.rval = _list_snaps(self.pg, m.oid, op)
+                continue
+            rv = await self._read_op(m.oid, op, m.snapid)
             if rv < 0 and result == 0:
                 result = rv
         return result
 
-    async def _read_op(self, oid: str, op: OSDOp) -> int:
+    async def _read_op(self, oid: str, op: OSDOp, snapid: int = 0) -> int:
         pg = self.pg
-        soid = pg.object_id(oid)
-        if op.op in (OP_GETXATTR, OP_GETXATTRS, OP_STAT):
+        from ceph_tpu.osd import snaps as snaps_mod
+        head = pg.object_id(oid)
+        soid = head
+        snap = 0
+        if snapid:
+            soid = snaps_mod.resolve_read(pg, oid, head, snapid)
+            if soid is None:
+                op.rval = -errno.ENOENT
+                return op.rval
+            snap = 0 if soid == head else soid.snap
+        if op.op in (OP_GETXATTR, OP_GETXATTRS, OP_STAT, OP_CMPXATTR,
+                     OP_ASSERT_EXISTS):
             # xattrs are replicated on every shard; size is in SIZE_XATTR
             if op.op == OP_STAT:
                 try:
@@ -526,7 +640,7 @@ class ECBackend(PGBackend):
         except (NoSuchObject, NoSuchCollection):
             op.rval = -errno.ENOENT
             return op.rval
-        whole = await self._read_object(oid, size)
+        whole = await self._read_object(oid, size, snap)
         if whole is None:
             op.rval = -errno.EIO
             return op.rval
@@ -548,14 +662,17 @@ class ECBackend(PGBackend):
         return out
 
     async def _gather_shards(self, oid: str,
-                             exclude: Set[int] = frozenset()
+                             exclude: Set[int] = frozenset(),
+                             snap: int = 0
                              ) -> Optional[Tuple[Dict[int, np.ndarray],
                                                  Dict[str, bytes]]]:
         """Collect >=k shard streams (minimum_to_decode role): local read
         for our shard, sub-op reads for the rest.  Returns (streams,
-        attrs-from-any-shard) or None."""
+        attrs-from-any-shard) or None.  `snap` reads clone chunks."""
         pg = self.pg
         soid = pg.object_id(oid)
+        if snap:
+            soid = soid.with_snap(snap)
         streams: Dict[int, np.ndarray] = {}
         attrs: Dict[str, bytes] = {}
         exclude = set(exclude) | self._stale_shards(oid)
@@ -582,7 +699,7 @@ class ECBackend(PGBackend):
             fut = asyncio.get_running_loop().create_future()
             self._inflight[tid] = ({osd_id}, fut)
             self.osd.send_osd(osd_id, MOSDECSubOpRead(
-                pg.pgid.with_shard(i), tid, [(oid, 0, -1)]))
+                pg.pgid.with_shard(i), tid, [(oid, 0, -1)], snap=snap))
             try:
                 reply: MOSDECSubOpReadReply = \
                     await asyncio.wait_for(fut, 15.0)
@@ -603,8 +720,9 @@ class ECBackend(PGBackend):
             return None
         return streams, attrs
 
-    async def _read_object(self, oid: str, size: int) -> Optional[bytes]:
-        got = await self._gather_shards(oid)
+    async def _read_object(self, oid: str, size: int,
+                           snap: int = 0) -> Optional[bytes]:
+        got = await self._gather_shards(oid, snap=snap)
         if got is None:
             return None
         streams, _ = got
@@ -701,6 +819,8 @@ class ECBackend(PGBackend):
             result = 0
             for oid, off, ln in m.reads:
                 soid = pg.object_id(oid)
+                if m.snap:
+                    soid = soid.with_snap(m.snap)
                 try:
                     data.append(self.osd.store.read(
                         pg.cid, soid, off, ln if ln >= 0 else -1))

@@ -27,6 +27,7 @@ from ceph_tpu.osd.messages import (
     MOSDECSubOpWriteReply, MOSDOp, MOSDOpReply, MOSDPing, MOSDRepOp,
     MOSDRepOpReply, MPGLog, MPGLogRequest, MPGNotify, MPGObjectList,
     MPGPush, MPGPushReply, MPGQuery, MPGScrub, MPGScrubMap, MPGScrubScan,
+    MWatchNotifyAck,
 )
 from ceph_tpu.osd.osdmap import OSDMap
 from ceph_tpu.osd.pg import PG
@@ -151,6 +152,7 @@ class OSD(Dispatcher):
                 pg.start()
             pg.pool = m.pools[pool_id]
             pg.advance_map(m)
+            pg.maybe_trim_snaps()
 
     def note_pg_active(self, pg: PG) -> None:
         """Primary finished peering: assert up_thru (MOSDAlive), once per
@@ -252,6 +254,11 @@ class OSD(Dispatcher):
             if pg is not None:
                 pg.on_object_list(m)
             return True
+        if isinstance(m, MWatchNotifyAck):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_notify_ack(m)     # primary awaits: bypass op queue
+            return True
         if isinstance(m, (MPGScrub, MPGScrubScan)):
             pg = self._pg_for(m.pgid)
             if pg is not None:
@@ -276,7 +283,24 @@ class OSD(Dispatcher):
             self.reply_to(m, MOSDOpReply(
                 m.tid, -errno.EAGAIN, map_epoch=self.osdmap.epoch))
             return
+        from ceph_tpu.osd.messages import OP_NOTIFY
+        if m.ops and all(o.op == OP_NOTIFY for o in m.ops):
+            # notify gathers remote acks for seconds and touches no
+            # object state: run it OFF the PG's serial worker so it
+            # cannot stall client I/O behind a slow/dead watcher
+            asyncio.get_running_loop().create_task(
+                self._do_notify_op(pg, m))
+            return
         pg.queue_op(m)
+
+    async def _do_notify_op(self, pg, m: MOSDOp) -> None:
+        result = 0
+        for op in m.ops:
+            op.rval = await pg.handle_notify(m, op)
+            if op.rval < 0 and result == 0:
+                result = op.rval
+        self.reply_to(m, MOSDOpReply(m.tid, result, m.ops,
+                                     self.osdmap.epoch))
 
     # ---------------------------------------------------------------- scrub
     async def _scrub_scheduler(self) -> None:

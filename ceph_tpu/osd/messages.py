@@ -18,6 +18,7 @@ from ceph_tpu.osd.types import ObjectLocator, PGId
 # implements — the interpreter is ReplicatedPG::do_osd_ops :4317)
 OP_READ = 1
 OP_STAT = 2
+OP_ASSERT_EXISTS = 3  # fail the op with ENOENT unless the object exists
 OP_WRITE = 10
 OP_WRITEFULL = 11
 OP_APPEND = 12
@@ -25,20 +26,25 @@ OP_TRUNCATE = 13
 OP_ZERO = 14
 OP_DELETE = 15
 OP_CREATE = 16
+OP_ROLLBACK = 17      # restore head from the snap in op.offset
 OP_GETXATTR = 20
 OP_SETXATTR = 21
 OP_RMXATTR = 22
 OP_GETXATTRS = 23
+OP_CMPXATTR = 24      # guard: stored xattr == op.data else ECANCELED
 OP_OMAP_GET_VALS = 30
 OP_OMAP_SET = 31
 OP_OMAP_RM_KEYS = 32
 OP_OMAP_GET_HEADER = 33
 OP_OMAP_SET_HEADER = 34
 OP_PGLS = 40          # list objects in pg (rados ls)
+OP_LIST_SNAPS = 41    # per-object SnapSet dump (librados list_snaps)
+OP_WATCH = 50         # op.offset: 1 = watch, 0 = unwatch
+OP_NOTIFY = 51        # fan payload out to watchers, gather acks
 
 WRITE_OPS = {OP_WRITE, OP_WRITEFULL, OP_APPEND, OP_TRUNCATE, OP_ZERO,
-             OP_DELETE, OP_CREATE, OP_SETXATTR, OP_RMXATTR, OP_OMAP_SET,
-             OP_OMAP_RM_KEYS, OP_OMAP_SET_HEADER}
+             OP_DELETE, OP_CREATE, OP_ROLLBACK, OP_SETXATTR, OP_RMXATTR,
+             OP_OMAP_SET, OP_OMAP_RM_KEYS, OP_OMAP_SET_HEADER, OP_WATCH}
 
 
 class OSDOp(Encodable):
@@ -123,13 +129,18 @@ class EVersion(Encodable):
 
 @register_message
 class MOSDOp(Message):
-    """Client -> primary OSD op (messages/MOSDOp.h)."""
+    """Client -> primary OSD op (messages/MOSDOp.h).  v2 adds the snap
+    context for writes (snap_seq + existing snap ids) and the read
+    snapid (0 = head), mirroring MOSDOp's snapc/snapid fields."""
     TYPE = 200
+    STRUCT_V = 2
 
     def __init__(self, pgid: Optional[PGId] = None, oid: str = "",
                  loc: Optional[ObjectLocator] = None,
                  ops: Optional[List[OSDOp]] = None, tid: int = 0,
-                 map_epoch: int = 0, reqid: str = ""):
+                 map_epoch: int = 0, reqid: str = "",
+                 snap_seq: int = 0, snaps: Optional[List[int]] = None,
+                 snapid: int = 0):
         super().__init__()
         self.pgid = pgid or PGId(0, 0)
         self.oid = oid
@@ -138,17 +149,28 @@ class MOSDOp(Message):
         self.tid = tid
         self.map_epoch = map_epoch
         self.reqid = reqid      # osd_reqid_t: client-unique, resend-stable
+        self.snap_seq = snap_seq      # write snapc: newest pool snap seq
+        self.snaps = snaps or []      # write snapc: existing snap ids
+        self.snapid = snapid          # read target snap (0 = head)
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid).string(self.oid).struct(self.loc)
         enc.list_(self.ops, lambda e, o: e.struct(o))
         enc.u64(self.tid).u32(self.map_epoch).string(self.reqid)
+        enc.u64(self.snap_seq)
+        enc.list_(self.snaps, lambda e, v: e.u64(v))
+        enc.u64(self.snapid)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDOp":
-        return cls(dec.struct(PGId), dec.string(), dec.struct(ObjectLocator),
-                   dec.list_(lambda d: d.struct(OSDOp)), dec.u64(),
-                   dec.u32(), dec.string())
+        m = cls(dec.struct(PGId), dec.string(), dec.struct(ObjectLocator),
+                dec.list_(lambda d: d.struct(OSDOp)), dec.u64(),
+                dec.u32(), dec.string())
+        if struct_v >= 2:
+            m.snap_seq = dec.u64()
+            m.snaps = dec.list_(lambda d: d.u64())
+            m.snapid = dec.u64()
+        return m
 
 
 @register_message
@@ -280,26 +302,34 @@ class MOSDECSubOpWriteReply(Message):
 
 @register_message
 class MOSDECSubOpRead(Message):
-    """Primary -> shard chunk read: (oid, off, len) list."""
+    """Primary -> shard chunk read: (oid, off, len) list.  v2 adds the
+    snap each read targets (clone chunk reads for snapshot decode)."""
     TYPE = 206
+    STRUCT_V = 2
     PRIORITY = PRIO_HIGH
 
     def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
-                 reads: Optional[List[Tuple[str, int, int]]] = None):
+                 reads: Optional[List[Tuple[str, int, int]]] = None,
+                 snap: int = 0):
         super().__init__()
         self.pgid = pgid or PGId(0, 0)
         self.tid = tid
         self.reads = reads or []
+        self.snap = snap              # 0 = head
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid).u64(self.tid)
         enc.list_(self.reads, lambda e, r: (e.string(r[0]), e.u64(r[1]),
                                             e.s64(r[2])))
+        enc.u64(self.snap)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int):
-        return cls(dec.struct(PGId), dec.u64(),
-                   dec.list_(lambda d: (d.string(), d.u64(), d.s64())))
+        m = cls(dec.struct(PGId), dec.u64(),
+                dec.list_(lambda d: (d.string(), d.u64(), d.s64())))
+        if struct_v >= 2:
+            m.snap = dec.u64()
+        return m
 
 
 @register_message
@@ -656,3 +686,57 @@ class MPGScrubMap(Message):
         return cls(dec.struct(PGId), dec.u64(),
                    dec.map_(lambda d: d.string(),
                             lambda d: d.struct(ScrubEntry)), dec.s32())
+
+
+# ----------------------------------------------------------- watch/notify
+
+@register_message
+class MWatchNotify(Message):
+    """OSD -> watching client: a notify fired on an object you watch
+    (messages/MWatchNotify.h)."""
+    TYPE = 230
+    PRIORITY = PRIO_HIGH
+
+    def __init__(self, pgid: Optional[PGId] = None, oid: str = "",
+                 notify_id: int = 0, payload: bytes = b"",
+                 from_osd: int = -1):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.oid = oid
+        self.notify_id = notify_id
+        self.payload = payload
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).string(self.oid).u64(self.notify_id)
+        enc.bytes_(self.payload).s32(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MWatchNotify":
+        return cls(dec.struct(PGId), dec.string(), dec.u64(),
+                   dec.bytes_(), dec.s32())
+
+
+@register_message
+class MWatchNotifyAck(Message):
+    """Watching client -> OSD: notify delivered (+ optional reply)."""
+    TYPE = 231
+    PRIORITY = PRIO_HIGH
+
+    def __init__(self, pgid: Optional[PGId] = None, oid: str = "",
+                 notify_id: int = 0, reply: bytes = b""):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.oid = oid
+        self.notify_id = notify_id
+        self.reply = reply
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).string(self.oid).u64(self.notify_id)
+        enc.bytes_(self.reply)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int
+                       ) -> "MWatchNotifyAck":
+        return cls(dec.struct(PGId), dec.string(), dec.u64(),
+                   dec.bytes_())

@@ -81,6 +81,14 @@ class PG:
         self._scrub_map_waiters: Dict[int, asyncio.Future] = {}
         self.last_scrub_result: Optional[Dict] = None
         self._scrub_queued = False      # scheduler de-dup flag
+        # watch/notify (osd/Watch.h): oid -> {watcher name: client addr}.
+        # Primary-local session state; clients re-register on every new
+        # osdmap (Rados._rewatch), covering primary changes, and
+        # watchers that miss a notify are reaped (timeout role).
+        self.watches: Dict[str, Dict[str, object]] = {}
+        self._notify_acks: Dict[int, Tuple[Set[str], asyncio.Future,
+                                           List]] = {}
+        self._trimmed_snaps: Set[int] = set()
         from ceph_tpu.osd.backend import ECBackend, ReplicatedBackend
         self.backend = (ECBackend(self) if pool.is_erasure()
                         else ReplicatedBackend(self))
@@ -598,6 +606,89 @@ class PG:
             result = -errno.EAGAIN
         self.osd.reply_to(m, MOSDOpReply(
             m.tid, result, m.ops, self.osd.osdmap.epoch))
+
+    # -------------------------------------------------------- watch/notify
+    def handle_watch(self, m, op) -> None:
+        """OP_WATCH (op.offset: 1=watch, 0=unwatch) — osd/Watch.h:46.
+        Watcher identity is the client entity; deliveries go to its
+        messenger address."""
+        key = str(m.src_name)
+        watchers = self.watches.setdefault(m.oid, {})
+        if op.offset:
+            watchers[key] = m.src_addr
+        else:
+            watchers.pop(key, None)
+            if not watchers:
+                self.watches.pop(m.oid, None)
+        op.rval = 0
+
+    async def handle_notify(self, m, op) -> int:
+        """OP_NOTIFY: fan op.data out to every watcher, gather acks with
+        a timeout (reference Watch.cc notify machinery).  outdata = json
+        of acked/missed watcher names."""
+        import json
+        from ceph_tpu.osd.messages import MWatchNotify
+        watchers = dict(self.watches.get(m.oid, {}))
+        notify_id = self.osd.next_tid()
+        if not watchers:
+            op.outdata = json.dumps({"acked": [], "missed": []}).encode()
+            return 0
+        fut = asyncio.get_running_loop().create_future()
+        pending = set(watchers)
+        replies: List = []
+        self._notify_acks[notify_id] = (pending, fut, replies)
+        msg = MWatchNotify(self.pgid, m.oid, notify_id, op.data,
+                           self.osd.whoami)
+        for key, addr in watchers.items():
+            self.osd.messenger.send_message(msg, addr,
+                                            peer_type="client")
+        timeout = (op.length / 1000.0) if op.length else 5.0
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            pending, _, replies = self._notify_acks.pop(
+                notify_id, (set(), None, []))
+        # dead-watcher reaping (the watch-timeout role): a watcher that
+        # missed this notify is dropped, so it cannot stall the next one
+        if pending:
+            cur = self.watches.get(m.oid, {})
+            for key in pending:
+                cur.pop(key, None)
+            if not cur:
+                self.watches.pop(m.oid, None)
+        op.outdata = json.dumps({
+            "acked": sorted(set(watchers) - pending),
+            "missed": sorted(pending),
+            "replies": {k: v.hex() for k, v in replies}}).encode()
+        return 0
+
+    def on_notify_ack(self, m) -> None:
+        ent = self._notify_acks.get(m.notify_id)
+        if ent is None:
+            return
+        pending, fut, replies = ent
+        pending.discard(str(m.src_name))
+        if m.reply:
+            replies.append((str(m.src_name), m.reply))
+        if not pending and not fut.done():
+            fut.set_result(True)
+
+    # ---------------------------------------------------------- snap trim
+    def maybe_trim_snaps(self) -> None:
+        """Deterministic local trim when the map carries removed snaps
+        we have not processed (SnapMapper/SnapTrimmer role)."""
+        removed = [s for s in self.pool.removed_snaps
+                   if s not in self._trimmed_snaps]
+        if not removed:
+            return
+        from ceph_tpu.osd import snaps as snaps_mod
+        n = snaps_mod.trim_pg(self, removed)
+        self._trimmed_snaps.update(removed)
+        if n:
+            self.log_.info(f"{self.pgid} snap trim: {n} clones removed "
+                           f"for snaps {removed}")
 
     # ---------------------------------------------------- version plumbing
     def next_version(self) -> EVersion:

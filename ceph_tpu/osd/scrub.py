@@ -52,8 +52,8 @@ def build_scrub_map(pg, deep: bool) -> Dict[str, ScrubEntry]:
     except NoSuchCollection:
         return out
     for soid in soids:
-        if soid.name == pg.meta_oid.name:
-            continue
+        if soid.name == pg.meta_oid.name or not soid.is_head():
+            continue    # snap clones: head-only scrub (documented scope)
         try:
             stored = -1
             try:

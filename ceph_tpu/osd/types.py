@@ -116,9 +116,11 @@ class ObjectLocator(Encodable):
 
 
 class PGPool(Encodable):
-    """pg_pool_t: per-pool placement + redundancy parameters."""
+    """pg_pool_t: per-pool placement + redundancy parameters + pool
+    snapshots (snap_seq/snaps/removed_snaps — osd_types.h pg_pool_t
+    snap state; v2)."""
 
-    STRUCT_V = 1
+    STRUCT_V = 2
 
     def __init__(self, type_: int = POOL_TYPE_REPLICATED, size: int = 3,
                  min_size: int = 0, crush_ruleset: int = 0,
@@ -136,6 +138,8 @@ class PGPool(Encodable):
         self.stripe_width = stripe_width  # bytes per full EC stripe
         self.snap_seq = 0
         self.last_change = 0             # epoch of last modification
+        self.snaps: Dict[int, str] = {}  # snapid -> name (pool snaps)
+        self.removed_snaps: List[int] = []   # await osd trim
 
     # -- masks (osd_types.cc:1193 calc_pg_masks) --
     @property
@@ -184,6 +188,9 @@ class PGPool(Encodable):
         enc.u32(self.flags).string(self.ec_profile)
         enc.u32(self.stripe_width).u64(self.snap_seq)
         enc.u32(self.last_change)
+        enc.map_(self.snaps, lambda e, k: e.u64(k),
+                 lambda e, v: e.string(v))
+        enc.list_(self.removed_snaps, lambda e, v: e.u64(v))
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "PGPool":
@@ -191,6 +198,9 @@ class PGPool(Encodable):
                 dec.u32(), dec.u32(), dec.string(), dec.u32())
         p.snap_seq = dec.u64()
         p.last_change = dec.u32()
+        if struct_v >= 2:
+            p.snaps = dec.map_(lambda d: d.u64(), lambda d: d.string())
+            p.removed_snaps = dec.list_(lambda d: d.u64())
         return p
 
 

@@ -82,7 +82,20 @@ async def run(args) -> int:
             print("\n".join(r.pool_list()))
             return 0
         io = r.open_ioctx(args.pool)
-        if args.op == "put":
+        if args.snap:
+            io.set_snap_read(io.snap_lookup(args.snap))
+        if args.op == "mksnap":
+            await io.snap_create(args.args[0])
+        elif args.op == "rmsnap":
+            await io.snap_remove(args.args[0])
+        elif args.op == "lssnap":
+            for sid, name in sorted(io.snap_list().items()):
+                print(f"{sid}\t{name}")
+        elif args.op == "rollback":
+            await io.rollback(args.args[0], args.args[1])
+        elif args.op == "listsnaps":
+            print(json.dumps(await io.list_snaps(args.args[0])))
+        elif args.op == "put":
             with open(args.args[1], "rb") as f:
                 await io.write_full(args.args[0], f.read())
         elif args.op == "get":
@@ -120,7 +133,10 @@ def main(argv=None) -> int:
     ap.add_argument("-p", "--pool", default="rbd")
     ap.add_argument("-b", "--block-size", type=int, default=4 << 20)
     ap.add_argument("-t", "--concurrent", type=int, default=16)
-    ap.add_argument("op", help="put|get|rm|ls|stat|bench|lspools")
+    ap.add_argument("-s", "--snap", default="",
+                    help="read from this pool snapshot")
+    ap.add_argument("op", help="put|get|rm|ls|stat|bench|lspools|"
+                               "mksnap|rmsnap|lssnap|rollback|listsnaps")
     ap.add_argument("args", nargs="*")
     args = ap.parse_args(argv)
     return asyncio.run(run(args))
