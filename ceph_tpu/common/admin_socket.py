@@ -1,0 +1,134 @@
+"""Admin socket: per-daemon unix-socket command server.
+
+Reference parity: common/admin_socket.h:39,64 — daemons expose a unix
+socket serving introspection commands (`perf dump`,
+`dump_ops_in_flight`, `config show/set`, `log dump`); the `ceph
+--admin-daemon <path> <cmd>` CLI talks to it directly, no cluster
+needed.
+
+Protocol (asyncio-idiomatic redesign of the reference's
+length-prefixed blob): one JSON request line in, one JSON reply out,
+connection per command.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import Callable, Dict, Optional, Tuple
+
+
+class AdminSocket:
+    """Command server on a unix socket (AdminSocket::register_command)."""
+
+    def __init__(self, ctx, path: str):
+        self.ctx = ctx
+        self.path = path
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._commands: Dict[str, Tuple[Callable, str]] = {}
+        self.register("help", lambda cmd: {
+            c: h for c, (_, h) in sorted(self._commands.items())},
+            "list available commands")
+        self.register("perf dump", lambda cmd: ctx.perf.dump(),
+                      "dump perf counters")
+        self.register("config show", lambda cmd: ctx.config.dump(),
+                      "dump current config values")
+        self.register("config set", self._config_set,
+                      "config set <key> <value> (runtime injectargs)")
+        self.register("log dump", lambda cmd: {
+            "recent": ctx.log.dump_recent(200)},
+            "recent in-memory log entries")
+        self.register("version", lambda cmd: _version(), "version")
+
+    def register(self, command: str, fn: Callable, help_: str = "") -> None:
+        self._commands[command] = (fn, help_)
+
+    def _config_set(self, cmd: dict):
+        key, value = cmd["args"][0], cmd["args"][1]
+        self.ctx.config.set(key, value)
+        return {"success": f"{key} = {value}"}
+
+    async def start(self) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+        self._server = await asyncio.start_unix_server(
+            self._serve, path=self.path)
+        self.ctx.admin_socket = self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            line = await asyncio.wait_for(reader.readline(), 10.0)
+            try:
+                req = json.loads(line.decode() or "{}")
+            except ValueError:
+                req = {"prefix": line.decode().strip()}
+            prefix = req.get("prefix", "")
+            ent = self._commands.get(prefix)
+            if ent is None:
+                # longest-prefix match with remaining words as args
+                words = prefix.split()
+                for n in range(len(words) - 1, 0, -1):
+                    cand = " ".join(words[:n])
+                    if cand in self._commands:
+                        ent = self._commands[cand]
+                        req.setdefault("args", []).extend(words[n:])
+                        break
+            if ent is None:
+                out = {"error": f"unknown command {prefix!r}"}
+            else:
+                fn, _ = ent
+                res = fn(req)
+                if asyncio.iscoroutine(res):
+                    res = await res
+                out = res
+            writer.write(json.dumps(out, default=str).encode() + b"\n")
+            await writer.drain()
+        except Exception as e:
+            try:
+                writer.write(json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}).encode()
+                    + b"\n")
+                await writer.drain()
+            except Exception:
+                pass
+        finally:
+            writer.close()
+
+
+def _version() -> dict:
+    from ceph_tpu.version import __version__
+    return {"version": __version__}
+
+
+def admin_command(path: str, command: str, timeout: float = 10.0) -> dict:
+    """Synchronous client for CLI use (`ceph --admin-daemon`)."""
+    import socket
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(timeout)
+    try:
+        s.connect(path)
+        s.sendall(json.dumps({"prefix": command}).encode() + b"\n")
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = s.recv(1 << 16)
+            if not chunk:
+                break
+            buf += chunk
+        return json.loads(buf.decode() or "{}")
+    finally:
+        s.close()

@@ -271,6 +271,10 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("osd_scrub_interval", "float", 60.0, "light scrub cadence (test scale)"),
     Option("osd_deep_scrub_interval", "float", 300.0,
            "deep scrub cadence (reads + recomputes every digest)"),
+    Option("osd_mon_report_interval", "float", 2.0,
+           "pg/osd stats report cadence to the mon (PGMap feed)"),
+    Option("mon_cluster_log_file", "str", "",
+           "cluster log sink path on the mon ('' = memory only)"),
     Option("osd_ec_batch_device", "str", "auto",
            "EC encode device routing: auto (accelerator only), on, off"),
     Option("osd_ec_batch_window_ms", "float", 2.0,

@@ -1,0 +1,75 @@
+"""In-flight + historic op tracking.
+
+Reference parity: common/TrackedOp.h:31,57,125 (OpTracker/TrackedOp/
+OpHistory) — every client op registers on arrival, marks named events
+with timestamps, and lands in a bounded history ring on completion;
+dumped via the admin socket as dump_ops_in_flight / dump_historic_ops
+(osd/OSD.cc:1790-1801).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+
+class TrackedOp:
+    __slots__ = ("seq", "desc", "start", "events", "done_at")
+
+    def __init__(self, seq: int, desc: str):
+        self.seq = seq
+        self.desc = desc
+        self.start = time.time()
+        self.events: List[tuple] = [(self.start, "initiated")]
+        self.done_at: Optional[float] = None
+
+    def mark(self, event: str) -> None:
+        self.events.append((time.time(), event))
+
+    def age(self) -> float:
+        return (self.done_at or time.time()) - self.start
+
+    def dump(self) -> Dict:
+        return {
+            "seq": self.seq,
+            "description": self.desc,
+            "initiated_at": self.start,
+            "age": round(self.age(), 6),
+            "events": [{"time": round(t, 6), "event": e}
+                       for t, e in self.events],
+        }
+
+
+class OpTracker:
+    """Per-daemon op registry (common/TrackedOp.h OpTracker)."""
+
+    def __init__(self, history_size: int = 20,
+                 history_duration: float = 600.0):
+        self._seq = itertools.count(1)
+        self._inflight: Dict[int, TrackedOp] = {}
+        self._history: Deque[TrackedOp] = deque(maxlen=history_size)
+        self.history_duration = history_duration
+
+    def create(self, desc: str) -> TrackedOp:
+        op = TrackedOp(next(self._seq), desc)
+        self._inflight[op.seq] = op
+        return op
+
+    def finish(self, op: TrackedOp, event: str = "done") -> None:
+        op.mark(event)
+        op.done_at = time.time()
+        self._inflight.pop(op.seq, None)
+        self._history.append(op)
+
+    def dump_in_flight(self) -> Dict:
+        ops = [o.dump() for o in
+               sorted(self._inflight.values(), key=lambda o: o.seq)]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic(self) -> Dict:
+        now = time.time()
+        ops = [o.dump() for o in self._history
+               if now - (o.done_at or now) <= self.history_duration]
+        return {"num_ops": len(ops), "ops": ops}

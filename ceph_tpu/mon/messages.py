@@ -291,3 +291,54 @@ class MPGTemp(Message):
             pg = dec.struct(PGId)
             m.pg_temp[pg] = dec.list_(lambda d: d.s32())
         return m
+
+
+@register_message
+class MPGStats(Message):
+    """OSD -> mon: periodic per-PG + per-OSD statistics
+    (messages/MPGStats.h; feeds PGMap aggregation)."""
+    TYPE = 114
+
+    def __init__(self, from_osd: int = -1, epoch: int = 0,
+                 pg_stats: Optional[List[dict]] = None,
+                 osd_stat: Optional[dict] = None):
+        super().__init__()
+        self.from_osd = from_osd
+        self.epoch = epoch
+        # per-pg rows: pgid(str), state, num_objects, num_bytes,
+        # scrub_errors, log_version
+        self.pg_stats = pg_stats or []
+        self.osd_stat = osd_stat or {}
+
+    def encode_payload(self, enc: Encoder) -> None:
+        import json
+        enc.s32(self.from_osd).u32(self.epoch)
+        enc.string(json.dumps(self.pg_stats))
+        enc.string(json.dumps(self.osd_stat))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGStats":
+        import json
+        return cls(dec.s32(), dec.u32(), json.loads(dec.string()),
+                   json.loads(dec.string()))
+
+
+@register_message
+class MLog(Message):
+    """Daemon -> mon cluster-log entries (messages/MLog.h; LogClient ->
+    LogMonitor path)."""
+    TYPE = 115
+
+    def __init__(self, entries: Optional[List[dict]] = None):
+        super().__init__()
+        # rows: stamp(float), who, level, message
+        self.entries = entries or []
+
+    def encode_payload(self, enc: Encoder) -> None:
+        import json
+        enc.string(json.dumps(self.entries))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MLog":
+        import json
+        return cls(json.loads(dec.string()))

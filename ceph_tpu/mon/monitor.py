@@ -21,9 +21,9 @@ from ceph_tpu.msg.messenger import Dispatcher, Messenger
 from ceph_tpu.msg.types import EntityAddr, EntityName
 from ceph_tpu.mon.elector import Elector
 from ceph_tpu.mon.messages import (
-    MMonCommand, MMonCommandAck, MMonElection, MMonGetMap, MMonMap,
+    MLog, MMonCommand, MMonCommandAck, MMonElection, MMonGetMap, MMonMap,
     MMonPaxos, MMonSubscribe, MMonSubscribeAck, MOSDAlive, MOSDBoot,
-    MOSDFailure, MOSDMap, MPGTemp,
+    MOSDFailure, MOSDMap, MPGStats, MPGTemp,
 )
 from ceph_tpu.mon.monmap import MonMap
 from ceph_tpu.mon.paxos import Paxos
@@ -77,6 +77,11 @@ class Monitor(Dispatcher):
         self.paxos = Paxos(self)
         self.osdmon = OSDMonitor(self)
         self.services: List[PaxosService] = [self.osdmon]
+        from ceph_tpu.mon.pg_monitor import LogMonitor, PGMonitor
+        self.pgmon = PGMonitor(self)
+        self.logmon = LogMonitor(
+            self, log_path=(ctx.config["mon_cluster_log_file"]
+                            or None))
         # subscriptions: session key -> {"_addr": addr, what: next_epoch}
         self.subs: Dict[tuple, Dict] = {}
         self._tick_task: Optional[asyncio.Task] = None
@@ -95,8 +100,27 @@ class Monitor(Dispatcher):
         self._tick_task = asyncio.get_running_loop().create_task(
             self._tick())
         self.bootstrap()
+        await self._start_admin_socket()
         self.log.info(f"mon.{self.name} rank {self.rank} started "
                       f"({self.monmap})")
+
+    async def _start_admin_socket(self) -> None:
+        path = self.ctx.config["admin_socket"]
+        if not path:
+            return
+        from ceph_tpu.common.admin_socket import AdminSocket
+        sock = AdminSocket(self.ctx, self.ctx.config.expand_meta(path))
+        sock.register("mon_status", lambda cmd: {
+            "name": self.name, "rank": self.rank, "state": self.state,
+            "quorum": self.quorum,
+            "election_epoch": self.election_epoch,
+            "paxos_last_committed": self.paxos.last_committed,
+        }, "monitor state")
+        sock.register("log last", lambda cmd: self.logmon.last(
+            int(cmd["args"][0]) if cmd.get("args") else 20),
+            "recent cluster log entries")
+        await sock.start()
+        self._admin_sock = sock
 
     def bootstrap(self) -> None:
         self.state = STATE_ELECTING
@@ -108,6 +132,8 @@ class Monitor(Dispatcher):
         self.running = False
         if self._tick_task:
             self._tick_task.cancel()
+        if getattr(self, "_admin_sock", None) is not None:
+            await self._admin_sock.stop()
         self.elector.shutdown()
         self.paxos.shutdown()
         await self.messenger.shutdown()
@@ -188,6 +214,18 @@ class Monitor(Dispatcher):
                 self.reply(m, MMonMap(self.monmap.to_bytes()))
             elif isinstance(m, (MOSDBoot, MOSDFailure, MOSDAlive, MPGTemp)):
                 self.osdmon.dispatch(m)
+            elif isinstance(m, (MPGStats, MLog)):
+                # aggregate on the LEADER (who answers status/health);
+                # peons forward like command redirects
+                if self.is_leader():
+                    if isinstance(m, MPGStats):
+                        self.pgmon.handle_stats(m)
+                    else:
+                        self.logmon.handle_log(m)
+                elif self.quorum:
+                    self.messenger.send_message(
+                        m, self.monmap.addr_of_rank(self.quorum[0]),
+                        peer_type="mon")
             elif isinstance(m, MPing):
                 pass
             else:
@@ -240,15 +278,30 @@ class Monitor(Dispatcher):
             return
         prefix = m.cmd.get("prefix", "")
         try:
-            if prefix in ("status", "health"):
+            if prefix == "health":
+                self.reply(m, MMonCommandAck(
+                    m.tid, 0, json.dumps(self.pgmon.health())))
+            elif prefix == "status":
                 out = {
                     "fsid": self.monmap.fsid,
+                    "health": self.pgmon.health(),
                     "election_epoch": self.election_epoch,
                     "quorum": self.quorum,
                     "monmap_epoch": self.monmap.epoch,
                     "osdmap": self.osdmon.osdmap.summary(),
+                    "pgmap": self.pgmon.pg_summary(),
                 }
                 self.reply(m, MMonCommandAck(m.tid, 0, json.dumps(out)))
+            elif prefix == "pg stat":
+                self.reply(m, MMonCommandAck(
+                    m.tid, 0, json.dumps(self.pgmon.pg_summary())))
+            elif prefix == "pg dump":
+                self.reply(m, MMonCommandAck(
+                    m.tid, 0, json.dumps(self.pgmon.dump())))
+            elif prefix == "log last":
+                n = int(m.cmd.get("num", 20))
+                self.reply(m, MMonCommandAck(
+                    m.tid, 0, json.dumps(self.logmon.last(n))))
             elif prefix == "mon dump":
                 self.reply(m, MMonCommandAck(
                     m.tid, 0, repr(self.monmap),

@@ -1,0 +1,147 @@
+"""PGMonitor (PGMap aggregation + health) and LogMonitor (cluster log).
+
+Reference parity: mon/PGMap.cc + mon/PGMonitor.cc (cluster-wide pg/usage
+stats, the data behind `ceph -s` / `ceph health`), mon/LogMonitor.cc
+(cluster log sink for LogClient entries, `ceph log last`).
+
+Redesign: aggregation state is leader-memory + a rolling kv checkpoint
+rather than a full PaxosService — stats are ephemeral observations that
+regenerate within one report interval after an election (the reference
+itself moved this aggregation out of paxos and into the mgr in later
+releases for the same reason).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+
+class PGMonitor:
+    """Aggregates MPGStats into a PGMap; computes health."""
+
+    STALE_AFTER = 30.0          # stats older than this mark pgs stale
+
+    def __init__(self, mon):
+        self.mon = mon
+        self.log = mon.ctx.logger("mon")
+        # pgid(str) -> {state, num_objects, num_bytes, scrub_errors,
+        #               reported_by, stamp}
+        self.pg_stats: Dict[str, dict] = {}
+        self.osd_stats: Dict[int, dict] = {}
+
+    def handle_stats(self, m) -> None:
+        now = time.time()
+        self.osd_stats[m.from_osd] = dict(m.osd_stat, stamp=now)
+        for row in m.pg_stats:
+            pgid = row.get("pgid")
+            if not pgid:
+                continue
+            cur = self.pg_stats.get(pgid)
+            # an in-flight report from a JUST-deposed primary must not
+            # overwrite the new primary's fresher row (epoch-guarded)
+            if cur is not None and cur.get("epoch", 0) > m.epoch:
+                continue
+            self.pg_stats[pgid] = dict(row, reported_by=m.from_osd,
+                                       stamp=now, epoch=m.epoch)
+        self._prune()
+
+    def _prune(self) -> None:
+        """Drop rows for pgs that no longer exist (pool deleted/shrunk),
+        so health doesn't flag dead pgs as stale forever."""
+        pools = self.mon.osdmon.osdmap.pools
+        dead = []
+        for pgid in self.pg_stats:
+            try:
+                pool_s, _, seed_s = pgid.partition(".")
+                pool = pools.get(int(pool_s))
+                if pool is None or int(seed_s, 16) >= pool.pg_num:
+                    dead.append(pgid)
+            except ValueError:
+                dead.append(pgid)
+        for pgid in dead:
+            del self.pg_stats[pgid]
+
+    # ------------------------------------------------------------- views
+    def pg_summary(self) -> Dict:
+        self._prune()
+        states: Dict[str, int] = {}
+        objects = 0
+        nbytes = 0
+        scrub_errors = 0
+        now = time.time()
+        for st in self.pg_stats.values():
+            state = st.get("state", "unknown")
+            if now - st.get("stamp", 0) > self.STALE_AFTER:
+                state = "stale+" + state
+            states[state] = states.get(state, 0) + 1
+            objects += st.get("num_objects", 0)
+            nbytes += st.get("num_bytes", 0)
+            scrub_errors += st.get("scrub_errors", 0)
+        return {"num_pgs": len(self.pg_stats), "by_state": states,
+                "num_objects": objects, "num_bytes": nbytes,
+                "scrub_errors": scrub_errors}
+
+    def expected_pg_count(self) -> int:
+        return sum(p.pg_num for p in self.mon.osdmon.osdmap.pools.values())
+
+    def health(self) -> Dict:
+        """HEALTH_OK/WARN/ERR roll-up (PGMap::get_health role)."""
+        checks: List[str] = []
+        osdmap = self.mon.osdmon.osdmap
+        down = [o for o in range(osdmap.max_osd)
+                if osdmap.exists(o) and osdmap.is_in(o)
+                and not osdmap.is_up(o)]
+        if down:
+            checks.append(f"{len(down)} osds down: {down}")
+        summ = self.pg_summary()
+        expected = self.expected_pg_count()
+        not_active = {s: n for s, n in summ["by_state"].items()
+                      if "active" not in s or s.startswith("stale+")}
+        if not_active:
+            checks.append(f"pgs not active/fresh: {not_active}")
+        if summ["num_pgs"] < expected:
+            checks.append(f"{expected - summ['num_pgs']} pgs not yet "
+                          f"reported")
+        status = "HEALTH_OK" if not checks else "HEALTH_WARN"
+        if summ["scrub_errors"]:
+            checks.append(f"{summ['scrub_errors']} scrub errors")
+            status = "HEALTH_ERR"
+        return {"status": status, "checks": checks}
+
+    def dump(self) -> Dict:
+        return {"pg_stats": self.pg_stats,
+                "osd_stats": self.osd_stats,
+                "summary": self.pg_summary()}
+
+
+class LogMonitor:
+    """Cluster log aggregation (mon/LogMonitor.cc): daemons' LogClient
+    entries land here; kept in a bounded ring + appended to
+    <mon_data>/cluster.log when file logging is on."""
+
+    MAX_RECENT = 1000
+
+    def __init__(self, mon, log_path: Optional[str] = None):
+        self.mon = mon
+        self.recent: List[dict] = []
+        self.log_path = log_path
+
+    def handle_log(self, m) -> None:
+        for e in m.entries:
+            self.recent.append(e)
+        del self.recent[:-self.MAX_RECENT]
+        if self.log_path:
+            try:
+                with open(self.log_path, "a") as f:
+                    for e in m.entries:
+                        f.write(f"{e.get('stamp', 0):.6f} "
+                                f"{e.get('who', '?')} "
+                                f"{e.get('level', 'INF')} "
+                                f"{e.get('message', '')}\n")
+            except OSError:
+                pass
+
+    def last(self, n: int = 20) -> List[dict]:
+        return self.recent[-n:]

@@ -20,6 +20,7 @@ from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Dispatcher, Messenger
 from ceph_tpu.msg.types import EntityAddr, EntityName
 from ceph_tpu.mon.client import MonClient
+from ceph_tpu.mon.messages import MLog, MPGStats
 from ceph_tpu.mon.messages import MOSDAlive, MOSDBoot, MOSDFailure
 from ceph_tpu.mon.monmap import MonMap
 from ceph_tpu.osd.messages import (
@@ -64,6 +65,10 @@ class OSD(Dispatcher):
                     "scrub_repaired"):
             self.perf_scrub.add_u64(key)
         self._scrub_task: Optional[asyncio.Task] = None
+        from ceph_tpu.common.op_tracker import OpTracker
+        self.op_tracker = OpTracker()
+        self.admin_socket = None
+        self._stats_task: Optional[asyncio.Task] = None
 
     def next_tid(self) -> int:
         self._tid += 1
@@ -84,6 +89,13 @@ class OSD(Dispatcher):
             self._heartbeat())
         self._scrub_task = asyncio.get_running_loop().create_task(
             self._scrub_scheduler())
+        self._stats_task = asyncio.get_running_loop().create_task(
+            self._report_stats())
+        # cluster log -> mon (LogClient role)
+        self.ctx.cluster_log.set_sink(self._send_cluster_log)
+        await self._start_admin_socket()
+        self.ctx.cluster_log.info(
+            f"osd.{self.whoami} boot at {self.messenger.addr}")
         self.logger.info(f"osd.{self.whoami} starting at "
                          f"{self.messenger.addr}")
 
@@ -100,6 +112,10 @@ class OSD(Dispatcher):
             self._hb_task.cancel()
         if self._scrub_task:
             self._scrub_task.cancel()
+        if self._stats_task:
+            self._stats_task.cancel()
+        if self.admin_socket is not None:
+            await self.admin_socket.stop()
         for pg in self.pgs.values():
             pg.stop()
         await self.ec_queue.stop()
@@ -283,6 +299,10 @@ class OSD(Dispatcher):
             self.reply_to(m, MOSDOpReply(
                 m.tid, -errno.EAGAIN, map_epoch=self.osdmap.epoch))
             return
+        # per-op tracking (OpTracker; admin socket dump_ops_in_flight)
+        m._tracked = self.op_tracker.create(
+            f"osd_op({m.src_name} {m.oid} tid {m.tid} "
+            f"{'+'.join(str(o.op) for o in m.ops)})")
         from ceph_tpu.osd.messages import OP_NOTIFY
         if m.ops and all(o.op == OP_NOTIFY for o in m.ops):
             # notify gathers remote acks for seconds and touches no
@@ -291,16 +311,117 @@ class OSD(Dispatcher):
             asyncio.get_running_loop().create_task(
                 self._do_notify_op(pg, m))
             return
+        m._tracked.mark("queued_for_pg")
         pg.queue_op(m)
 
     async def _do_notify_op(self, pg, m: MOSDOp) -> None:
-        result = 0
-        for op in m.ops:
-            op.rval = await pg.handle_notify(m, op)
-            if op.rval < 0 and result == 0:
-                result = op.rval
-        self.reply_to(m, MOSDOpReply(m.tid, result, m.ops,
-                                     self.osdmap.epoch))
+        try:
+            result = 0
+            for op in m.ops:
+                op.rval = await pg.handle_notify(m, op)
+                if op.rval < 0 and result == 0:
+                    result = op.rval
+            self.reply_to(m, MOSDOpReply(m.tid, result, m.ops,
+                                         self.osdmap.epoch))
+        except Exception:
+            self.logger.exception(f"notify op failed: {m}")
+        finally:
+            if getattr(m, "_tracked", None) is not None:
+                self.op_tracker.finish(m._tracked)
+
+    # -------------------------------------------------------- introspection
+    async def _start_admin_socket(self) -> None:
+        path = self.cfg["admin_socket"]
+        if not path:
+            return
+        from ceph_tpu.common.admin_socket import AdminSocket
+        sock = AdminSocket(self.ctx, self.ctx.config.expand_meta(path))
+        sock.register(
+            "dump_ops_in_flight",
+            lambda cmd: self.op_tracker.dump_in_flight(),
+            "client ops currently executing (TrackedOp)")
+        sock.register(
+            "dump_historic_ops",
+            lambda cmd: self.op_tracker.dump_historic(),
+            "recently completed client ops")
+        sock.register(
+            "status", lambda cmd: {
+                "whoami": self.whoami,
+                "osdmap_epoch": self.osdmap.epoch,
+                "num_pgs": len(self.pgs),
+                "pgs": {str(pg.pgid): pg.state
+                        for pg in self.pgs.values()},
+            }, "daemon status")
+        await sock.start()
+        self.admin_socket = sock
+
+    def _send_cluster_log(self, entry: dict) -> None:
+        try:
+            self.monc.messenger.send_message(
+                MLog([{"stamp": entry["stamp"], "who": entry["who"],
+                       "level": entry["level"],
+                       "message": entry["msg"]}]),
+                self.monc.monmap.addr_of_rank(self.monc.cur_mon),
+                peer_type="mon")
+        except Exception:
+            pass
+
+    async def _report_stats(self) -> None:
+        """Periodic PG/OSD stats to the mon (MPGStats -> PGMap)."""
+        interval = self.cfg["osd_mon_report_interval"]
+        from ceph_tpu.osd.pg import STATE_ACTIVE
+        # pg.last_update version -> (num_objects, num_bytes): unchanged
+        # PGs skip the store walk, so steady-state reporting is O(PGs)
+        usage_cache: Dict[PGId, tuple] = {}
+        while self.running:
+            await asyncio.sleep(interval)
+            rows = []
+            for pg in list(self.pgs.values()):
+                if not pg.is_primary():
+                    continue
+                ver = (pg.info.last_update.epoch,
+                       pg.info.last_update.version)
+                cached = usage_cache.get(pg.pgid)
+                if cached is not None and cached[0] == ver:
+                    _, n_objs, nbytes = cached
+                else:
+                    try:
+                        objs = [o for o in
+                                self.store.collection_list(pg.cid)
+                                if o.name != pg.meta_oid.name
+                                and o.is_head()]
+                        nbytes = sum(self.store.stat(pg.cid, o)["size"]
+                                     for o in objs)
+                        n_objs = len(objs)
+                    except Exception:
+                        n_objs, nbytes = 0, 0
+                    usage_cache[pg.pgid] = (ver, n_objs, nbytes)
+                state = pg.state
+                if state == STATE_ACTIVE:
+                    state = "active+clean" if not pg.peer_missing or \
+                        not any(pm.items
+                                for pm in pg.peer_missing.values()) \
+                        else "active+recovering"
+                errors = 0
+                if pg.last_scrub_result:
+                    errors = (pg.last_scrub_result.get("errors", 0)
+                              - pg.last_scrub_result.get("repaired", 0))
+                rows.append({
+                    "pgid": str(pg.pgid.without_shard()),
+                    "state": state,
+                    "num_objects": n_objs,
+                    "num_bytes": nbytes,
+                    "scrub_errors": max(errors, 0),
+                    "log_version": pg.info.last_update.version,
+                })
+            try:
+                self.monc.messenger.send_message(
+                    MPGStats(self.whoami, self.osdmap.epoch, rows,
+                             {"num_pgs": len(self.pgs)}),
+                    self.monc.monmap.addr_of_rank(self.monc.cur_mon),
+                    peer_type="mon")
+            except Exception:
+                pass
 
     # ---------------------------------------------------------------- scrub
     async def _scrub_scheduler(self) -> None:

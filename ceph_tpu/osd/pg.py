@@ -568,6 +568,16 @@ class PG:
 
     async def _do_client_op(self, m: MOSDOp) -> None:
         """ReplicatedPG::do_op/execute_ctx distilled."""
+        tracked = getattr(m, "_tracked", None)
+        if tracked is not None:
+            tracked.mark("reached_pg")
+        try:
+            await self._do_client_op_inner(m)
+        finally:
+            if tracked is not None:
+                self.osd.op_tracker.finish(tracked)
+
+    async def _do_client_op_inner(self, m: MOSDOp) -> None:
         if not self.is_primary():
             # stale client mapping: tell it to refresh + resend
             self.osd.reply_to(m, MOSDOpReply(

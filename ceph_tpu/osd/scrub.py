@@ -147,6 +147,10 @@ async def scrub_pg(pg, deep: bool, repair: bool = True) -> Dict:
         pg.log_.warning(
             f"{pg.pgid} {'deep-' if deep else ''}scrub: {errors} errors, "
             f"{repaired} repaired ({time.time() - t0:.2f}s)")
+        # operator-visible cluster log event (LogClient -> LogMonitor)
+        osd.ctx.cluster_log.warn(
+            f"pg {pg.pgid} {'deep-' if deep else ''}scrub: {errors} "
+            f"errors, {repaired} repaired")
     else:
         pg.log_.info(f"{pg.pgid} {'deep-' if deep else ''}scrub ok "
                      f"({len(all_oids)} objects, {time.time() - t0:.2f}s)")

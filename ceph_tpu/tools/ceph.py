@@ -109,8 +109,17 @@ def main(argv=None) -> int:
     ap.add_argument("--k", type=int, default=0)
     ap.add_argument("--m", type=int, default=0)
     ap.add_argument("--size", type=int, default=0)
+    ap.add_argument("--admin-daemon", default="",
+                    help="talk to a daemon's admin socket instead of "
+                         "the cluster (reference ceph.in)")
     ap.add_argument("command", nargs="+")
     args, extra = ap.parse_known_args(argv)
+    if args.admin_daemon:
+        import json as _json
+        from ceph_tpu.common.admin_socket import admin_command
+        out = admin_command(args.admin_daemon, " ".join(args.command))
+        print(_json.dumps(out, indent=2, default=str))
+        return 1 if isinstance(out, dict) and "error" in out else 0
     return asyncio.run(run(args, extra))
 
 

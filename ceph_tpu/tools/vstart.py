@@ -60,10 +60,16 @@ class VCluster:
                             EntityAddr("127.0.0.1", _free_port(), 0))
         with open(os.path.join(self.dir, "monmap.bin"), "wb") as f:
             f.write(self.monmap.to_bytes())
-        if self.conf:
-            with open(os.path.join(self.dir, "ceph.conf"), "w") as f:
-                for k, v in self.conf.items():
-                    f.write(f"{k} = {v}\n")
+        conf = dict(self.conf)
+        # every daemon gets an admin socket under the cluster dir
+        # ($name expands per daemon: mon.a.asok, osd.0.asok, ...)
+        conf.setdefault("admin_socket",
+                        os.path.join(self.dir, "$name.asok"))
+        conf.setdefault("mon_cluster_log_file",
+                        os.path.join(self.dir, "cluster.log"))
+        with open(os.path.join(self.dir, "ceph.conf"), "w") as f:
+            for k, v in conf.items():
+                f.write(f"{k} = {v}\n")
 
     def _spawn(self, kind: str, id_: str) -> None:
         with open(os.path.join(self.dir, f"{kind}.{id_}.log"), "ab") as logf:

@@ -1,0 +1,179 @@
+"""Health + introspection: PGMap/ceph -s, admin socket, OpTracker,
+cluster log.
+
+VERDICT r2 ask #7 done-criterion: `ceph -s` tracks a kill/recover cycle
+correctly (HEALTH_OK -> WARN on kill -> OK after down-out + re-peer).
+"""
+
+import asyncio
+import json
+import sys
+import tempfile
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from test_osd import Cluster, FAST_CFG, make_ctx  # noqa: E402
+
+from ceph_tpu.common.admin_socket import (AdminSocket,  # noqa: E402
+                                          admin_command)
+from ceph_tpu.common.op_tracker import OpTracker  # noqa: E402
+
+
+# ----------------------------------------------------------- op tracker
+
+def test_op_tracker_inflight_and_history():
+    t = OpTracker(history_size=2)
+    a = t.create("op-a")
+    b = t.create("op-b")
+    a.mark("reached_pg")
+    d = t.dump_in_flight()
+    assert d["num_ops"] == 2
+    assert d["ops"][0]["description"] == "op-a"
+    assert [e["event"] for e in d["ops"][0]["events"]] == \
+        ["initiated", "reached_pg"]
+    t.finish(a)
+    assert t.dump_in_flight()["num_ops"] == 1
+    assert t.dump_historic()["num_ops"] == 1
+    t.finish(b)
+    c = t.create("op-c")
+    t.finish(c)
+    d2 = t.dump_historic()          # ring bounded at 2
+    assert d2["num_ops"] == 2
+    assert [o["description"] for o in d2["ops"]] == ["op-b", "op-c"]
+
+
+# --------------------------------------------------------- admin socket
+
+def test_admin_socket_commands():
+    async def run():
+        ctx = make_ctx("osd.9")
+        with tempfile.TemporaryDirectory() as td:
+            path = f"{td}/osd.9.asok"
+            sock = AdminSocket(ctx, path)
+            sock.register("whoami", lambda cmd: {"id": 9}, "test cmd")
+            await sock.start()
+            loop = asyncio.get_running_loop()
+
+            def cmd(c):
+                return admin_command(path, c)
+            out = await loop.run_in_executor(None, cmd, "whoami")
+            assert out == {"id": 9}
+            out = await loop.run_in_executor(None, cmd, "perf dump")
+            assert isinstance(out, dict)
+            out = await loop.run_in_executor(None, cmd, "config show")
+            assert out["osd_heartbeat_interval"] == 0.3
+            out = await loop.run_in_executor(
+                None, cmd, "config set log_level 3")
+            assert "success" in out
+            assert ctx.config["log_level"] == 3
+            out = await loop.run_in_executor(None, cmd, "help")
+            assert "perf dump" in out
+            out = await loop.run_in_executor(None, cmd, "no-such")
+            assert "error" in out
+            await sock.stop()
+    asyncio.run(run())
+
+
+# -------------------------------------------------------------- health
+
+async def wait_health(admin, want_status, timeout=30.0, forbid=None):
+    deadline = asyncio.get_event_loop().time() + timeout
+    last = None
+    while asyncio.get_event_loop().time() < deadline:
+        ack = await admin.mon_command({"prefix": "health"})
+        last = json.loads(ack.outs)
+        if last["status"] == want_status and (
+                forbid is None or
+                not any(forbid in c for c in last["checks"])):
+            return last
+        await asyncio.sleep(0.2)
+    raise AssertionError(f"health never became {want_status}: {last}")
+
+
+def test_ceph_status_tracks_kill_and_recover_cycle():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(4)
+        await admin.pool_create("data", pg_num=8)
+        io = admin.open_ioctx("data")
+        for i in range(4):
+            await io.write_full(f"o{i}", b"h" * 2048)
+        # stats flow in; everything active+clean -> HEALTH_OK
+        h = await wait_health(admin, "HEALTH_OK")
+        ack = await admin.mon_command({"prefix": "status"})
+        st = json.loads(ack.outs)
+        assert st["pgmap"]["num_pgs"] == 8
+        assert st["pgmap"]["num_objects"] == 4
+        assert set(st["pgmap"]["by_state"]) == {"active+clean"}
+        # kill an osd: health degrades to WARN (osd down)
+        await cl.kill_osd(3)
+        h = await wait_health(admin, "HEALTH_WARN")
+        assert any("osds down" in c for c in h["checks"])
+        # after down-out + re-peer + recovery the cluster heals itself
+        h = await wait_health(admin, "HEALTH_OK", timeout=60.0)
+        for i in range(4):
+            assert await io.read(f"o{i}") == b"h" * 2048
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_pg_stat_and_dump_commands():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("data", pg_num=4)
+        io = admin.open_ioctx("data")
+        await io.write_full("x", b"y" * 100)
+        await wait_health(admin, "HEALTH_OK")
+        ack = await admin.mon_command({"prefix": "pg stat"})
+        st = json.loads(ack.outs)
+        assert st["num_pgs"] == 4 and st["num_objects"] == 1
+        assert st["num_bytes"] == 100
+        ack = await admin.mon_command({"prefix": "pg dump"})
+        dump = json.loads(ack.outs)
+        assert len(dump["pg_stats"]) == 4
+        assert all(r["state"] == "active+clean"
+                   for r in dump["pg_stats"].values())
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_cluster_log_reaches_mon():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        # boot messages arrive via MLog -> LogMonitor
+        deadline = asyncio.get_event_loop().time() + 10
+        while asyncio.get_event_loop().time() < deadline:
+            ack = await admin.mon_command({"prefix": "log last",
+                                           "num": 50})
+            entries = json.loads(ack.outs)
+            boots = [e for e in entries
+                     if "boot" in e.get("message", "")]
+            if len(boots) >= 3:
+                break
+            await asyncio.sleep(0.2)
+        assert len(boots) >= 3, entries
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_osd_op_tracking_via_client_io():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("data", pg_num=4)
+        io = admin.open_ioctx("data")
+        await io.write_full("tracked", b"z" * 512)
+        await io.read("tracked")
+        hist = [o for osd in cl.osds.values()
+                for o in osd.op_tracker.dump_historic()["ops"]]
+        assert any("tracked" in o["description"] for o in hist)
+        done = [o for o in hist if "tracked" in o["description"]][0]
+        events = [e["event"] for e in done["events"]]
+        assert events[0] == "initiated" and "reached_pg" in events
+        assert all(osd.op_tracker.dump_in_flight()["num_ops"] == 0
+                   for osd in cl.osds.values())
+        await cl.stop()
+    asyncio.run(run())
