@@ -119,8 +119,13 @@ class Objecter(Dispatcher):
             peer_type="osd")
 
     async def op_submit(self, oid: str, loc: ObjectLocator,
-                        ops: List[OSDOp], timeout: float = 30.0,
+                        ops: List[OSDOp], timeout: float = 120.0,
                         snapid: int = 0) -> MOSDOpReply:
+        # The reference Objecter never deadlines an op — it waits and
+        # resends across map changes (Objecter::handle_osd_map). The
+        # generous default here only bounds true wedges; first-touch
+        # device compiles in a freshly booted OSD can take tens of
+        # seconds on a loaded host.
         if self.osdmap is None:
             await self.monc.wait_for_osdmap()
         self._tid += 1

@@ -325,6 +325,13 @@ class OSD(Dispatcher):
                                          self.osdmap.epoch))
         except Exception:
             self.logger.exception(f"notify op failed: {m}")
+            # still answer: an unreplied op stalls the client for the
+            # full objecter timeout
+            try:
+                self.reply_to(m, MOSDOpReply(
+                    m.tid, -errno.EIO, map_epoch=self.osdmap.epoch))
+            except Exception:
+                pass
         finally:
             if getattr(m, "_tracked", None) is not None:
                 self.op_tracker.finish(m._tracked)
@@ -393,9 +400,13 @@ class OSD(Dispatcher):
                         nbytes = sum(self.store.stat(pg.cid, o)["size"]
                                      for o in objs)
                         n_objs = len(objs)
+                        # only cache a SUCCESSFUL walk: recovery pushes
+                        # don't bump last_update, so caching a failed or
+                        # mid-recovery count would freeze the undercount
+                        # until the next client write
+                        usage_cache[pg.pgid] = (ver, n_objs, nbytes)
                     except Exception:
                         n_objs, nbytes = 0, 0
-                    usage_cache[pg.pgid] = (ver, n_objs, nbytes)
                 state = pg.state
                 if state == STATE_ACTIVE:
                     state = "active+clean" if not pg.peer_missing or \

@@ -184,6 +184,14 @@ class PG:
             if t is not None:
                 t.cancel()
         self._peering_task = self._worker_task = None
+        # drain queued-but-never-run ops so their TrackedOps don't sit in
+        # the OpTracker's in-flight dump forever (the client will resend
+        # against the new mapping on the next map epoch)
+        while not self._op_queue.empty():
+            m = self._op_queue.get_nowait()
+            tracked = getattr(m, "_tracked", None)
+            if tracked is not None and self.osd is not None:
+                self.osd.op_tracker.finish(tracked)
 
     # ------------------------------------------------------------- peering
     async def _peer(self) -> None:
