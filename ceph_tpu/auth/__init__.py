@@ -1,0 +1,11 @@
+from ceph_tpu.auth.keyring import Keyring, generate_key
+from ceph_tpu.auth.cephx import (AuthError, Ticket, seal, unseal,
+                                 service_secret, auth_proof,
+                                 issue_ticket, open_ticket,
+                                 make_authorizer, verify_authorizer,
+                                 authorizer_reply_proof, sign_payload)
+
+__all__ = ["Keyring", "generate_key", "AuthError", "Ticket", "seal",
+           "unseal", "service_secret", "auth_proof", "issue_ticket",
+           "open_ticket", "make_authorizer", "verify_authorizer",
+           "authorizer_reply_proof", "sign_payload"]

@@ -1,0 +1,49 @@
+"""MonCap-lite: capability grants checked by the monitor.
+
+Reference parity: mon/MonCap.{h,cc} — grant strings ("allow *",
+"allow rw", "allow profile osd") parsed into permission sets and checked
+per command/message.  The reference's full grammar (service/command/pool
+qualifiers) collapses to the three forms the rest of this framework
+issues; unknown forms deny, never allow.
+"""
+
+from __future__ import annotations
+
+_PROFILES = {
+    # profile osd: what an OSD daemon needs from the mon — boot/failure/
+    # alive/pgtemp/stats reporting plus map reads (MonCap.cc profile
+    # expansion)
+    "osd": {"r", "w", "daemon"},
+    "mon": {"r", "w", "x", "daemon"},
+}
+
+
+class MonCap:
+    def __init__(self, allow_all: bool = False, perms: frozenset = frozenset()):
+        self.allow_all = allow_all
+        self.perms = perms
+
+    @classmethod
+    def parse(cls, grant: str) -> "MonCap":
+        g = (grant or "").strip().lower()
+        if not g.startswith("allow"):
+            return cls()
+        rest = g[5:].strip()
+        if rest == "*":
+            return cls(allow_all=True)
+        if rest.startswith("profile"):
+            prof = rest.split(None, 1)[1] if len(rest.split()) > 1 else ""
+            return cls(perms=frozenset(_PROFILES.get(prof, ())))
+        if rest and set(rest) <= set("rwx"):
+            return cls(perms=frozenset(rest))
+        return cls()
+
+    def allows(self, need: str) -> bool:
+        """need: 'r' read, 'w' mutate, 'x' admin (auth db), 'daemon'
+        (osd boot/failure/stats intake)."""
+        return self.allow_all or need in self.perms
+
+
+def mon_cap_allows(caps: dict, need: str) -> bool:
+    """caps: the entity's {service: grant} map from its keyring entry."""
+    return MonCap.parse(caps.get("mon", "")).allows(need)

@@ -1,0 +1,184 @@
+"""cephx-analog ticket protocol: challenge auth, service tickets,
+per-connection authorizers, per-message signing.
+
+Reference parity: the CephX message flow
+(/root/reference/src/auth/cephx/CephxProtocol.h:1 — CEPHX_GET_AUTH_SESSION_KEY
+/ CEPHX_GET_PRINCIPAL_SESSION_KEY, CephXTicketBlob, CephXAuthorizer with
+mutual proof, CephXServiceTicketInfo) and message signing
+(src/msg/Message.cc sign_message / check_signature under MSG_AUTH).
+
+Redesign notes (asyncio/stdlib-idiomatic, same trust structure):
+  * AES + double-encryption becomes HMAC-SHA256 everywhere: `seal` is
+    encrypt-then-MAC with an HMAC-CTR keystream (stdlib has no AES; the
+    protocol's guarantees — key possession proof, ticket opacity to the
+    client, mutual auth, signature unforgeability — only need a PRF).
+  * The reference's rotating service keys (RotatingKeyRing) collapse to a
+    per-service secret DERIVED from the mon master key, handed to daemons
+    over their authenticated mon session at boot.  Same trust shape
+    (compromise of one OSD never reveals another entity's key), no
+    rotation epochs to ship around.
+  * Tickets carry entity + caps + expiry, sealed with the service secret:
+    services validate clients with no mon round-trip, as in the reference.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import struct
+import time
+from typing import Dict, Optional, Tuple
+
+from ceph_tpu.common.encoding import Decoder, Encoder
+
+
+class AuthError(Exception):
+    pass
+
+
+# ------------------------------------------------------------------ sealing
+
+def _keystream(key: bytes, nonce: bytes, n: int) -> bytes:
+    out = bytearray()
+    ctr = 0
+    while len(out) < n:
+        out += hmac.new(key, nonce + struct.pack("<Q", ctr),
+                        hashlib.sha256).digest()
+        ctr += 1
+    return bytes(out[:n])
+
+
+def seal(key: bytes, plaintext: bytes) -> bytes:
+    """Authenticated encryption: nonce || ciphertext || mac."""
+    nonce = os.urandom(16)
+    ct = bytes(a ^ b for a, b in
+               zip(plaintext, _keystream(key, nonce, len(plaintext))))
+    mac = hmac.new(key, b"seal" + nonce + ct, hashlib.sha256).digest()[:16]
+    return nonce + ct + mac
+
+
+def unseal(key: bytes, blob: bytes) -> bytes:
+    if len(blob) < 32:
+        raise AuthError("sealed blob truncated")
+    nonce, ct, mac = blob[:16], blob[16:-16], blob[-16:]
+    want = hmac.new(key, b"seal" + nonce + ct, hashlib.sha256).digest()[:16]
+    if not hmac.compare_digest(mac, want):
+        raise AuthError("sealed blob MAC mismatch (wrong key or tampered)")
+    return bytes(a ^ b for a, b in
+                 zip(ct, _keystream(key, nonce, len(ct))))
+
+
+def service_secret(master_key: bytes, service: str) -> bytes:
+    """The per-service shared secret (rotating-key analog)."""
+    return hmac.new(master_key, b"svc:" + service.encode(),
+                    hashlib.sha256).digest()
+
+
+def auth_proof(entity_key: bytes, server_challenge: bytes,
+               client_challenge: bytes) -> bytes:
+    """Proof of entity-key possession (CephXChallengeBlob hash role)."""
+    return hmac.new(entity_key, b"proof" + server_challenge +
+                    client_challenge, hashlib.sha256).digest()
+
+
+# ------------------------------------------------------------------ tickets
+
+class Ticket:
+    """What a service learns about a client from its ticket blob."""
+
+    def __init__(self, entity: str, service: str, session_key: bytes,
+                 caps: Dict[str, str], expires: float):
+        self.entity = entity
+        self.service = service
+        self.session_key = session_key
+        self.caps = caps
+        self.expires = expires
+
+    def encode(self) -> bytes:
+        enc = Encoder()
+        enc.string(self.entity).string(self.service)
+        enc.bytes_(self.session_key).f64(self.expires)
+        enc.map_(self.caps, lambda e, k: e.string(k),
+                 lambda e, v: e.string(v))
+        return enc.getvalue()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Ticket":
+        dec = Decoder(data)
+        entity, service = dec.string(), dec.string()
+        skey, expires = dec.bytes_(), dec.f64()
+        caps = dec.map_(lambda d: d.string(), lambda d: d.string())
+        return cls(entity, service, skey, caps, expires)
+
+
+def issue_ticket(svc_secret: bytes, entity: str, service: str,
+                 caps: Dict[str, str], ttl: float,
+                 now: Optional[float] = None) -> Tuple[bytes, bytes]:
+    """Mon side: -> (ticket_blob sealed for the service, session_key)."""
+    session_key = os.urandom(32)
+    t = Ticket(entity, service, session_key, caps,
+               (now if now is not None else time.time()) + ttl)
+    return seal(svc_secret, t.encode()), session_key
+
+
+def open_ticket(svc_secret: bytes, blob: bytes,
+                now: Optional[float] = None) -> Ticket:
+    """Service side: unseal + expiry check."""
+    t = Ticket.decode(unseal(svc_secret, blob))
+    if (now if now is not None else time.time()) > t.expires:
+        raise AuthError(f"ticket for {t.entity} expired")
+    return t
+
+
+# -------------------------------------------------------------- authorizers
+
+def make_authorizer(ticket_blob: bytes,
+                    session_key: bytes) -> Tuple[bytes, bytes]:
+    """Client side: ticket + a sealed fresh nonce proving we hold the
+    session key (CephXAuthorizer::build_authorizer).  Returns
+    (authorizer_bytes, nonce) — the caller keeps the nonce to check the
+    service's mutual reply proof."""
+    nonce = os.urandom(16)
+    enc = Encoder()
+    enc.bytes_(ticket_blob).bytes_(seal(session_key, b"authz" + nonce))
+    enc.bytes_(nonce)
+    return enc.getvalue(), nonce
+
+
+def verify_authorizer(svc_secret: bytes, authorizer: bytes,
+                      now: Optional[float] = None
+                      ) -> Tuple[Ticket, bytes]:
+    """Service side: -> (ticket, reply_proof to send back).  Raises
+    AuthError on any mismatch."""
+    try:
+        dec = Decoder(authorizer)
+        ticket_blob = dec.bytes_()
+        sealed_nonce = dec.bytes_()
+        nonce = dec.bytes_()
+    except Exception as e:
+        raise AuthError(f"malformed authorizer: {e!r}")
+    t = open_ticket(svc_secret, ticket_blob, now)
+    if unseal(t.session_key, sealed_nonce) != b"authz" + nonce:
+        raise AuthError("authorizer nonce proof mismatch")
+    return t, authorizer_reply_proof(t.session_key, nonce)
+
+
+def authorizer_reply_proof(session_key: bytes, nonce: bytes) -> bytes:
+    """Mutual auth: the service proves IT holds the session key too
+    (reference: authorizer reply carries nonce+1 encrypted)."""
+    return hmac.new(session_key, b"authz-reply" + nonce,
+                    hashlib.sha256).digest()[:16]
+
+
+# ----------------------------------------------------------------- signing
+
+def hmac_eq(a: bytes, b: bytes) -> bool:
+    return hmac.compare_digest(a, b)
+
+
+def sign_payload(session_key: bytes, payload: bytes) -> bytes:
+    """Per-message signature (sign_message under MSG_AUTH), truncated to
+    16 bytes like the reference's 64-bit sig is to its header field."""
+    return hmac.new(session_key, b"msg" + payload,
+                    hashlib.sha256).digest()[:16]

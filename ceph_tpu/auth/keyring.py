@@ -1,0 +1,98 @@
+"""Keyring: entity name -> secret key + capability grants.
+
+Reference parity: KeyRing (/root/reference/src/auth/KeyRing.h:24-74) and its
+INI-style text format (src/auth/KeyRing.cc:93-185):
+
+    [client.admin]
+        key = <base64>
+        caps mon = "allow *"
+        caps osd = "allow *"
+
+Keys here are 32 random bytes (HMAC-SHA256 keys — see auth/cephx.py for why
+HMAC replaces AES) carried base64, caps are the same quoted grant strings.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+from typing import Dict, Optional, Tuple
+
+
+def generate_key() -> bytes:
+    return os.urandom(32)
+
+
+class Keyring:
+    def __init__(self):
+        # entity -> (key, {service: grant})
+        self._entries: Dict[str, Tuple[bytes, Dict[str, str]]] = {}
+
+    # -- mutation ------------------------------------------------------------
+    def add(self, entity: str, key: Optional[bytes] = None,
+            caps: Optional[Dict[str, str]] = None) -> bytes:
+        key = key if key is not None else generate_key()
+        self._entries[entity] = (key, dict(caps or {}))
+        return key
+
+    def remove(self, entity: str) -> None:
+        self._entries.pop(entity, None)
+
+    # -- lookup --------------------------------------------------------------
+    def get_key(self, entity: str) -> Optional[bytes]:
+        e = self._entries.get(entity)
+        return e[0] if e else None
+
+    def get_caps(self, entity: str) -> Dict[str, str]:
+        e = self._entries.get(entity)
+        return dict(e[1]) if e else {}
+
+    def entities(self):
+        return sorted(self._entries)
+
+    def __contains__(self, entity: str) -> bool:
+        return entity in self._entries
+
+    # -- text format ---------------------------------------------------------
+    def dumps(self) -> str:
+        out = []
+        for entity in sorted(self._entries):
+            key, caps = self._entries[entity]
+            out.append(f"[{entity}]")
+            out.append(f"\tkey = {base64.b64encode(key).decode()}")
+            for svc in sorted(caps):
+                out.append(f'\tcaps {svc} = "{caps[svc]}"')
+        return "\n".join(out) + "\n"
+
+    @classmethod
+    def loads(cls, text: str) -> "Keyring":
+        kr = cls()
+        entity = None
+        for raw in text.splitlines():
+            line = raw.strip()
+            if not line or line.startswith("#") or line.startswith(";"):
+                continue
+            if line.startswith("[") and line.endswith("]"):
+                entity = line[1:-1].strip()
+                kr._entries.setdefault(entity, (b"", {}))
+                continue
+            if "=" not in line or entity is None:
+                continue
+            lhs, rhs = (s.strip() for s in line.split("=", 1))
+            key, caps = kr._entries[entity]
+            if lhs == "key":
+                kr._entries[entity] = (base64.b64decode(rhs), caps)
+            elif lhs.startswith("caps "):
+                caps[lhs[5:].strip()] = rhs.strip().strip('"')
+        return kr
+
+    def save(self, path: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.dumps())
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "Keyring":
+        with open(path) as f:
+            return cls.loads(f.read())

@@ -54,6 +54,9 @@ class Rados:
         self.monc = MonClient(self.ctx, self.messenger, self.monmap)
         self.objecter = Objecter(self.ctx, self.messenger, self.monc)
         self.messenger.add_dispatcher(_WatchDispatcher(self))
+        # cephx first (no-op when auth_supported=none): tickets must be
+        # in hand before any mon command or osd op leaves this process
+        await self.monc.authenticate()
         self.monc.sub_want("osdmap", 0)
         self.monc.on_osdmap(self._rewatch)
         await self.monc.wait_for_osdmap()
@@ -85,6 +88,8 @@ class Rados:
             asyncio.get_running_loop().create_task(rewatch())
 
     async def shutdown(self) -> None:
+        if self.monc is not None:
+            self.monc.stop()
         if self.messenger is not None:
             await self.messenger.shutdown()
         self.connected = False

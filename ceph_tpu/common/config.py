@@ -295,4 +295,9 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("crush_backend", "str", "auto", "auto|jax|host placement backend"),
     Option("heartbeat_inject_failure", "int", 0,
            "seconds to fake missed heartbeats (config_opts.h:172)"),
+    Option("auth_supported", "str", "none",
+           "cephx|none (auth_cluster_required, config_opts.h)"),
+    Option("keyring", "str", "", "keyring file path ($name etc expanded)"),
+    Option("auth_ticket_ttl", "float", 3600.0,
+           "service ticket lifetime (auth_service_ticket_ttl)"),
 ]

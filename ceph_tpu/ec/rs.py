@@ -97,7 +97,12 @@ class _MatrixCodec(ErasureCode):
                 f"technique {self.technique!r} is RAID-6 only: m must be "
                 f"2, not {self._m}")
         try:
-            w = int(profile.get("w", "7"))
+            # technique-dependent default w: liberation needs w prime
+            # (reference DEFAULT_W=7); blaum_roth needs w+1 prime, and
+            # since we reject the reference's legacy w=7 tolerance the
+            # default must be a valid 6
+            default_w = "7" if self.technique == "liberation" else "6"
+            w = int(profile.get("w", default_w))
             ps = int(profile.get("packetsize", "2048"))
         except ValueError as e:
             raise ErasureCodeError(f"bad w/packetsize in profile: {e}")

@@ -1,23 +1,27 @@
 """MonClient: the client-side monitor session.
 
 Reference parity: mon/MonClient.{h,cc} — command proxy with retry,
-map subscriptions, hunting for a live/leader mon.  Auth (cephx) is out
-of scope this round; sessions are implicit in the messenger.  Commands
-follow the leader hint a non-leader mon returns (-EAGAIN + rank),
-replacing MonClient's forwarding dance with an explicit redirect.
+map subscriptions, hunting for a live/leader mon, and the cephx
+authenticate() handshake (MonClient::authenticate -> MAuth rounds).
+Commands follow the leader hint a non-leader mon returns (-EAGAIN +
+rank), replacing MonClient's forwarding dance with an explicit redirect.
+After authenticate(), the messenger presents ticket authorizers on every
+new outgoing connection (ms_get_authorizer role) and signs frames with
+the per-service session key.
 """
 
 from __future__ import annotations
 
 import asyncio
 import errno
-from typing import Callable, Dict, List, Optional
+import os
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Dispatcher, Messenger
 from ceph_tpu.mon.messages import (
-    MMonCommand, MMonCommandAck, MMonMap, MMonSubscribe, MMonSubscribeAck,
-    MOSDMap,
+    MAuth, MAuthReply, MMonCommand, MMonCommandAck, MMonMap, MMonSubscribe,
+    MMonSubscribeAck, MOSDMap,
 )
 from ceph_tpu.mon.monmap import MonMap
 from ceph_tpu.osd.osdmap import Incremental, OSDMap
@@ -46,6 +50,15 @@ class MonClient(Dispatcher):
         self._pending: Dict[int, asyncio.Future] = {}
         self._subs: Dict[str, int] = {}
         self._sub_task: Optional[asyncio.Task] = None
+        # cephx state: service -> (ticket_blob, session_key, expires);
+        # service secrets arrive only for daemon entities
+        self.tickets: Dict[str, Tuple[bytes, bytes, float]] = {}
+        self.service_secrets: Dict[str, bytes] = {}
+        self._auth_futs: Dict[int, asyncio.Future] = {}
+        self._auth_tid = 0
+        self._auth_entity: Optional[str] = None
+        self._auth_want: Optional[List[str]] = None
+        self._renew_task: Optional[asyncio.Task] = None
 
     # ---------------------------------------------------------- dispatch
     def ms_dispatch(self, m: Message) -> bool:
@@ -61,6 +74,11 @@ class MonClient(Dispatcher):
             self.monmap = MonMap.from_bytes(m.monmap_bytes)
             return True
         if isinstance(m, MMonSubscribeAck):
+            return True
+        if isinstance(m, MAuthReply):
+            fut = self._auth_futs.pop(m.tid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(m)
             return True
         return False
 
@@ -127,6 +145,109 @@ class MonClient(Dispatcher):
                     rank = (rank + 1) % self.monmap.size()
         finally:
             self._osdmap_waiters.remove(ev)
+
+    # ----------------------------------------------------------------- auth
+    async def authenticate(self, entity: Optional[str] = None,
+                           want: Optional[List[str]] = None,
+                           timeout: float = 30.0) -> None:
+        """cephx handshake (MonClient::authenticate): prove key
+        possession, collect service tickets, arm the messenger's
+        authorizer + signing hooks.  No-op when auth_supported != cephx.
+        Raises CommandError(-EACCES) on denial."""
+        if self.cfg["auth_supported"] != "cephx":
+            return
+        from ceph_tpu.auth import cephx
+        from ceph_tpu.auth.keyring import Keyring
+        if entity is None:
+            entity = str(self.messenger.name)
+        path = self.ctx.config.expand_meta(self.cfg["keyring"])
+        keyring = Keyring.load(path)
+        key = keyring.get_key(entity)
+        if key is None:
+            raise CommandError(-errno.ENOENT,
+                               f"no key for {entity} in {path}")
+        if want is None:
+            want = ["mon", "osd"]
+        client_challenge = os.urandom(16)
+        deadline = asyncio.get_running_loop().time() + timeout
+        rank = self.cur_mon
+        while True:
+            try:
+                r1 = await self._auth_round(
+                    MAuth(entity, 1, client_challenge), rank)
+                if r1.result < 0:
+                    raise CommandError(r1.result, "auth phase 1 denied")
+                proof = cephx.auth_proof(key, r1.server_challenge,
+                                         client_challenge)
+                r2 = await self._auth_round(
+                    MAuth(entity, 2, client_challenge, proof, want), rank)
+                break
+            except asyncio.TimeoutError:
+                rank = (rank + 1) % self.monmap.size()   # hunt
+                if asyncio.get_running_loop().time() >= deadline:
+                    raise CommandError(-errno.ETIMEDOUT, "auth timeout")
+        if r2.result < 0:
+            raise CommandError(r2.result, f"auth denied for {entity}")
+        from ceph_tpu.common.encoding import Decoder
+        dec = Decoder(cephx.unseal(key, r2.payload))
+        self.tickets = dec.map_(
+            lambda d: d.string(),
+            lambda d: (d.bytes_(), d.bytes_(), d.f64()))
+        self.service_secrets = dec.map_(lambda d: d.string(),
+                                        lambda d: d.bytes_())
+        self.cur_mon = rank
+        self._auth_entity, self._auth_want = entity, want
+        self.messenger.get_authorizer_cb = self._get_authorizer
+        if self._renew_task is None:
+            self._renew_task = asyncio.get_running_loop().create_task(
+                self._renew_tickets())
+        self.log.info(f"authenticated as {entity} "
+                      f"(tickets: {sorted(self.tickets)})")
+
+    async def _renew_tickets(self) -> None:
+        """Re-run the handshake before the earliest ticket expiry so
+        long-lived sessions never present a dead ticket
+        (CephXTicketHandler::need_key / renew_after)."""
+        import time
+        while True:
+            if not self.tickets:
+                return
+            expires = min(t[2] for t in self.tickets.values())
+            delay = max(0.5, (expires - time.time()) * 0.7)
+            await asyncio.sleep(delay)
+            try:
+                await self.authenticate(self._auth_entity,
+                                        self._auth_want)
+            except Exception as e:
+                self.log.warning(f"ticket renewal failed ({e}); retrying")
+                await asyncio.sleep(5.0)
+
+    def stop(self) -> None:
+        if self._renew_task is not None:
+            self._renew_task.cancel()
+            self._renew_task = None
+
+    def _get_authorizer(self, peer_type: Optional[str]):
+        from ceph_tpu.auth import cephx
+        t = self.tickets.get(peer_type or "")
+        if t is None:
+            return None
+        blob, session_key, _expires = t
+        authorizer, nonce = cephx.make_authorizer(blob, session_key)
+        return authorizer, session_key, nonce
+
+    async def _auth_round(self, m: MAuth, rank: int,
+                          step: float = 3.0) -> MAuthReply:
+        self._auth_tid += 1
+        m.tid = self._auth_tid
+        fut = asyncio.get_running_loop().create_future()
+        self._auth_futs[m.tid] = fut
+        self.messenger.send_message(m, self.monmap.addr_of_rank(rank),
+                                    peer_type="mon")
+        try:
+            return await asyncio.wait_for(fut, step)
+        finally:
+            self._auth_futs.pop(m.tid, None)
 
     # ------------------------------------------------------------ commands
     async def command(self, cmd: dict, inbl: bytes = b"",

@@ -342,3 +342,62 @@ class MLog(Message):
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MLog":
         import json
         return cls(json.loads(dec.string()))
+
+
+@register_message
+class MAuth(Message):
+    """Client -> mon cephx exchange (messages/MAuth.h).  phase 1 requests a
+    server challenge; phase 2 carries the key-possession proof and the
+    service-ticket wants (CEPHX_GET_AUTH_SESSION_KEY flow)."""
+    TYPE = 116
+
+    def __init__(self, entity: str = "", phase: int = 1,
+                 client_challenge: bytes = b"", proof: bytes = b"",
+                 want: Optional[List[str]] = None, tid: int = 0):
+        super().__init__()
+        self.entity = entity
+        self.phase = phase
+        self.client_challenge = client_challenge
+        self.proof = proof
+        self.want = want if want is not None else []
+        self.tid = tid     # round correlator: replies echo it so a slow
+        #                    mon's late answer can't cross-wire hunting
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.string(self.entity).u8(self.phase)
+        enc.bytes_(self.client_challenge).bytes_(self.proof)
+        enc.list_(self.want, lambda e, s: e.string(s))
+        enc.u64(self.tid)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MAuth":
+        return cls(dec.string(), dec.u8(), dec.bytes_(), dec.bytes_(),
+                   dec.list_(lambda d: d.string()), dec.u64())
+
+
+@register_message
+class MAuthReply(Message):
+    """Mon -> client (messages/MAuthReply.h).  phase 1: server_challenge.
+    phase 2: result + payload sealed with the entity key (tickets,
+    service secrets)."""
+    TYPE = 117
+
+    def __init__(self, phase: int = 1, result: int = 0,
+                 server_challenge: bytes = b"", payload: bytes = b"",
+                 tid: int = 0):
+        super().__init__()
+        self.phase = phase
+        self.result = result
+        self.server_challenge = server_challenge
+        self.payload = payload
+        self.tid = tid
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u8(self.phase).s32(self.result)
+        enc.bytes_(self.server_challenge).bytes_(self.payload)
+        enc.u64(self.tid)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MAuthReply":
+        return cls(dec.u8(), dec.s32(), dec.bytes_(), dec.bytes_(),
+                   dec.u64())

@@ -21,9 +21,9 @@ from ceph_tpu.msg.messenger import Dispatcher, Messenger
 from ceph_tpu.msg.types import EntityAddr, EntityName
 from ceph_tpu.mon.elector import Elector
 from ceph_tpu.mon.messages import (
-    MLog, MMonCommand, MMonCommandAck, MMonElection, MMonGetMap, MMonMap,
-    MMonPaxos, MMonSubscribe, MMonSubscribeAck, MOSDAlive, MOSDBoot,
-    MOSDFailure, MOSDMap, MPGStats, MPGTemp,
+    MAuth, MLog, MMonCommand, MMonCommandAck, MMonElection, MMonGetMap,
+    MMonMap, MMonPaxos, MMonSubscribe, MMonSubscribeAck, MOSDAlive,
+    MOSDBoot, MOSDFailure, MOSDMap, MPGStats, MPGTemp,
 )
 from ceph_tpu.mon.monmap import MonMap
 from ceph_tpu.mon.paxos import Paxos
@@ -76,7 +76,12 @@ class Monitor(Dispatcher):
         self.elector = Elector(self)
         self.paxos = Paxos(self)
         self.osdmon = OSDMonitor(self)
-        self.services: List[PaxosService] = [self.osdmon]
+        from ceph_tpu.mon.auth_monitor import AuthMonitor
+        self.authmon = AuthMonitor(self)
+        self.services: List[PaxosService] = [self.osdmon, self.authmon]
+        self.auth_required = (self.cfg["auth_supported"] == "cephx")
+        if self.auth_required:
+            self._arm_auth_hooks()
         from ceph_tpu.mon.pg_monitor import LogMonitor, PGMonitor
         self.pgmon = PGMonitor(self)
         self.logmon = LogMonitor(
@@ -121,6 +126,38 @@ class Monitor(Dispatcher):
             "recent cluster log entries")
         await sock.start()
         self._admin_sock = sock
+
+    def _arm_auth_hooks(self) -> None:
+        """Transport auth for mon<->mon links: every mon holds the master
+        key, so each self-issues a 'mon' service ticket for outgoing
+        connections and validates peers' with the derived secret."""
+        from ceph_tpu.auth import cephx
+        master = self.authmon.master_key
+        if master is None:
+            # limping along would be worse: _auth_gate drops quorum
+            # traffic lacking a verified mon identity, so a mon with no
+            # master key can never join an election — fail at boot
+            raise RuntimeError(
+                "auth_supported=cephx but the keyring has no 'mon.' "
+                f"master key (keyring={self.cfg['keyring']!r})")
+        tickets = {}   # service -> (blob, session_key), self-issued lazily
+
+        def get_authorizer(peer_type):
+            if peer_type in (None, "client"):
+                return None   # clients don't run an auth acceptor
+            t = tickets.get(peer_type)
+            if t is None:
+                svc = cephx.service_secret(master, peer_type)
+                t = tickets[peer_type] = cephx.issue_ticket(
+                    svc, f"mon.{self.name}", peer_type,
+                    {peer_type: "allow *"}, ttl=10 * 365 * 86400)
+            authorizer, nonce = cephx.make_authorizer(t[0], t[1])
+            return authorizer, t[1], nonce
+
+        mon_svc = cephx.service_secret(master, "mon")
+        self.messenger.get_authorizer_cb = get_authorizer
+        self.messenger.verify_authorizer_cb = (
+            lambda a: cephx.verify_authorizer(mon_svc, a))
 
     def bootstrap(self) -> None:
         self.state = STATE_ELECTING
@@ -202,6 +239,11 @@ class Monitor(Dispatcher):
     # ------------------------------------------------------------- dispatch
     def ms_dispatch(self, m: Message) -> bool:
         try:
+            if isinstance(m, MAuth):
+                self.authmon.handle_auth(m)
+                return True
+            if self.auth_required and not self._auth_gate(m):
+                return True
             if isinstance(m, MMonElection):
                 self.elector.dispatch(m)
             elif isinstance(m, MMonPaxos):
@@ -234,6 +276,42 @@ class Monitor(Dispatcher):
         except Exception:
             self.log.exception(f"dispatch of {m} failed")
             return True
+
+    def _auth_gate(self, m: Message) -> bool:
+        """With cephx on, who may say what (Monitor::_ms_dispatch session
+        gating + MonCap checks): map fetches and pings are open; quorum
+        traffic needs a transport-verified mon identity; daemon intake
+        needs 'profile osd'-class caps; everything else needs a proved
+        key — MAuth session or connection authorizer."""
+        if isinstance(m, (MMonGetMap, MPing)):
+            return True
+        if isinstance(m, (MMonElection, MMonPaxos)):
+            ent = getattr(m, "auth_entity", "")
+            if ent.startswith("mon."):
+                return True
+            self.log.warning(f"dropping unauthenticated quorum msg {m} "
+                             f"from {m.src_addr}")
+            return False
+        if not self.authmon.is_authed(m):
+            if isinstance(m, MMonCommand):
+                self.reply(m, MMonCommandAck(
+                    m.tid, -errno.EACCES,
+                    "access denied: authenticate first"))
+            else:
+                self.log.warning(
+                    f"dropping unauthenticated {type(m).__name__} from "
+                    f"{m.src_addr}")
+            return False
+        if isinstance(m, (MOSDBoot, MOSDFailure, MOSDAlive, MPGTemp,
+                          MPGStats, MLog)):
+            from ceph_tpu.auth.caps import mon_cap_allows
+            caps = self.authmon.caps_for(m) or {}
+            if not mon_cap_allows(caps, "daemon"):
+                self.log.warning(
+                    f"denying daemon msg {type(m).__name__} from "
+                    f"{m.src_addr}: mon caps {caps.get('mon', '')!r}")
+                return False
+        return True
 
     # --------------------------------------------------------- subscriptions
     def handle_subscribe(self, m: MMonSubscribe) -> None:
@@ -277,6 +355,8 @@ class Monitor(Dispatcher):
                 leader_hint=self.rank))
             return
         prefix = m.cmd.get("prefix", "")
+        if self.auth_required and not self._command_allowed(m, prefix):
+            return
         try:
             if prefix == "health":
                 self.reply(m, MMonCommandAck(
@@ -312,6 +392,8 @@ class Monitor(Dispatcher):
                        "quorum_names": [self.monmap.name_of_rank(r)
                                         for r in self.quorum]}
                 self.reply(m, MMonCommandAck(m.tid, 0, json.dumps(out)))
+            elif prefix.startswith("auth"):
+                self.authmon.handle_command(m)
             elif prefix.startswith("osd") or prefix.startswith("pg"):
                 self.osdmon.handle_command(m)
             else:
@@ -320,6 +402,36 @@ class Monitor(Dispatcher):
         except Exception as e:
             self.log.exception(f"command {prefix!r} failed")
             self.reply(m, MMonCommandAck(m.tid, -errno.EIO, repr(e)))
+
+    _READONLY_COMMANDS = frozenset({
+        "health", "status", "pg stat", "pg dump", "log last", "mon dump",
+        "quorum_status", "osd dump", "osd tree", "osd stat", "osd ls",
+        "osd pool ls", "osd getmap", "osd getcrushmap",
+        "osd erasure-code-profile ls", "osd erasure-code-profile get",
+    })
+
+    def _command_allowed(self, m: MMonCommand, prefix: str) -> bool:
+        """MonCap check: reads need r, mutations need w, the auth
+        database needs x (MonCap.cc command profiles, collapsed)."""
+        from ceph_tpu.auth.caps import mon_cap_allows
+        caps = self.authmon.caps_for(m)
+        if caps is None:
+            self.reply(m, MMonCommandAck(
+                m.tid, -errno.EACCES, "access denied"))
+            return False
+        if prefix.startswith("auth"):
+            need = "x"
+        elif prefix in self._READONLY_COMMANDS:
+            need = "r"
+        else:
+            need = "w"
+        if not mon_cap_allows(caps, need):
+            self.reply(m, MMonCommandAck(
+                m.tid, -errno.EACCES,
+                f"access denied: {prefix!r} requires mon cap "
+                f"{need!r}, have {caps.get('mon', '')!r}"))
+            return False
+        return True
 
     # ---------------------------------------------------------------- store
     def store_get(self, prefix: str, key) -> Optional[bytes]:

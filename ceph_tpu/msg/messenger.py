@@ -41,6 +41,7 @@ from ceph_tpu.msg.types import EntityAddr, EntityName
 TAG_MSG = 1
 TAG_ACK = 2
 TAG_KEEPALIVE = 3
+TAG_AUTH_REPLY = 4
 
 _FRAME_HDR = struct.Struct("<BI")       # tag, len
 _MSG_HDR = struct.Struct("<QHI")        # seq, type, crc
@@ -83,10 +84,17 @@ class Dispatcher:
 class Connection:
     """Outgoing logical channel to one peer address (sender-owned)."""
 
-    def __init__(self, msgr: "Messenger", addr: EntityAddr, policy: Policy):
+    def __init__(self, msgr: "Messenger", addr: EntityAddr, policy: Policy,
+                 peer_type: Optional[str] = None):
         self.msgr = msgr
         self.addr = addr
         self.policy = policy
+        self.peer_type = peer_type
+        # cephx: authorizer presented in the banner; session key signs
+        # every frame once the peer's AUTH_REPLY proof checks out
+        self.session_key: Optional[bytes] = None
+        self._auth_nonce: Optional[bytes] = None
+        self._auth_verified = asyncio.Event()
         # identifies THIS logical connection across its tcp reconnects;
         # a fresh Connection (e.g. after mark_down) gets a fresh seq space
         self.conn_id = random.getrandbits(63)
@@ -128,9 +136,11 @@ class Connection:
                 self._read_acks(reader))
             try:
                 await self._send_banner(writer)
-                # replay everything not yet acked, oldest first
-                for _, frame in list(self.unacked):
-                    writer.write(frame)
+                # replay everything not yet acked, oldest first (framed
+                # at write time so replays re-sign with the CURRENT
+                # session key, not the pre-reconnect one)
+                for _, payload in list(self.unacked):
+                    writer.write(self._wrap(payload))
                 await writer.drain()
                 await self._pump(writer)
             except (OSError, asyncio.IncompleteReadError,
@@ -155,12 +165,27 @@ class Connection:
             d.ms_handle_reset(self.addr)
 
     async def _send_banner(self, writer: asyncio.StreamWriter) -> None:
+        authorizer = b""
+        self.session_key = None
+        self._auth_verified = asyncio.Event()
+        if self.msgr.get_authorizer_cb is not None:
+            got = self.msgr.get_authorizer_cb(self.peer_type)
+            if got is not None:
+                authorizer, self.session_key, self._auth_nonce = got
         enc = Encoder()
         enc.struct(self.msgr.name).struct(self.msgr.addr)
         enc.u64(self.conn_id)
+        enc.bytes_(authorizer)
         b = enc.getvalue()
         writer.write(struct.pack("<I", len(b)) + b)
         await writer.drain()
+        if self.session_key is not None:
+            # wait for the acceptor's mutual proof before trusting the
+            # link with any frames (cephx authorizer reply)
+            try:
+                await asyncio.wait_for(self._auth_verified.wait(), 10.0)
+            except asyncio.TimeoutError:
+                raise ConnectionError("authorizer reply timed out")
 
     async def _pump(self, writer: asyncio.StreamWriter) -> None:
         while not self.closed:
@@ -173,20 +198,23 @@ class Connection:
                 msg = self.out_q.popleft()
                 self.out_seq += 1
                 msg.seq = self.out_seq
-                frame = self._frame(msg)
-                self.unacked.append((self.out_seq, frame))
+                body = msg.to_bytes()
+                payload = _MSG_HDR.pack(msg.seq, msg.TYPE,
+                                        zlib.crc32(body)) + body
+                self.unacked.append((self.out_seq, payload))
                 if self.msgr._inject_failure():
                     writer.transport.abort()   # hard drop, like a RST
                     raise ConnectionError("injected socket failure")
-                writer.write(frame)
+                writer.write(self._wrap(payload))
             await writer.drain()
             self._kick.clear()
             if not self.out_q and not self._broken:
                 await self._kick.wait()
 
-    def _frame(self, msg: Message) -> bytes:
-        body = msg.to_bytes()
-        payload = _MSG_HDR.pack(msg.seq, msg.TYPE, zlib.crc32(body)) + body
+    def _wrap(self, payload: bytes) -> bytes:
+        if self.session_key is not None:
+            from ceph_tpu.auth.cephx import sign_payload
+            payload = payload + sign_payload(self.session_key, payload)
         return _FRAME_HDR.pack(TAG_MSG, len(payload)) + payload
 
     async def _read_acks(self, reader: asyncio.StreamReader) -> None:
@@ -200,6 +228,25 @@ class Connection:
                     self.acked_seq = max(self.acked_seq, seq)
                     while self.unacked and self.unacked[0][0] <= seq:
                         self.unacked.popleft()
+                elif tag == TAG_AUTH_REPLY:
+                    from ceph_tpu.auth.cephx import (
+                        authorizer_reply_proof, hmac_eq)
+                    if payload == b"":
+                        # acceptor has no verifier armed yet (e.g. an OSD
+                        # still inside its own boot handshake): downgrade
+                        # to an unsigned session rather than stall — the
+                        # acceptor treats us as unauthenticated anyway
+                        self.session_key = None
+                        self._auth_verified.set()
+                    elif (self.session_key is not None
+                            and self._auth_nonce is not None
+                            and hmac_eq(payload, authorizer_reply_proof(
+                                self.session_key, self._auth_nonce))):
+                        self._auth_verified.set()
+                    else:
+                        self.msgr.log.warning(
+                            f"bad authorizer reply from {self.addr}")
+                        raise ConnectionError("bad authorizer reply")
         except asyncio.CancelledError:
             return
         except (OSError, asyncio.IncompleteReadError, ConnectionError):
@@ -249,6 +296,19 @@ class Messenger:
         self._in_tasks: set = set()
         self._msgs_sent = 0
         self._msgs_received = 0
+        # cephx hooks (msg/Messenger.h ms_get_authorizer /
+        # ms_verify_authorizer dispatcher hooks, collapsed onto the
+        # messenger since auth state lives with the owning stack):
+        #   get_authorizer_cb(peer_type) -> (authorizer, session_key,
+        #       nonce) | None — presented in the banner of OUTGOING
+        #       connections
+        #   verify_authorizer_cb(authorizer) -> (ticket, reply_proof) —
+        #       validates INCOMING banners; raises AuthError to reject
+        #   require_authorizer — drop incoming connections with no/bad
+        #       authorizer (daemons with auth_supported=cephx)
+        self.get_authorizer_cb = None
+        self.verify_authorizer_cb = None
+        self.require_authorizer = False
 
     # --- setup ---
     def add_dispatcher(self, d: Dispatcher) -> None:
@@ -283,7 +343,8 @@ class Messenger:
         key = addr.without_nonce()
         conn = self.conns.get(key)
         if conn is None or conn.closed:
-            conn = Connection(self, addr, self._policy_for(peer_type))
+            conn = Connection(self, addr, self._policy_for(peer_type),
+                              peer_type)
             self.conns[key] = conn
             conn.start()
         self._msgs_sent += 1
@@ -326,7 +387,34 @@ class Messenger:
             peer_name = dec.struct(EntityName)
             peer_addr = dec.struct(EntityAddr)
             conn_id = dec.u64()
+            authorizer = dec.bytes_() if dec.remaining() else b""
         except (OSError, asyncio.IncompleteReadError, ConnectionError):
+            writer.close()
+            return
+        # cephx: validate the authorizer before ANY frame is accepted
+        auth_ticket = None
+        session_key = None
+        if authorizer and self.verify_authorizer_cb is not None:
+            try:
+                auth_ticket, reply_proof = self.verify_authorizer_cb(
+                    authorizer)
+                session_key = auth_ticket.session_key
+                writer.write(_FRAME_HDR.pack(TAG_AUTH_REPLY,
+                                             len(reply_proof)) + reply_proof)
+            except Exception as e:
+                self.log.warning(
+                    f"authorizer from {peer_name} {peer_addr} rejected: "
+                    f"{e}")
+                writer.close()
+                return
+        elif authorizer:
+            # no verifier armed: tell the connector explicitly so it can
+            # downgrade instead of waiting out its proof timeout
+            writer.write(_FRAME_HDR.pack(TAG_AUTH_REPLY, 0))
+        if self.require_authorizer and auth_ticket is None:
+            self.log.warning(
+                f"unauthenticated connection from {peer_name} "
+                f"{peer_addr} refused (auth required)")
             writer.close()
             return
         # restart detection only applies to BOUND peers: distinct unbound
@@ -348,8 +436,19 @@ class Messenger:
                 tag, ln = _FRAME_HDR.unpack(hdr)
                 payload = await reader.readexactly(ln)
                 if tag == TAG_MSG:
+                    if session_key is not None:
+                        from ceph_tpu.auth.cephx import (hmac_eq,
+                                                         sign_payload)
+                        payload, sig = payload[:-16], payload[-16:]
+                        if not hmac_eq(sig, sign_payload(session_key,
+                                                         payload)):
+                            self.log.warning(
+                                f"message signature mismatch from "
+                                f"{peer_name}")
+                            raise ConnectionError("bad message signature")
                     self._handle_msg_frame(payload, peer_name, peer_addr,
-                                           conn_id, writer)
+                                           conn_id, writer,
+                                           auth_ticket)
                 elif tag == TAG_KEEPALIVE:
                     pass
         except (OSError, asyncio.IncompleteReadError, ConnectionError):
@@ -359,7 +458,8 @@ class Messenger:
 
     def _handle_msg_frame(self, payload: bytes, peer_name: EntityName,
                           peer_addr: EntityAddr, conn_id: int,
-                          writer: asyncio.StreamWriter) -> None:
+                          writer: asyncio.StreamWriter,
+                          auth_ticket=None) -> None:
         seq, mtype, crc = _MSG_HDR.unpack_from(payload, 0)
         body = payload[_MSG_HDR.size:]
         if zlib.crc32(body) != crc:
@@ -389,6 +489,11 @@ class Messenger:
         msg.seq = seq
         msg.src_name = peer_name
         msg.src_addr = peer_addr
+        if auth_ticket is not None:
+            # transport-authenticated identity (verified authorizer) —
+            # dispatchers gate on this, never on the claimed src_name
+            msg.auth_entity = auth_ticket.entity
+            msg.auth_caps = auth_ticket.caps
         msg.recv_stamp = time.monotonic()
         self._msgs_received += 1
         for d in self.dispatchers:

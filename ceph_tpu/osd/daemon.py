@@ -79,6 +79,7 @@ class OSD(Dispatcher):
         self.store.mount()
         if self.messenger.addr.is_blank():
             await self.messenger.bind()
+        await self._authenticate()
         self.monc.on_osdmap(self._on_osdmap)
         self.monc.sub_want("osdmap", 0)
         self.monc.messenger.send_message(
@@ -99,6 +100,24 @@ class OSD(Dispatcher):
         self.logger.info(f"osd.{self.whoami} starting at "
                          f"{self.messenger.addr}")
 
+    async def _authenticate(self) -> None:
+        """cephx boot: prove osd.N's key to the mon, fetch the 'osd'
+        service secret (rotating-key fetch role), then require + verify
+        authorizers on every incoming connection and present our own on
+        outgoing osd links."""
+        if self.cfg["auth_supported"] != "cephx":
+            return
+        from ceph_tpu.auth import cephx
+        await self.monc.authenticate(f"osd.{self.whoami}")
+        svc = self.monc.service_secrets.get("osd")
+        if svc is None:
+            raise RuntimeError(
+                f"osd.{self.whoami}: mon did not grant the osd service "
+                f"secret (entity caps missing?)")
+        self.messenger.verify_authorizer_cb = (
+            lambda a: cephx.verify_authorizer(svc, a))
+        self.messenger.require_authorizer = True
+
     async def wait_for_boot(self, timeout: float = 30.0) -> None:
         deadline = asyncio.get_event_loop().time() + timeout
         while not (self.osdmap.epoch and self.osdmap.is_up(self.whoami)):
@@ -118,6 +137,7 @@ class OSD(Dispatcher):
             await self.admin_socket.stop()
         for pg in self.pgs.values():
             pg.stop()
+        self.monc.stop()
         await self.ec_queue.stop()
         await self.messenger.shutdown()
         self.store.umount()

@@ -18,13 +18,14 @@ import asyncio
 import os
 import sys
 
-from ceph_tpu.tools.daemons import load_monmap
+from ceph_tpu.tools.daemons import apply_conf, load_monmap
 
 
 async def run(args, extra) -> int:
     from ceph_tpu.client.rados import Rados
     from ceph_tpu.common.context import Context
     ctx = Context("client.admin")
+    apply_conf(ctx, args.dir)   # picks up auth_supported/keyring etc.
     monmap = load_monmap(args.dir)
     r = Rados(ctx, monmap)
     await r.connect()

@@ -83,7 +83,10 @@ async def run(args) -> int:
     from ceph_tpu.client.rados import Rados
     from ceph_tpu.common.context import Context
     from ceph_tpu.services.rbd import RBD, Image, RBDError
-    r = Rados(Context("client.admin"), load_monmap(args.dir))
+    ctx = Context("client.admin")
+    from ceph_tpu.tools.daemons import apply_conf
+    apply_conf(ctx, args.dir)
+    r = Rados(ctx, load_monmap(args.dir))
     await r.connect()
     try:
         io = r.open_ioctx(args.pool)

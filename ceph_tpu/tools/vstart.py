@@ -41,12 +41,14 @@ class VCluster:
 
     def __init__(self, directory: str, n_osds: int = 3, n_mons: int = 1,
                  osds_per_host: int = 1,
-                 conf: Optional[Dict[str, str]] = None):
+                 conf: Optional[Dict[str, str]] = None,
+                 cephx: bool = False):
         self.dir = os.path.abspath(directory)
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.osds_per_host = osds_per_host
         self.conf = conf or {}
+        self.cephx = cephx
         self.procs: Dict[str, subprocess.Popen] = {}
         self.monmap = MonMap()
 
@@ -67,6 +69,20 @@ class VCluster:
                         os.path.join(self.dir, "$name.asok"))
         conf.setdefault("mon_cluster_log_file",
                         os.path.join(self.dir, "cluster.log"))
+        if self.cephx:
+            # one shared keyring (vstart.sh writes keyring + caps the
+            # same way: mon. master, client.admin allow *, per-osd keys)
+            from ceph_tpu.auth.keyring import Keyring
+            kr = Keyring()
+            kr.add("mon.")
+            kr.add("client.admin",
+                   caps={"mon": "allow *", "osd": "allow *"})
+            for i in range(self.n_osds):
+                kr.add(f"osd.{i}", caps={"mon": "allow profile osd",
+                                         "osd": "allow *"})
+            kr.save(os.path.join(self.dir, "keyring"))
+            conf["auth_supported"] = "cephx"
+            conf["keyring"] = os.path.join(self.dir, "keyring")
         with open(os.path.join(self.dir, "ceph.conf"), "w") as f:
             for k, v in conf.items():
                 f.write(f"{k} = {v}\n")
@@ -116,6 +132,9 @@ class VCluster:
                 ctx.config.set(k, v)
             except KeyError:
                 pass
+        if self.cephx:
+            ctx.config.set("auth_supported", "cephx")
+            ctx.config.set("keyring", os.path.join(self.dir, "keyring"))
         r = Rados(ctx, self.monmap)
         await r.connect()
         return r
@@ -159,6 +178,8 @@ def main(argv=None) -> int:
                     help="extra k=v config entries")
     ap.add_argument("--new", action="store_true",
                     help="wipe the cluster dir first (vstart -n)")
+    ap.add_argument("--cephx", action="store_true",
+                    help="enable cephx auth (generates a keyring)")
     ap.add_argument("--keep-running", action="store_true",
                     help="stay attached until ^C")
     args = ap.parse_args(argv)
@@ -167,7 +188,7 @@ def main(argv=None) -> int:
         shutil.rmtree(args.dir)
     conf = dict(kv.split("=", 1) for kv in args.conf)
     cl = VCluster(args.dir, args.osds, args.mons, args.osds_per_host,
-                  conf)
+                  conf, cephx=args.cephx)
     cl.write_configs()
     cl.start_daemons()
     asyncio.run(cl.bootstrap())
