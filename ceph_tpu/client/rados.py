@@ -256,9 +256,10 @@ class IoCtx:
                                    length=len(data), data=data)])
 
     async def read(self, oid: str, length: int = 0,
-                   offset: int = 0) -> bytes:
+                   offset: int = 0, timeout: float = 30.0) -> bytes:
         reply = await self._op(oid, [OSDOp(OP_READ, offset=offset,
-                                           length=length)])
+                                           length=length)],
+                               timeout=timeout)
         op = reply.ops[0]
         if op.rval < 0:
             raise ObjectOperationError(op.rval, oid)

@@ -180,8 +180,10 @@ class Encodable:
         return cls.decode(Decoder(data))
 
     def __eq__(self, other):
+        # compare by encoded bytes: __dict__ is empty for __slots__
+        # subclasses, which would make any two instances "equal"
         return (type(self) is type(other)
-                and self.__dict__ == other.__dict__)
+                and self.to_bytes() == other.to_bytes())
 
     def __repr__(self):
         kv = ", ".join(f"{k}={v!r}" for k, v in list(self.__dict__.items())[:6])

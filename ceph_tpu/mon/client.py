@@ -83,23 +83,31 @@ class MonClient(Dispatcher):
         return False
 
     def _handle_osdmap(self, m: MOSDMap) -> None:
+        # callbacks fire per applied EPOCH, not per message: the OSD
+        # advances its PGs through every map and persists each full map
+        # for past-interval walks (OSD::handle_osd_map does the same)
+        changed = False
         if m.fulls:
             e = max(m.fulls)
             if self.osdmap is None or e > self.osdmap.epoch:
                 self.osdmap = OSDMap.from_bytes(m.fulls[e])
+                changed = True
+                for cb in self._map_cb:
+                    cb(self.osdmap)
         for e in sorted(m.incrementals):
             if self.osdmap is None:
                 continue
             if e == self.osdmap.epoch + 1:
                 self.osdmap.apply_incremental(
                     Incremental.from_bytes(m.incrementals[e]))
-        if self.osdmap is not None:
+                changed = True
+                for cb in self._map_cb:
+                    cb(self.osdmap)
+        if self.osdmap is not None and changed:
             self._subs["osdmap"] = self.osdmap.epoch + 1
             self.log.debug(f"got osdmap {self.osdmap.summary()}")
             for ev in self._osdmap_waiters:
                 ev.set()
-            for cb in self._map_cb:
-                cb(self.osdmap)
 
     def on_osdmap(self, cb: Callable[[OSDMap], None]) -> None:
         self._map_cb.append(cb)

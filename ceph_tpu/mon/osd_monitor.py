@@ -67,7 +67,7 @@ class OSDMonitor(PaxosService):
                     or inc.new_primary_affinity or inc.new_up_thru
                     or inc.new_pg_temp or inc.new_primary_temp
                     or inc.new_crush is not None or inc.new_max_osd >= 0
-                    or inc.fsid)
+                    or inc.fsid or inc.new_lost)
 
     def on_active(self) -> None:
         if self.osdmap.epoch == 0:
@@ -266,6 +266,24 @@ class OSDMonitor(PaxosService):
                     (self.pending_inc.new_state.get(osd, 0) & OSD_UP):
                 self.pending_inc.new_state[osd] = \
                     self.pending_inc.new_state.get(osd, 0) | OSD_UP
+            self._propose_and_ack(m)
+        elif prefix == "osd lost":
+            # operator declares an osd's data unrecoverable so peering
+            # stops waiting for it (OSDMonitor 'osd lost' command; needs
+            # the same explicit confirmation the reference demands)
+            osd = int(cmd["id"])
+            if not self.osdmap.exists(osd):
+                ack(-errno.ENOENT, f"osd.{osd} dne")
+                return
+            if not cmd.get("yes_i_really_mean_it"):
+                ack(-errno.EPERM,
+                    "are you SURE? this might mean real, permanent data "
+                    "loss. pass --yes-i-really-mean-it if you really do")
+                return
+            if self.osdmap.is_up(osd):
+                ack(-errno.EBUSY, f"osd.{osd} is up; mark it down first")
+                return
+            self.pending_inc.new_lost[osd] = self.osdmap.epoch
             self._propose_and_ack(m)
         elif prefix == "osd primary-affinity":
             osd = int(cmd["id"])

@@ -27,14 +27,14 @@ from ceph_tpu.osd.messages import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDOp, MOSDOpReply, MOSDPing, MOSDRepOp,
     MOSDRepOpReply, MPGLog, MPGLogRequest, MPGNotify, MPGObjectList,
-    MPGPush, MPGPushReply, MPGQuery, MPGScrub, MPGScrubMap, MPGScrubScan,
-    MWatchNotifyAck,
+    MPGPush, MPGPushReply, MPGQuery, MPGRemove, MPGScrub, MPGScrubMap,
+    MPGScrubScan, MWatchNotifyAck,
 )
 from ceph_tpu.osd.osdmap import OSDMap
 from ceph_tpu.osd.pg import PG
 from ceph_tpu.osd.types import NO_SHARD, PGId
 from ceph_tpu.crush.constants import CRUSH_ITEM_NONE
-from ceph_tpu.store.objectstore import ObjectStore
+from ceph_tpu.store.objectstore import ObjectStore, Transaction
 
 
 class OSD(Dispatcher):
@@ -52,6 +52,7 @@ class OSD(Dispatcher):
         self.pgs: Dict[PGId, PG] = {}
         self._tid = 0
         self._hb_last: Dict[int, float] = {}     # peer osd -> last reply
+        self._map_cache: Dict[int, OSDMap] = {}
         self._hb_task: Optional[asyncio.Task] = None
         self._waiting_maps: List[Message] = []
         self.running = False
@@ -143,8 +144,74 @@ class OSD(Dispatcher):
         self.store.umount()
 
     # ----------------------------------------------------------------- maps
+    MAP_HISTORY = 1000   # epochs of full maps kept for interval walks
+
+    def _store_map(self, osdmap: OSDMap) -> None:
+        """Persist the full map per epoch (OSD superblock map store,
+        OSD::write_map) so generate_past_intervals can walk history
+        after restarts."""
+        from ceph_tpu.store.types import CollectionId, ObjectId
+        cid = CollectionId.meta()
+        txn = Transaction()
+        if not self.store.collection_exists(cid):
+            txn.create_collection(cid)
+        txn.write(cid, ObjectId(f"osdmap.{osdmap.epoch}"), 0,
+                  osdmap.to_bytes())
+        old = osdmap.epoch - self.MAP_HISTORY
+        if old > 0 and self.store.exists(cid, ObjectId(f"osdmap.{old}")):
+            txn.remove(cid, ObjectId(f"osdmap.{old}"))
+        self.store.apply_transaction(txn)
+
+    def get_map(self, epoch: int) -> Optional[OSDMap]:
+        """A historical full map, if still within the kept window.
+        Decoded maps are memoized: interval walks touch the same epochs
+        once per PG, and a full decode per (PG, epoch) would stall the
+        event loop on a wide _advance_pgs."""
+        if self.osdmap is not None and epoch == self.osdmap.epoch:
+            return self.osdmap
+        cached = self._map_cache.get(epoch)
+        if cached is not None:
+            return cached
+        from ceph_tpu.store.types import CollectionId, ObjectId
+        try:
+            data = self.store.read(CollectionId.meta(),
+                                   ObjectId(f"osdmap.{epoch}"))
+            m = OSDMap.from_bytes(bytes(data))
+        except Exception:
+            return None
+        self._map_cache[epoch] = m
+        while len(self._map_cache) > 128:
+            self._map_cache.pop(next(iter(self._map_cache)))
+        return m
+
+    async def ensure_map_history(self, from_e: int, to_e: int) -> None:
+        """Fill holes in the stored map history by fetching full maps
+        from the mon (OSD::osdmap_subscribe catch-up role).  A hole
+        appears when the mon's subscription fallback skipped >100 epochs
+        with one full map; walking past intervals across such a hole
+        would silently miss acting sets that accepted writes."""
+        from ceph_tpu.store.types import CollectionId, ObjectId
+        cid = CollectionId.meta()
+        for e in range(max(1, from_e), to_e):
+            if self.store.exists(cid, ObjectId(f"osdmap.{e}")):
+                continue
+            try:
+                ack = await self.monc.command(
+                    {"prefix": "osd getmap", "epoch": e}, timeout=15.0)
+            except Exception as ex:
+                self.logger.warning(
+                    f"could not backfill osdmap e{e} from mon: {ex}")
+                continue
+            if ack.outbl:
+                txn = Transaction()
+                if not self.store.collection_exists(cid):
+                    txn.create_collection(cid)
+                txn.write(cid, ObjectId(f"osdmap.{e}"), 0, ack.outbl)
+                self.store.apply_transaction(txn)
+
     def _on_osdmap(self, osdmap: OSDMap) -> None:
         self.osdmap = osdmap
+        self._store_map(osdmap)
         if (self.running and osdmap.exists(self.whoami)
                 and not osdmap.is_up(self.whoami)):
             # falsely marked down (missed heartbeats during a stall):
@@ -174,20 +241,33 @@ class OSD(Dispatcher):
                              and self.whoami in acting else NO_SHARD)
                     wanted[pgid.with_shard(shard)
                            if shard != NO_SHARD else pgid] = pool_id
-        # drop PGs we no longer host (or whose EC shard moved); on-store
-        # data stays — a returning mapping reloads it and peering heals
+        # PGs we no longer host stay live as STRAYS when they hold data:
+        # their copy may be the only survivor of a past interval, so they
+        # must keep answering peering queries and serving log/object
+        # pulls until the new primary confirms clean and sends MPGRemove
+        # (PG stray role).  Empty copies are dropped immediately
         for pgid in [p for p in self.pgs if p not in wanted]:
-            self.pgs.pop(pgid).stop()
+            pg = self.pgs[pgid]
+            if pg.info.is_empty():
+                self.pgs.pop(pgid).stop()
+            else:
+                if pgid.pool in m.pools:
+                    pg.pool = m.pools[pgid.pool]
+                pg.advance_map(m)
         for pgid, pool_id in wanted.items():
             pg = self.pgs.get(pgid)
-            if pg is None:
+            fresh = pg is None
+            if fresh:
                 pg = PG(self, pgid, pool_id, m.pools[pool_id])
                 pg.create_onstore()
                 pg.load_meta()
+                pg.generate_past_intervals()
                 self.pgs[pgid] = pg
                 pg.start()
             pg.pool = m.pools[pool_id]
             pg.advance_map(m)
+            if fresh:
+                pg.ensure_peering()
             pg.maybe_trim_snaps()
 
     def note_pg_active(self, pg: PG) -> None:
@@ -200,6 +280,53 @@ class OSD(Dispatcher):
             MOSDAlive(self.whoami, self.osdmap.epoch),
             self.monc.monmap.addr_of_rank(self.monc.cur_mon),
             peer_type="mon")
+
+    def _load_stray_pg(self, pgid: PGId):
+        """A peering query arrived for a PG we are not mapped to.  If a
+        previous incarnation left data on-store (e.g. we restarted while
+        stray), resurrect it as a stray so the PriorSet walk can read our
+        info/log instead of losing the last copy of a past interval."""
+        from ceph_tpu.store.types import CollectionId
+        pool = self.osdmap.pools.get(pgid.pool)
+        if pool is None:
+            return None
+        cid = CollectionId.pg(pgid.pool, pgid.seed, pgid.shard)
+        if not self.store.collection_exists(cid):
+            return None
+        pg = PG(self, pgid, pgid.pool, pool)
+        pg.load_meta()
+        if pg.info.is_empty():
+            return None
+        self.pgs[pgid] = pg
+        pg.start()
+        pg.advance_map(self.osdmap)
+        self.logger.info(f"resurrected stray {pgid} "
+                         f"(lu {pg.info.last_update})")
+        return pg
+
+    def _handle_pg_remove(self, m) -> None:
+        """MPGRemove: the clean primary says our stray copy is garbage."""
+        if m.epoch > self.osdmap.epoch:
+            # we haven't seen the map the primary decided under: decide
+            # after catching up, not against a stale mapping
+            self._waiting_maps.append(m)
+            return
+        pg = self._pg_for(m.pgid)
+        if pg is None:
+            return
+        # judge membership from the CURRENT map, not possibly-stale pg
+        # state
+        up, _, acting, _ = self.osdmap.pg_to_up_acting_osds(
+            m.pgid.without_shard())
+        if self.whoami in acting or self.whoami in up:
+            self.logger.warning(
+                f"ignoring pg remove for {m.pgid}: we are in up/acting")
+            return
+        self.pgs.pop(pg.pgid, None)
+        pg.stop()
+        txn = Transaction().remove_collection(pg.cid)
+        self.store.apply_transaction(txn)
+        self.logger.info(f"removed stray {pg.pgid} (per osd.{m.from_osd})")
 
     # ------------------------------------------------------------- plumbing
     def send_osd(self, osd_id: int, msg: Message) -> None:
@@ -246,7 +373,7 @@ class OSD(Dispatcher):
                 pg.backend.handle_reply(m)
             return True
         if isinstance(m, MPGQuery):
-            pg = self._pg_for(m.pgid)
+            pg = self._pg_for(m.pgid) or self._load_stray_pg(m.pgid)
             if pg is not None:
                 pg.on_query(m)
             else:
@@ -257,6 +384,9 @@ class OSD(Dispatcher):
                 self.send_osd(m.from_osd, MPGNotify(
                     m.pgid, m.epoch, PGInfo(m.pgid).to_bytes(),
                     self.whoami))
+            return True
+        if isinstance(m, MPGRemove):
+            self._handle_pg_remove(m)
             return True
         if isinstance(m, MPGNotify):
             pg = self._pg_for(m.pgid)
@@ -428,6 +558,10 @@ class OSD(Dispatcher):
                     except Exception:
                         n_objs, nbytes = 0, 0
                 state = pg.state
+                if state != STATE_ACTIVE and pg.peering_blocked_by:
+                    # surfaced in `ceph -s` / pg dump like the reference's
+                    # down+peering with blocked_by
+                    state = "down+peering"
                 if state == STATE_ACTIVE:
                     state = "active+clean" if not pg.peer_missing or \
                         not any(pm.items

@@ -740,3 +740,24 @@ class MWatchNotifyAck(Message):
                        ) -> "MWatchNotifyAck":
         return cls(dec.struct(PGId), dec.string(), dec.u64(),
                    dec.bytes_())
+
+
+@register_message
+class MPGRemove(Message):
+    """Primary -> stray after the PG went clean: delete your copy
+    (messages/MOSDPGRemove.h)."""
+    TYPE = 232
+
+    def __init__(self, pgid: Optional[PGId] = None, epoch: int = 0,
+                 from_osd: int = -1):
+        super().__init__()
+        self.pgid = pgid or PGId(0, 0)
+        self.epoch = epoch
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.struct(self.pgid).u32(self.epoch).s32(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGRemove":
+        return cls(dec.struct(PGId), dec.u32(), dec.s32())

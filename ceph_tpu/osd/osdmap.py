@@ -29,7 +29,7 @@ from ceph_tpu.osd.types import (
 class Incremental(Encodable):
     """OSDMap::Incremental — the delta the monitor commits per epoch."""
 
-    STRUCT_V = 2
+    STRUCT_V = 3
 
     def __init__(self, epoch: int = 0):
         self.epoch = epoch
@@ -50,6 +50,8 @@ class Incremental(Encodable):
         # new_erasure_code_profiles / old_erasure_code_profiles
         self.new_ec_profiles: Dict[str, Dict[str, str]] = {}
         self.old_ec_profiles: List[str] = []
+        # v3: `osd lost` declarations (osd -> epoch of the declaration)
+        self.new_lost: Dict[int, int] = {}
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u32(self.epoch).string(self.fsid).s32(self.new_max_osd)
@@ -78,6 +80,7 @@ class Incremental(Encodable):
                  lambda e, v: e.map_(v, lambda e2, k2: e2.string(k2),
                                      lambda e2, v2: e2.string(v2)))
         enc.list_(self.old_ec_profiles, lambda e, v: e.string(v))
+        enc.map_(self.new_lost, lambda e, k: e.s32(k), lambda e, v: e.u32(v))
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "Incremental":
@@ -109,6 +112,8 @@ class Incremental(Encodable):
                 lambda d: d.map_(lambda d2: d2.string(),
                                  lambda d2: d2.string()))
             inc.old_ec_profiles = dec.list_(lambda d: d.string())
+        if struct_v >= 3:
+            inc.new_lost = dec.map_(lambda d: d.s32(), lambda d: d.u32())
         return inc
 
 
@@ -179,6 +184,9 @@ class OSDMap(Encodable):
 
     def get_up_thru(self, osd: int) -> int:
         return self.osd_info[osd].up_thru if 0 <= osd < self.max_osd else 0
+
+    def get_lost_at(self, osd: int) -> int:
+        return self.osd_info[osd].lost_at if 0 <= osd < self.max_osd else 0
 
     # ------------------------------------------------------------- pools
     def get_pool(self, pool: int) -> Optional[PGPool]:
@@ -396,6 +404,8 @@ class OSDMap(Encodable):
             self.osd_primary_affinity[osd] = a
         for osd, e in inc.new_up_thru.items():
             self.osd_info[osd].up_thru = e
+        for osd, e in inc.new_lost.items():
+            self.osd_info[osd].lost_at = e
         for pg, osds in inc.new_pg_temp.items():
             if osds:
                 self.pg_temp[pg] = list(osds)

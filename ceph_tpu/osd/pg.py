@@ -35,7 +35,8 @@ from ceph_tpu.osd.messages import (
     EVersion, MOSDOp, MOSDOpReply, MPGLog, MPGLogRequest, MPGNotify,
     MPGObjectList, MPGPush, MPGPushReply, MPGQuery,
 )
-from ceph_tpu.osd.pglog import LogEntry, MissingSet, PGInfo, PGLog
+from ceph_tpu.osd.pglog import (LogEntry, MissingSet, PastInterval, PGInfo,
+                                PGLog)
 from ceph_tpu.osd.types import NO_SHARD, PGId, PGPool
 from ceph_tpu.store.objectstore import Transaction
 from ceph_tpu.store.types import CollectionId, ObjectId
@@ -61,6 +62,12 @@ class PG:
         self.peer_info: Dict[int, PGInfo] = {}
         self.peer_missing: Dict[int, MissingSet] = {}
         self._backfilling: Set[int] = set()   # peers mid-full-resync
+        # closed mapping intervals since last_epoch_started
+        # (PG::past_intervals) + who blocks peering (PriorSet pg_down)
+        self.past_intervals: List[PastInterval] = []
+        self.peering_blocked_by: List[int] = []
+        self._probe_shards: Dict[int, int] = {}   # probe osd -> EC shard
+        self._strays: Set[int] = set()            # probed non-members
         # current mapping
         self.up: List[int] = []
         self.acting: List[int] = []
@@ -122,10 +129,14 @@ class PG:
 
     # --------------------------------------------------------- persistence
     def save_meta(self, txn: Transaction) -> None:
+        from ceph_tpu.common.encoding import Encoder
         txn.touch(self.cid, self.meta_oid)
         txn.omap_setkeys(self.cid, self.meta_oid, {
             b"info": self.info.to_bytes(),
             b"log": self.log.to_bytes(),
+            b"past_intervals": Encoder().list_(
+                self.past_intervals,
+                lambda e, v: e.struct(v)).getvalue(),
         })
 
     def load_meta(self) -> None:
@@ -138,6 +149,11 @@ class PG:
         if b"log" in omap:
             self.log = PGLog.from_bytes(omap[b"log"])
             self.reqids = self.log.reqids()
+        if b"past_intervals" in omap:
+            from ceph_tpu.common.encoding import Decoder
+            self.past_intervals = Decoder(
+                omap[b"past_intervals"]).list_(
+                lambda d: d.struct(PastInterval))
 
     def create_onstore(self) -> None:
         if not self.osd.store.collection_exists(self.cid):
@@ -158,6 +174,26 @@ class PG:
             osdmap.pg_to_up_acting_osds(self.pgid.without_shard())
         interval_changed = (acting != self.acting or up != self.up
                             or acting_primary != self.primary)
+        if interval_changed and self.info.same_interval_since \
+                and (self.up or self.acting):
+            # close the old interval (PG::start_peering_interval ->
+            # pg_interval_t::check_new_interval).  maybe_went_rw: the old
+            # primary asserted up_thru into the interval and had enough
+            # members to meet min_size — writes may have committed there
+            old_acting = [o for o in self.acting
+                          if o >= 0 and o != CRUSH_ITEM_NONE]
+            went_rw = (self.primary >= 0
+                       and osdmap.get_up_thru(self.primary)
+                       >= self.info.same_interval_since
+                       and len(old_acting) >= self.pool.min_size)
+            self.past_intervals.append(PastInterval(
+                self.info.same_interval_since, osdmap.epoch - 1,
+                list(self.up), list(self.acting), self.primary, went_rw))
+            # trim intervals fully before the last started epoch: their
+            # writes are subsumed by any copy from last_epoch_started on
+            self.past_intervals = [
+                iv for iv in self.past_intervals
+                if iv.last >= self.info.last_epoch_started]
         self.up, self.acting, self.primary = up, acting, acting_primary
         me = self.osd.whoami
         self.role = self.acting.index(me) if me in self.acting else -1
@@ -178,6 +214,72 @@ class PG:
                 self._peering_task = \
                     asyncio.get_running_loop().create_task(self._peer())
             # non-primaries wait for the primary's MPGLog(activate)
+
+    def generate_past_intervals(self, replace: bool = False) -> None:
+        """Reconstruct closed intervals from the OSD's stored map history
+        (PG::generate_past_intervals): a freshly instantiated copy — new
+        member or rebooted after missing epochs — must learn which acting
+        sets may have accepted writes while it wasn't watching, or the
+        PriorSet walk would trust an incomplete world.
+
+        With replace=True the list is rebuilt from scratch starting at
+        last_epoch_started — the authoritative pre-peering pass (holes in
+        the map history must be filled first; see OSD.ensure_map_history).
+        """
+        cur_map = self.osd.osdmap
+        if replace:
+            self.past_intervals = []
+            start = max(self.info.last_epoch_started, 1)
+        else:
+            start = max(self.info.same_interval_since, 1)
+        known_to = max((iv.last for iv in self.past_intervals), default=0)
+        prev = None   # [up, acting, primary, first_epoch]
+        for e in range(start, cur_map.epoch + 1):
+            m = cur_map if e == cur_map.epoch else self.osd.get_map(e)
+            if m is None or self.pool_id not in m.pools:
+                continue
+            up, _, acting, actp = m.pg_to_up_acting_osds(
+                self.pgid.without_shard())
+            if prev is None:
+                prev = [up, acting, actp, e]
+                continue
+            if (up, acting, actp) != (prev[0], prev[1], prev[2]):
+                if e - 1 > known_to:
+                    pool = m.pools[self.pool_id]
+                    went_rw = (prev[2] >= 0
+                               and m.get_up_thru(prev[2]) >= prev[3]
+                               and len([o for o in prev[1] if o >= 0
+                                        and o != CRUSH_ITEM_NONE])
+                               >= pool.min_size)
+                    self.past_intervals.append(PastInterval(
+                        prev[3], e - 1, list(prev[0]), list(prev[1]),
+                        prev[2], went_rw))
+                prev = [up, acting, actp, e]
+        if prev is not None:
+            # the surviving interval is the OPEN one
+            self.info.same_interval_since = prev[3]
+            if not self.up and not self.acting:
+                # fresh instance: adopt the open interval's membership so
+                # the advance_map that follows instantiation sees no
+                # bogus []->acting "change" that would clobber
+                # same_interval_since with the current epoch
+                self.up, self.acting, self.primary = (list(prev[0]),
+                                                      list(prev[1]),
+                                                      prev[2])
+                me = self.osd.whoami
+                self.role = (self.acting.index(me) if me in self.acting
+                             else -1)
+                self.interval_epoch = cur_map.epoch
+
+    def ensure_peering(self) -> None:
+        """Kick peering on a freshly instantiated copy whose mapping is
+        unchanged (advance_map sees no interval change then)."""
+        if self.is_primary() and self._peering_task is None \
+                and self.state != STATE_ACTIVE:
+            self.state = STATE_PEERING
+            self._active_event.clear()
+            self._peering_task = asyncio.get_running_loop().create_task(
+                self._peer())
 
     def stop(self) -> None:
         for t in (self._peering_task, self._worker_task):
@@ -207,12 +309,75 @@ class PG:
                 self._peering_task = asyncio.get_running_loop().create_task(
                     self._peer())
 
+    def _build_prior_set(self) -> Tuple[Dict[int, int], List[int]]:
+        """PriorSet (PG::PriorSet): every osd that may hold writes we
+        must see — the current up∪acting plus acting members of every
+        maybe-went-rw past interval since last_epoch_started.  Returns
+        (probe osd -> EC shard to ask, blocked_by osds): peering must
+        NOT proceed while an interval that may have gone rw has no
+        live member and its down members aren't declared lost."""
+        m = self.osd.osdmap
+        probe: Dict[int, int] = {p: self.shard_of(p)
+                                 for p in self.actual_peers()}
+        blocked: List[int] = []
+        for iv in self.past_intervals:
+            if not iv.maybe_went_rw \
+                    or iv.last < self.info.last_epoch_started:
+                continue
+            any_up, down_not_lost = False, []
+            for pos, o in enumerate(iv.acting):
+                if o < 0 or o == CRUSH_ITEM_NONE:
+                    continue
+                if m.is_up(o):
+                    any_up = True
+                    if o != self.osd.whoami:
+                        shard = (pos if self.pool.is_erasure()
+                                 else NO_SHARD)
+                        probe.setdefault(o, shard)
+                elif m.get_lost_at(o) < iv.last:
+                    down_not_lost.append(o)
+            if not any_up and down_not_lost:
+                blocked.extend(down_not_lost)
+        return probe, sorted(set(blocked))
+
     async def _peer_inner(self, epoch: int) -> None:
-        # GetInfo: query every live peer of this interval
+        # The interval record kept incrementally by advance_map is only a
+        # cache: a full-map jump (mon's >100-epoch subscription fallback)
+        # would have collapsed every missed epoch into one interval with
+        # stale membership.  Fill map-history holes from the mon and
+        # rebuild past_intervals authoritatively before trusting them
+        await self.osd.ensure_map_history(
+            max(1, self.info.last_epoch_started), self.osd.osdmap.epoch)
+        if epoch != self.interval_epoch:
+            return   # superseded while backfilling maps
+        self.generate_past_intervals(replace=True)
+        # GetInfo: query the PriorSet — current peers + past-interval
+        # members that may hold newer writes (PG.h GetInfo state)
         self.peer_info.clear()
         self.peer_missing.clear()
-        peers = self.actual_peers()
-        self.log_.debug(f"{self.pgid} peering e{epoch}: peers {peers}")
+        probe, blocked = self._build_prior_set()
+        self.peering_blocked_by = blocked
+        if blocked:
+            # an interval that may have accepted writes has no live
+            # member: serving reads/writes now could silently lose those
+            # writes.  Wait for one to return or `osd lost` (PG 'down+
+            # peering' state).  advance_map cancels+restarts this task
+            # on any interval change; lost declarations and reboots
+            # change the map, so poll it
+            self.log_.warning(
+                f"{self.pgid} peering blocked: down osds {blocked} from "
+                f"a possibly-rw interval (mark lost to proceed)")
+            while True:
+                await asyncio.sleep(1.0)
+                probe, blocked = self._build_prior_set()
+                self.peering_blocked_by = blocked
+                if not blocked:
+                    break
+        peers = sorted(probe)
+        self._probe_shards = probe
+        self._strays = {p for p in probe
+                        if p not in self.acting and p not in self.up}
+        self.log_.debug(f"{self.pgid} peering e{epoch}: probing {peers}")
         infos: Dict[int, PGInfo] = {}
         if peers:
             futs = {}
@@ -221,7 +386,7 @@ class PG:
                 self._notify_waiters[p] = fut
                 futs[p] = fut
                 self.osd.send_osd(p, MPGQuery(
-                    self.pgid.with_shard(self.shard_of(p)), epoch,
+                    self.pgid.with_shard(probe[p]), epoch,
                     self.osd.whoami))
             for p, fut in futs.items():
                 try:
@@ -257,8 +422,9 @@ class PG:
         fut = asyncio.get_running_loop().create_future()
         self._log_waiters[peer] = fut
         since = self.info.last_update
+        peer_shard = self._probe_shards.get(peer, self.shard_of(peer))
         self.osd.send_osd(peer, MPGLogRequest(
-            self.pgid.with_shard(self.shard_of(peer)), epoch, since,
+            self.pgid.with_shard(peer_shard), epoch, since,
             self.osd.whoami))
         try:
             info_b, log_b = await asyncio.wait_for(fut, 15.0)
@@ -316,8 +482,9 @@ class PG:
         # both-sides scan: fetch the auth peer's object listing
         fut = asyncio.get_running_loop().create_future()
         self._list_waiters[peer] = fut
+        peer_shard = self._probe_shards.get(peer, self.shard_of(peer))
         self.osd.send_osd(peer, MPGLogRequest(
-            self.pgid.with_shard(self.shard_of(peer)), epoch,
+            self.pgid.with_shard(peer_shard), epoch,
             EVersion.zero(), self.osd.whoami, want_list=True))
         try:
             names = await asyncio.wait_for(fut, 15.0)
@@ -352,8 +519,9 @@ class PG:
         """Whole-object pull: ask peer to push its copy (replicated)."""
         fut = asyncio.get_running_loop().create_future()
         self._pull_waiters[oid] = fut
+        peer_shard = self._probe_shards.get(peer, self.shard_of(peer))
         self.osd.send_osd(peer, MPGLogRequest(
-            self.pgid.with_shard(self.shard_of(peer)), epoch,
+            self.pgid.with_shard(peer_shard), epoch,
             EVersion.zero(), self.osd.whoami, want_object=oid))
         try:
             await asyncio.wait_for(fut, 15.0)
@@ -420,6 +588,8 @@ class PG:
         # confirmation still goes out
         if any(self.peer_missing.values()) or self._backfilling:
             asyncio.get_running_loop().create_task(self._recover(epoch))
+        else:
+            self._on_clean(epoch)
 
     async def _recover(self, epoch: int) -> None:
         """Push missing objects to peers (ReplicatedPG recovery WQ /
@@ -442,10 +612,31 @@ class PG:
                         self.info.to_bytes(), self.log.to_bytes(),
                         self.osd.whoami, activate=True, backfill_done=True))
             self.log_.debug(f"{self.pgid} recovery complete")
+            if epoch == self.interval_epoch:
+                self._on_clean(epoch)
         except asyncio.CancelledError:
             raise
         except Exception:
             self.log_.exception(f"{self.pgid} recovery failed")
+
+    def _on_clean(self, epoch: int) -> None:
+        """Every copy caught up: past-interval history is no longer
+        needed (PG::mark_clean trims past_intervals) and strays that
+        served the PriorSet may delete their copies (the reference's
+        MOSDPGRemove after clean)."""
+        from ceph_tpu.osd.messages import MPGRemove
+        self.past_intervals = []
+        txn = Transaction()
+        self.save_meta(txn)
+        self.osd.store.apply_transaction(txn)
+        for p in self._strays:
+            # send regardless of up state: send_osd drops unreachable
+            # targets, and a stray that misses this gets mopped up when
+            # its next notify reaches an active clean primary
+            shard = self._probe_shards.get(p, NO_SHARD)
+            self.osd.send_osd(p, MPGRemove(
+                self.pgid.with_shard(shard), epoch, self.osd.whoami))
+        self._strays = set()
 
     async def _recover_object_everywhere(self, oid: str) -> None:
         # snapshot: re-peering may mutate peer_missing across the awaits
@@ -463,6 +654,18 @@ class PG:
         fut = self._notify_waiters.get(m.from_osd)
         if fut is not None and not fut.done():
             fut.set_result(PGInfo.from_bytes(m.info_bytes))
+            return
+        if (self.state == STATE_ACTIVE and self.is_primary()
+                and m.from_osd not in self.acting
+                and m.from_osd not in self.up
+                and not self._backfilling
+                and not any(pm.items
+                            for pm in self.peer_missing.values())):
+            # unsolicited notify from a non-member while clean: a stray
+            # that missed its MPGRemove (down at clean time) — mop it up
+            from ceph_tpu.osd.messages import MPGRemove
+            self.osd.send_osd(m.from_osd, MPGRemove(
+                m.pgid, self.interval_epoch, self.osd.whoami))
 
     def on_log_request(self, m: MPGLogRequest) -> None:
         if m.want_list:

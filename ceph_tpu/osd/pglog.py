@@ -110,6 +110,41 @@ class PGInfo(Encodable):
                 f"sis={self.same_interval_since})")
 
 
+class PastInterval(Encodable):
+    """pg_interval_t (osd_types.h): one closed mapping interval, kept
+    from last_epoch_started forward so peering can walk every acting set
+    that might have accepted writes (PG::PriorSet)."""
+
+    __slots__ = ("first", "last", "up", "acting", "primary",
+                 "maybe_went_rw")
+
+    def __init__(self, first: int = 0, last: int = 0,
+                 up: Optional[List[int]] = None,
+                 acting: Optional[List[int]] = None,
+                 primary: int = -1, maybe_went_rw: bool = False):
+        self.first = first
+        self.last = last
+        self.up = up or []
+        self.acting = acting or []
+        self.primary = primary
+        self.maybe_went_rw = maybe_went_rw
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u32(self.first).u32(self.last)
+        enc.list_(self.up, lambda e, v: e.s32(v))
+        enc.list_(self.acting, lambda e, v: e.s32(v))
+        enc.s32(self.primary).boolean(self.maybe_went_rw)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "PastInterval":
+        return cls(dec.u32(), dec.u32(), dec.list_(lambda d: d.s32()),
+                   dec.list_(lambda d: d.s32()), dec.s32(), dec.boolean())
+
+    def __repr__(self):
+        return (f"interval({self.first}-{self.last} acting {self.acting}"
+                f"{' rw' if self.maybe_went_rw else ''})")
+
+
 class PGLog(Encodable):
     """Bounded in-order entry list (osd/PGLog.h)."""
 

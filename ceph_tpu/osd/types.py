@@ -205,23 +205,34 @@ class PGPool(Encodable):
 
 
 class OSDInfo(Encodable):
-    """osd_info_t: liveness epochs used by peering."""
+    """osd_info_t: liveness epochs used by peering.  v2 adds lost_at —
+    the epoch an operator declared the osd's data unrecoverable
+    (`osd lost`), which unblocks PriorSet waits (osd_types.h
+    osd_info_t::lost_at)."""
+
+    STRUCT_V = 2
 
     __slots__ = ("up_from", "up_thru", "down_at", "last_clean_begin",
-                 "last_clean_end")
+                 "last_clean_end", "lost_at")
 
     def __init__(self, up_from: int = 0, up_thru: int = 0, down_at: int = 0,
-                 last_clean_begin: int = 0, last_clean_end: int = 0):
+                 last_clean_begin: int = 0, last_clean_end: int = 0,
+                 lost_at: int = 0):
         self.up_from = up_from
         self.up_thru = up_thru
         self.down_at = down_at
         self.last_clean_begin = last_clean_begin
         self.last_clean_end = last_clean_end
+        self.lost_at = lost_at
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u32(self.up_from).u32(self.up_thru).u32(self.down_at)
         enc.u32(self.last_clean_begin).u32(self.last_clean_end)
+        enc.u32(self.lost_at)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "OSDInfo":
-        return cls(dec.u32(), dec.u32(), dec.u32(), dec.u32(), dec.u32())
+        o = cls(dec.u32(), dec.u32(), dec.u32(), dec.u32(), dec.u32())
+        if struct_v >= 2:
+            o.lost_at = dec.u32()
+        return o

@@ -80,8 +80,15 @@ def build_command(args, extra) -> dict:
                         prof[k.lstrip("-")] = v
                         extra.remove(kv)
                 cmd["profile"] = prof
-        elif words[1] in ("out", "in", "down") and len(words) > 2:
+        elif words[1] in ("out", "in", "down", "lost") and len(words) > 2:
+            confirmed = False
+            for bag in (extra, words):
+                if "--yes-i-really-mean-it" in bag:
+                    bag.remove("--yes-i-really-mean-it")
+                    confirmed = True
             cmd = {"prefix": f"osd {words[1]}", "id": int(words[2])}
+            if words[1] == "lost" and confirmed:
+                cmd["yes_i_really_mean_it"] = True
         elif words[1] == "getmap":
             cmd = {"prefix": "osd getmap"}
             if len(words) > 2:

@@ -65,8 +65,13 @@ class Cluster:
     async def start_osd(self, i: int, store=None):
         ctx = make_ctx(f"osd.{i}")
         msgr = Messenger(ctx, EntityName("osd", str(i)))
+        # a handed-in store is a RESTART with surviving data: never mkfs
+        # it (mkfs wipes), or restart-with-data scenarios silently test
+        # recovery-from-peers instead
+        fresh = store is None
         store = store or MemStore()
-        store.mkfs()
+        if fresh:
+            store.mkfs()
         osd = OSD(ctx, i, store, msgr, self.monmap)
         await osd.start()
         self.osds[i] = osd

@@ -1,0 +1,211 @@
+"""Past-intervals / PriorSet peering: the interval walk, blocked-on-down
+semantics, `osd lost`, and stray-copy rescue.
+
+Mirrors the reference's PG::generate_past_intervals / PriorSet logic
+(osd/PG.cc:3300 region) and its qa thrash invariants: a PG whose only
+possibly-written copies are down must NOT serve (it blocks) until an
+operator declares the osds lost; a stray copy holding the newest data
+must be found and adopted even when no current member has it.
+"""
+
+import asyncio
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from test_osd import Cluster  # noqa: E402
+
+from ceph_tpu.client import ObjectOperationError  # noqa: E402
+from ceph_tpu.osd.pglog import PastInterval  # noqa: E402
+
+
+def test_past_interval_roundtrip():
+    iv = PastInterval(5, 9, [1, 2], [2, 1], 2, True)
+    iv2 = PastInterval.from_bytes(iv.to_bytes())
+    assert iv2 == iv and iv2.maybe_went_rw
+
+
+def _pg_of(admin, pool, oid):
+    m = admin.monc.osdmap
+    from ceph_tpu.osd.types import ObjectLocator
+    pid = m.lookup_pool(pool)
+    raw = m.object_locator_to_pg(oid, ObjectLocator(pid))
+    pgid = m.pools[pid].raw_pg_to_pg(raw)   # masked: matches PG instances
+    up, _, acting, primary = m.pg_to_up_acting_osds(pgid)
+    return pgid, acting, primary
+
+
+def test_blocked_when_rw_interval_all_down_then_osd_lost():
+    """Kill BOTH holders of a 2-replica PG: the remapped PG must refuse
+    to serve (down+peering, PriorSet blocked) until `osd lost`."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(4)
+        await admin.pool_create("p", pg_num=8, size=2)
+        io = admin.open_ioctx("p")
+        # find an object and its acting pair
+        oid = None
+        for i in range(64):
+            cand = f"obj{i}"
+            _, acting, _ = _pg_of(admin, "p", cand)
+            if len(acting) == 2:
+                oid = cand
+                break
+        assert oid is not None
+        await io.write_full(oid, b"precious")
+        pgid, acting, _ = _pg_of(admin, "p", oid)
+        a, b = acting
+
+        # kill both holders and mark them out so crush remaps the pg to
+        # survivors with no data
+        await cl.kill_osd(a)
+        await cl.mark_down_and_wait(admin, a)
+        await cl.kill_osd(b)
+        await cl.mark_down_and_wait(admin, b)
+        for o in (a, b):
+            await admin.mon_command({"prefix": "osd out", "id": o})
+        deadline = asyncio.get_running_loop().time() + 15
+        while True:
+            _, new_acting, new_primary = _pg_of(admin, "p", oid)
+            if new_acting and not (set(new_acting) & {a, b}):
+                break
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.1)
+
+        # some survivor must now be primary and BLOCKED
+        _, new_acting, new_primary = _pg_of(admin, "p", oid)
+        assert new_primary not in (a, b) and new_primary >= 0
+        pg = None
+        deadline = asyncio.get_running_loop().time() + 10
+        while pg is None or not pg.peering_blocked_by:
+            for osd in cl.osds.values():
+                for p in osd.pgs.values():
+                    if p.pgid.without_shard() == pgid.without_shard() \
+                            and p.is_primary():
+                        pg = p
+            assert asyncio.get_running_loop().time() < deadline, \
+                "pg never blocked on the downed rw interval"
+            await asyncio.sleep(0.1)
+        assert set(pg.peering_blocked_by) <= {a, b}
+
+        # reads must NOT be served from the empty survivors
+        with pytest.raises(asyncio.TimeoutError):
+            await io.read(oid, timeout=2.0)
+
+        # operator declares the osds lost -> pg unblocks (data is gone,
+        # an honest ENOENT instead of a hang)
+        for o in (a, b):
+            await admin.mon_command({"prefix": "osd lost", "id": o,
+                                     "yes_i_really_mean_it": True})
+        deadline = asyncio.get_running_loop().time() + 15
+        while True:
+            try:
+                await io.read(oid, timeout=2.0)
+                break   # served (empty object would also be a serve)
+            except ObjectOperationError:
+                break   # -ENOENT: pg active, object honestly gone
+            except asyncio.TimeoutError:
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "pg stayed blocked after osd lost"
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_stray_copy_rescues_writes_after_full_remap():
+    """Move a PG entirely off its acting set (reweight both members to
+    0): the new members hold nothing, but peering must find the STRAY
+    copies via past intervals and adopt their data."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(4)
+        await admin.pool_create("p", pg_num=8, size=2)
+        io = admin.open_ioctx("p")
+        oid = None
+        for i in range(64):
+            cand = f"obj{i}"
+            _, acting, _ = _pg_of(admin, "p", cand)
+            if len(acting) == 2:
+                oid = cand
+                break
+        await io.write_full(oid, b"survives the remap")
+        pgid, acting, _ = _pg_of(admin, "p", oid)
+        a, b = acting
+
+        # push both members out (osds stay UP as strays)
+        for o in (a, b):
+            await admin.mon_command({"prefix": "osd out", "id": o})
+        deadline = asyncio.get_running_loop().time() + 15
+        while True:
+            _, new_acting, _ = _pg_of(admin, "p", oid)
+            if new_acting and not (set(new_acting) & {a, b}):
+                break
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.1)
+
+        # the data must be served by the NEW acting set (pulled from the
+        # strays during peering)
+        deadline = asyncio.get_running_loop().time() + 20
+        while True:
+            try:
+                got = await io.read(oid, timeout=3.0)
+                assert got == b"survives the remap"
+                break
+            except (asyncio.TimeoutError, ObjectOperationError):
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "data lost after full remap: stray never consulted"
+                await asyncio.sleep(0.2)
+
+        # once clean, the primary tells the strays to drop their copies
+        deadline = asyncio.get_running_loop().time() + 20
+        while True:
+            stray_live = [
+                1 for o in (a, b) if o in cl.osds
+                for p in cl.osds[o].pgs.values()
+                if p.pgid.without_shard() == pgid.without_shard()]
+            if not stray_live:
+                break
+            if asyncio.get_running_loop().time() > deadline:
+                break   # removal is best-effort cleanup; don't hard-fail
+            await asyncio.sleep(0.2)
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_restart_survivor_unblocks_without_lost():
+    """The good path: when one member of the rw interval comes BACK, the
+    pg unblocks by itself and serves the old data (no operator action)."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(4)
+        await admin.pool_create("p", pg_num=8, size=2)
+        io = admin.open_ioctx("p")
+        oid = None
+        for i in range(64):
+            cand = f"obj{i}"
+            _, acting, _ = _pg_of(admin, "p", cand)
+            if len(acting) == 2:
+                oid = cand
+                break
+        await io.write_full(oid, b"come back to me")
+        pgid, acting, _ = _pg_of(admin, "p", oid)
+        a, b = acting
+        store_a = await cl.kill_osd(a)
+        await cl.mark_down_and_wait(admin, a)
+        store_b = await cl.kill_osd(b)
+        await cl.mark_down_and_wait(admin, b)
+        await asyncio.sleep(1.5)
+        # restart one with its data: peering should find it and serve
+        await cl.start_osd(a, store=store_a)
+        deadline = asyncio.get_running_loop().time() + 25
+        while True:
+            try:
+                got = await io.read(oid, timeout=3.0)
+                assert got == b"come back to me"
+                break
+            except (asyncio.TimeoutError, ObjectOperationError):
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "pg never recovered after a member returned"
+                await asyncio.sleep(0.2)
+        await cl.stop()
+    asyncio.run(run())
