@@ -281,7 +281,8 @@ DEFAULT_OPTIONS: List[Option] = [
            "batch-collector fill window before a device launch"),
     Option("osd_ec_batch_min_bytes", "size", "64k",
            "lone requests below this take the host SIMD kernel"),
-    Option("objectstore", "str", "memstore", "backend: memstore|filestore"),
+    Option("objectstore", "str", "memstore",
+           "backend: memstore|filestore|blockstore"),
     Option("objectstore_path", "str", "", "data dir for filestore"),
     Option("filestore_journal_size", "size", "64m", "WAL size"),
     Option("filestore_kill_at", "int", 0,

@@ -400,7 +400,33 @@ class PG:
         # GetLog: adopt the best log (PG::choose_acting/GetLog).  A
         # half-backfilled copy claims its auth donor's last_update but is
         # missing objects — it must never outrank a complete copy
-        # (reference find_best_info excludes last_backfill < MAX peers)
+        # (reference find_best_info excludes last_backfill < MAX peers).
+        # But the converse trap is worse: a fresh EMPTY copy is
+        # "complete", and if it won while the only copies of newer writes
+        # are mid-backfill, activation would full-resync the cluster from
+        # nothing and delete real data (found by qa/rados_model under
+        # out/in+kill churn).  When the freshest last_update exists only
+        # on incomplete copies, the PG must wait — the reference's
+        # 'incomplete' state
+        candidates = dict(infos)
+        candidates[self.osd.whoami] = self.info
+        max_lu = max(pi.last_update for pi in candidates.values())
+        complete_max = max(
+            (pi.last_update for pi in candidates.values()
+             if pi.backfill_complete), default=None)
+        if complete_max is None or complete_max < max_lu:
+            holders = [o for o, pi in candidates.items()
+                       if pi.last_update == max_lu]
+            self.log_.warning(
+                f"{self.pgid} incomplete: newest data (lu {max_lu}) "
+                f"lives only on mid-backfill copies {holders}; waiting "
+                f"for a complete copy")
+            await asyncio.sleep(1.0)
+            if epoch == self.interval_epoch:
+                self._peering_task = \
+                    asyncio.get_running_loop().create_task(self._peer())
+            return
+
         def rank(pi: PGInfo):
             return (pi.backfill_complete, pi.last_update,
                     pi.last_epoch_started)

@@ -1,0 +1,6 @@
+"""QA harnesses: in-process cluster driver + stochastic model checker.
+
+Reference parity: the src/test strategy (SURVEY §4) — ceph-helpers-style
+cluster orchestration and the RadosModel randomized consistency checker
+(src/test/osd/RadosModel.h:104) that the rados suites run under thrashing.
+"""

@@ -1,0 +1,285 @@
+"""RadosModel: randomized op workload + in-memory model + thrashing.
+
+Reference parity: src/test/osd/RadosModel.h:104 (the expected-object
+model behind ceph_test_rados) combined with the thrashosds role from
+qa/tasks — random writes/deletes/reads race osd kills, restarts, out/in
+flaps and map churn, and every read is checked against the model.
+
+Ambiguity handling mirrors the reference's in-flight accounting: an op
+that neither acked nor errored definitively (timeout, interval-change
+EAGAIN) leaves the object in a set of acceptable values; any later read
+must observe one of them.  Objects with pending ambiguity are not
+written again (the abandoned op could land later and clobber a newer
+write — the reference serializes per-object ops the same way).
+
+Run standalone over many seeds:
+
+    python -m ceph_tpu.qa.rados_model --seeds 20 --rounds 80
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import sys
+import time
+from typing import Dict, List, Optional, Set
+
+from ceph_tpu.client import ObjectOperationError
+from ceph_tpu.qa.cluster import Cluster
+
+
+class ObjectModel:
+    """Expected state of one pool; None = object absent."""
+
+    def __init__(self):
+        self.acceptable: Dict[str, Set[Optional[bytes]]] = {}
+        self.dirty: Set[str] = set()    # oids with an abandoned op
+
+    def value(self, oid: str) -> Set[Optional[bytes]]:
+        return self.acceptable.get(oid, {None})
+
+    def committed(self, oid: str, val: Optional[bytes]) -> None:
+        self.acceptable[oid] = {val}
+        self.dirty.discard(oid)
+
+    def ambiguous(self, oid: str, val: Optional[bytes]) -> None:
+        self.acceptable[oid] = self.value(oid) | {val}
+        self.dirty.add(oid)
+
+    def check(self, oid: str, got: Optional[bytes]) -> bool:
+        return got in self.value(oid)
+
+
+class Thrasher:
+    """Random failure injector (thrashosds role): at most one osd is
+    gone at a time so a size-3/min_size-2 pool keeps making progress."""
+
+    def __init__(self, cl: Cluster, admin, rng: random.Random,
+                 log: List[str]):
+        self.cl = cl
+        self.admin = admin
+        self.rng = rng
+        self.log = log
+        self.stopped = False
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self.stopped = True
+        if self._task is not None:
+            await self._task
+
+    async def _heal(self) -> None:
+        """Bring every osd back up and in."""
+        for i, store in list(getattr(self, "_down", {}).items()):
+            await self.cl.start_osd(i, store=store)
+            self.log.append(f"heal: restarted osd.{i}")
+        self._down = {}
+        m = self.admin.monc.osdmap
+        for i in range(m.max_osd):
+            if m.exists(i) and m.is_out(i):
+                await self.admin.mon_command({"prefix": "osd in",
+                                              "id": i})
+                self.log.append(f"heal: osd.{i} back in")
+
+    async def _run(self) -> None:
+        self._down: Dict[int, object] = {}
+        try:
+            while not self.stopped:
+                await asyncio.sleep(self.rng.uniform(0.15, 0.5))
+                if self.stopped:
+                    break
+                action = self.rng.choice(
+                    ["kill", "restart", "out_in", "down"])
+                try:
+                    if action == "kill" and not self._down:
+                        victim = self.rng.choice(list(self.cl.osds))
+                        store = await self.cl.kill_osd(victim)
+                        self._down[victim] = store
+                        await self.cl.mark_down_and_wait(
+                            self.admin, victim)
+                        self.log.append(f"killed osd.{victim}")
+                    elif action == "restart" and self._down:
+                        victim, store = self._down.popitem()
+                        await self.cl.start_osd(victim, store=store)
+                        self.log.append(f"restarted osd.{victim}")
+                    elif action == "out_in":
+                        m = self.admin.monc.osdmap
+                        live = [i for i in self.cl.osds
+                                if m.is_in(i) and m.is_up(i)]
+                        if len(live) > 3:
+                            victim = self.rng.choice(live)
+                            await self.admin.mon_command(
+                                {"prefix": "osd out", "id": victim})
+                            self.log.append(f"out osd.{victim}")
+                            await asyncio.sleep(
+                                self.rng.uniform(0.5, 1.5))
+                            await self.admin.mon_command(
+                                {"prefix": "osd in", "id": victim})
+                            self.log.append(f"in osd.{victim}")
+                    elif action == "down":
+                        # false alarm: daemon alive, map says down; it
+                        # must re-assert itself
+                        live = [i for i in self.cl.osds]
+                        victim = self.rng.choice(live)
+                        await self.admin.mon_command(
+                            {"prefix": "osd down", "id": victim})
+                        self.log.append(f"false-down osd.{victim}")
+                except Exception as e:            # pragma: no cover
+                    self.log.append(f"thrash {action} failed: {e!r}")
+        finally:
+            await self._heal()
+
+
+async def run_model(seed: int, rounds: int = 80, n_osds: int = 5,
+                    pool_kw: Optional[dict] = None,
+                    n_oids: int = 24,
+                    verbose: bool = False) -> dict:
+    """One seeded run: returns a result dict (ok, ops, ambiguities...)."""
+    rng = random.Random(seed)
+    events: List[str] = []
+    cl = Cluster()
+    admin = await cl.start(n_osds)
+    await admin.pool_create("model", pg_num=8,
+                            **(pool_kw or {"size": 3}))
+    io = admin.open_ioctx("model")
+    model = ObjectModel()
+    history: Dict[str, List[str]] = {}
+    oids = [f"m{i}" for i in range(n_oids)]
+    thrasher = Thrasher(cl, admin, rng, events)
+    thrasher.start()
+    stats = {"writes": 0, "deletes": 0, "reads": 0, "ambiguous": 0,
+             "read_checks": 0}
+    failures: List[str] = []
+    try:
+        for r in range(rounds):
+            await asyncio.sleep(rng.uniform(0.0, 0.06))
+            oid = rng.choice(oids)
+            op = rng.choice(["write", "write", "write", "read", "read",
+                             "delete"])
+            if op in ("write", "delete") and oid in model.dirty:
+                op = "read"   # never overwrite an ambiguous object
+            try:
+                if op == "write":
+                    val = bytes([rng.randrange(256)]) * \
+                        rng.randrange(1, 4096)
+                    await io.write_full(oid, val)
+                    model.committed(oid, val)
+                    history.setdefault(oid, []).append(
+                        f"r{r}: wrote {val[:1]!r}x{len(val)}")
+                    stats["writes"] += 1
+                elif op == "delete":
+                    history.setdefault(oid, []).append(f"r{r}: delete")
+                    try:
+                        await io.remove(oid)
+                        model.committed(oid, None)
+                    except ObjectOperationError:
+                        # ENOENT — fine iff absence is acceptable
+                        if not model.check(oid, None):
+                            failures.append(
+                                f"round {r}: remove {oid} says ENOENT "
+                                f"but model has it")
+                        else:
+                            model.committed(oid, None)
+                    stats["deletes"] += 1
+                else:
+                    try:
+                        got = await io.read(oid, timeout=10.0)
+                    except ObjectOperationError:
+                        got = None
+                    stats["reads"] += 1
+                    stats["read_checks"] += 1
+                    if not model.check(oid, got):
+                        failures.append(
+                            f"round {r}: read {oid} = "
+                            f"{got if got is None else got[:16]!r}"
+                            f"... not in model "
+                            f"({[v if v is None else v[:16] for v in model.value(oid)]})")
+            except (asyncio.TimeoutError, ObjectOperationError) as e:
+                # outcome unknown: both old and new values acceptable
+                if op == "write":
+                    model.ambiguous(oid, val)
+                elif op == "delete":
+                    model.ambiguous(oid, None)
+                stats["ambiguous"] += 1
+                events.append(f"round {r}: {op} {oid} ambiguous ({e!r})")
+    finally:
+        await thrasher.stop()
+
+    # settle: all osds healed; wait for every pg clean, then final verify
+    await _wait_clean(cl, admin, events)
+    for oid in oids:
+        try:
+            got = await io.read(oid, timeout=15.0)
+        except ObjectOperationError:
+            got = None
+        except asyncio.TimeoutError:
+            failures.append(f"final read {oid} timed out")
+            continue
+        stats["read_checks"] += 1
+        if not model.check(oid, got):
+            failures.append(
+                f"final: {oid} = {got if got is None else got[:16]!r} "
+                f"not acceptable")
+    await cl.stop()
+    result = {"seed": seed, "ok": not failures, "failures": failures,
+              **stats, "events": len(events)}
+    if verbose or failures:
+        for e in events:
+            print("  ", e, file=sys.stderr)
+        for f in failures:
+            bad_oid = f.split()[1]
+            for h in history.get(bad_oid, []):
+                print(f"   {bad_oid}: {h}", file=sys.stderr)
+    return result
+
+
+async def _wait_clean(cl: Cluster, admin, events: List[str],
+                      timeout: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        dirty = 0
+        for osd in cl.osds.values():
+            for pg in osd.pgs.values():
+                if not pg.is_primary():
+                    continue
+                if pg.state != "active" or pg._backfilling or \
+                        any(pm.items for pm in pg.peer_missing.values()):
+                    dirty += 1
+        if dirty == 0:
+            return
+        await asyncio.sleep(0.3)
+    events.append(f"wait_clean timed out with {dirty} dirty pgs")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="rados_model")
+    ap.add_argument("--seeds", type=int, default=5,
+                    help="number of seeds (seed, seed+1, ...)")
+    ap.add_argument("--seed", type=int, default=1, help="first seed")
+    ap.add_argument("--rounds", type=int, default=80)
+    ap.add_argument("--osds", type=int, default=5)
+    ap.add_argument("--ec", action="store_true",
+                    help="run against an EC (k=2,m=2) pool")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    pool_kw = ({"pool_type": "erasure", "k": 2, "m": 2}
+               if args.ec else {"size": 3})
+    bad = 0
+    for s in range(args.seed, args.seed + args.seeds):
+        res = asyncio.run(run_model(s, rounds=args.rounds,
+                                    n_osds=args.osds, pool_kw=pool_kw,
+                                    verbose=args.verbose))
+        print(json.dumps(res))
+        if not res["ok"]:
+            bad += 1
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

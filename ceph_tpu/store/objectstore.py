@@ -220,6 +220,9 @@ class ObjectStore:
             return MemStore(path)
         if kind == "filestore":
             return FileStore(path)
+        if kind == "blockstore":
+            from ceph_tpu.store.blockstore import BlockStore
+            return BlockStore(path)
         raise ValueError(f"unknown objectstore kind {kind!r}")
 
     # lifecycle

@@ -52,13 +52,18 @@ async def run_mon(args) -> None:
 
 async def run_osd(args) -> None:
     from ceph_tpu.osd.daemon import OSD
-    from ceph_tpu.store.filestore import FileStore
+    from ceph_tpu.store.objectstore import ObjectStore
     ctx = Context(f"osd.{args.id}")
     apply_conf(ctx, args.dir)
     monmap = load_monmap(args.dir)
     path = os.path.join(args.dir, f"osd.{args.id}")
-    store = FileStore(path)
-    if not os.path.exists(os.path.join(path, "fsid")):
+    kind = ctx.config["objectstore"]
+    if kind == "memstore":        # memstore can't back a daemon restart
+        kind = "filestore"
+    store = ObjectStore.create(kind, path)
+    fresh_marker = os.path.join(
+        path, "fsid" if kind == "filestore" else "block")
+    if not os.path.exists(fresh_marker):
         store.mkfs()
     msgr = Messenger(ctx, EntityName("osd", args.id))
     osd = OSD(ctx, int(args.id), store, msgr, monmap)

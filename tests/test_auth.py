@@ -93,7 +93,8 @@ class SecureCluster(Cluster):
     """In-process cluster with auth_supported=cephx and a shared keyring."""
 
     def __init__(self, tmpdir: str):
-        super().__init__()
+        super().__init__(
+            ctx_factory=lambda name: self._secure(make_ctx(name)))
         self.keyring_path = os.path.join(tmpdir, "keyring")
         kr = Keyring()
         kr.add("mon.")
@@ -111,17 +112,9 @@ class SecureCluster(Cluster):
         return ctx
 
 
-def _patch_ctx(cl: SecureCluster, monkeypatch):
-    import test_osd
-    orig = test_osd.make_ctx
-    monkeypatch.setattr(test_osd, "make_ctx",
-                        lambda name: cl._secure(orig(name)))
-
-
-def test_secured_cluster_end_to_end(tmp_path, monkeypatch):
+def test_secured_cluster_end_to_end(tmp_path):
     async def run():
         cl = SecureCluster(str(tmp_path))
-        _patch_ctx(cl, monkeypatch)
         admin = await cl.start(3)
         await admin.pool_create("p", pg_num=8)
         io = admin.open_ioctx("p")
@@ -162,12 +155,11 @@ def test_secured_cluster_end_to_end(tmp_path, monkeypatch):
     asyncio.run(run())
 
 
-def test_unauthenticated_client_rejected(tmp_path, monkeypatch):
+def test_unauthenticated_client_rejected(tmp_path):
     """A client that skips the cephx handshake gets nothing: the mon
     denies its commands and the OSD refuses its data-path sockets."""
     async def run():
         cl = SecureCluster(str(tmp_path))
-        _patch_ctx(cl, monkeypatch)
         admin = await cl.start(3)
         await admin.pool_create("p", pg_num=8)
         io = admin.open_ioctx("p")
@@ -204,12 +196,11 @@ def test_unauthenticated_client_rejected(tmp_path, monkeypatch):
     asyncio.run(run())
 
 
-def test_caps_enforced_and_tickets_renew(tmp_path, monkeypatch):
+def test_caps_enforced_and_tickets_renew(tmp_path):
     """MonCap checks: a read-only entity can look but not touch; and the
     client renews tickets before expiry (CephXTicketHandler renew role)."""
     async def run():
         cl = SecureCluster(str(tmp_path))
-        _patch_ctx(cl, monkeypatch)
         admin = await cl.start(3)
 
         ro_ctx = cl._secure(make_ctx("client.readonly"))

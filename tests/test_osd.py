@@ -19,93 +19,7 @@ from ceph_tpu.osd import OSD
 from ceph_tpu.store.kv import MemDB
 from ceph_tpu.store.memstore import MemStore
 
-FAST_CFG = {
-    "mon_election_timeout": 0.3,
-    "mon_lease": 1.0,
-    "mon_tick_interval": 0.5,
-    "ms_initial_backoff": 0.02,
-    "osd_heartbeat_interval": 0.3,
-    "osd_heartbeat_grace": 1.5,
-    "mon_osd_down_out_interval": 3.0,
-}
-
-
-def make_ctx(name):
-    ctx = Context(name)
-    for k, v in FAST_CFG.items():
-        ctx.config.set(k, v)
-    return ctx
-
-
-class Cluster:
-    def __init__(self):
-        self.monmap = MonMap()
-        self.mons = []
-        self.osds = {}
-        self.clients = []
-
-    async def start(self, n_osds: int, osds_per_host: int = 1):
-        self.monmap.fsid = "e2e-fsid"
-        ctx = make_ctx("mon.a")
-        msgr = Messenger(ctx, EntityName("mon", "a"))
-        self.monmap.add("a", await msgr.bind())
-        mon = Monitor(ctx, "a", self.monmap, MemDB(), msgr)
-        await mon.start()
-        self.mons.append(mon)
-        admin = await self.client()
-        await admin.mon_command({"prefix": "osd crush build-simple",
-                                 "num_osds": n_osds,
-                                 "osds_per_host": osds_per_host})
-        for i in range(n_osds):
-            await self.start_osd(i)
-        for osd in self.osds.values():
-            await osd.wait_for_boot()
-        return admin
-
-    async def start_osd(self, i: int, store=None):
-        ctx = make_ctx(f"osd.{i}")
-        msgr = Messenger(ctx, EntityName("osd", str(i)))
-        # a handed-in store is a RESTART with surviving data: never mkfs
-        # it (mkfs wipes), or restart-with-data scenarios silently test
-        # recovery-from-peers instead
-        fresh = store is None
-        store = store or MemStore()
-        if fresh:
-            store.mkfs()
-        osd = OSD(ctx, i, store, msgr, self.monmap)
-        await osd.start()
-        self.osds[i] = osd
-        return osd
-
-    async def kill_osd(self, i: int):
-        osd = self.osds.pop(i)
-        await osd.shutdown()
-        return osd.store
-
-    async def client(self, name="client.admin") -> Rados:
-        r = Rados(make_ctx(name), self.monmap)
-        await r.connect()
-        self.clients.append(r)
-        return r
-
-    async def mark_down_and_wait(self, admin: Rados, osd_id: int):
-        await admin.mon_command({"prefix": "osd down", "id": osd_id})
-        while admin.monc.osdmap.is_up(osd_id):
-            await asyncio.sleep(0.05)
-
-    async def wait_epoch(self, admin: Rados, epoch: int, timeout=15.0):
-        deadline = asyncio.get_event_loop().time() + timeout
-        while admin.monc.osdmap.epoch < epoch:
-            assert asyncio.get_event_loop().time() < deadline
-            await asyncio.sleep(0.05)
-
-    async def stop(self):
-        for c in self.clients:
-            await c.shutdown()
-        for o in list(self.osds.values()):
-            await o.shutdown()
-        for m in self.mons:
-            await m.shutdown()
+from ceph_tpu.qa.cluster import FAST_CFG, Cluster, make_ctx  # noqa: F401,E402
 
 
 def test_replicated_put_get_cycle():
