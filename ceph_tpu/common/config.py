@@ -283,6 +283,10 @@ DEFAULT_OPTIONS: List[Option] = [
            "lone requests below this take the host SIMD kernel"),
     Option("objectstore", "str", "memstore",
            "backend: memstore|filestore|blockstore"),
+    Option("blockstore_compression", "str", "",
+           "blob compressor: zlib|bz2|lzma|'' (bluestore_compression_*)"),
+    Option("blockstore_compression_min_blob", "size", "4k",
+           "smallest blob worth compressing"),
     Option("objectstore_path", "str", "", "data dir for filestore"),
     Option("filestore_journal_size", "size", "64m", "WAL size"),
     Option("filestore_kill_at", "int", 0,

@@ -1,0 +1,5 @@
+from ceph_tpu.compressor.registry import (Compressor, CompressorError,
+                                          cached, create, plugin_names)
+
+__all__ = ["Compressor", "CompressorError", "cached", "create",
+           "plugin_names"]

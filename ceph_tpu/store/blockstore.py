@@ -54,27 +54,40 @@ _PREFIX_OMAP = "M"        # cid + 0x00 + oidkey + 0x00 + key -> value
 
 class Extent(Encodable):
     """One contiguous logical->disk mapping (bluestore_pextent_t +
-    csum)."""
+    csum).  v2 adds blob compression (bluestore_blob_t compressed
+    flag): `length` is always the LOGICAL byte count, `disk_len` the
+    stored bytes, `alg` the compressor that produced them ("" = raw);
+    crc covers the stored bytes."""
 
-    __slots__ = ("logical", "disk", "length", "crc")
+    STRUCT_V = 2
+
+    __slots__ = ("logical", "disk", "length", "crc", "disk_len", "alg")
 
     def __init__(self, logical: int = 0, disk: int = 0, length: int = 0,
-                 crc: int = 0):
+                 crc: int = 0, disk_len: int = -1, alg: str = ""):
         self.logical = logical
         self.disk = disk
         self.length = length
         self.crc = crc
+        self.disk_len = disk_len if disk_len >= 0 else length
+        self.alg = alg
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u64(self.logical).u64(self.disk).u32(self.length)
         enc.u32(self.crc)
+        enc.u32(self.disk_len).string(self.alg)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "Extent":
-        return cls(dec.u64(), dec.u64(), dec.u32(), dec.u32())
+        e = cls(dec.u64(), dec.u64(), dec.u32(), dec.u32())
+        if struct_v >= 2:
+            e.disk_len = dec.u32()
+            e.alg = dec.string()
+        return e
 
     def __repr__(self):
-        return f"ext({self.logical}+{self.length}@{self.disk:#x})"
+        z = f"~{self.alg}" if self.alg else ""
+        return f"ext({self.logical}+{self.length}@{self.disk:#x}{z})"
 
 
 class Onode(Encodable):
@@ -188,13 +201,24 @@ def _omap_key(cid: CollectionId, oid: ObjectId, key: bytes) -> bytes:
 
 
 class BlockStore(ObjectStore):
-    def __init__(self, path: str):
+    def __init__(self, path: str, compression: str = "",
+                 compression_min_blob: int = 4096):
         super().__init__(path)
         self.db: Optional[FileDB] = None
         self._fd = -1
         self.alloc = Allocator()
         self._onodes: Dict[bytes, Onode] = {}    # write-through cache
         self.mounted = False
+        self._comp = None
+        self.set_compression(compression, compression_min_blob)
+
+    def set_compression(self, algorithm: str,
+                        min_blob: int = 4096) -> None:
+        """Enable blob compression for future writes (per-extent alg tag
+        means mixed/compressed data coexists and stays readable)."""
+        from ceph_tpu.compressor import create
+        self._comp = create(algorithm) if algorithm else None
+        self.compression_min_blob = min_blob
 
     # ------------------------------------------------------------ lifecycle
     def _block_path(self) -> str:
@@ -225,8 +249,8 @@ class BlockStore(ObjectStore):
         for k in self.db.keys(_PREFIX_ONODE):
             on = Onode.from_bytes(self.db.get(_PREFIX_ONODE, k))
             for ext in on.extents:
-                alen = _align_up(ext.length)
-                self.alloc.init_rm_free(ext.disk, alen)
+                self.alloc.init_rm_free(ext.disk,
+                                        _align_up(ext.disk_len))
         self._onodes = {}
         self.mounted = True
 
@@ -412,7 +436,7 @@ class BlockStore(ObjectStore):
             try:
                 old = self._get_onode(c, op.oid2)
                 for ext in old.extents:
-                    freed.append((ext.disk, _align_up(ext.length)))
+                    freed.append((ext.disk, _align_up(ext.disk_len)))
                 pre_old = _omap_key(c, op.oid2, b"")
                 for k in self._kv_keys(_PREFIX_OMAP, pre_old):
                     self._stage(_PREFIX_OMAP, k, None)
@@ -462,7 +486,7 @@ class BlockStore(ObjectStore):
                 old = self._get_onode(newcid, op.oid2)
                 if old is not src:
                     for ext in old.extents:
-                        freed.append((ext.disk, _align_up(ext.length)))
+                        freed.append((ext.disk, _align_up(ext.disk_len)))
                     for k in self._kv_keys(_PREFIX_OMAP,
                                            _omap_key(newcid, op.oid2,
                                                      b"")):
@@ -521,7 +545,7 @@ class BlockStore(ObjectStore):
         except NoSuchObject:
             return
         for ext in on.extents:
-            freed.append((ext.disk, _align_up(ext.length)))
+            freed.append((ext.disk, _align_up(ext.disk_len)))
         pre = _omap_key(cid, oid, b"")
         for k in self._kv_keys(_PREFIX_OMAP, pre):
             self._stage(_PREFIX_OMAP, k, None)
@@ -552,26 +576,10 @@ class BlockStore(ObjectStore):
         for ext in drop:
             span[ext.logical - lo:ext.logical - lo + ext.length] = \
                 self._pread_checked(ext)
-            freed.append((ext.disk, _align_up(ext.length)))
+            freed.append((ext.disk, _align_up(ext.disk_len)))
         span[off - lo:end - lo] = data
-        # allocate fresh space and write the merged span
-        new_exts = []
-        pos = 0
-        for d_off, d_len in self.alloc.allocate(_align_up(len(span))):
-            take = min(d_len, len(span) - pos)
-            if take <= 0:
-                self.alloc.release(d_off, d_len)
-                continue
-            chunk = bytes(span[pos:pos + take])
-            os.pwrite(self._fd, chunk, d_off)
-            self._wrote_data = True
-            new_exts.append(Extent(lo + pos, d_off, take,
-                                   crc32c(chunk)))
-            if take < d_len:
-                self.alloc.release(d_off + _align_up(take),
-                                   d_len - _align_up(take))
-            pos += take
-        on.extents = sorted(keep + new_exts, key=lambda e: e.logical)
+        on.extents = sorted(keep + self._rewrite(lo, bytes(span)),
+                            key=lambda e: e.logical)
         on.size = max(on.size, end)
 
     def _punch(self, on: Onode, off: int, length: int,
@@ -586,7 +594,7 @@ class BlockStore(ObjectStore):
                 out.append(ext)
                 continue
             data = self._pread_checked(ext)
-            freed.append((ext.disk, _align_up(ext.length)))
+            freed.append((ext.disk, _align_up(ext.disk_len)))
             if ext.logical < off:
                 head = data[:off - ext.logical]
                 out.extend(self._rewrite(ext.logical, head))
@@ -604,22 +612,49 @@ class BlockStore(ObjectStore):
                 self.alloc.release(d_off, d_len)
                 continue
             chunk = data[pos:pos + take]
-            os.pwrite(self._fd, chunk, d_off)
-            self._wrote_data = True
-            exts.append(Extent(logical + pos, d_off, take, crc32c(chunk)))
-            if take < d_len:
-                self.alloc.release(d_off + _align_up(take),
-                                   d_len - _align_up(take))
+            exts.append(self._store_piece(logical + pos, chunk, d_off,
+                                          d_len))
             pos += take
         return exts
 
+    def _store_piece(self, logical: int, chunk: bytes, d_off: int,
+                     d_len: int) -> Extent:
+        """Write one contiguous piece, compressing when it pays
+        (bluestore_compression_required_ratio role: stored bytes must
+        save at least one alloc unit)."""
+        stored, alg = chunk, ""
+        if (self._comp is not None
+                and len(chunk) >= self.compression_min_blob):
+            cand = self._comp.compress(chunk)
+            if _align_up(len(cand)) < _align_up(len(chunk)):
+                stored, alg = cand, self._comp.name
+        os.pwrite(self._fd, stored, d_off)
+        self._wrote_data = True
+        used = _align_up(len(stored))
+        if used < d_len:
+            self.alloc.release(d_off + used, d_len - used)
+        return Extent(logical, d_off, len(chunk), crc32c(stored),
+                      len(stored), alg)
+
     # --------------------------------------------------------------- reads
     def _pread_checked(self, ext: Extent) -> bytes:
-        data = os.pread(self._fd, ext.length, ext.disk)
-        if len(data) != ext.length or crc32c(data) != ext.crc:
+        data = os.pread(self._fd, ext.disk_len, ext.disk)
+        if len(data) != ext.disk_len or crc32c(data) != ext.crc:
             raise StoreError(
                 f"blockstore: csum mismatch at {ext!r} "
                 f"(stored {ext.crc:#x}, got {crc32c(data):#x})")
+        if ext.alg:
+            from ceph_tpu.compressor import CompressorError, cached
+            try:
+                data = cached(ext.alg).decompress(data)
+            except CompressorError as e:
+                # integrity failures must surface uniformly (scrub deep
+                # pass catches StoreError as a shard error)
+                raise StoreError(f"blockstore: {ext!r}: {e}")
+            if len(data) != ext.length:
+                raise StoreError(
+                    f"blockstore: decompressed length mismatch at "
+                    f"{ext!r}")
         return data
 
     def _read_onode(self, on: Onode, off: int, length: int) -> bytes:

@@ -61,6 +61,10 @@ async def run_osd(args) -> None:
     if kind == "memstore":        # memstore can't back a daemon restart
         kind = "filestore"
     store = ObjectStore.create(kind, path)
+    if kind == "blockstore" and ctx.config["blockstore_compression"]:
+        store.set_compression(
+            ctx.config["blockstore_compression"],
+            ctx.config["blockstore_compression_min_blob"])
     fresh_marker = os.path.join(
         path, "fsid" if kind == "filestore" else "block")
     if not os.path.exists(fresh_marker):
