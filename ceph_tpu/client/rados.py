@@ -21,7 +21,7 @@ from ceph_tpu.osd.messages import (
     OSDOp, OP_ASSERT_EXISTS, OP_CMPXATTR, OP_CREATE, OP_DELETE,
     OP_GETXATTR, OP_LIST_SNAPS, OP_NOTIFY, OP_OMAP_GET_VALS,
     OP_OMAP_RM_KEYS, OP_OMAP_SET, OP_PGLS, OP_READ, OP_ROLLBACK,
-    OP_SETXATTR, OP_STAT, OP_WATCH, OP_WRITE, OP_WRITEFULL,
+    OP_SETXATTR, OP_STAT, OP_TRUNCATE, OP_WATCH, OP_WRITE, OP_WRITEFULL,
 )
 from ceph_tpu.osd.types import ObjectLocator, PGId
 
@@ -254,6 +254,9 @@ class IoCtx:
     async def write(self, oid: str, data: bytes, offset: int = 0) -> None:
         await self._op(oid, [OSDOp(OP_WRITE, offset=offset,
                                    length=len(data), data=data)])
+
+    async def truncate(self, oid: str, size: int) -> None:
+        await self._op(oid, [OSDOp(OP_TRUNCATE, offset=size)])
 
     async def read(self, oid: str, length: int = 0,
                    offset: int = 0, timeout: float = 30.0) -> bytes:

@@ -126,6 +126,7 @@ class Image:
         # (Single-client protection — the exclusive-lock feature's role
         # for multi-client is not implemented.)
         self._obj_locks: Dict[str, asyncio.Lock] = {}
+        self._cacher = None      # ObjectCacher when opened cached=True
 
     def _obj_lock(self, oid: str) -> asyncio.Lock:
         lock = self._obj_locks.get(oid)
@@ -134,7 +135,13 @@ class Image:
         return lock
 
     @classmethod
-    async def open(cls, ioctx, name: str) -> "Image":
+    async def open(cls, ioctx, name: str, cached: bool = False,
+                   cache_max_dirty: int = 8 << 20,
+                   cache_max_bytes: int = 32 << 20) -> "Image":
+        """cached=True puts an ObjectCacher (write-back) between the
+        image and its data objects — librbd's rbd_cache=true
+        (librbd/ImageCtx.cc object_cacher init).  Call close() to flush
+        before dropping the handle."""
         img_id = name
         hdr = _header_oid(img_id)
 
@@ -147,7 +154,35 @@ class Image:
                             await attr("stripe_count"), 1 << order)
         except Exception:
             raise ImageNotFound(name)
-        return cls(ioctx, name, img_id, size, order, layout)
+        img = cls(ioctx, name, img_id, size, order, layout)
+        if cached:
+            from ceph_tpu.client.object_cacher import ObjectCacher
+            img._cacher = ObjectCacher(
+                img._backend_read, img._backend_write,
+                max_dirty=cache_max_dirty, max_bytes=cache_max_bytes)
+            img._cacher.start()
+        return img
+
+    # cacher backend: oid-granular IO with sparse/EC handling
+    async def _backend_read(self, oid: str, off: int,
+                            length: int) -> bytes:
+        import errno as _errno
+        from ceph_tpu.client.objecter import ObjectOperationError
+        try:
+            return await self.io.read(oid, length=length, offset=off)
+        except ObjectOperationError as e:
+            if e.retcode == -_errno.ENOENT:
+                return b""      # absent object: genuine hole
+            raise               # transient errors must NOT cache as zeros
+
+    async def _backend_write(self, oid: str, off: int,
+                             data: bytes) -> None:
+        if self._ec_pool:
+            from ceph_tpu.services.striper import Extent as _E
+            await self._rmw_object(oid, [_E(0, off, len(data), off)],
+                                   data, off)
+        else:
+            await self.io.write(oid, data, offset=off)
 
     def stat(self) -> Dict:
         return {"size": self.size, "order": self.order,
@@ -173,10 +208,14 @@ class Image:
             oid = _data_oid(self.id, object_no)
             lo = min(e.offset for e in extents)
             hi = max(e.offset + e.length for e in extents)
-            try:
-                data = await self.io.read(oid, length=hi - lo, offset=lo)
-            except Exception:
-                return                    # sparse object: zeros
+            if self._cacher is not None:
+                data = await self._cacher.read(oid, lo, hi - lo)
+            else:
+                try:
+                    data = await self.io.read(oid, length=hi - lo,
+                                              offset=lo)
+                except Exception:
+                    return                # sparse object: zeros
             for e in extents:
                 piece = data[e.offset - lo:e.offset - lo + e.length]
                 buf[e.logical - offset:
@@ -195,6 +234,13 @@ class Image:
 
         async def write_obj(object_no, extents):
             oid = _data_oid(self.id, object_no)
+            if self._cacher is not None:
+                for e in extents:
+                    await self._cacher.write(
+                        oid, e.offset,
+                        data[e.logical - offset:
+                             e.logical - offset + e.length])
+                return
             if self._ec_pool:
                 await self._rmw_object(oid, extents, data, offset)
                 return
@@ -226,7 +272,15 @@ class Image:
                          e.logical - offset + e.length]
             await self.io.write_full(oid, bytes(cur))
 
+    async def _cache_barrier(self) -> None:
+        """Out-of-band mutations (discard/resize) go straight to the
+        backend: the cache must be drained and dropped first or it will
+        serve stale reads and resurrect deleted objects."""
+        if self._cacher is not None:
+            await self._cacher.invalidate_all()
+
     async def discard(self, offset: int, length: int) -> None:
+        await self._cache_barrier()
         """Zero a range: remove objects the range fully covers (sparse
         reads return zeros for free), RMW-zero the partial edges."""
         length = min(length, self.size - offset)
@@ -300,4 +354,12 @@ class Image:
                                str(new_size).encode())
 
     async def flush(self) -> None:
-        return None                       # writes are synchronous acks
+        """Uncached writes are synchronous acks; with the ObjectCacher
+        this drains every dirty buffer (librbd::flush)."""
+        if self._cacher is not None:
+            await self._cacher.flush_all()
+
+    async def close(self) -> None:
+        if self._cacher is not None:
+            await self._cacher.stop()     # flushes
+            self._cacher = None

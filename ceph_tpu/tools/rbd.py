@@ -129,10 +129,14 @@ async def run(args) -> int:
                     f.write(await img.read(off, min(step,
                                                     img.size - off)))
         elif args.op == "bench":
-            img = await Image.open(io, args.args[0])
-            out = await bench(img, parse_size(args.io_size),
-                              parse_size(args.io_total),
-                              args.io_pattern, args.workload)
+            img = await Image.open(io, args.args[0], cached=args.cached)
+            try:
+                out = await bench(img, parse_size(args.io_size),
+                                  parse_size(args.io_total),
+                                  args.io_pattern, args.workload)
+            finally:
+                await img.close()    # drain the write-back cache
+            out["cached"] = args.cached
             print(json.dumps(out))
         else:
             print(f"unknown op {args.op}", file=sys.stderr)
@@ -157,6 +161,8 @@ def main(argv=None) -> int:
     ap.add_argument("--io-total", default="4M")
     ap.add_argument("--io-pattern", choices=("seq", "rand"),
                     default="seq")
+    ap.add_argument("--cached", action="store_true",
+                    help="use the client ObjectCacher (rbd_cache=true)")
     ap.add_argument("--workload", choices=("write", "read"),
                     default="write")
     ap.add_argument("op",
