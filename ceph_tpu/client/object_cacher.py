@@ -188,34 +188,56 @@ class ObjectCacher:
         """Write out dirty buffers (oldest first); returns bytes
         flushed."""
         now = time.monotonic()
-        work: List[Tuple[str, _Buffer]] = []
+        # group per object and coalesce ADJACENT dirty buffers into one
+        # backend write — on EC pools each write is a whole-object RMW,
+        # so 64 small buffers must not cost 64 RMWs
+        work: List[Tuple[str, List[_Buffer]]] = []
         async with self._lock:
             for oid, bufs in self._objects.items():
                 if only_oid is not None and oid != only_oid:
                     continue
-                for b in bufs:
+                run: List[_Buffer] = []
+                for b in sorted(bufs, key=lambda b: b.off):
                     if b.state == DIRTY and now - b.stamp >= min_age:
                         b.state = TX
                         self._inflight += 1
-                        work.append((oid, b))
+                        if run and run[-1].end == b.off:
+                            run.append(b)
+                        else:
+                            if run:
+                                work.append((oid, run))
+                            run = [b]
+                    elif run:
+                        work.append((oid, run))
+                        run = []
+                if run:
+                    work.append((oid, run))
         flushed = 0
-        for oid, b in sorted(work, key=lambda w: w[1].stamp):
+        pending = list(work)
+        while pending:
+            oid, run = pending.pop(0)
+            data = b"".join(b.data for b in run)
             try:
-                await self._write_backend(oid, b.off, b.data)
+                await self._write_backend(oid, run[0].off, data)
             except BaseException:
-                # includes CancelledError: the bytes may not have landed
+                # includes CancelledError: these bytes may not have
+                # landed — and buffers queued BEHIND the failure must
+                # not strand in TX either
                 async with self._lock:
-                    if b.state == TX:
-                        b.state = DIRTY     # retry on next pass
-                    self._inflight -= 1
+                    for _, r in [(oid, run)] + pending:
+                        for b in r:
+                            if b.state == TX:
+                                b.state = DIRTY   # retry on next pass
+                            self._inflight -= 1
                     self._tx_done.set()
                 raise
-            flushed += len(b.data)
+            flushed += len(data)
             async with self._lock:
-                if b.state == TX:   # not overwritten meanwhile
-                    b.state = CLEAN
-                    self._dirty_bytes -= len(b.data)
-                self._inflight -= 1
+                for b in run:
+                    if b.state == TX:   # not overwritten meanwhile
+                        b.state = CLEAN
+                        self._dirty_bytes -= len(b.data)
+                    self._inflight -= 1
                 self._tx_done.set()
             self.stats["flushes"] += 1
         return flushed
@@ -248,25 +270,22 @@ class ObjectCacher:
 
     # ------------------------------------------------------------ trimming
     def _trim(self) -> None:
-        """Evict CLEAN buffers LRU until under max_bytes."""
-        while self._total_bytes > self.max_bytes:
-            evicted = False
-            for oid in list(self._objects):
-                bufs = self._objects[oid]
-                keep = []
-                for b in bufs:
-                    if (b.state == CLEAN and not evicted
-                            and self._total_bytes > self.max_bytes):
-                        self._account(b, remove=True)
-                        self.stats["evictions"] += 1
-                        evicted = True
-                    else:
-                        keep.append(b)
-                if keep:
-                    self._objects[oid] = keep
+        """Evict CLEAN buffers LRU (oldest objects first) until under
+        max_bytes — single pass, evicting as many as needed."""
+        if self._total_bytes <= self.max_bytes:
+            return
+        for oid in list(self._objects):
+            bufs = self._objects[oid]
+            keep = []
+            for b in bufs:
+                if b.state == CLEAN and self._total_bytes > self.max_bytes:
+                    self._account(b, remove=True)
+                    self.stats["evictions"] += 1
                 else:
-                    del self._objects[oid]
-                if self._total_bytes <= self.max_bytes:
-                    break
-            if not evicted:
-                break   # all remaining bytes are dirty/tx
+                    keep.append(b)
+            if keep:
+                self._objects[oid] = keep
+            else:
+                del self._objects[oid]
+            if self._total_bytes <= self.max_bytes:
+                return

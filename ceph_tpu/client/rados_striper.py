@@ -45,6 +45,7 @@ class RadosStriper:
 
     # ------------------------------------------------------------ metadata
     async def _load_meta(self, soid: str):
+        import errno as _errno
         try:
             size = int(await self.io.getxattr(_sub_oid(soid, 0),
                                               XATTR_SIZE))
@@ -52,8 +53,11 @@ class RadosStriper:
                                           XATTR_LAYOUT)).decode()
             su, sc, os_ = (int(x) for x in lay.split(":"))
             return size, Layout(su, sc, os_)
-        except ObjectOperationError:
-            raise StripedObjectNotFound(soid)
+        except ObjectOperationError as e:
+            if e.retcode == -_errno.ENOENT:
+                raise StripedObjectNotFound(soid)
+            raise   # transient errors must NOT look like "create me":
+            #         write() would clobber real size/layout metadata
 
     async def _save_meta(self, soid: str, size: int,
                          layout: Layout) -> None:

@@ -1,0 +1,3 @@
+from ceph_tpu.journal.journaler import Journaler, JournalEntry
+
+__all__ = ["Journaler", "JournalEntry"]

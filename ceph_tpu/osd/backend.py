@@ -98,8 +98,18 @@ class PGBackend:
             return False
 
     def apply_push(self, m: MPGPush) -> None:
-        """Install a pushed object (recovery receive side)."""
+        """Install a pushed object (recovery receive side).  A push
+        snapshotted BEFORE a concurrent client write but delivered after
+        it must not regress the object: the reference orders this with
+        the last_backfill cursor + per-object version checks
+        (ReplicatedPG::recover_object_replicas); here the local log is
+        the arbiter — never install below what we already applied
+        (found by qa/rados_model: a committed write vanished when the
+        stale backfill push of the same object landed after it)."""
         pg = self.pg
+        local = pg.log.latest_entry_for(m.oid)
+        if local is not None and m.version < local.version:
+            return
         oid = pg.object_id(m.oid)
         txn = Transaction()
         txn.remove(pg.cid, oid)

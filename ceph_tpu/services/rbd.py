@@ -127,6 +127,7 @@ class Image:
         # for multi-client is not implemented.)
         self._obj_locks: Dict[str, asyncio.Lock] = {}
         self._cacher = None      # ObjectCacher when opened cached=True
+        self._journal = None     # Journaler when opened journaling=True
 
     def _obj_lock(self, oid: str) -> asyncio.Lock:
         lock = self._obj_locks.get(oid)
@@ -137,11 +138,14 @@ class Image:
     @classmethod
     async def open(cls, ioctx, name: str, cached: bool = False,
                    cache_max_dirty: int = 8 << 20,
-                   cache_max_bytes: int = 32 << 20) -> "Image":
+                   cache_max_bytes: int = 32 << 20,
+                   journaling: bool = False) -> "Image":
         """cached=True puts an ObjectCacher (write-back) between the
         image and its data objects — librbd's rbd_cache=true
         (librbd/ImageCtx.cc object_cacher init).  Call close() to flush
-        before dropping the handle."""
+        before dropping the handle.  journaling=True records every
+        mutation to the image journal BEFORE applying it (the librbd
+        journaling feature rbd-mirror replays)."""
         img_id = name
         hdr = _header_oid(img_id)
 
@@ -161,6 +165,11 @@ class Image:
                 img._backend_read, img._backend_write,
                 max_dirty=cache_max_dirty, max_bytes=cache_max_bytes)
             img._cacher.start()
+        if journaling:
+            from ceph_tpu.journal import Journaler
+            img._journal = Journaler(ioctx, img_id)
+            if not await img._journal.exists():
+                await img._journal.create()
         return img
 
     # cacher backend: oid-granular IO with sparse/EC handling
@@ -230,6 +239,9 @@ class Image:
         if offset + len(data) > self.size:
             raise RBDError(f"write past image end "
                            f"({offset + len(data)} > {self.size})")
+        if self._journal is not None:
+            from ceph_tpu.services.rbd_mirror import encode_write_event
+            await self._journal.append(encode_write_event(offset, data))
         per_obj = extents_by_object(self.layout, offset, len(data))
 
         async def write_obj(object_no, extents):
@@ -280,9 +292,13 @@ class Image:
             await self._cacher.invalidate_all()
 
     async def discard(self, offset: int, length: int) -> None:
-        await self._cache_barrier()
         """Zero a range: remove objects the range fully covers (sparse
         reads return zeros for free), RMW-zero the partial edges."""
+        if self._journal is not None:
+            from ceph_tpu.services.rbd_mirror import encode_discard_event
+            await self._journal.append(encode_discard_event(offset,
+                                                            length))
+        await self._cache_barrier()
         length = min(length, self.size - offset)
         if length <= 0:
             return
@@ -327,6 +343,9 @@ class Image:
             return True
 
     async def resize(self, new_size: int) -> None:
+        if self._journal is not None:
+            from ceph_tpu.services.rbd_mirror import encode_resize_event
+            await self._journal.append(encode_resize_event(new_size))
         if new_size < self.size:
             # zero the tail so a later grow reads zeros, not stale bytes
             # (chunked: never materialize the whole tail in memory)

@@ -128,6 +128,16 @@ async def run(args) -> int:
                 for off in range(0, img.size, step):
                     f.write(await img.read(off, min(step,
                                                     img.size - off)))
+        elif args.op == "mirror":
+            # rbd mirror IMAGE DST_POOL: bootstrap + replay once (the
+            # rbd-mirror daemon loop, one-shot form)
+            from ceph_tpu.services.rbd_mirror import ImageReplayer
+            dst_io = r.open_ioctx(args.args[1])
+            rep = ImageReplayer(io, dst_io, args.args[0])
+            await rep.bootstrap()
+            n = await rep.replay_once()
+            print(f"mirrored {args.args[0]!r} -> pool "
+                  f"{args.args[1]!r} ({n} events replayed)")
         elif args.op == "bench":
             img = await Image.open(io, args.args[0], cached=args.cached)
             try:
