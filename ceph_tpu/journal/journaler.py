@@ -58,20 +58,34 @@ class Journaler:
         self._lock = asyncio.Lock()
 
     # ------------------------------------------------------------- metadata
+    # Every field is its OWN omap key on the header object, so concurrent
+    # updaters (appender rotating, mirrors committing, clients
+    # registering) each touch one key atomically and cannot clobber each
+    # other — the role the reference's cls_journal object class plays.
     async def _get_meta(self) -> dict:
         try:
-            raw = await self.io.getxattr(_hdr_oid(self.jid), "journal.meta")
-            return json.loads(raw.decode())
+            omap = await self.io.omap_get(_hdr_oid(self.jid))
         except ObjectOperationError:
             raise KeyError(f"journal {self.jid} does not exist")
+        if b"first_obj" not in omap:
+            raise KeyError(f"journal {self.jid} does not exist")
+        clients = {}
+        for k, v in omap.items():
+            if k.startswith(b"client."):
+                clients[k[7:].decode()] = {
+                    "committed_seq": int(v.decode())}
+        return {"first_obj": int(omap[b"first_obj"].decode()),
+                "active_obj": int(omap[b"active_obj"].decode()),
+                "clients": clients}
 
-    async def _put_meta(self, meta: dict) -> None:
-        await self.io.setxattr(_hdr_oid(self.jid), "journal.meta",
-                               json.dumps(meta).encode())
+    async def _put_key(self, key: str, value: str) -> None:
+        await self.io.omap_set(_hdr_oid(self.jid),
+                               {key.encode(): value.encode()})
 
     async def create(self) -> None:
-        await self._put_meta({"first_obj": 0, "active_obj": 0,
-                              "clients": {}})
+        await self.io.write_full(_hdr_oid(self.jid), b"")
+        await self._put_key("first_obj", "0")
+        await self._put_key("active_obj", "0")
 
     async def exists(self) -> bool:
         try:
@@ -93,25 +107,42 @@ class Journaler:
     async def register_client(self, client_id: str) -> None:
         """A tailer that participates in trim decisions
         (JournalMetadata::register_client)."""
-        meta = await self._get_meta()
-        meta["clients"].setdefault(client_id, {"committed_seq": 0})
-        await self._put_meta(meta)
+        if await self.get_commit(client_id) == 0:
+            cur = await self._get_client_raw(client_id)
+            if cur is None:
+                await self._put_key(f"client.{client_id}", "0")
 
     async def unregister_client(self, client_id: str) -> None:
-        meta = await self._get_meta()
-        meta["clients"].pop(client_id, None)
-        await self._put_meta(meta)
+        await self.io.omap_rm_keys(_hdr_oid(self.jid),
+                                   [f"client.{client_id}".encode()])
+
+    async def _get_client_raw(self, client_id: str):
+        try:
+            omap = await self.io.omap_get(_hdr_oid(self.jid))
+        except ObjectOperationError:
+            return None
+        raw = omap.get(f"client.{client_id}".encode())
+        return int(raw.decode()) if raw is not None else None
 
     async def commit(self, client_id: str, seq: int) -> None:
-        """Record replay progress (commit position)."""
-        meta = await self._get_meta()
-        cl = meta["clients"].setdefault(client_id, {"committed_seq": 0})
-        cl["committed_seq"] = max(cl["committed_seq"], seq)
-        await self._put_meta(meta)
+        """Record replay progress (commit position; monotonic)."""
+        cur = await self._get_client_raw(client_id) or 0
+        if seq > cur:
+            await self._put_key(f"client.{client_id}", str(seq))
 
     async def get_commit(self, client_id: str) -> int:
+        return await self._get_client_raw(client_id) or 0
+
+    async def tail_seq(self) -> int:
+        """Highest appended seq (bootstrap position marker)."""
         meta = await self._get_meta()
-        return meta["clients"].get(client_id, {}).get("committed_seq", 0)
+        top = 0
+        async for e in self._iter_object(meta["active_obj"]):
+            top = max(top, e.seq)
+        if top == 0 and meta["active_obj"] > meta["first_obj"]:
+            async for e in self._iter_object(meta["active_obj"] - 1):
+                top = max(top, e.seq)
+        return top
 
     # --------------------------------------------------------------- append
     async def _recover_appender(self) -> None:
@@ -149,17 +180,19 @@ class Journaler:
             if self._obj_bytes >= self.object_size:
                 self._obj += 1
                 self._obj_bytes = 0
-                meta = await self._get_meta()
-                meta["active_obj"] = self._obj
-                await self._put_meta(meta)
+                await self._put_key("active_obj", str(self._obj))
             return self._seq
 
     # --------------------------------------------------------------- replay
     async def _iter_object(self, n: int):
+        import errno as _errno
         try:
             raw = await self.io.read(_data_oid(self.jid, n))
-        except ObjectOperationError:
-            return
+        except ObjectOperationError as e:
+            if e.retcode == -_errno.ENOENT:
+                return
+            raise   # a transient error must not silently skip (and
+            #         later TRIM) a whole object of events
         dec = Decoder(raw)
         while dec.remaining() > 0:
             try:
@@ -203,7 +236,5 @@ class Journaler:
             else:
                 break
         if removed:
-            meta = await self._get_meta()
-            meta["first_obj"] = n
-            await self._put_meta(meta)
+            await self._put_key("first_obj", str(n))
         return removed

@@ -392,7 +392,20 @@ class PG:
                 try:
                     infos[p] = await asyncio.wait_for(fut, 10.0)
                 except asyncio.TimeoutError:
-                    self.log_.warning(f"{self.pgid}: no info from osd.{p}")
+                    if self.osd.osdmap.is_up(p):
+                        # an UP prior-set member we couldn't hear from
+                        # may hold the newest writes: proceeding without
+                        # it could elect a stale authority and resync
+                        # its data away (GetInfo waits for all in the
+                        # reference; a truly dead peer gets marked down
+                        # by heartbeats, changing the interval).  Found
+                        # by qa/rados_model under load
+                        self._notify_waiters.pop(p, None)
+                        raise RuntimeError(
+                            f"{self.pgid}: no info from UP osd.{p}; "
+                            f"retrying peering")
+                    self.log_.warning(
+                        f"{self.pgid}: no info from down osd.{p}")
                 finally:
                     self._notify_waiters.pop(p, None)
         self.peer_info = infos

@@ -294,7 +294,10 @@ class Image:
     async def discard(self, offset: int, length: int) -> None:
         """Zero a range: remove objects the range fully covers (sparse
         reads return zeros for free), RMW-zero the partial edges."""
-        if self._journal is not None:
+        if self._journal is not None and not getattr(
+                self, "_in_resize", False):
+            # resize journals ONE event; its internal tail-zeroing
+            # discards must not bloat the journal with redundant entries
             from ceph_tpu.services.rbd_mirror import encode_discard_event
             await self._journal.append(encode_discard_event(offset,
                                                             length))
@@ -346,6 +349,13 @@ class Image:
         if self._journal is not None:
             from ceph_tpu.services.rbd_mirror import encode_resize_event
             await self._journal.append(encode_resize_event(new_size))
+        self._in_resize = True
+        try:
+            await self._resize_inner(new_size)
+        finally:
+            self._in_resize = False
+
+    async def _resize_inner(self, new_size: int) -> None:
         if new_size < self.size:
             # zero the tail so a later grow reads zeros, not stale bytes
             # (chunked: never materialize the whole tail in memory)

@@ -47,7 +47,12 @@ async def apply_event(img: Image, payload: bytes) -> None:
     t = dec.u8()
     if t == EVENT_WRITE:
         off = dec.u64()
-        await img.write(off, dec.bytes_())
+        data = dec.bytes_()
+        # a write journaled BEFORE a later shrink can exceed the
+        # secondary's current size: clamp — the shrink (already applied
+        # or still coming) governs the final bytes either way
+        if off < img.size:
+            await img.write(off, data[:img.size - off])
     elif t == EVENT_DISCARD:
         await img.discard(dec.u64(), dec.u64())
     elif t == EVENT_RESIZE:
@@ -78,10 +83,15 @@ class ImageReplayer:
                 f"image {self.image!r} has no journal: open the primary "
                 f"with journaling=True")
         await jr.register_client(self.client_id)
-        start_seq = await jr.get_commit(self.client_id)
         try:
             await Image.open(self.dst_io, self.image)
         except ImageNotFound:
+            # snapshot the journal position BEFORE copying: the copy
+            # reads data newer than this point, so committing here means
+            # only copy-raced events replay (idempotently) — never the
+            # whole history (which could even wedge on a write event
+            # preceding a shrink)
+            start_seq = await jr.tail_seq()
             await RBD(self.dst_io).create(
                 self.image, src.size, order=src.order,
                 stripe_unit=src.layout.stripe_unit,
@@ -92,9 +102,7 @@ class ImageReplayer:
                 chunk = await src.read(off, min(step, src.size - off))
                 if chunk.strip(b"\x00"):
                     await dst.write(off, chunk)
-        # events appended after start_seq will be replayed; the copy
-        # already contains their effects or they re-apply harmlessly
-        del start_seq
+            await jr.commit(self.client_id, start_seq)
 
     async def replay_once(self) -> int:
         """Apply new journal events; returns how many were applied."""

@@ -1,0 +1,369 @@
+"""RGW-lite: S3-compatible object gateway over librados.
+
+Reference parity: src/rgw/ — rgw_main.cc:194 (the HTTP frontend loop),
+rgw_rest_s3.cc (S3 REST dialect: bucket/object CRUD + ListBucketResult
+XML), rgw_bucket.cc (bucket index objects), rgw_user.cc (user records
+with access/secret keys), rgw_auth_s3.cc (AWS v2 HMAC signatures).
+
+Redesign notes:
+  * The frontend is a minimal asyncio HTTP/1.1 server (civetweb's role),
+    one coroutine per connection — no thread pools.
+  * Buckets are an omap-indexed head object per bucket
+    (.bucket.index.<name>: key -> json{size, etag, mtime}) plus a
+    global bucket directory object; object DATA rides RadosStriper so
+    multi-GB uploads stripe like rgw manifests do.
+  * Users live in one omap object (.rgw.users: access_key ->
+    json{secret, display}); radosgw-admin's user create/rm surface is
+    tools/rgw_admin.py.
+  * Auth: AWS signature v2 (Authorization: AWS access:sig over the
+    canonical string) — matching the reference at this vintage; v4 is
+    out of scope and documented as such.
+  * Multipart upload is not implemented (reference rgw_multi.cc);
+    PUTs are single-request.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import json
+import time
+from email.utils import formatdate
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import quote, unquote, urlsplit
+
+from ceph_tpu.client.objecter import ObjectOperationError
+from ceph_tpu.client.rados_striper import (RadosStriper,
+                                           StripedObjectNotFound)
+
+USERS_OID = ".rgw.users"
+BUCKETS_OID = ".rgw.buckets"
+
+
+def _index_oid(bucket: str) -> str:
+    return f".bucket.index.{bucket}"
+
+
+def _data_soid(bucket: str, key: str) -> str:
+    return f"{bucket}//{key}"
+
+
+# --------------------------------------------------------------------- users
+
+class UserDB:
+    def __init__(self, ioctx):
+        self.io = ioctx
+
+    async def create(self, access: str, secret: str,
+                     display: str = "") -> None:
+        await self.io.omap_set(USERS_OID, {
+            access.encode(): json.dumps(
+                {"secret": secret, "display": display}).encode()})
+
+    async def remove(self, access: str) -> None:
+        await self.io.omap_rm_keys(USERS_OID, [access.encode()])
+
+    async def get(self, access: str) -> Optional[dict]:
+        try:
+            omap = await self.io.omap_get(USERS_OID)
+        except ObjectOperationError:
+            return None
+        raw = omap.get(access.encode())
+        return json.loads(raw.decode()) if raw else None
+
+    async def list(self) -> List[str]:
+        try:
+            omap = await self.io.omap_get(USERS_OID)
+        except ObjectOperationError:
+            return []
+        return sorted(k.decode() for k in omap)
+
+
+# ---------------------------------------------------------------------- auth
+
+def sign_v2(secret: str, method: str, content_md5: str, content_type: str,
+            date: str, canonical_resource: str) -> str:
+    """AWS signature v2 (rgw_auth_s3.cc string-to-sign)."""
+    sts = "\n".join([method, content_md5, content_type, date,
+                     canonical_resource])
+    mac = hmac.new(secret.encode(), sts.encode(), hashlib.sha1)
+    return base64.b64encode(mac.digest()).decode()
+
+
+# ------------------------------------------------------------------- gateway
+
+class S3Gateway:
+    def __init__(self, rados, pool: str = ".rgw",
+                 require_auth: bool = True):
+        self.rados = rados
+        self.io = rados.open_ioctx(pool)
+        self.users = UserDB(self.io)
+        self.require_auth = require_auth
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.port = 0
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._client, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ----------------------------------------------------------------- http
+    async def _client(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    return
+                try:
+                    method, target, _ = line.decode().split(" ", 2)
+                except ValueError:
+                    return
+                headers: Dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                n = int(headers.get("content-length", "0") or 0)
+                if n:
+                    body = await reader.readexactly(n)
+                status, rhdrs, payload = await self._route(
+                    method.upper(), target, headers, body)
+                self._respond(writer, status, rhdrs, payload)
+                await writer.drain()
+                if headers.get("connection", "").lower() == "close":
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+    def _respond(self, writer, status: int, headers: Dict[str, str],
+                 payload: bytes) -> None:
+        reason = {200: "OK", 204: "No Content", 206: "Partial Content",
+                  403: "Forbidden", 404: "Not Found", 405: "Bad Method",
+                  400: "Bad Request", 409: "Conflict"}.get(status, "?")
+        head = [f"HTTP/1.1 {status} {reason}",
+                f"Date: {formatdate(usegmt=True)}",
+                f"Content-Length: {len(payload)}"]
+        head += [f"{k}: {v}" for k, v in headers.items()]
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + payload)
+
+    # ----------------------------------------------------------------- auth
+    async def _authenticate(self, method: str, path: str,
+                            headers: Dict[str, str]) -> Optional[str]:
+        """-> access key of the verified caller, else None."""
+        auth = headers.get("authorization", "")
+        if not auth.startswith("AWS "):
+            return None
+        try:
+            access, got_sig = auth[4:].split(":", 1)
+        except ValueError:
+            return None
+        user = await self.users.get(access)
+        if user is None:
+            return None
+        want = sign_v2(user["secret"], method,
+                       headers.get("content-md5", ""),
+                       headers.get("content-type", ""),
+                       headers.get("date", ""), path)
+        return access if hmac.compare_digest(want, got_sig) else None
+
+    # -------------------------------------------------------------- routing
+    async def _route(self, method: str, target: str,
+                     headers: Dict[str, str], body: bytes
+                     ) -> Tuple[int, Dict[str, str], bytes]:
+        parts = urlsplit(target)
+        path = unquote(parts.path)
+        if self.require_auth:
+            who = await self._authenticate(method, path, headers)
+            if who is None:
+                return 403, {}, _xml_error("AccessDenied")
+        segs = [s for s in path.split("/") if s]
+        try:
+            if not segs:
+                if method == "GET":
+                    return await self._list_buckets()
+                return 405, {}, b""
+            bucket = segs[0]
+            key = "/".join(segs[1:])
+            if not key:
+                if method == "PUT":
+                    return await self._put_bucket(bucket)
+                if method == "DELETE":
+                    return await self._delete_bucket(bucket)
+                if method == "GET":
+                    return await self._list_objects(bucket, parts.query)
+                if method == "HEAD":
+                    return (200 if await self._bucket_exists(bucket)
+                            else 404), {}, b""
+                return 405, {}, b""
+            if method == "PUT":
+                return await self._put_object(bucket, key, body, headers)
+            if method == "GET":
+                return await self._get_object(bucket, key, headers)
+            if method == "HEAD":
+                return await self._head_object(bucket, key)
+            if method == "DELETE":
+                return await self._delete_object(bucket, key)
+            return 405, {}, b""
+        except ObjectOperationError:
+            return 404, {}, _xml_error("NoSuchBucket")
+        except StripedObjectNotFound:
+            # index entry present but data gone (interrupted overwrite
+            # or delete raced a read)
+            return 404, {}, _xml_error("NoSuchKey")
+
+    # -------------------------------------------------------------- buckets
+    async def _bucket_exists(self, bucket: str) -> bool:
+        try:
+            omap = await self.io.omap_get(BUCKETS_OID)
+        except ObjectOperationError:
+            return False
+        return bucket.encode() in omap
+
+    async def _list_buckets(self):
+        try:
+            omap = await self.io.omap_get(BUCKETS_OID)
+        except ObjectOperationError:
+            omap = {}
+        entries = "".join(
+            f"<Bucket><Name>{k.decode()}</Name></Bucket>"
+            for k in sorted(omap))
+        xml = (f'<?xml version="1.0"?><ListAllMyBucketsResult>'
+               f"<Buckets>{entries}</Buckets></ListAllMyBucketsResult>")
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
+
+    async def _put_bucket(self, bucket: str):
+        if await self._bucket_exists(bucket):
+            return 409, {}, _xml_error("BucketAlreadyExists")
+        await self.io.omap_set(BUCKETS_OID, {
+            bucket.encode(): json.dumps(
+                {"created": time.time()}).encode()})
+        await self.io.write_full(_index_oid(bucket), b"")
+        return 200, {}, b""
+
+    async def _delete_bucket(self, bucket: str):
+        if not await self._bucket_exists(bucket):
+            return 404, {}, _xml_error("NoSuchBucket")
+        idx = await self.io.omap_get(_index_oid(bucket))
+        if idx:
+            return 409, {}, _xml_error("BucketNotEmpty")
+        await self.io.omap_rm_keys(BUCKETS_OID, [bucket.encode()])
+        try:
+            await self.io.remove(_index_oid(bucket))
+        except ObjectOperationError:
+            pass
+        return 204, {}, b""
+
+    async def _list_objects(self, bucket: str, query: str):
+        if not await self._bucket_exists(bucket):
+            return 404, {}, _xml_error("NoSuchBucket")
+        prefix = ""
+        for kv in query.split("&"):
+            k, _, v = kv.partition("=")
+            if k == "prefix":
+                prefix = unquote(v)
+        idx = await self.io.omap_get(_index_oid(bucket))
+        rows = []
+        for k in sorted(idx):
+            key = k.decode()
+            if not key.startswith(prefix):
+                continue
+            meta = json.loads(idx[k].decode())
+            rows.append(
+                f"<Contents><Key>{quote(key)}</Key>"
+                f"<Size>{meta['size']}</Size>"
+                f"<ETag>&quot;{meta['etag']}&quot;</ETag></Contents>")
+        xml = (f'<?xml version="1.0"?><ListBucketResult>'
+               f"<Name>{bucket}</Name>{''.join(rows)}</ListBucketResult>")
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
+
+    # -------------------------------------------------------------- objects
+    async def _put_object(self, bucket: str, key: str, body: bytes,
+                          headers: Dict[str, str]):
+        if not await self._bucket_exists(bucket):
+            return 404, {}, _xml_error("NoSuchBucket")
+        st = RadosStriper(self.io)
+        soid = _data_soid(bucket, key)
+        try:
+            await st.remove(soid)      # overwrite: drop old sub-objects
+        except StripedObjectNotFound:
+            pass
+        await st.write(soid, body)
+        etag = hashlib.md5(body).hexdigest()
+        await self.io.omap_set(_index_oid(bucket), {
+            key.encode(): json.dumps({
+                "size": len(body), "etag": etag,
+                "mtime": time.time()}).encode()})
+        return 200, {"ETag": f'"{etag}"'}, b""
+
+    async def _get_object(self, bucket: str, key: str,
+                          headers: Dict[str, str]):
+        meta = await self._obj_meta(bucket, key)
+        if meta is None:
+            return 404, {}, _xml_error("NoSuchKey")
+        st = RadosStriper(self.io)
+        rng = headers.get("range", "")
+        if rng.startswith("bytes="):
+            lo_s, _, hi_s = rng[6:].partition("-")
+            if not lo_s:
+                # suffix range: the LAST N bytes
+                n = min(int(hi_s), meta["size"])
+                lo, hi = meta["size"] - n, meta["size"] - 1
+            else:
+                lo = int(lo_s)
+                hi = min(int(hi_s) if hi_s else meta["size"] - 1,
+                         meta["size"] - 1)
+            if lo > hi:
+                return 400, {}, _xml_error("InvalidRange")
+            data = await st.read(_data_soid(bucket, key),
+                                 length=hi - lo + 1, offset=lo)
+            return 206, {
+                "Content-Range":
+                    f"bytes {lo}-{hi}/{meta['size']}",
+                "ETag": f'"{meta["etag"]}"'}, data
+        data = await st.read(_data_soid(bucket, key))
+        return 200, {"ETag": f'"{meta["etag"]}"'}, data
+
+    async def _head_object(self, bucket: str, key: str):
+        meta = await self._obj_meta(bucket, key)
+        if meta is None:
+            return 404, {}, b""
+        return 200, {"Content-Length-Hint": str(meta["size"]),
+                     "ETag": f'"{meta["etag"]}"'}, b""
+
+    async def _delete_object(self, bucket: str, key: str):
+        meta = await self._obj_meta(bucket, key)
+        if meta is None:
+            return 404, {}, _xml_error("NoSuchKey")
+        try:
+            await RadosStriper(self.io).remove(_data_soid(bucket, key))
+        except StripedObjectNotFound:
+            pass
+        await self.io.omap_rm_keys(_index_oid(bucket), [key.encode()])
+        return 204, {}, b""
+
+    async def _obj_meta(self, bucket: str, key: str) -> Optional[dict]:
+        try:
+            idx = await self.io.omap_get(_index_oid(bucket))
+        except ObjectOperationError:
+            return None
+        raw = idx.get(key.encode())
+        return json.loads(raw.decode()) if raw else None
+
+
+def _xml_error(code: str) -> bytes:
+    return (f'<?xml version="1.0"?><Error><Code>{code}</Code>'
+            f"</Error>").encode()

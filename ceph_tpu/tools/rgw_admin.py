@@ -1,0 +1,86 @@
+"""radosgw-admin analog: RGW user management + gateway runner.
+
+Reference parity: src/rgw/rgw_admin.cc (user create/rm/list) and the
+radosgw daemon entry (rgw_main.cc) — here one tool does both:
+
+    python -m ceph_tpu.tools.rgw_admin --dir DIR user create \
+        --access AK --secret SK [--display NAME]
+    python -m ceph_tpu.tools.rgw_admin --dir DIR user ls
+    python -m ceph_tpu.tools.rgw_admin --dir DIR serve --port 8080
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ceph_tpu.tools.daemons import apply_conf, load_monmap
+
+
+async def _connect(args):
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.common.context import Context
+    ctx = Context("client.admin")
+    apply_conf(ctx, args.dir)
+    r = Rados(ctx, load_monmap(args.dir))
+    await r.connect()
+    # the gateway's backing pool (rgw_main.cc default .rgw.* pools)
+    if r.monc.osdmap.lookup_pool(args.pool) < 0:
+        await r.pool_create(args.pool, pg_num=8)
+    return r
+
+
+async def run(args) -> int:
+    from ceph_tpu.services.rgw import S3Gateway, UserDB
+    r = await _connect(args)
+    try:
+        io = r.open_ioctx(args.pool)
+        if args.cmd == "user":
+            db = UserDB(io)
+            if args.op == "create":
+                await db.create(args.access, args.secret, args.display)
+                print(json.dumps({"user": args.access, "created": True}))
+            elif args.op == "rm":
+                await db.remove(args.access)
+                print(json.dumps({"user": args.access, "removed": True}))
+            elif args.op == "ls":
+                print(json.dumps(await db.list()))
+            return 0
+        if args.cmd == "serve":
+            gw = S3Gateway(r, pool=args.pool,
+                           require_auth=not args.no_auth)
+            port = await gw.start(port=args.port)
+            print(f"rgw listening on 127.0.0.1:{port}", flush=True)
+            try:
+                while True:
+                    await asyncio.sleep(3600)
+            except (KeyboardInterrupt, asyncio.CancelledError):
+                pass
+            await gw.stop()
+            return 0
+        return 2
+    finally:
+        await r.shutdown()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="radosgw-admin")
+    ap.add_argument("--dir", default="./vcluster")
+    ap.add_argument("--pool", default=".rgw")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    u = sub.add_parser("user")
+    u.add_argument("op", choices=("create", "rm", "ls"))
+    u.add_argument("--access", default="")
+    u.add_argument("--secret", default="")
+    u.add_argument("--display", default="")
+    s = sub.add_parser("serve")
+    s.add_argument("--port", type=int, default=7480)
+    s.add_argument("--no-auth", action="store_true")
+    args = ap.parse_args(argv)
+    return asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,153 @@
+"""RGW-lite S3 gateway: bucket/object REST surface + v2 auth.
+
+Mirrors the reference's s3tests role (qa s3-tests subset): bucket CRUD,
+object round-trips with ETag, listing with prefix, range reads, auth
+rejection — all against a live in-process cluster and a real HTTP
+socket.
+"""
+
+import asyncio
+import hashlib
+import sys
+from email.utils import formatdate
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from test_osd import Cluster  # noqa: E402
+
+from ceph_tpu.services.rgw import S3Gateway, UserDB, sign_v2  # noqa: E402
+
+
+class S3Client:
+    """Tiny raw-socket S3 client speaking signature v2."""
+
+    def __init__(self, port, access="", secret=""):
+        self.port = port
+        self.access = access
+        self.secret = secret
+
+    async def request(self, method, path, body=b"", headers=None,
+                      sign=True):
+        headers = dict(headers or {})
+        headers.setdefault("Date", formatdate(usegmt=True))
+        if sign and self.access:
+            sig = sign_v2(self.secret, method,
+                          headers.get("Content-MD5", ""),
+                          headers.get("Content-Type", ""),
+                          headers["Date"], path.split("?")[0])
+            headers["Authorization"] = f"AWS {self.access}:{sig}"
+        reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                       self.port)
+        try:
+            lines = [f"{method} {path} HTTP/1.1", "Host: localhost",
+                     f"Content-Length: {len(body)}",
+                     "Connection: close"]
+            lines += [f"{k}: {v}" for k, v in headers.items()]
+            writer.write(("\r\n".join(lines) + "\r\n\r\n").encode() + body)
+            await writer.drain()
+            status_line = await reader.readline()
+            status = int(status_line.split()[1])
+            rhdrs = {}
+            while True:
+                h = await reader.readline()
+                if h in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = h.decode().partition(":")
+                rhdrs[k.strip().lower()] = v.strip()
+            n = int(rhdrs.get("content-length", "0"))
+            payload = await reader.readexactly(n) if n else b""
+            return status, rhdrs, payload
+        finally:
+            writer.close()
+
+
+def test_s3_gateway_end_to_end():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create(".rgw", pg_num=8)
+        gw = S3Gateway(admin)
+        await UserDB(admin.open_ioctx(".rgw")).create("AKID", "sekrit")
+        port = await gw.start()
+        c = S3Client(port, "AKID", "sekrit")
+
+        # unauthenticated / bad-signature requests are refused
+        st, _, _ = await S3Client(port).request("GET", "/", sign=False)
+        assert st == 403
+        st, _, _ = await S3Client(port, "AKID", "wrong").request("GET", "/")
+        assert st == 403
+
+        # bucket lifecycle
+        st, _, _ = await c.request("PUT", "/photos")
+        assert st == 200
+        st, _, _ = await c.request("PUT", "/photos")
+        assert st == 409                        # exists
+        st, _, body = await c.request("GET", "/")
+        assert st == 200 and b"<Name>photos</Name>" in body
+
+        # object round-trip with etag
+        payload = b"s3 object payload " * 5000       # ~90 KiB, striped
+        st, h, _ = await c.request("PUT", "/photos/album/pic1.jpg",
+                                   payload)
+        assert st == 200
+        assert h["etag"].strip('"') == hashlib.md5(payload).hexdigest()
+        st, h, got = await c.request("GET", "/photos/album/pic1.jpg")
+        assert st == 200 and got == payload
+
+        # range read
+        st, h, got = await c.request("GET", "/photos/album/pic1.jpg",
+                                     headers={"Range": "bytes=10-29"})
+        assert st == 206 and got == payload[10:30]
+        assert h["content-range"] == f"bytes 10-29/{len(payload)}"
+
+        # listing + prefix filter
+        await c.request("PUT", "/photos/album/pic2.jpg", b"x")
+        await c.request("PUT", "/photos/other.txt", b"y")
+        st, _, body = await c.request("GET", "/photos?prefix=album/")
+        assert st == 200
+        assert b"pic1.jpg" in body and b"pic2.jpg" in body
+        assert b"other.txt" not in body
+
+        # head / delete
+        st, _, _ = await c.request("HEAD", "/photos/other.txt")
+        assert st == 200
+        st, _, _ = await c.request("DELETE", "/photos/other.txt")
+        assert st == 204
+        st, _, _ = await c.request("HEAD", "/photos/other.txt")
+        assert st == 404
+
+        # bucket with content refuses delete; empty deletes
+        st, _, _ = await c.request("DELETE", "/photos")
+        assert st == 409
+        for k in ("album/pic1.jpg", "album/pic2.jpg"):
+            await c.request("DELETE", f"/photos/{k}")
+        st, _, _ = await c.request("DELETE", "/photos")
+        assert st == 204
+
+        await gw.stop()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_s3_overwrite_and_missing():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create(".rgw", pg_num=8)
+        gw = S3Gateway(admin, require_auth=False)
+        port = await gw.start()
+        c = S3Client(port)
+        await c.request("PUT", "/b", sign=False)
+        # overwrite shrinks: no stale tail from the previous version
+        await c.request("PUT", "/b/k", b"A" * 50000, sign=False)
+        await c.request("PUT", "/b/k", b"B" * 100, sign=False)
+        st, _, got = await c.request("GET", "/b/k", sign=False)
+        assert st == 200 and got == b"B" * 100
+        st, _, _ = await c.request("GET", "/b/missing", sign=False)
+        assert st == 404
+        st, _, _ = await c.request("GET", "/nobucket?list", sign=False)
+        assert st == 404
+        await gw.stop()
+        await cl.stop()
+    asyncio.run(run())
