@@ -19,6 +19,7 @@ from typing import Deque, Dict, Optional
 SUBSYSTEMS = [
     "ms", "mon", "paxos", "osd", "pg", "ec", "crush", "objecter", "rados",
     "store", "journal", "client", "mesh", "admin", "bench", "auth", "mgr",
+    "mds", "rgw",
 ]
 
 _FMT = "%(asctime)s %(name)s %(levelname).1s %(message)s"

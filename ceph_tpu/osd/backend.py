@@ -41,6 +41,9 @@ from ceph_tpu.store.objectstore import (
 )
 
 SIZE_XATTR = "_size"       # EC: original object length (hinfo role)
+VERSION_XATTR = "_ver"     # log version of the stored object state:
+#                            lets adoption scans spot STALE copies, not
+#                            just absent ones, and breaks EC cohort ties
 
 
 class PGIntervalChanged(Exception):
@@ -97,7 +100,7 @@ class PGBackend:
         except (asyncio.TimeoutError, PGIntervalChanged):
             return False
 
-    def apply_push(self, m: MPGPush) -> None:
+    def apply_push(self, m: MPGPush) -> bool:
         """Install a pushed object (recovery receive side).  A push
         snapshotted BEFORE a concurrent client write but delivered after
         it must not regress the object: the reference orders this with
@@ -109,7 +112,14 @@ class PGBackend:
         pg = self.pg
         local = pg.log.latest_entry_for(m.oid)
         if local is not None and m.version < local.version:
-            return
+            return False
+        if m.deleted and local is not None and not local.is_delete():
+            # the pusher has NO copy and claims "deleted" at its log
+            # head, but OUR log says this object exists — the pusher is
+            # just another victim of the same missed recovery, and
+            # installing its tombstone would erase committed data still
+            # present elsewhere
+            return False
         oid = pg.object_id(m.oid)
         txn = Transaction()
         txn.remove(pg.cid, oid)
@@ -121,8 +131,16 @@ class PGBackend:
                 txn.omap_setkeys(pg.cid, oid, m.omap)
             if m.omap_header:
                 txn.omap_setheader(pg.cid, oid, m.omap_header)
+            if local is not None and VERSION_XATTR not in m.attrs:
+                txn.setattr(pg.cid, oid, VERSION_XATTR,
+                            local.version.to_bytes())
+        # recovery landed: this object no longer gates our completeness
+        pg.missing.items.pop(m.oid, None)
+        if not pg.missing:
+            pg.info.last_complete = pg.info.last_update
         pg.save_meta(txn)
         self.osd.store.apply_transaction(txn)
+        return True
 
     def push_object(self, peer: int, oid: str, at: EVersion) -> None:
         """Send full object state to peer (fire-and-forget variant)."""
@@ -357,6 +375,8 @@ class ReplicatedBackend(PGBackend):
         version = pg.next_version()
         entry = LogEntry(LOG_DELETE if deletes else LOG_MODIFY, m.oid,
                          version, pg.info.last_update, m.reqid)
+        if not deletes:
+            txn.setattr(pg.cid, soid, VERSION_XATTR, version.to_bytes())
         pg.append_log(txn, entry)
         txn_bytes = txn.to_bytes()
         # local apply first (the primary is always shard 0 of the data)
@@ -417,7 +437,10 @@ class ReplicatedBackend(PGBackend):
                 pg.log.append(entry)
                 pg.note_reqid(entry)
                 pg.info.last_update = entry.version
-                pg.info.last_complete = entry.version
+                if not pg.missing:
+                    # a copy still owed recovery pushes must keep its
+                    # honest last_complete cursor, or the gap hides
+                    pg.info.last_complete = entry.version
             pg.save_meta(txn)
             self.osd.store.apply_transaction(txn)
             self.osd.send_osd(int(m.src_name.id), MOSDRepOpReply(
@@ -568,6 +591,10 @@ class ECBackend(PGBackend):
                 return -errno.EOPNOTSUPP
         entry = LogEntry(LOG_DELETE if deletes else LOG_MODIFY, m.oid,
                          version, pg.info.last_update, m.reqid)
+        if not deletes:
+            for i, t in shard_txns.items():
+                t.setattr(cids[i], soid, VERSION_XATTR,
+                          version.to_bytes())
         entry_bytes = entry.to_bytes()
         # local shard applies directly
         my = self.my_shard
@@ -685,6 +712,7 @@ class ECBackend(PGBackend):
             soid = soid.with_snap(snap)
         streams: Dict[int, np.ndarray] = {}
         attrs: Dict[str, bytes] = {}
+        shard_vers: Dict[int, bytes] = {}
         exclude = set(exclude) | self._stale_shards(oid)
         my = self.my_shard
         candidates: List[int] = []
@@ -696,6 +724,7 @@ class ECBackend(PGBackend):
                     streams[i] = np.frombuffer(
                         self.osd.store.read(pg.cid, soid), np.uint8)
                     attrs = self.osd.store.getattrs(pg.cid, soid)
+                    shard_vers[i] = attrs.get(VERSION_XATTR, b"")
                 except (NoSuchObject, NoSuchCollection):
                     pass
             else:
@@ -725,9 +754,53 @@ class ECBackend(PGBackend):
                 streams[i] = np.frombuffer(reply.data[0], np.uint8)
                 if reply.attrs:
                     attrs = reply.attrs
+                    shard_vers[i] = reply.attrs.get(VERSION_XATTR, b"")
                 need -= 1
         if len(streams) < self.k:
             return None
+        lens = {len(s) for s in streams.values()}
+        if len(lens) > 1:
+            # mixed generations: a shard mid-recovery (or racing a
+            # size-changing overwrite) returned a stale-length chunk.
+            # Pull every remaining candidate and decode from the best
+            # same-length cohort — k consistent shards beat an EIO
+            for i in candidates:
+                if i in streams:
+                    continue
+                osd_id = pg.acting[i]
+                tid = self.osd.next_tid()
+                fut = asyncio.get_running_loop().create_future()
+                self._inflight[tid] = ({osd_id}, fut)
+                self.osd.send_osd(osd_id, MOSDECSubOpRead(
+                    pg.pgid.with_shard(i), tid, [(oid, 0, -1)],
+                    snap=snap))
+                try:
+                    reply = await asyncio.wait_for(fut, 15.0)
+                except asyncio.TimeoutError:
+                    self._inflight.pop(tid, None)
+                    continue
+                if reply.result == 0 and reply.data:
+                    streams[i] = np.frombuffer(reply.data[0], np.uint8)
+                    if reply.attrs:
+                        shard_vers[i] = reply.attrs.get(VERSION_XATTR,
+                                                        b"")
+            by_len: Dict[int, Dict[int, np.ndarray]] = {}
+            for i, s in streams.items():
+                by_len.setdefault(len(s), {})[i] = s
+
+            def cohort_score(cohort):
+                # the NEWEST generation wins, cohort size breaks ties —
+                # equal-sized cohorts must never resolve by dict order
+                # (an acked overwrite could read back its old bytes)
+                vers = [EVersion.from_bytes(shard_vers[i])
+                        for i in cohort if shard_vers.get(i)]
+                top = max(vers) if vers else EVersion()
+                return (top, len(cohort))
+
+            best = max(by_len.values(), key=cohort_score)
+            if len(best) < self.k:
+                return None
+            streams = best
         return streams, attrs
 
     async def _read_object(self, oid: str, size: int,
@@ -819,7 +892,10 @@ class ECBackend(PGBackend):
                 pg.log.append(entry)
                 pg.note_reqid(entry)
                 pg.info.last_update = entry.version
-                pg.info.last_complete = entry.version
+                if not pg.missing:
+                    # a copy still owed recovery pushes must keep its
+                    # honest last_complete cursor, or the gap hides
+                    pg.info.last_complete = entry.version
             pg.save_meta(txn)
             self.osd.store.apply_transaction(txn)
             self.osd.send_osd(int(m.src_name.id), MOSDECSubOpWriteReply(

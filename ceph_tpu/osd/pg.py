@@ -137,6 +137,13 @@ class PG:
             b"past_intervals": Encoder().list_(
                 self.past_intervals,
                 lambda e, v: e.struct(v)).getvalue(),
+            # the missing set survives restarts: reconstruction from the
+            # log window cannot see STALE-version objects, only absent
+            # ones (pg_missing_t is likewise persisted in the reference)
+            b"missing": Encoder().map_(
+                dict(self.missing.items),
+                lambda e, k: e.string(k),
+                lambda e, v: e.struct(v)).getvalue(),
         })
 
     def load_meta(self) -> None:
@@ -154,6 +161,24 @@ class PG:
             self.past_intervals = Decoder(
                 omap[b"past_intervals"]).list_(
                 lambda d: d.struct(PastInterval))
+        if b"missing" in omap:
+            from ceph_tpu.common.encoding import Decoder
+            for oid, v in Decoder(omap[b"missing"]).map_(
+                    lambda d: d.string(),
+                    lambda d: d.struct(EVersion)).items():
+                self.missing.add(oid, v)
+        # belt: a crash between log advance and object pulls leaves
+        # last_complete < last_update — rebuild absent objects from that
+        # window too (PGLog::read_log missing reconstruction role)
+        if self.info.last_complete < self.info.last_update \
+                and self.log.can_catch_up_from(self.info.last_complete):
+            stored = {s.name
+                      for s in self.osd.store.collection_list(self.cid)}
+            for oid, e in self.log.objects_since(
+                    self.info.last_complete).items():
+                if not e.is_delete() and oid not in stored \
+                        and oid not in self.missing.items:
+                    self.missing.add(oid, e.version)
 
     def create_onstore(self) -> None:
         if not self.osd.store.collection_exists(self.cid):
@@ -452,6 +477,21 @@ class PG:
                 or not self.info.backfill_complete):
             await self._catch_up_from(best_osd, best_info, epoch)
 
+        if self.missing:
+            # an earlier peering round was interrupted between advancing
+            # last_update and draining its pulls: our log looks caught
+            # up, so catch-up was skipped, but objects are still absent.
+            # Activating like this serves ENOENT for committed writes
+            # and poisons backfill listings (found by qa/rados_model on
+            # an EC pool).  Heal from the best peer first
+            heal_src = best_osd if best_osd != self.osd.whoami else next(
+                iter(sorted(self.peer_info)), -1)
+            if heal_src >= 0:
+                await self._heal_missing(heal_src, epoch)
+                txn = Transaction()
+                self.save_meta(txn)
+                self.osd.store.apply_transaction(txn)
+
         # compute peer missing + activate peers
         await self._activate(epoch)
 
@@ -487,10 +527,17 @@ class PG:
             self.missing.add(e.oid, e.version)
         self.reqids = self.log.reqids()
         self.info.last_update = self.log.head
-        # heal every missing object: deletions apply directly, the rest
-        # are pulled (replicated: whole-object push from the auth peer;
-        # EC: reconstruct OUR shard from k peers — a foreign shard's
-        # bytes must never be installed as ours)
+        await self._heal_missing(peer, epoch)
+        self.info.last_complete = self.info.last_update
+        txn = Transaction()
+        self.save_meta(txn)
+        self.osd.store.apply_transaction(txn)
+
+    async def _heal_missing(self, peer: int, epoch: int) -> None:
+        """Drain the primary's own missing set: deletions apply
+        directly, the rest are pulled (replicated: whole-object push
+        from the auth peer; EC: reconstruct OUR shard from k peers — a
+        foreign shard's bytes must never be installed as ours)."""
         for oid in list(self.missing.items):
             latest = self.log.latest_entry_for(oid)
             if latest is not None and latest.is_delete():
@@ -498,11 +545,16 @@ class PG:
                 self.osd.store.apply_transaction(t)
             else:
                 await self.backend.pull_object(peer, oid, epoch)
-        self.missing = MissingSet()
-        self.info.last_complete = self.info.last_update
-        txn = Transaction()
-        self.save_meta(txn)
-        self.osd.store.apply_transaction(txn)
+                if not self.osd.store.exists(self.cid,
+                                             self.object_id(oid)):
+                    # the donor couldn't provide it (it may be missing
+                    # the object too — its tombstone push is rejected):
+                    # keep the gap on the books and let the retry loop
+                    # find a better source
+                    raise RuntimeError(
+                        f"{self.pgid}: heal of {oid} from osd.{peer} "
+                        f"did not materialize the object")
+            self.missing.items.pop(oid, None)
 
     async def _full_resync_from(self, peer: int, auth_info: PGInfo,
                                 auth_log: PGLog, epoch: int) -> None:
@@ -577,12 +629,18 @@ class PG:
             pm = MissingSet()
             # a peer is in sync if it is empty along with us (initial
             # activation), or backfill-complete and within the log window
+            # recover from the peer's last_COMPLETE cursor, not its log
+            # head: a copy that adopted a log during a previous
+            # activation but never received the recovery pushes reports
+            # last_complete < last_update, and those objects must be
+            # re-pushed by us (the new primary)
+            peer_from = min(pi.last_update, pi.last_complete)
             in_sync = ((pi.is_empty() and self.info.is_empty())
                        or (not pi.is_empty() and pi.backfill_complete
-                           and self.log.can_catch_up_from(pi.last_update)))
+                           and self.log.can_catch_up_from(peer_from)))
             full_resync = not in_sync
             if not full_resync:
-                for oid, e in self.log.objects_since(pi.last_update).items():
+                for oid, e in self.log.objects_since(peer_from).items():
                     if not e.is_delete():
                         pm.add(oid, e.version)
             else:
@@ -736,13 +794,41 @@ class PG:
                         txn.remove(self.cid, soid)
             else:
                 # apply log-window deletions: adopting the log alone
-                # would leave the object bytes in our store
-                for oid, e in new_log.objects_since(since).items():
+                # would leave the object bytes in our store; for the
+                # rest, record what we DON'T have — adopting the
+                # primary's last_update while objects are still absent
+                # must not masquerade as completeness, or a primary
+                # failover before its recovery pushes land makes the
+                # gap permanent (found by qa/rados_model, EC pool).
+                # Scan from the honest cursor (covers gaps recorded by
+                # PREVIOUS activations, merged not reset) and compare
+                # stored VERSIONS, not mere existence — a stale copy of
+                # an overwritten object is just as missing
+                from ceph_tpu.osd.backend import VERSION_XATTR
+                scan_from = min(since, self.info.last_complete)
+                if not new_log.can_catch_up_from(scan_from):
+                    scan_from = since
+                for oid, e in new_log.objects_since(scan_from).items():
                     if e.is_delete():
                         txn.remove(self.cid, self.object_id(oid))
+                        self.missing.items.pop(oid, None)
+                        continue
+                    soid_o = self.object_id(oid)
+                    try:
+                        have_v = EVersion.from_bytes(
+                            self.osd.store.getattr(self.cid, soid_o,
+                                                   VERSION_XATTR))
+                    except Exception:
+                        have_v = None
+                    if have_v is not None and not (have_v < e.version):
+                        self.missing.items.pop(oid, None)
+                    else:
+                        self.missing.add(oid, e.version)
             prev_complete = self.info.backfill_complete
             self.info = PGInfo.from_bytes(m.info_bytes)
             self.info.pgid = self.pgid
+            if self.missing and not m.full_resync:
+                self.info.last_complete = since   # honest cursor
             # the adopted info carries the PRIMARY's backfill state; ours
             # is: mid-resync until the primary confirms every push landed
             if m.full_resync:
@@ -855,6 +941,14 @@ class PG:
             return
         from ceph_tpu.osd.backend import PGIntervalChanged
         try:
+            if m.oid in self.missing.items:
+                # our OWN copy of this object is still owed a recovery
+                # pull (log adopted before data): serving now would
+                # return ENOENT for committed data — heal it first
+                # (the reference's wait_for_missing_object)
+                src = next((p for p in self.actual_peers()), -1)
+                if src >= 0:
+                    await self._heal_missing(src, self.interval_epoch)
             if has_write:
                 # recover-before-write: peers must have the current object
                 # before a mutation lands on top of it
@@ -959,7 +1053,10 @@ class PG:
         self.log.append(entry)
         self.note_reqid(entry)
         self.info.last_update = entry.version
-        self.info.last_complete = entry.version
+        if not self.missing:
+            # a copy still owed recovery pulls keeps its honest cursor:
+            # new writes advance the head, not completeness
+            self.info.last_complete = entry.version
         self.save_meta(txn)
 
     def note_reqid(self, entry: LogEntry) -> None:

@@ -200,6 +200,8 @@ async def run_model(seed: int, rounds: int = 80, n_osds: int = 5,
                             f"{got if got is None else got[:16]!r}"
                             f"... not in model "
                             f"({[v if v is None else v[:16] for v in model.value(oid)]})")
+                        events.extend(_forensics(cl, admin, "model",
+                                                 oid))
             except (asyncio.TimeoutError, ObjectOperationError) as e:
                 # outcome unknown: both old and new values acceptable
                 if op == "write":
@@ -214,18 +216,31 @@ async def run_model(seed: int, rounds: int = 80, n_osds: int = 5,
     # settle: all osds healed; wait for every pg clean, then final verify
     await _wait_clean(cl, admin, events)
     for oid in oids:
-        try:
-            got = await io.read(oid, timeout=15.0)
-        except ObjectOperationError:
-            got = None
-        except asyncio.TimeoutError:
-            failures.append(f"final read {oid} timed out")
+        deadline = time.monotonic() + 45.0
+        while True:
+            try:
+                got = await io.read(oid, timeout=10.0)
+                break
+            except ObjectOperationError:
+                got = None
+                break
+            except asyncio.TimeoutError:
+                if time.monotonic() >= deadline:
+                    # prolonged unavailability after full heal is a
+                    # LIVENESS failure (wedged pg), distinct from loss
+                    failures.append(
+                        f"final read {oid} unavailable after 45s")
+                    got = "__unavailable__"
+                    break
+        if got == "__unavailable__":
+            events.extend(_forensics(cl, admin, "model", oid))
             continue
         stats["read_checks"] += 1
         if not model.check(oid, got):
             failures.append(
                 f"final: {oid} = {got if got is None else got[:16]!r} "
                 f"not acceptable")
+            events.extend(_forensics(cl, admin, "model", oid))
     await cl.stop()
     result = {"seed": seed, "ok": not failures, "failures": failures,
               **stats, "events": len(events)}
@@ -237,6 +252,42 @@ async def run_model(seed: int, rounds: int = 80, n_osds: int = 5,
             for h in history.get(bad_oid, []):
                 print(f"   {bad_oid}: {h}", file=sys.stderr)
     return result
+
+
+def _forensics(cl: Cluster, admin, pool: str, oid: str) -> List[str]:
+    """Cluster-side state dump for a lost object: which pg, and every
+    osd's log/store view of it — printed with the failure so a one-shot
+    stochastic repro still tells the whole story."""
+    out = [f"FORENSICS {oid}:"]
+    try:
+        from ceph_tpu.osd.types import ObjectLocator
+        m = admin.monc.osdmap
+        pid = m.lookup_pool(pool)
+        raw = m.object_locator_to_pg(oid, ObjectLocator(pid))
+        pgid = m.pools[pid].raw_pg_to_pg(raw)
+        up, _, acting, primary = m.pg_to_up_acting_osds(pgid)
+        out.append(f"  pg {pgid} up {up} acting {acting} "
+                   f"primary {primary}")
+        for osd_id, osd in sorted(cl.osds.items()):
+            for pg in osd.pgs.values():
+                if pg.pgid.without_shard() != pgid.without_shard():
+                    continue
+                e = pg.log.latest_entry_for(oid)
+                in_store = any(
+                    s.name == oid
+                    for s in osd.store.collection_list(pg.cid))
+                out.append(
+                    f"  osd.{osd_id} shard {pg.pgid.shard}: "
+                    f"state={pg.state} role={pg.role} "
+                    f"lu={pg.info.last_update} "
+                    f"bc={pg.info.backfill_complete} "
+                    f"log[{oid}]={e.version if e else None}"
+                    f"{'(del)' if e and e.is_delete() else ''} "
+                    f"stored={in_store} "
+                    f"missing={oid in pg.missing.items}")
+    except Exception as e:   # forensics must never mask the failure
+        out.append(f"  (forensics failed: {e!r})")
+    return out
 
 
 async def _wait_clean(cl: Cluster, admin, events: List[str],

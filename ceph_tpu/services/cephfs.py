@@ -1,0 +1,204 @@
+"""CephFS-lite client: POSIX-ish file API over MDS metadata + striped
+file data.
+
+Reference parity: src/client/Client.cc:1 — metadata ops go to the MDS
+(MClientRequest/MClientReply), file DATA is striped by the client
+directly into the data pool using the file layout (<ino>.<block>
+objects, here via RadosStriper on soid `<ino hex>`), sizes propagate
+back to the MDS on close/flush (cap flush role).
+
+Redesign notes: no capabilities/leases — every metadata op consults the
+MDS (write-through MDS makes this correct, just chattier than the
+reference's cap-cached fast paths); single active MDS addressed
+directly instead of an mdsmap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+from typing import Dict, List, Optional
+
+from ceph_tpu.client.rados_striper import (RadosStriper,
+                                           StripedObjectNotFound)
+from ceph_tpu.msg.messenger import Dispatcher
+from ceph_tpu.services.mds import MClientReply, MClientRequest
+
+
+class CephFSError(OSError):
+    pass
+
+
+def _file_soid(ino: int) -> str:
+    return f"{ino:x}"
+
+
+class CephFS(Dispatcher):
+    def __init__(self, rados, mds_addr, data_pool: str):
+        self.rados = rados
+        self.messenger = rados.messenger
+        self.messenger.add_dispatcher(self)
+        self.mds_addr = mds_addr
+        self.data_io = rados.open_ioctx(data_pool)
+        # random tid base: several mounts can share one messenger and
+        # must never collide on reply matching
+        import random
+        self._tid = random.getrandbits(32) << 20
+        self._pending: Dict[int, asyncio.Future] = {}
+
+    # ------------------------------------------------------------ transport
+    def ms_dispatch(self, m) -> bool:
+        if isinstance(m, MClientReply):
+            fut = self._pending.pop(m.tid, None)
+            if fut is None:
+                return False    # another mount on this messenger owns it
+            if not fut.done():
+                fut.set_result(m)
+            return True
+        return False
+
+    async def _request(self, op: str, timeout: float = 30.0,
+                       **args) -> dict:
+        self._tid += 1
+        tid = self._tid
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[tid] = fut
+        self.messenger.send_message(MClientRequest(op, args, tid),
+                                    self.mds_addr, peer_type="mds")
+        try:
+            reply: MClientReply = await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(tid, None)
+        if reply.result < 0:
+            raise CephFSError(-reply.result,
+                              f"{op} {args}: {reply.data}")
+        return reply.data
+
+    # ------------------------------------------------------------ metadata
+    async def mkdir(self, path: str) -> None:
+        await self._request("mkdir", path=path)
+
+    async def makedirs(self, path: str) -> None:
+        parts = [p for p in path.split("/") if p]
+        cur = ""
+        for p in parts:
+            cur += "/" + p
+            try:
+                await self._request("mkdir", path=cur)
+            except CephFSError as e:
+                if e.errno != errno.EEXIST:
+                    raise
+
+    async def listdir(self, path: str) -> List[str]:
+        data = await self._request("readdir", path=path)
+        return sorted(data["entries"])
+
+    async def stat(self, path: str) -> dict:
+        data = await self._request("lookup", path=path)
+        return data["ent"]
+
+    async def rename(self, src: str, dst: str) -> None:
+        await self._request("rename", src=src, dst=dst)
+
+    async def unlink(self, path: str) -> None:
+        data = await self._request("unlink", path=path)
+        # the MDS dropped the dentry; the data objects are ours to reap
+        # (client-driven purge, the reference queues this on the MDS
+        # PurgeQueue — acceptable divergence, documented)
+        try:
+            await RadosStriper(self.data_io).remove(
+                _file_soid(data["ent"]["ino"]))
+        except StripedObjectNotFound:
+            pass
+
+    async def rmdir(self, path: str) -> None:
+        await self._request("rmdir", path=path)
+
+    # ------------------------------------------------------------ file io
+    async def open(self, path: str, mode: str = "r") -> "File":
+        if mode not in ("r", "w", "a", "r+", "w+"):
+            raise ValueError(f"mode {mode!r}")
+        if "w" in mode or "a" in mode or "+" in mode:
+            data = await self._request("create", path=path)
+        else:
+            data = await self._request("lookup", path=path)
+            if data["ent"]["type"] != "file":
+                raise CephFSError(errno.EISDIR, path)
+        f = File(self, path, data["ent"], mode)
+        if mode.startswith("w"):
+            await f.truncate(0)
+        if mode == "a":
+            f.pos = f.size
+        return f
+
+    # convenience one-shots
+    async def write_file(self, path: str, data: bytes) -> None:
+        f = await self.open(path, "w")
+        await f.write(data)
+        await f.close()
+
+    async def read_file(self, path: str) -> bytes:
+        f = await self.open(path, "r")
+        try:
+            return await f.read()
+        finally:
+            await f.close()
+
+
+class File:
+    """An open file handle (Client::Fh)."""
+
+    def __init__(self, fs: CephFS, path: str, ent: dict, mode: str):
+        self.fs = fs
+        self.path = path
+        self.ino = ent["ino"]
+        self.size = ent["size"]
+        self.mode = mode
+        self.pos = 0
+        self._striper = RadosStriper(fs.data_io)
+        self._dirty_size = False
+
+    async def write(self, data: bytes,
+                    offset: Optional[int] = None) -> int:
+        if self.mode == "r":
+            raise CephFSError(errno.EBADF, "read-only handle")
+        off = self.pos if offset is None else offset
+        await self._striper.write(_file_soid(self.ino), data, offset=off)
+        if offset is None:
+            self.pos = off + len(data)
+        if off + len(data) > self.size:
+            self.size = off + len(data)
+            self._dirty_size = True
+        return len(data)
+
+    async def read(self, length: int = -1,
+                   offset: Optional[int] = None) -> bytes:
+        off = self.pos if offset is None else offset
+        n = self.size - off if length < 0 else length
+        if n <= 0:
+            return b""
+        try:
+            data = await self._striper.read(_file_soid(self.ino),
+                                            length=n, offset=off)
+        except StripedObjectNotFound:
+            data = b""          # never-written file
+        if offset is None:
+            self.pos = off + len(data)
+        return data
+
+    async def truncate(self, size: int) -> None:
+        try:
+            await self._striper.truncate(_file_soid(self.ino), size)
+        except StripedObjectNotFound:
+            pass
+        self.size = size
+        self._dirty_size = True
+
+    async def flush(self) -> None:
+        if self._dirty_size:
+            await self.fs._request("setattr", path=self.path,
+                                   size=self.size)
+            self._dirty_size = False
+
+    async def close(self) -> None:
+        await self.flush()

@@ -1,0 +1,290 @@
+"""MDS-lite: the CephFS metadata server.
+
+Reference parity: src/mds/ — MDCache.cc:1 (directories as omap-backed
+objects in the metadata pool: CDir/CDentry/CInode), MDS request
+dispatch (Server::handle_client_request for lookup/mkdir/create/
+unlink/rename...), the inode table (InoTable.cc) allocating inode
+numbers, and src/client/Client.cc's request/reply protocol distilled to
+MClientRequest/MClientReply.
+
+Redesign notes:
+  * ONE active MDS, no clustering: subtree partitioning, migration and
+    the journal/MDLog are out of scope — metadata mutations go straight
+    to RADOS omap (a crash loses nothing committed; in-flight requests
+    are retried by clients).  The reference needs the MDLog because its
+    cache is write-back; this MDS is write-through.
+  * Directories: object `dir.<ino>` in the metadata pool, omap
+    name -> json{ino, type, size, mtime}.  Root is ino 1.
+  * Inode numbers from `mds_inotable` (omap key "next"), the InoTable
+    role.
+  * File DATA never touches the MDS: clients stripe it directly into
+    the data pool as `<ino hex>` striped objects (cephfs file layout).
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.client.objecter import ObjectOperationError
+from ceph_tpu.msg.message import Message, register_message
+from ceph_tpu.msg.messenger import Dispatcher
+from ceph_tpu.common.encoding import Decoder, Encoder
+
+ROOT_INO = 1
+INOTABLE_OID = "mds_inotable"
+
+
+def dir_oid(ino: int) -> str:
+    return f"dir.{ino:x}"
+
+
+@register_message
+class MClientRequest(Message):
+    """Client -> MDS metadata op (messages/MClientRequest.h)."""
+    TYPE = 240
+
+    def __init__(self, op: str = "", args: Optional[dict] = None,
+                 tid: int = 0):
+        super().__init__()
+        self.op = op
+        self.args = args or {}
+        self.tid = tid
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.string(self.op).string(json.dumps(self.args)).u64(self.tid)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int):
+        return cls(dec.string(), json.loads(dec.string()), dec.u64())
+
+
+@register_message
+class MClientReply(Message):
+    """MDS -> client (messages/MClientReply.h)."""
+    TYPE = 241
+
+    def __init__(self, tid: int = 0, result: int = 0,
+                 data: Optional[dict] = None):
+        super().__init__()
+        self.tid = tid
+        self.result = result
+        self.data = data or {}
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid).s32(self.result).string(json.dumps(self.data))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int):
+        return cls(dec.u64(), dec.s32(), json.loads(dec.string()))
+
+
+class MDS(Dispatcher):
+    """The metadata server: owns the metadata pool, answers
+    MClientRequest."""
+
+    def __init__(self, ctx, messenger, rados, metadata_pool: str):
+        self.ctx = ctx
+        self.log = ctx.logger("mds")
+        self.messenger = messenger
+        messenger.add_dispatcher(self)
+        self.rados = rados
+        self.io = rados.open_ioctx(metadata_pool)
+        # one mutation at a time: inode allocation and dentry updates
+        # are read-modify-write against omap (the reference serializes
+        # through the MDLog; this MDS is write-through so a plain mutex
+        # is the equivalent ordering point)
+        import asyncio
+        self._mutex = asyncio.Lock()
+
+    # ------------------------------------------------------------ lifecycle
+    async def create_fs(self) -> None:
+        """mkfs: root directory + inode table (ceph fs new role)."""
+        try:
+            await self.io.omap_get(dir_oid(ROOT_INO))
+        except ObjectOperationError:
+            await self.io.write_full(dir_oid(ROOT_INO), b"")
+            await self.io.write_full(INOTABLE_OID, b"")
+            await self.io.omap_set(INOTABLE_OID, {b"next": b"2"})
+
+    async def _alloc_ino(self) -> int:
+        omap = await self.io.omap_get(INOTABLE_OID)
+        nxt = int(omap.get(b"next", b"2"))
+        await self.io.omap_set(INOTABLE_OID,
+                               {b"next": str(nxt + 1).encode()})
+        return nxt
+
+    # -------------------------------------------------------------- helpers
+    async def _dir_entries(self, ino: int) -> Dict[str, dict]:
+        try:
+            omap = await self.io.omap_get(dir_oid(ino))
+        except ObjectOperationError:
+            raise FileNotFoundError(ino)
+        return {k.decode(): json.loads(v.decode())
+                for k, v in omap.items()}
+
+    async def _dentry(self, ino: int, name: str) -> Optional[dict]:
+        try:
+            ents = await self._dir_entries(ino)
+        except FileNotFoundError:
+            return None
+        return ents.get(name)
+
+    async def _set_dentry(self, ino: int, name: str, ent: dict) -> None:
+        await self.io.omap_set(dir_oid(ino),
+                               {name.encode(): json.dumps(ent).encode()})
+
+    async def _resolve(self, path: str) -> Tuple[int, dict]:
+        """-> (parent dir ino of final component, dentry dict) for the
+        full path; root resolves to (0, root-dir pseudo entry)."""
+        parts = [p for p in path.split("/") if p]
+        ino = ROOT_INO
+        ent = {"ino": ROOT_INO, "type": "dir", "size": 0, "mtime": 0}
+        parent = 0
+        for i, name in enumerate(parts):
+            d = await self._dentry(ino, name)
+            if d is None:
+                raise FileNotFoundError(path)
+            parent = ino
+            ent = d
+            if i < len(parts) - 1:
+                if d["type"] != "dir":
+                    raise NotADirectoryError(path)
+                ino = d["ino"]
+        return parent, ent
+
+    @staticmethod
+    def _split(path: str) -> Tuple[str, str]:
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            raise ValueError("root has no name")
+        return "/" + "/".join(parts[:-1]), parts[-1]
+
+    # ------------------------------------------------------------- dispatch
+    def ms_dispatch(self, m: Message) -> bool:
+        if isinstance(m, MClientRequest):
+            import asyncio
+            asyncio.get_running_loop().create_task(self._handle(m))
+            return True
+        return False
+
+    async def _handle(self, m: MClientRequest) -> None:
+        try:
+            async with self._mutex:
+                data = await self._execute(m.op, m.args)
+            reply = MClientReply(m.tid, 0, data)
+        except FileNotFoundError:
+            reply = MClientReply(m.tid, -errno.ENOENT)
+        except FileExistsError:
+            reply = MClientReply(m.tid, -errno.EEXIST)
+        except NotADirectoryError:
+            reply = MClientReply(m.tid, -errno.ENOTDIR)
+        except IsADirectoryError:
+            reply = MClientReply(m.tid, -errno.EISDIR)
+        except OSError as e:
+            reply = MClientReply(m.tid, -(e.errno or errno.EIO))
+        except Exception as e:
+            self.log.exception(f"mds op {m.op} failed")
+            reply = MClientReply(m.tid, -errno.EIO,
+                                 {"error": repr(e)})
+        self.messenger.send_message(reply, m.src_addr,
+                                    peer_type="client")
+
+    # ------------------------------------------------------------ operations
+    async def _execute(self, op: str, a: dict) -> dict:
+        if op == "lookup":
+            _, ent = await self._resolve(a["path"])
+            return {"ent": ent}
+        if op == "readdir":
+            _, ent = await self._resolve(a["path"])
+            if ent["type"] != "dir":
+                raise NotADirectoryError(a["path"])
+            ents = await self._dir_entries(ent["ino"])
+            return {"entries": ents}
+        if op == "mkdir":
+            parent_path, name = self._split(a["path"])
+            _, pent = await self._resolve(parent_path)
+            if pent["type"] != "dir":
+                raise NotADirectoryError(parent_path)
+            if await self._dentry(pent["ino"], name) is not None:
+                raise FileExistsError(a["path"])
+            ino = await self._alloc_ino()
+            await self.io.write_full(dir_oid(ino), b"")
+            ent = {"ino": ino, "type": "dir", "size": 0,
+                   "mtime": time.time()}
+            await self._set_dentry(pent["ino"], name, ent)
+            return {"ent": ent}
+        if op == "create":
+            parent_path, name = self._split(a["path"])
+            _, pent = await self._resolve(parent_path)
+            if pent["type"] != "dir":
+                raise NotADirectoryError(parent_path)
+            existing = await self._dentry(pent["ino"], name)
+            if existing is not None:
+                if existing["type"] != "file":
+                    raise IsADirectoryError(a["path"])
+                if a.get("excl"):
+                    raise FileExistsError(a["path"])
+                return {"ent": existing}
+            ino = await self._alloc_ino()
+            ent = {"ino": ino, "type": "file", "size": 0,
+                   "mtime": time.time()}
+            await self._set_dentry(pent["ino"], name, ent)
+            return {"ent": ent}
+        if op == "setattr":
+            parent_path, name = self._split(a["path"])
+            _, pent = await self._resolve(parent_path)
+            ent = await self._dentry(pent["ino"], name)
+            if ent is None:
+                raise FileNotFoundError(a["path"])
+            if "size" in a:
+                ent["size"] = a["size"]
+            ent["mtime"] = time.time()
+            await self._set_dentry(pent["ino"], name, ent)
+            return {"ent": ent}
+        if op == "unlink":
+            parent_path, name = self._split(a["path"])
+            _, pent = await self._resolve(parent_path)
+            ent = await self._dentry(pent["ino"], name)
+            if ent is None:
+                raise FileNotFoundError(a["path"])
+            if ent["type"] == "dir":
+                raise IsADirectoryError(a["path"])
+            await self.io.omap_rm_keys(dir_oid(pent["ino"]),
+                                       [name.encode()])
+            return {"ent": ent}   # client punches the data objects
+        if op == "rmdir":
+            parent_path, name = self._split(a["path"])
+            _, pent = await self._resolve(parent_path)
+            ent = await self._dentry(pent["ino"], name)
+            if ent is None:
+                raise FileNotFoundError(a["path"])
+            if ent["type"] != "dir":
+                raise NotADirectoryError(a["path"])
+            if await self._dir_entries(ent["ino"]):
+                raise OSError(errno.ENOTEMPTY, "directory not empty")
+            await self.io.omap_rm_keys(dir_oid(pent["ino"]),
+                                       [name.encode()])
+            try:
+                await self.io.remove(dir_oid(ent["ino"]))
+            except ObjectOperationError:
+                pass
+            return {}
+        if op == "rename":
+            sp, sn = self._split(a["src"])
+            dp, dn = self._split(a["dst"])
+            _, spent = await self._resolve(sp)
+            _, dpent = await self._resolve(dp)
+            ent = await self._dentry(spent["ino"], sn)
+            if ent is None:
+                raise FileNotFoundError(a["src"])
+            dst_ent = await self._dentry(dpent["ino"], dn)
+            if dst_ent is not None and dst_ent["type"] == "dir":
+                raise IsADirectoryError(a["dst"])
+            await self._set_dentry(dpent["ino"], dn, ent)
+            await self.io.omap_rm_keys(dir_oid(spent["ino"]),
+                                       [sn.encode()])
+            return {"ent": ent}
+        raise OSError(errno.EOPNOTSUPP, f"mds op {op!r}")

@@ -1,0 +1,84 @@
+"""cephfs CLI: drive a CephFS tree on a live cluster (cephfs-shell role).
+
+    python -m ceph_tpu.tools.cephfs --dir DIR ls /path
+    ... mkdir /path | put LOCAL /path | get /path LOCAL | rm /path
+    ... mv /src /dst | stat /path
+
+Talks to the mds daemon started via `python -m ceph_tpu.tools.daemons
+mds --id a --dir DIR` (its address is published in DIR/mds.<id>.addr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+from ceph_tpu.tools.daemons import apply_conf, load_monmap
+
+
+def _mds_addr(cluster_dir: str, mds_id: str):
+    from ceph_tpu.msg.types import EntityAddr
+    path = os.path.join(cluster_dir, f"mds.{mds_id}.addr")
+    host, port, nonce = open(path).read().strip().rsplit(":", 2)
+    return EntityAddr(host, int(port), int(nonce))
+
+
+async def run(args) -> int:
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.common.context import Context
+    from ceph_tpu.services.cephfs import CephFS, CephFSError
+    ctx = Context("client.admin")
+    apply_conf(ctx, args.dir)
+    r = Rados(ctx, load_monmap(args.dir))
+    await r.connect()
+    try:
+        fs = CephFS(r, _mds_addr(args.dir, args.mds), "cephfs_data")
+        if args.op == "ls":
+            for name in await fs.listdir(args.args[0]):
+                print(name)
+        elif args.op == "mkdir":
+            await fs.makedirs(args.args[0])
+        elif args.op == "put":
+            with open(args.args[0], "rb") as f:
+                await fs.write_file(args.args[1], f.read())
+        elif args.op == "get":
+            data = await fs.read_file(args.args[0])
+            if args.args[1] == "-":
+                sys.stdout.buffer.write(data)
+            else:
+                with open(args.args[1], "wb") as f:
+                    f.write(data)
+        elif args.op == "rm":
+            await fs.unlink(args.args[0])
+        elif args.op == "rmdir":
+            await fs.rmdir(args.args[0])
+        elif args.op == "mv":
+            await fs.rename(args.args[0], args.args[1])
+        elif args.op == "stat":
+            print(json.dumps(await fs.stat(args.args[0])))
+        else:
+            return 2
+        return 0
+    except CephFSError as e:
+        print(f"cephfs: {e}", file=sys.stderr)
+        return 1
+    finally:
+        await r.shutdown()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="cephfs")
+    ap.add_argument("--dir", default="./vcluster")
+    ap.add_argument("--mds", default="a")
+    ap.add_argument("op", choices=("ls", "mkdir", "put", "get", "rm",
+                                   "rmdir", "mv", "stat"))
+    ap.add_argument("args", nargs="*")
+    args = ap.parse_args(argv)
+    return asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

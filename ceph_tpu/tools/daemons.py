@@ -76,6 +76,31 @@ async def run_osd(args) -> None:
     await osd.shutdown()
 
 
+async def run_mds(args) -> None:
+    """MDS daemon: metadata service over the cephfs metadata pool
+    (creates both cephfs pools if absent)."""
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.services.mds import MDS
+    ctx = Context(f"mds.{args.id}")
+    apply_conf(ctx, args.dir)
+    monmap = load_monmap(args.dir)
+    r = Rados(ctx, monmap)
+    await r.connect()
+    for pool in ("cephfs_metadata", "cephfs_data"):
+        if r.monc.osdmap.lookup_pool(pool) < 0:
+            await r.pool_create(pool, pg_num=8)
+    msgr = Messenger(ctx, EntityName("mds", args.id))
+    addr = await msgr.bind()
+    mds = MDS(ctx, msgr, r, "cephfs_metadata")
+    await mds.create_fs()
+    # publish our address for clients (mdsmap stand-in)
+    with open(os.path.join(args.dir, f"mds.{args.id}.addr"), "w") as f:
+        f.write(f"{addr.host}:{addr.port}:{addr.nonce}")
+    await _run_until_signal()
+    await msgr.shutdown()
+    await r.shutdown()
+
+
 async def _run_until_signal() -> None:
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -86,11 +111,12 @@ async def _run_until_signal() -> None:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ceph-tpu-daemon")
-    ap.add_argument("kind", choices=["mon", "osd"])
+    ap.add_argument("kind", choices=["mon", "osd", "mds"])
     ap.add_argument("--id", required=True)
     ap.add_argument("--dir", required=True, help="cluster directory")
     args = ap.parse_args(argv)
-    runner = run_mon if args.kind == "mon" else run_osd
+    runner = {"mon": run_mon, "osd": run_osd,
+              "mds": run_mds}[args.kind]
     asyncio.run(runner(args))
     return 0
 
