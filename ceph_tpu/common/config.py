@@ -305,4 +305,6 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("keyring", "str", "", "keyring file path ($name etc expanded)"),
     Option("auth_ticket_ttl", "float", 3600.0,
            "service ticket lifetime (auth_service_ticket_ttl)"),
+    Option("lockdep", "bool", False,
+           "lock-order cycle detection (common/lockdep.cc role)"),
 ]

@@ -1,0 +1,121 @@
+"""lockdep: lock-ordering cycle detection for asyncio locks.
+
+Reference parity: src/common/lockdep.cc — every named lock acquisition
+records an ordering edge (held -> acquiring) in a global graph; an
+acquisition that would close a cycle is a potential deadlock and is
+reported with both acquisition backtraces.  The reference hooks
+pthread mutexes; here DepLock wraps asyncio.Lock and the "thread" is
+the current asyncio task.
+
+Enable per-context with config lockdep=true; lock-holders construct
+their locks through make_lock (the MDS mutex does today; new multi-lock
+daemons should follow).  Disabled, the factory returns a plain
+asyncio.Lock — zero overhead.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class LockOrderViolation(Exception):
+    pass
+
+
+class _Graph:
+    def __init__(self):
+        # edge a -> b: lock a was held while acquiring b
+        self.edges: Dict[str, Set[str]] = {}
+        self.where: Dict[Tuple[str, str], str] = {}
+
+    def add(self, held: str, acquiring: str) -> Optional[List[str]]:
+        """Record edge; returns a cycle path if this edge closes one."""
+        if acquiring == held:
+            return [held, held]
+        path = self._find_path(acquiring, held)
+        if path is not None:
+            return path + [acquiring]
+        self.edges.setdefault(held, set()).add(acquiring)
+        self.where.setdefault(
+            (held, acquiring),
+            "".join(traceback.format_stack(limit=8)))
+        return None
+
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        seen = set()
+        stack = [(src, [src])]
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in self.edges.get(node, ()):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    def clear(self) -> None:
+        self.edges.clear()
+        self.where.clear()
+
+
+GRAPH = _Graph()
+_held: Dict[int, List[str]] = {}    # task id -> lock names held (ordered)
+
+
+def _task_key() -> int:
+    t = asyncio.current_task()
+    return id(t) if t is not None else 0
+
+
+class DepLock:
+    """asyncio.Lock with ordering checks (lockdep_will_lock role)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = asyncio.Lock()
+
+    async def __aenter__(self):
+        key = _task_key()
+        held = _held.setdefault(key, [])
+        for h in held:
+            cycle = GRAPH.add(h, self.name)
+            if cycle is not None:
+                order = " -> ".join(cycle)
+                first = GRAPH.where.get((cycle[0], cycle[1]), "")
+                raise LockOrderViolation(
+                    f"lock cycle {order}: acquiring {self.name!r} while "
+                    f"holding {h!r}, but the reverse order was "
+                    f"established here:\n{first}")
+        await self._lock.acquire()
+        held.append(self.name)
+        return self
+
+    async def __aexit__(self, *exc):
+        self._lock.release()
+        held = _held.get(_task_key(), [])
+        if self.name in held:
+            held.remove(self.name)
+        return False
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+
+def make_lock(ctx, name: str):
+    """Factory: a checked DepLock when ctx config lockdep=true, a plain
+    asyncio.Lock otherwise (zero overhead when off)."""
+    try:
+        enabled = bool(ctx.config["lockdep"])
+    except Exception:
+        enabled = False
+    return DepLock(name) if enabled else asyncio.Lock()
+
+
+def reset() -> None:
+    """Test isolation: wipe the global order graph."""
+    GRAPH.clear()
+    _held.clear()

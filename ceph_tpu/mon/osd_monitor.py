@@ -267,6 +267,53 @@ class OSDMonitor(PaxosService):
                 self.pending_inc.new_state[osd] = \
                     self.pending_inc.new_state.get(osd, 0) | OSD_UP
             self._propose_and_ack(m)
+        elif prefix == "osd reweight":
+            osd = int(cmd["id"])
+            if not self.osdmap.exists(osd):
+                ack(-errno.ENOENT, f"osd.{osd} dne")
+                return
+            w = float(cmd["weight"])
+            if not (0.0 <= w <= 1.0):
+                ack(-errno.EINVAL, "weight must be in [0, 1]")
+                return
+            self.pending_inc.new_weight[osd] = int(w * OSD_IN_WEIGHT)
+            self._propose_and_ack(m)
+        elif prefix == "osd reweight-by-utilization":
+            # OSDMonitor::reweight_by_utilization: nudge overloaded osds
+            # down proportionally to their PG-count excess over the mean
+            # (usage proxy — the reference uses kb_used the same way)
+            oload = int(cmd.get("oload", 120))
+            if oload <= 100:
+                ack(-errno.EINVAL, "oload must be > 100")
+                return
+            per_osd: Dict[int, int] = {}
+            for row in self.mon.pgmon.pg_stats.values():
+                for o in row.get("acting", []):
+                    if o >= 0:
+                        per_osd[o] = per_osd.get(o, 0) + 1
+            if not per_osd:
+                ack(0, json.dumps({"avg_pgs": 0, "reweighted": {}}))
+                return
+            avg = sum(per_osd.values()) / len(per_osd)
+            changed = {}
+            for o, n in per_osd.items():
+                # pg_stats rows can reference osds that no longer exist
+                # or were operator-outed: never resurrect or crash on
+                # them
+                if not self.osdmap.exists(o) or self.osdmap.is_out(o):
+                    continue
+                if n * 100 > avg * oload:
+                    cur = self.osdmap.osd_weight[o]
+                    neww = max(1, int(cur * avg / n))
+                    self.pending_inc.new_weight[o] = neww
+                    changed[o] = {"pgs": n,
+                                  "weight": neww / OSD_IN_WEIGHT}
+            if changed:
+                self._propose_and_ack(
+                    m, outs=json.dumps({"avg_pgs": avg,
+                                        "reweighted": changed}))
+            else:
+                ack(0, json.dumps({"avg_pgs": avg, "reweighted": {}}))
         elif prefix == "osd lost":
             # operator declares an osd's data unrecoverable so peering
             # stops waiting for it (OSDMonitor 'osd lost' command; needs

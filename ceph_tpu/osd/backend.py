@@ -864,10 +864,23 @@ class ECBackend(PGBackend):
         soid = pg.object_id(oid)
         got = await self._gather_shards(oid, exclude={my} | set(exclude))
         if got is None:
-            # peers have no data: the object was deleted
-            self.osd.store.apply_transaction(
-                Transaction().remove(pg.cid, soid))
-            return
+            latest = pg.log.latest_entry_for(oid)
+            if latest is not None and latest.is_delete():
+                # genuinely deleted per our log: drop the local shard.
+                # `latest is None` proves NOTHING — old objects fall out
+                # of the log window, and during full resync the adopted
+                # log is exactly one whose window has closed
+                self.osd.store.apply_transaction(
+                    Transaction().remove(pg.cid, soid))
+                return
+            # the log says this object EXISTS: an insufficient gather is
+            # a transient failure (peers down/backfilling), never a
+            # license to delete — raise so the caller retries (this
+            # exact confusion erased committed shards under churn;
+            # qa/rados_model seed 101)
+            raise RuntimeError(
+                f"{pg.pgid}: cannot reconstruct {oid}: insufficient "
+                f"shards (transient)")
         streams, attrs = got
         rebuilt = self.codec.decode({my}, streams)[my]
         from ceph_tpu.common.crc import crc32c

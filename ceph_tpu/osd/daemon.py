@@ -578,6 +578,8 @@ class OSD(Dispatcher):
                     "num_bytes": nbytes,
                     "scrub_errors": max(errors, 0),
                     "log_version": pg.info.last_update.version,
+                    "up": list(pg.up),
+                    "acting": list(pg.acting),
                 })
             try:
                 self.monc.messenger.send_message(

@@ -690,31 +690,43 @@ class PG:
 
     async def _recover(self, epoch: int) -> None:
         """Push missing objects to peers (ReplicatedPG recovery WQ /
-        ECBackend::continue_recovery_op role)."""
-        try:
-            for p, pm in list(self.peer_missing.items()):
-                for oid in list(pm.items):
-                    if epoch != self.interval_epoch:
-                        return
-                    await self.backend.recover_object(p, oid)
-                    pm.items.pop(oid, None)
-                if p in self._backfilling and not pm.items \
-                        and epoch == self.interval_epoch:
-                    # every object pushed: the peer may now trust its copy
-                    self._backfilling.discard(p)
-                    if p in self.peer_info:
-                        self.peer_info[p].backfill_complete = True
-                    self.osd.send_osd(p, MPGLog(
-                        self.pgid.with_shard(self.shard_of(p)), epoch,
-                        self.info.to_bytes(), self.log.to_bytes(),
-                        self.osd.whoami, activate=True, backfill_done=True))
-            self.log_.debug(f"{self.pgid} recovery complete")
-            if epoch == self.interval_epoch:
-                self._on_clean(epoch)
-        except asyncio.CancelledError:
-            raise
-        except Exception:
-            self.log_.exception(f"{self.pgid} recovery failed")
+        ECBackend::continue_recovery_op role).  Failures RETRY with
+        backoff while the interval holds — a recovery task that gives up
+        leaves backfilling peers incomplete forever, and nothing else
+        would ever restart it (qa/rados_model seed 101 wedge)."""
+        backoff = 0.5
+        while epoch == self.interval_epoch:
+            try:
+                for p, pm in list(self.peer_missing.items()):
+                    for oid in list(pm.items):
+                        if epoch != self.interval_epoch:
+                            return
+                        await self.backend.recover_object(p, oid)
+                        pm.items.pop(oid, None)
+                    if p in self._backfilling and not pm.items \
+                            and epoch == self.interval_epoch:
+                        # every object pushed: the peer may now trust
+                        # its copy
+                        self._backfilling.discard(p)
+                        if p in self.peer_info:
+                            self.peer_info[p].backfill_complete = True
+                        self.osd.send_osd(p, MPGLog(
+                            self.pgid.with_shard(self.shard_of(p)),
+                            epoch, self.info.to_bytes(),
+                            self.log.to_bytes(), self.osd.whoami,
+                            activate=True, backfill_done=True))
+                self.log_.debug(f"{self.pgid} recovery complete")
+                if epoch == self.interval_epoch:
+                    self._on_clean(epoch)
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.log_.warning(
+                    f"{self.pgid} recovery error ({e}); retrying in "
+                    f"{backoff:.1f}s")
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
 
     def _on_clean(self, epoch: int) -> None:
         """Every copy caught up: past-interval history is no longer

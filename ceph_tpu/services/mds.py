@@ -94,10 +94,11 @@ class MDS(Dispatcher):
         self.io = rados.open_ioctx(metadata_pool)
         # one mutation at a time: inode allocation and dentry updates
         # are read-modify-write against omap (the reference serializes
-        # through the MDLog; this MDS is write-through so a plain mutex
-        # is the equivalent ordering point)
-        import asyncio
-        self._mutex = asyncio.Lock()
+        # through the MDLog; this MDS is write-through so a mutex is the
+        # equivalent ordering point).  Built through the lockdep factory
+        # so `lockdep = true` catches ordering cycles as locks multiply
+        from ceph_tpu.common.lockdep import make_lock
+        self._mutex = make_lock(ctx, "mds.mutex")
 
     # ------------------------------------------------------------ lifecycle
     async def create_fs(self) -> None:

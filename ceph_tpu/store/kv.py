@@ -109,6 +109,11 @@ class KeyValueDB:
     def keys(self, prefix: str) -> List[bytes]:
         return [k for k, _ in self.iterate(prefix)]
 
+    def iterate_all(self) -> Iterator[Tuple[str, bytes, bytes]]:
+        """Yield (prefix, key, value) over the whole keyspace — offline
+        tooling surface (kvstore tool list/stats)."""
+        raise NotImplementedError
+
 
 class MemDB(KeyValueDB):
     """Sorted in-memory backend (reference kv/MemDB analog)."""
@@ -165,6 +170,11 @@ class MemDB(KeyValueDB):
             if end is not None and short >= end:
                 break
             yield short, self._map[k]
+
+    def iterate_all(self):
+        for k in self._keys:
+            p, _, short = k.partition(_SEP)
+            yield p.decode("utf-8", errors="replace"), short, self._map[k]
 
 
 class FileDB(MemDB):

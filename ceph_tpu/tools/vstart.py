@@ -139,7 +139,7 @@ class VCluster:
         await r.connect()
         return r
 
-    async def wait_healthy(self, timeout: float = 60.0) -> None:
+    async def wait_healthy(self, timeout: float = 120.0) -> None:
         """Wait until every osd is up/in (wait_for_clean role)."""
         admin = await self.admin()
         try:
