@@ -381,8 +381,11 @@ class ReplicatedBackend(PGBackend):
         txn_bytes = txn.to_bytes()
         # local apply first (the primary is always shard 0 of the data)
         self.osd.store.apply_transaction(txn)
-        peers = {o for o in pg.acting
-                 if o != self.osd.whoami and o != CRUSH_ITEM_NONE}
+        # fan out to acting AND up: an up-but-not-acting member (pg_temp
+        # backfill target) must see every write or its copy stales
+        peers = {o for o in set(pg.acting) | set(pg.up)
+                 if o != self.osd.whoami and o >= 0
+                 and o != CRUSH_ITEM_NONE}
         tid = self.osd.next_tid()
         fut = self._ack_init(tid, peers)
         for p in peers:
@@ -601,17 +604,28 @@ class ECBackend(PGBackend):
         local_txn = shard_txns.get(my, Transaction())
         pg.append_log(local_txn, entry)
         self.osd.store.apply_transaction(local_txn)
-        # fan out to the other shards
+        # fan out to the other shards; each position also goes to its
+        # UP holder when that differs from acting (pg_temp backfill
+        # target keeps current while the complete copy serves)
         tid = self.osd.next_tid()
         peers = set()
         sends = []
         for i, osd_id in enumerate(pg.acting):
-            if i == my or osd_id == CRUSH_ITEM_NONE:
-                continue
-            peers.add(osd_id)
-            sends.append((osd_id, MOSDECSubOpWrite(
-                pg.pgid.with_shard(i), tid, shard_txns[i].to_bytes(),
-                entry_bytes, version, self.osd.osdmap.epoch)))
+            targets = {osd_id}
+            if i < len(pg.up):
+                targets.add(pg.up[i])
+            for t_osd in targets:
+                # NOTE: no position filter here — even at the primary's
+                # own position, the up-side backfill target must get the
+                # write; only self is excluded
+                if t_osd == self.osd.whoami or t_osd < 0 \
+                        or t_osd == CRUSH_ITEM_NONE:
+                    continue
+                peers.add(t_osd)
+                sends.append((t_osd, MOSDECSubOpWrite(
+                    pg.pgid.with_shard(i), tid,
+                    shard_txns[i].to_bytes(), entry_bytes, version,
+                    self.osd.osdmap.epoch)))
         fut = self._ack_init(tid, peers)
         for osd_id, msg in sends:
             self.osd.send_osd(osd_id, msg)

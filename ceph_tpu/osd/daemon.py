@@ -536,6 +536,20 @@ class OSD(Dispatcher):
             for pg in list(self.pgs.values()):
                 if not pg.is_primary():
                     continue
+                # a clean primary still pinned to pg_temp lost its clear
+                # request (mon down / not leader at the time): re-send
+                # until the map reflects it
+                if (pg.state == STATE_ACTIVE and not pg._backfilling
+                        and not any(pm.items
+                                    for pm in pg.peer_missing.values())
+                        and self.osdmap.pg_temp.get(
+                            pg.pgid.without_shard())):
+                    from ceph_tpu.mon.messages import MPGTemp
+                    self.monc.messenger.send_message(
+                        MPGTemp(self.whoami,
+                                {pg.pgid.without_shard(): []}),
+                        self.monc.monmap.addr_of_rank(self.monc.cur_mon),
+                        peer_type="mon")
                 ver = (pg.info.last_update.epoch,
                        pg.info.last_update.version)
                 cached = usage_cache.get(pg.pgid)

@@ -114,10 +114,15 @@ class PG:
         return sorted(peers)
 
     def shard_of(self, osd_id: int) -> int:
-        """Acting position of osd_id (EC shard); NO_SHARD for replicated."""
+        """EC shard position of osd_id; NO_SHARD for replicated.  An
+        up-but-not-acting member (backfill target under pg_temp) owns
+        the shard of its UP position."""
         if not self.pool.is_erasure():
             return NO_SHARD
         for i, o in enumerate(self.acting):
+            if o == osd_id:
+                return i
+        for i, o in enumerate(self.up):
             if o == osd_id:
                 return i
         return NO_SHARD
@@ -619,26 +624,77 @@ class PG:
         finally:
             self._pull_waiters.pop(oid, None)
 
+    def _peer_in_sync(self, pi: PGInfo) -> bool:
+        """Can this copy be trusted to serve after a log catch-up?"""
+        peer_from = min(pi.last_update, pi.last_complete)
+        return ((pi.is_empty() and self.info.is_empty())
+                or (not pi.is_empty() and pi.backfill_complete
+                    and self.log.can_catch_up_from(peer_from)))
+
+    def _want_pg_temp(self) -> Optional[List[int]]:
+        """pg_temp gate (PG::choose_acting -> queue_want_pg_temp): when
+        an ACTING member needs a full backfill but a COMPLETE copy of
+        its position exists on a probed stray, the complete holder
+        should keep serving (as acting via pg_temp) while the new
+        member backfills as an up-only target.  Returns the desired
+        acting list, or None when no substitution helps."""
+        m = self.osd.osdmap
+        want = list(self.acting)
+        changed = False
+        for pos, p in enumerate(self.acting):
+            if p == self.osd.whoami or p < 0 or p == CRUSH_ITEM_NONE:
+                continue
+            pi = self.peer_info.get(p)
+            if pi is None or self._peer_in_sync(pi):
+                continue
+            for s, shard in self._probe_shards.items():
+                if s in want or not m.is_up(s):
+                    continue
+                if self.pool.is_erasure() and shard != pos:
+                    continue
+                spi = self.peer_info.get(s)
+                if spi is not None and self._peer_in_sync(spi):
+                    want[pos] = s
+                    changed = True
+                    break
+        return want if changed else None
+
     async def _activate(self, epoch: int) -> None:
         """Ship logs to peers, compute their missing sets, go active."""
         me = self.osd.whoami
         self._backfilling.clear()
+        want = self._want_pg_temp()
+        if want is not None \
+                and self.osd.osdmap.pg_temp.get(
+                    self.pgid.without_shard()) != want:
+            # keep complete copies serving while the newcomers backfill:
+            # ask the mon for pg_temp and re-peer under the new mapping
+            from ceph_tpu.mon.messages import MPGTemp
+            self.log_.info(
+                f"{self.pgid} requesting pg_temp {want} (backfill gate)")
+            self.osd.monc.messenger.send_message(
+                MPGTemp(self.osd.whoami,
+                        {self.pgid.without_shard(): want}),
+                self.osd.monc.monmap.addr_of_rank(self.osd.monc.cur_mon),
+                peer_type="mon")
+            # do NOT activate the degraded set; the map change restarts
+            # peering.  If the mon proposal is lost, retry via timeout
+            await asyncio.sleep(2.0)
+            if epoch == self.interval_epoch:
+                self._peering_task = \
+                    asyncio.get_running_loop().create_task(self._peer())
+            return
         for p, pi in self.peer_info.items():
             if p not in self.acting and p not in self.up:
                 continue
             pm = MissingSet()
             # a peer is in sync if it is empty along with us (initial
-            # activation), or backfill-complete and within the log window
-            # recover from the peer's last_COMPLETE cursor, not its log
-            # head: a copy that adopted a log during a previous
-            # activation but never received the recovery pushes reports
-            # last_complete < last_update, and those objects must be
-            # re-pushed by us (the new primary)
+            # activation), or backfill-complete and within the log
+            # window measured from its last_COMPLETE cursor (a copy that
+            # adopted a log without the recovery pushes reports
+            # last_complete < last_update; those objects get re-pushed)
             peer_from = min(pi.last_update, pi.last_complete)
-            in_sync = ((pi.is_empty() and self.info.is_empty())
-                       or (not pi.is_empty() and pi.backfill_complete
-                           and self.log.can_catch_up_from(peer_from)))
-            full_resync = not in_sync
+            full_resync = not self._peer_in_sync(pi)
             if not full_resync:
                 for oid, e in self.log.objects_since(peer_from).items():
                     if not e.is_delete():
@@ -738,6 +794,17 @@ class PG:
         txn = Transaction()
         self.save_meta(txn)
         self.osd.store.apply_transaction(txn)
+        if self.osd.osdmap.pg_temp.get(self.pgid.without_shard()):
+            # every copy caught up: hand serving back to the CRUSH
+            # acting set (clear_want_pg_temp)
+            from ceph_tpu.mon.messages import MPGTemp
+            self.log_.info(f"{self.pgid} clearing pg_temp (clean)")
+            self.osd.monc.messenger.send_message(
+                MPGTemp(self.osd.whoami,
+                        {self.pgid.without_shard(): []}),
+                self.osd.monc.monmap.addr_of_rank(
+                    self.osd.monc.cur_mon),
+                peer_type="mon")
         for p in self._strays:
             # send regardless of up state: send_osd drops unreachable
             # targets, and a stray that misses this gets mopped up when

@@ -30,14 +30,16 @@ def test_model_checker_replicated(seed):
 
 @pytest.mark.xfail(
     strict=False,
-    reason="KNOWN OPEN ISSUE: under kill/out-in churn an EC pg can serve "
-           "ENOENT (and rarely wedge mid-backfill) while enough complete "
-           "shards exist — the checker found and we fixed five data-loss "
-           "bugs in this area this round (stale pushes, empty-authority "
-           "election, adopted-log completeness, tombstone pulls, "
-           "abandoned recovery); the residual ~30%-of-seeds failure "
-           "needs pg_temp-gated backfill (serving set excludes "
-           "mid-backfill members) — next round. Repro: "
+    reason="KNOWN OPEN ISSUE: under kill/out-in churn an EC pg can "
+           "still serve ENOENT in ~1/3 of seeds. This round's checker "
+           "drove six fixes here (stale pushes, empty-authority "
+           "election, adopted-log completeness/version tracking, "
+           "tombstone pulls, abandoned-recovery retry, pg_temp-gated "
+           "backfill so complete strays keep serving) which cut the "
+           "failure rate from ~100% of affected interleavings; the "
+           "remaining window needs per-object backfill cursors "
+           "(reference last_backfill) so reads can block on exactly "
+           "the unbackfilled objects. Repro: "
            "python -m ceph_tpu.qa.rados_model --ec --seeds 10")
 def test_model_checker_ec_pool():
     res = asyncio.run(run_model(
