@@ -392,6 +392,45 @@ class Monitor(Dispatcher):
                        "quorum_names": [self.monmap.name_of_rank(r)
                                         for r in self.quorum]}
                 self.reply(m, MMonCommandAck(m.tid, 0, json.dumps(out)))
+            elif prefix == "mds boot":
+                # FSMonitor-lite (mon/MDSMonitor.cc beacon role): the
+                # mds registers its address; clients resolve via
+                # `mds dump` instead of side-channel files.  Replicated
+                # through paxos like every map mutation — a leader
+                # failover must not lose registrations
+                import time as _time
+                txn = KVTransaction()
+                txn.set("fsmap", m.cmd["name"], json.dumps({
+                    "addr": m.cmd["addr"],
+                    "stamp": _time.time()}).encode())
+                self._propose_kv(m, txn, "registered")
+            elif prefix == "mds dump":
+                out = {}
+                for k in self.store.keys("fsmap"):
+                    out[k.decode()] = json.loads(
+                        self.store_get("fsmap", k).decode())
+                self.reply(m, MMonCommandAck(m.tid, 0, json.dumps(out)))
+            elif prefix == "config-key set":
+                txn = KVTransaction()
+                txn.set("config-key", m.cmd["key"],
+                        m.inbl or m.cmd.get("val", "").encode())
+                self._propose_kv(m, txn, "set")
+            elif prefix == "config-key get":
+                v = self.store_get("config-key", m.cmd["key"])
+                if v is None:
+                    self.reply(m, MMonCommandAck(
+                        m.tid, -errno.ENOENT, "no such key"))
+                else:
+                    self.reply(m, MMonCommandAck(
+                        m.tid, 0, v.decode(errors="replace"), outbl=v))
+            elif prefix == "config-key ls":
+                self.reply(m, MMonCommandAck(m.tid, 0, json.dumps(
+                    sorted(k.decode() for k in
+                           self.store.keys("config-key")))))
+            elif prefix == "config-key rm":
+                txn = KVTransaction()
+                txn.rmkey("config-key", m.cmd["key"])
+                self._propose_kv(m, txn, "removed")
             elif prefix.startswith("auth"):
                 self.authmon.handle_command(m)
             elif prefix.startswith("osd") or prefix.startswith("pg"):
@@ -408,7 +447,19 @@ class Monitor(Dispatcher):
         "quorum_status", "osd dump", "osd tree", "osd stat", "osd ls",
         "osd pool ls", "osd getmap", "osd getcrushmap",
         "osd erasure-code-profile ls", "osd erasure-code-profile get",
+        "mds dump", "config-key get", "config-key ls",
     })
+
+    def _propose_kv(self, m: MMonCommand, txn: "KVTransaction",
+                    ok_msg: str) -> None:
+        """Commit a small kv mutation through paxos and ack when
+        replicated (the PaxosService encode_pending path for services
+        too simple to batch)."""
+        def done(ok):
+            self.reply(m, MMonCommandAck(
+                m.tid, 0 if ok else -errno.EAGAIN,
+                ok_msg if ok else "paxos proposal failed"))
+        self.paxos.propose_new_value(txn.encode(), done)
 
     def _command_allowed(self, m: MMonCommand, prefix: str) -> bool:
         """MonCap check: reads need r, mutations need w, the auth

@@ -236,9 +236,17 @@ class OSD(Dispatcher):
                 pgid = PGId(pool_id, ps)
                 up, upp, acting, actp = m.pg_to_up_acting_osds(pgid)
                 if self.whoami in acting or self.whoami in up:
-                    shard = (acting.index(self.whoami)
-                             if pool.is_erasure()
-                             and self.whoami in acting else NO_SHARD)
+                    # EC shard comes from our acting OR up position: an
+                    # up-only backfill target (pg_temp window) must key
+                    # its PG/collection by the shard it is being filled
+                    # FOR, or the pushed data lands in a NO_SHARD
+                    # collection that evaporates when pg_temp clears
+                    shard = NO_SHARD
+                    if pool.is_erasure():
+                        if self.whoami in acting:
+                            shard = acting.index(self.whoami)
+                        elif self.whoami in up:
+                            shard = up.index(self.whoami)
                     wanted[pgid.with_shard(shard)
                            if shard != NO_SHARD else pgid] = pool_id
         # PGs we no longer host stay live as STRAYS when they hold data:
@@ -539,17 +547,9 @@ class OSD(Dispatcher):
                 # a clean primary still pinned to pg_temp lost its clear
                 # request (mon down / not leader at the time): re-send
                 # until the map reflects it
-                if (pg.state == STATE_ACTIVE and not pg._backfilling
-                        and not any(pm.items
-                                    for pm in pg.peer_missing.values())
-                        and self.osdmap.pg_temp.get(
-                            pg.pgid.without_shard())):
-                    from ceph_tpu.mon.messages import MPGTemp
-                    self.monc.messenger.send_message(
-                        MPGTemp(self.whoami,
-                                {pg.pgid.without_shard(): []}),
-                        self.monc.monmap.addr_of_rank(self.monc.cur_mon),
-                        peer_type="mon")
+                if (pg.is_fully_clean() and self.osdmap.pg_temp.get(
+                        pg.pgid.without_shard())):
+                    pg.send_pg_temp([])
                 ver = (pg.info.last_update.epoch,
                        pg.info.last_update.version)
                 cached = usage_cache.get(pg.pgid)

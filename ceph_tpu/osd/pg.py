@@ -127,6 +127,22 @@ class PG:
                 return i
         return NO_SHARD
 
+    def is_fully_clean(self) -> bool:
+        """Active with every copy caught up (no recovery owed)."""
+        return (self.state == STATE_ACTIVE and not self._backfilling
+                and not self.missing
+                and not any(pm.items
+                            for pm in self.peer_missing.values()))
+
+    def send_pg_temp(self, want: List[int]) -> None:
+        """Ask the mon for a pg_temp override ([] clears) —
+        queue_want_pg_temp."""
+        from ceph_tpu.mon.messages import MPGTemp
+        self.osd.monc.messenger.send_message(
+            MPGTemp(self.osd.whoami, {self.pgid.without_shard(): want}),
+            self.osd.monc.monmap.addr_of_rank(self.osd.monc.cur_mon),
+            peer_type="mon")
+
     def describe(self) -> str:
         return (f"pg {self.pgid} {self.state} role {self.role} "
                 f"up {self.up} acting {self.acting} "
@@ -669,14 +685,9 @@ class PG:
                     self.pgid.without_shard()) != want:
             # keep complete copies serving while the newcomers backfill:
             # ask the mon for pg_temp and re-peer under the new mapping
-            from ceph_tpu.mon.messages import MPGTemp
             self.log_.info(
                 f"{self.pgid} requesting pg_temp {want} (backfill gate)")
-            self.osd.monc.messenger.send_message(
-                MPGTemp(self.osd.whoami,
-                        {self.pgid.without_shard(): want}),
-                self.osd.monc.monmap.addr_of_rank(self.osd.monc.cur_mon),
-                peer_type="mon")
+            self.send_pg_temp(want)
             # do NOT activate the degraded set; the map change restarts
             # peering.  If the mon proposal is lost, retry via timeout
             await asyncio.sleep(2.0)
@@ -797,14 +808,8 @@ class PG:
         if self.osd.osdmap.pg_temp.get(self.pgid.without_shard()):
             # every copy caught up: hand serving back to the CRUSH
             # acting set (clear_want_pg_temp)
-            from ceph_tpu.mon.messages import MPGTemp
             self.log_.info(f"{self.pgid} clearing pg_temp (clean)")
-            self.osd.monc.messenger.send_message(
-                MPGTemp(self.osd.whoami,
-                        {self.pgid.without_shard(): []}),
-                self.osd.monc.monmap.addr_of_rank(
-                    self.osd.monc.cur_mon),
-                peer_type="mon")
+            self.send_pg_temp([])
         for p in self._strays:
             # send regardless of up state: send_osd drops unreachable
             # targets, and a stray that misses this gets mopped up when

@@ -19,8 +19,18 @@ import sys
 from ceph_tpu.tools.daemons import apply_conf, load_monmap
 
 
-def _mds_addr(cluster_dir: str, mds_id: str):
+async def _mds_addr(r, cluster_dir: str, mds_id: str):
+    """Resolve via the mon's fsmap (mds dump); file fallback for dirs
+    whose mds predates registration."""
     from ceph_tpu.msg.types import EntityAddr
+    try:
+        ack = await r.mon_command({"prefix": "mds dump"})
+        ent = json.loads(ack.outs).get(f"mds.{mds_id}")
+        if ent:
+            host, port, nonce = ent["addr"].rsplit(":", 2)
+            return EntityAddr(host, int(port), int(nonce))
+    except Exception:
+        pass
     path = os.path.join(cluster_dir, f"mds.{mds_id}.addr")
     host, port, nonce = open(path).read().strip().rsplit(":", 2)
     return EntityAddr(host, int(port), int(nonce))
@@ -35,7 +45,8 @@ async def run(args) -> int:
     r = Rados(ctx, load_monmap(args.dir))
     await r.connect()
     try:
-        fs = CephFS(r, _mds_addr(args.dir, args.mds), "cephfs_data")
+        fs = CephFS(r, await _mds_addr(r, args.dir, args.mds),
+                    "cephfs_data")
         if args.op == "ls":
             for name in await fs.listdir(args.args[0]):
                 print(name)

@@ -93,7 +93,10 @@ async def run_mds(args) -> None:
     addr = await msgr.bind()
     mds = MDS(ctx, msgr, r, "cephfs_metadata")
     await mds.create_fs()
-    # publish our address for clients (mdsmap stand-in)
+    # register with the mon (FSMonitor beacon) + a file fallback for
+    # offline inspection
+    await r.mon_command({"prefix": "mds boot", "name": f"mds.{args.id}",
+                         "addr": f"{addr.host}:{addr.port}:{addr.nonce}"})
     with open(os.path.join(args.dir, f"mds.{args.id}.addr"), "w") as f:
         f.write(f"{addr.host}:{addr.port}:{addr.nonce}")
     await _run_until_signal()

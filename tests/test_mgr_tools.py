@@ -177,3 +177,35 @@ def test_reweight_by_utilization_moves_weight():
                 await asyncio.sleep(0.05)
         await cl.stop()
     asyncio.run(run())
+
+
+# ------------------------------------------------- fsmap + config-key
+
+def test_fsmap_registration_and_config_key():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        ack = await admin.mon_command({"prefix": "mds boot",
+                                       "name": "mds.a",
+                                       "addr": "127.0.0.1:1234:99"})
+        assert ack.retcode == 0
+        ack = await admin.mon_command({"prefix": "mds dump"})
+        dump = json.loads(ack.outs)
+        assert dump["mds.a"]["addr"] == "127.0.0.1:1234:99"
+
+        await admin.mon_command({"prefix": "config-key set",
+                                 "key": "rgw/zone", "val": "us-east"})
+        ack = await admin.mon_command({"prefix": "config-key get",
+                                       "key": "rgw/zone"})
+        assert ack.outs == "us-east"
+        ack = await admin.mon_command({"prefix": "config-key ls"})
+        assert "rgw/zone" in json.loads(ack.outs)
+        await admin.mon_command({"prefix": "config-key rm",
+                                 "key": "rgw/zone"})
+        import pytest as _pytest
+        from ceph_tpu.mon.client import CommandError
+        with _pytest.raises(CommandError):
+            await admin.mon_command({"prefix": "config-key get",
+                                     "key": "rgw/zone"})
+        await cl.stop()
+    asyncio.run(run())

@@ -31,7 +31,7 @@ def test_model_checker_replicated(seed):
 @pytest.mark.xfail(
     strict=False,
     reason="KNOWN OPEN ISSUE: under kill/out-in churn an EC pg can "
-           "still serve ENOENT in ~1/3 of seeds. This round's checker "
+           "still serve ENOENT in a minority (~1/6) of seeds. The checker "
            "drove six fixes here (stale pushes, empty-authority "
            "election, adopted-log completeness/version tracking, "
            "tombstone pulls, abandoned-recovery retry, pg_temp-gated "
