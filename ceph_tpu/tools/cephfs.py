@@ -19,21 +19,24 @@ import sys
 from ceph_tpu.tools.daemons import apply_conf, load_monmap
 
 
+def _parse_addr(s: str):
+    from ceph_tpu.msg.types import EntityAddr
+    host, port, nonce = s.strip().rsplit(":", 2)
+    return EntityAddr(host, int(port), int(nonce))
+
+
 async def _mds_addr(r, cluster_dir: str, mds_id: str):
     """Resolve via the mon's fsmap (mds dump); file fallback for dirs
     whose mds predates registration."""
-    from ceph_tpu.msg.types import EntityAddr
     try:
         ack = await r.mon_command({"prefix": "mds dump"})
         ent = json.loads(ack.outs).get(f"mds.{mds_id}")
         if ent:
-            host, port, nonce = ent["addr"].rsplit(":", 2)
-            return EntityAddr(host, int(port), int(nonce))
+            return _parse_addr(ent["addr"])
     except Exception:
         pass
     path = os.path.join(cluster_dir, f"mds.{mds_id}.addr")
-    host, port, nonce = open(path).read().strip().rsplit(":", 2)
-    return EntityAddr(host, int(port), int(nonce))
+    return _parse_addr(open(path).read())
 
 
 async def run(args) -> int:

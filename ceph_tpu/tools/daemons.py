@@ -94,11 +94,16 @@ async def run_mds(args) -> None:
     mds = MDS(ctx, msgr, r, "cephfs_metadata")
     await mds.create_fs()
     # register with the mon (FSMonitor beacon) + a file fallback for
-    # offline inspection
-    await r.mon_command({"prefix": "mds boot", "name": f"mds.{args.id}",
-                         "addr": f"{addr.host}:{addr.port}:{addr.nonce}"})
+    # offline inspection; a transient registration failure must not
+    # kill the daemon — clients fall back to the file
     with open(os.path.join(args.dir, f"mds.{args.id}.addr"), "w") as f:
         f.write(f"{addr.host}:{addr.port}:{addr.nonce}")
+    try:
+        await r.mon_command(
+            {"prefix": "mds boot", "name": f"mds.{args.id}",
+             "addr": f"{addr.host}:{addr.port}:{addr.nonce}"})
+    except Exception as e:
+        ctx.logger("mds").warning(f"mds boot registration failed: {e}")
     await _run_until_signal()
     await msgr.shutdown()
     await r.shutdown()

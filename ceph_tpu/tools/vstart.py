@@ -42,13 +42,14 @@ class VCluster:
     def __init__(self, directory: str, n_osds: int = 3, n_mons: int = 1,
                  osds_per_host: int = 1,
                  conf: Optional[Dict[str, str]] = None,
-                 cephx: bool = False):
+                 cephx: bool = False, mds: bool = False):
         self.dir = os.path.abspath(directory)
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.osds_per_host = osds_per_host
         self.conf = conf or {}
         self.cephx = cephx
+        self.mds = mds
         self.procs: Dict[str, subprocess.Popen] = {}
         self.monmap = MonMap()
 
@@ -80,6 +81,7 @@ class VCluster:
             for i in range(self.n_osds):
                 kr.add(f"osd.{i}", caps={"mon": "allow profile osd",
                                          "osd": "allow *"})
+            kr.add("mds.a", caps={"mon": "allow *", "osd": "allow *"})
             kr.save(os.path.join(self.dir, "keyring"))
             conf["auth_supported"] = "cephx"
             conf["keyring"] = os.path.join(self.dir, "keyring")
@@ -102,6 +104,10 @@ class VCluster:
             self._spawn("mon", chr(ord("a") + i))
         for i in range(self.n_osds):
             self._spawn("osd", str(i))
+
+    def start_mds(self) -> None:
+        """After bootstrap (the mds needs pools + a served osdmap)."""
+        self._spawn("mds", "a")
 
     def kill_daemon(self, name: str, sig=signal.SIGKILL) -> None:
         """qa/ceph-helpers.sh kill_daemon."""
@@ -180,6 +186,8 @@ def main(argv=None) -> int:
                     help="wipe the cluster dir first (vstart -n)")
     ap.add_argument("--cephx", action="store_true",
                     help="enable cephx auth (generates a keyring)")
+    ap.add_argument("--mds", action="store_true",
+                    help="also start an mds (CephFS) after bootstrap")
     ap.add_argument("--keep-running", action="store_true",
                     help="stay attached until ^C")
     args = ap.parse_args(argv)
@@ -188,11 +196,14 @@ def main(argv=None) -> int:
         shutil.rmtree(args.dir)
     conf = dict(kv.split("=", 1) for kv in args.conf)
     cl = VCluster(args.dir, args.osds, args.mons, args.osds_per_host,
-                  conf, cephx=args.cephx)
+                  conf, cephx=args.cephx, mds=args.mds)
     cl.write_configs()
     cl.start_daemons()
     asyncio.run(cl.bootstrap())
-    print(f"cluster up: dir={cl.dir} mons={args.mons} osds={args.osds}")
+    if args.mds:
+        cl.start_mds()
+    print(f"cluster up: dir={cl.dir} mons={args.mons} osds={args.osds}"
+          + (" +mds" if args.mds else ""))
     print(f"  use: python -m ceph_tpu.tools.ceph --dir {cl.dir} status")
     if args.keep_running:
         try:
