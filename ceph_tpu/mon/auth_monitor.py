@@ -39,11 +39,16 @@ class AuthMonitor(PaxosService):
         self.file_keyring = Keyring()       # mon. master + bootstrap seeds
         self.db: Dict[str, Tuple[bytes, Dict[str, str]]] = {}
         self.pending: Dict[str, Optional[Tuple[bytes, Dict]]] = {}
-        # (src host, port, nonce) -> (entity, stamp): sessions that proved
-        # a key; entries age out after auth_ticket_ttl (the reference
-        # prunes MonSessions on close — we have no close event on the
-        # per-direction transport, so expiry stands in)
-        self.authed: Dict[tuple, Tuple[str, float]] = {}
+        # transport_id -> (entity, stamp): sessions that proved a key.
+        # Keyed by the RECEIVER-assigned socket id (msg.transport_id),
+        # never by the banner-claimed src address — that triple is fully
+        # sender-controlled and, for daemons, published in the osdmap, so
+        # keying on it let an unauthenticated peer impersonate an authed
+        # daemon (the reference binds cephx sessions to the Connection).
+        # Entries age out after auth_ticket_ttl (the reference prunes
+        # MonSessions on close; a reconnect gets a fresh transport_id and
+        # re-auths — MonClient's tickets make that transparent).
+        self.authed: Dict[int, Tuple[str, float]] = {}
         self._challenges: Dict[tuple, Tuple[bytes, float]] = {}
         path = mon.cfg["keyring"]
         if path:
@@ -118,7 +123,13 @@ class AuthMonitor(PaxosService):
         self._challenges = {k: v for k, v in self._challenges.items()
                             if now - v[1] < _CHALLENGE_TTL}
         self._prune_sessions(now)
-        skey = (m.src_addr.host, m.src_addr.port, m.src_addr.nonce)
+        skey = m.transport_id
+        if skey is None:
+            # not delivered via the messenger: no unforgeable transport
+            # identity to bind a session to — refuse
+            self.mon.reply(m, MAuthReply(m.phase, -errno.EACCES,
+                                         tid=m.tid))
+            return
         if self.master_key is None:
             self.mon.reply(m, MAuthReply(m.phase, -errno.EACCES,
                                          tid=m.tid))
@@ -130,8 +141,15 @@ class AuthMonitor(PaxosService):
                                          tid=m.tid))
             return
         stored = self._challenges.pop((skey, m.entity), None)
+        if stored is None:
+            # no challenge under THIS socket: the link reconnected
+            # between phases (fresh transport_id) or the challenge aged
+            # out — not a wrong key.  EAGAIN tells the client to restart
+            # from phase 1 rather than treating it as a denial.
+            self.mon.reply(m, MAuthReply(2, -errno.EAGAIN, tid=m.tid))
+            return
         rec = self.get_entity(m.entity)
-        if stored is None or rec is None or not cephx.hmac_eq(
+        if rec is None or not cephx.hmac_eq(
                 m.proof, cephx.auth_proof(rec[0], stored[0],
                                           m.client_challenge)):
             self.log.warning(f"auth: denied {m.entity} from {m.src_addr}")
@@ -174,11 +192,13 @@ class AuthMonitor(PaxosService):
 
     def is_authed(self, m) -> bool:
         """Did this message's sender prove a key — via the MAuth session
-        or a transport-level authorizer (messenger banner)?"""
+        on this same socket or a transport-level authorizer (messenger
+        banner)?"""
         if getattr(m, "auth_entity", None):
             return True
-        rec = self.authed.get(
-            (m.src_addr.host, m.src_addr.port, m.src_addr.nonce))
+        if m.transport_id is None:
+            return False
+        rec = self.authed.get(m.transport_id)
         return (rec is not None
                 and time.time() - rec[1] < self.mon.cfg["auth_ticket_ttl"])
 
@@ -188,8 +208,9 @@ class AuthMonitor(PaxosService):
         caps = getattr(m, "auth_caps", None)
         if caps is not None:
             return caps
-        rec = self.authed.get(
-            (m.src_addr.host, m.src_addr.port, m.src_addr.nonce))
+        if m.transport_id is None:
+            return None
+        rec = self.authed.get(m.transport_id)
         if rec is None:
             return None
         ent = self.get_entity(rec[0])
@@ -218,7 +239,23 @@ class AuthMonitor(PaxosService):
                         (m.cmd.get("caps") or {}).items()}
                 rec = (generate_key(), caps)
                 self.pending[entity] = rec
-                self.propose_pending()
+
+                # reply only once the proposal COMMITS: handing out the
+                # key first would leave the client with a keyring entry
+                # the replicated auth db never recorded if the proposal
+                # is lost to a leader change (then auth fails EACCES with
+                # no hint why)
+                def _committed(ok, rec=rec, m=m):
+                    if not ok:
+                        self.mon.reply(m, MMonCommandAck(
+                            m.tid, -errno.EAGAIN,
+                            "paxos proposal failed; retry"))
+                        return
+                    kr = Keyring()
+                    kr.add(entity, rec[0], rec[1])
+                    self.mon.reply(m, MMonCommandAck(m.tid, 0, kr.dumps()))
+                self.propose_pending(done=_committed)
+                return
             elif prefix == "auth add":
                 self.mon.reply(m, MMonCommandAck(
                     m.tid, -errno.EEXIST, f"entity {entity!r} exists"))

@@ -108,6 +108,20 @@ class MonClient(Dispatcher):
             self.log.debug(f"got osdmap {self.osdmap.summary()}")
             for ev in self._osdmap_waiters:
                 ev.set()
+        elif m.incrementals and not changed and (
+                self.osdmap is None
+                or min(m.incrementals) > self.osdmap.epoch + 1):
+            # unbridgeable: we lack the base these incrementals build on
+            # (subscribed pre-first-commit, hunted to a new mon, or the
+            # mon trimmed the range).  Silently skipping would wedge us
+            # mapless forever — re-subscribe from 0 to force a full map
+            # (OSD::osdmap_subscribe "onetime full" role)
+            self.log.warning(
+                f"osdmap incrementals {sorted(m.incrementals)} don't "
+                f"chain onto e{self.osdmap.epoch if self.osdmap else 0}; "
+                f"requesting full map")
+            self._subs["osdmap"] = 0
+            self._renew_subs()
 
     def on_osdmap(self, cb: Callable[[OSDMap], None]) -> None:
         self._map_cb.append(cb)
@@ -189,6 +203,13 @@ class MonClient(Dispatcher):
                                          client_challenge)
                 r2 = await self._auth_round(
                     MAuth(entity, 2, client_challenge, proof, want), rank)
+                if r2.result == -errno.EAGAIN:
+                    # mon lost our challenge (link reconnected between
+                    # phases, or it aged out): restart from phase 1
+                    if asyncio.get_running_loop().time() >= deadline:
+                        raise CommandError(-errno.ETIMEDOUT,
+                                           "auth timeout")
+                    continue
                 break
             except asyncio.TimeoutError:
                 rank = (rank + 1) % self.monmap.size()   # hunt

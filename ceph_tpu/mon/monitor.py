@@ -331,7 +331,13 @@ class Monitor(Dispatcher):
         if "osdmap" in sub:
             cur = self.osdmon.osdmap.epoch
             start = sub["osdmap"]
-            if start <= cur:
+            # never serve (and advance past) epoch 0: a subscriber that
+            # arrives before our first commit would get an empty push,
+            # then incrementals-only forever — which a map-less client
+            # can't bootstrap from (found by the vstart cephx race:
+            # osds subscribing to a mon still electing stayed mapless
+            # while the cluster went healthy around them)
+            if cur >= 1 and start <= cur:
                 msg = self.osdmon.build_osdmap_msg(start, cur)
                 self.messenger.send_message(msg, sub["_addr"],
                                             peer_type=sub.get("_type"))

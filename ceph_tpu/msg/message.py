@@ -54,6 +54,10 @@ class Message(Encodable):
         self.src_addr: Optional[EntityAddr] = None
         self.recv_stamp = 0.0
         self.connection = None   # receiving Connection (for replies)
+        # receiver-assigned id of the incoming socket this message rode;
+        # unforgeable (unlike src_addr, which is banner-claimed) — auth
+        # session state keys on this
+        self.transport_id: Optional[int] = None
 
     def encode_payload(self, enc: Encoder) -> None:  # default: no body
         pass

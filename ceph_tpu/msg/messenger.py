@@ -95,6 +95,7 @@ class Connection:
         self.session_key: Optional[bytes] = None
         self._auth_nonce: Optional[bytes] = None
         self._auth_verified = asyncio.Event()
+        self._auth_error: Optional[str] = None
         # identifies THIS logical connection across its tcp reconnects;
         # a fresh Connection (e.g. after mark_down) gets a fresh seq space
         self.conn_id = random.getrandbits(63)
@@ -136,6 +137,9 @@ class Connection:
                 self._read_acks(reader))
             try:
                 await self._send_banner(writer)
+                self.msgr.log.debug(
+                    f"link to {self.addr} up (replay "
+                    f"{len(self.unacked)})")
                 # replay everything not yet acked, oldest first (framed
                 # at write time so replays re-sign with the CURRENT
                 # session key, not the pre-reconnect one)
@@ -144,8 +148,9 @@ class Connection:
                 await writer.drain()
                 await self._pump(writer)
             except (OSError, asyncio.IncompleteReadError,
-                    ConnectionError):
-                pass
+                    ConnectionError) as e:
+                self.msgr.log.debug(
+                    f"link to {self.addr} dropped: {e!r}")
             finally:
                 ack_task.cancel()
                 self._writer = None
@@ -168,6 +173,7 @@ class Connection:
         authorizer = b""
         self.session_key = None
         self._auth_verified = asyncio.Event()
+        self._auth_error = None
         if self.msgr.get_authorizer_cb is not None:
             got = self.msgr.get_authorizer_cb(self.peer_type)
             if got is not None:
@@ -181,11 +187,16 @@ class Connection:
         await writer.drain()
         if self.session_key is not None:
             # wait for the acceptor's mutual proof before trusting the
-            # link with any frames (cephx authorizer reply)
+            # link with any frames (cephx authorizer reply); _read_acks
+            # also sets the event on FAILURE (with _auth_error) so a
+            # rejected handshake surfaces immediately with its real
+            # reason instead of burning the full timeout
             try:
                 await asyncio.wait_for(self._auth_verified.wait(), 10.0)
             except asyncio.TimeoutError:
                 raise ConnectionError("authorizer reply timed out")
+            if self._auth_error is not None:
+                raise ConnectionError(self._auth_error)
 
     async def _pump(self, writer: asyncio.StreamWriter) -> None:
         while not self.closed:
@@ -232,10 +243,32 @@ class Connection:
                     from ceph_tpu.auth.cephx import (
                         authorizer_reply_proof, hmac_eq)
                     if payload == b"":
-                        # acceptor has no verifier armed yet (e.g. an OSD
-                        # still inside its own boot handshake): downgrade
-                        # to an unsigned session rather than stall — the
-                        # acceptor treats us as unauthenticated anyway
+                        # acceptor claims no verifier armed.  With cephx
+                        # mandated, downgrading would let an active MITM
+                        # strip mutual auth + signing by forging this
+                        # empty frame — fail closed.  The one legitimate
+                        # window is a MON pushing to an OSD still inside
+                        # its own boot handshake (its verifier arms only
+                        # after MAuth completes, and the MAuthReply rides
+                        # THIS link): allow that downgrade; the OSD kills
+                        # unauthenticated inbound links once it arms
+                        # require_authorizer (osd/daemon.py), so the mon
+                        # re-handshakes signed right after boot.  The OSD
+                        # is the ONLY daemon type the mon dials (mds/mgr
+                        # talk through their own client stacks), so the
+                        # window stays osd-scoped — for everyone else an
+                        # empty reply can only be an attack or a bug.
+                        boot_window = (self.msgr.name.type == "mon"
+                                       and self.peer_type == "osd")
+                        if (self.msgr.cfg["auth_supported"] == "cephx"
+                                and not boot_window):
+                            self._auth_error = ("empty authorizer reply "
+                                                "(cephx required)")
+                            self._auth_verified.set()
+                            raise ConnectionError(self._auth_error)
+                        self.msgr.log.info(
+                            f"downgrading link to {self.addr} to "
+                            f"unsigned (acceptor has no verifier yet)")
                         self.session_key = None
                         self._auth_verified.set()
                     elif (self.session_key is not None
@@ -246,7 +279,9 @@ class Connection:
                     else:
                         self.msgr.log.warning(
                             f"bad authorizer reply from {self.addr}")
-                        raise ConnectionError("bad authorizer reply")
+                        self._auth_error = "bad authorizer reply"
+                        self._auth_verified.set()
+                        raise ConnectionError(self._auth_error)
         except asyncio.CancelledError:
             return
         except (OSError, asyncio.IncompleteReadError, ConnectionError):
@@ -294,6 +329,7 @@ class Messenger:
         self._peer_nonce: Dict[Tuple[str, int], int] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._in_tasks: set = set()
+        self._next_transport_id = 1    # per-incoming-socket id counter
         self._msgs_sent = 0
         self._msgs_received = 0
         # cephx hooks (msg/Messenger.h ms_get_authorizer /
@@ -380,6 +416,11 @@ class Messenger:
 
     async def _serve_peer(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
+        # receiver-assigned, unforgeable per-socket id: auth sessions bind
+        # to this, never to the banner-claimed src address (which daemons
+        # publish in the osdmap and anyone can claim)
+        transport_id = self._next_transport_id
+        self._next_transport_id += 1
         try:
             (blen,) = struct.unpack("<I",
                                     await reader.readexactly(4))
@@ -435,6 +476,17 @@ class Messenger:
                 hdr = await reader.readexactly(_FRAME_HDR.size)
                 tag, ln = _FRAME_HDR.unpack(hdr)
                 payload = await reader.readexactly(ln)
+                if self.require_authorizer and auth_ticket is None:
+                    # the bar was raised after this connection was
+                    # accepted (daemon finished its auth boot): drop the
+                    # unauthenticated link so the peer re-handshakes
+                    # with a verifiable authorizer (unacked messages
+                    # replay signed on its reconnect)
+                    self.log.info(
+                        f"dropping unauthenticated link from {peer_name} "
+                        f"{peer_addr} (authorizer now required)")
+                    raise ConnectionError(
+                        "authorizer now required; re-handshake")
                 if tag == TAG_MSG:
                     if session_key is not None:
                         from ceph_tpu.auth.cephx import (hmac_eq,
@@ -448,7 +500,7 @@ class Messenger:
                             raise ConnectionError("bad message signature")
                     self._handle_msg_frame(payload, peer_name, peer_addr,
                                            conn_id, writer,
-                                           auth_ticket)
+                                           auth_ticket, transport_id)
                 elif tag == TAG_KEEPALIVE:
                     pass
         except (OSError, asyncio.IncompleteReadError, ConnectionError):
@@ -459,7 +511,8 @@ class Messenger:
     def _handle_msg_frame(self, payload: bytes, peer_name: EntityName,
                           peer_addr: EntityAddr, conn_id: int,
                           writer: asyncio.StreamWriter,
-                          auth_ticket=None) -> None:
+                          auth_ticket=None,
+                          transport_id: Optional[int] = None) -> None:
         seq, mtype, crc = _MSG_HDR.unpack_from(payload, 0)
         body = payload[_MSG_HDR.size:]
         if zlib.crc32(body) != crc:
@@ -489,6 +542,7 @@ class Messenger:
         msg.seq = seq
         msg.src_name = peer_name
         msg.src_addr = peer_addr
+        msg.transport_id = transport_id
         if auth_ticket is not None:
             # transport-authenticated identity (verified authorizer) —
             # dispatchers gate on this, never on the claimed src_name

@@ -773,11 +773,16 @@ class ECBackend(PGBackend):
         if len(streams) < self.k:
             return None
         lens = {len(s) for s in streams.values()}
-        if len(lens) > 1:
-            # mixed generations: a shard mid-recovery (or racing a
-            # size-changing overwrite) returned a stale-length chunk.
-            # Pull every remaining candidate and decode from the best
-            # same-length cohort — k consistent shards beat an EIO
+        vers = {shard_vers.get(i, b"") for i in streams}
+        if len(lens) > 1 or len(vers) > 1:
+            # mixed generations: a shard mid-recovery (or racing an
+            # overwrite) returned a stale chunk.  Length alone can't
+            # detect the common fixed-block (RBD) case — a same-size
+            # overwrite one shard missed yields same-length,
+            # mixed-generation shards, and decoding across generations
+            # reconstructs garbage SILENTLY — so the cohort must also
+            # agree on VERSION_XATTR.  Pull every remaining candidate
+            # and decode from the best consistent cohort.
             for i in candidates:
                 if i in streams:
                     continue
@@ -798,20 +803,21 @@ class ECBackend(PGBackend):
                     if reply.attrs:
                         shard_vers[i] = reply.attrs.get(VERSION_XATTR,
                                                         b"")
-            by_len: Dict[int, Dict[int, np.ndarray]] = {}
+            cohorts: Dict[tuple, Dict[int, np.ndarray]] = {}
             for i, s in streams.items():
-                by_len.setdefault(len(s), {})[i] = s
+                cohorts.setdefault(
+                    (len(s), shard_vers.get(i, b"")), {})[i] = s
 
             def cohort_score(cohort):
                 # the NEWEST generation wins, cohort size breaks ties —
                 # equal-sized cohorts must never resolve by dict order
                 # (an acked overwrite could read back its old bytes)
-                vers = [EVersion.from_bytes(shard_vers[i])
-                        for i in cohort if shard_vers.get(i)]
-                top = max(vers) if vers else EVersion()
+                vs = [EVersion.from_bytes(shard_vers[i])
+                      for i in cohort if shard_vers.get(i)]
+                top = max(vs) if vs else EVersion()
                 return (top, len(cohort))
 
-            best = max(by_len.values(), key=cohort_score)
+            best = max(cohorts.values(), key=cohort_score)
             if len(best) < self.k:
                 return None
             streams = best

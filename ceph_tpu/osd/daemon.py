@@ -54,6 +54,7 @@ class OSD(Dispatcher):
         self._hb_last: Dict[int, float] = {}     # peer osd -> last reply
         self._map_cache: Dict[int, OSDMap] = {}
         self._hb_task: Optional[asyncio.Task] = None
+        self._boot_task: Optional[asyncio.Task] = None
         self._waiting_maps: List[Message] = []
         self.running = False
         from ceph_tpu.osd.ec_queue import ECBatchQueue
@@ -83,10 +84,15 @@ class OSD(Dispatcher):
         await self._authenticate()
         self.monc.on_osdmap(self._on_osdmap)
         self.monc.sub_want("osdmap", 0)
-        self.monc.messenger.send_message(
-            MOSDBoot(self.whoami, self.messenger.addr),
-            self.monc.monmap.addr_of_rank(0), peer_type="mon")
         self.running = True
+        # boot is RETRIED until the map shows us up (OSD::start_boot
+        # role): a single fire-and-forget MOSDBoot can land on a mon
+        # that has no quorum yet and is silently dropped — nothing else
+        # ever re-asserts a brand-new osd (build-simple only sets
+        # max_osd, so the "marked down but alive" re-boot in _on_osdmap
+        # never fires for an osd with no EXISTS state)
+        self._boot_task = asyncio.get_running_loop().create_task(
+            self._boot_loop())
         self._hb_task = asyncio.get_running_loop().create_task(
             self._heartbeat())
         self._scrub_task = asyncio.get_running_loop().create_task(
@@ -130,6 +136,8 @@ class OSD(Dispatcher):
         self.running = False
         if self._hb_task:
             self._hb_task.cancel()
+        if self._boot_task:
+            self._boot_task.cancel()
         if self._scrub_task:
             self._scrub_task.cancel()
         if self._stats_task:
@@ -645,6 +653,19 @@ class OSD(Dispatcher):
                         and self.osdmap.is_up(o):
                     peers.add(o)
         return sorted(peers)
+
+    async def _boot_loop(self) -> None:
+        """Send MOSDBoot at rotating mons until the osdmap says we're
+        up.  Rotation matters: boots are leader-only intake and the osd
+        doesn't know the leader, so spraying ranks guarantees one lands
+        once ANY quorum exists."""
+        rank = self.monc.cur_mon
+        while self.running and not self.osdmap.is_up(self.whoami):
+            self.monc.messenger.send_message(
+                MOSDBoot(self.whoami, self.messenger.addr),
+                self.monc.monmap.addr_of_rank(rank), peer_type="mon")
+            rank = (rank + 1) % self.monc.monmap.size()
+            await asyncio.sleep(1.0)
 
     async def _heartbeat(self) -> None:
         interval = self.cfg["osd_heartbeat_interval"]

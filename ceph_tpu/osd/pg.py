@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import asyncio
 import errno
+import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.crush.constants import CRUSH_ITEM_NONE
@@ -413,12 +414,26 @@ class PG:
             self.log_.warning(
                 f"{self.pgid} peering blocked: down osds {blocked} from "
                 f"a possibly-rw interval (mark lost to proceed)")
+            warned = time.monotonic()
             while True:
                 await asyncio.sleep(1.0)
+                # advance_map cancellation is the primary exit, but don't
+                # rely on it alone: bail if this PG stopped being ours
+                # (pool deleted, no longer primary) or the interval moved
+                if (epoch != self.interval_epoch or not self.is_primary()
+                        or self.pool_id not in
+                        self.osd.osdmap.pools):
+                    self.peering_blocked_by = []
+                    return
                 probe, blocked = self._build_prior_set()
                 self.peering_blocked_by = blocked
                 if not blocked:
                     break
+                if time.monotonic() - warned > 30.0:   # rate-limited
+                    warned = time.monotonic()
+                    self.log_.warning(
+                        f"{self.pgid} still blocked by down osds "
+                        f"{blocked}")
         peers = sorted(probe)
         self._probe_shards = probe
         self._strays = {p for p in probe

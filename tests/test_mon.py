@@ -232,3 +232,57 @@ def test_mon_restart_preserves_state():
         assert leader.osdmon.osdmap.lookup_pool("persist") >= 0
         await stop_all([mon2], [cmsgr])
     asyncio.run(run())
+
+
+def test_subscription_before_first_commit_bootstraps():
+    """A subscriber that arrives before the mon's first osdmap commit
+    must still bootstrap: the mon must not serve an epoch-0 push and
+    advance the cursor past the full map (vstart race: early osds
+    stayed mapless forever on incrementals they couldn't chain)."""
+    async def run():
+        monmap, mons = await start_mons(1)
+        mon = mons[0]
+        monc, msgr = await make_client(monmap)
+        # simulate the race: cursor at 0 while the mon has no map yet
+        sub = {"_addr": msgr.addr, "_type": "client", "osdmap": 0}
+        saved_epoch = mon.osdmon.osdmap.epoch
+        mon.osdmon.osdmap.epoch = 0
+        mon._push_maps_to(sub)
+        assert sub["osdmap"] == 0, \
+            "cursor must not advance past an unserved epoch-0 push"
+        mon.osdmon.osdmap.epoch = saved_epoch
+        # normal path still works end to end
+        await wait_quorum(mons)
+        monc.sub_want("osdmap", 0)
+        got = await monc.wait_for_osdmap(timeout=10)
+        assert got.epoch >= 1
+        await stop_all(mons, [msgr])
+    asyncio.run(run())
+
+
+def test_monclient_rerequests_full_on_unbridgeable_incrementals():
+    """Incrementals with no base map (or a gap) must trigger a full-map
+    re-request instead of being skipped silently."""
+    from ceph_tpu.mon.messages import MOSDMap
+
+    class _Rec:
+        def __init__(self):
+            self.sent = []
+
+        def send_message(self, msg, addr, peer_type=None):
+            self.sent.append(msg)
+
+    async def run():
+        monmap, mons = await start_mons(1)
+        await wait_quorum(mons)
+        monc, msgr = await make_client(monmap)
+        monc._subs["osdmap"] = 5
+        rec = _Rec()
+        monc.messenger = rec            # capture the re-subscription
+        m = MOSDMap()
+        m.incrementals[7] = b"\x00"     # no base: cannot chain onto None
+        monc._handle_osdmap(m)
+        assert monc._subs["osdmap"] == 0, "must reset to request full map"
+        assert rec.sent, "must re-send the subscription"
+        await stop_all(mons, [msgr])
+    asyncio.run(run())
