@@ -374,9 +374,9 @@ def main():
     if tpu_up:
         crush_env = {}
     else:
-        pp = [p for p in os.environ.get("PYTHONPATH", "").split(":")
-              if p and "axon" not in p]
-        crush_env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ":".join(pp)}
+        from ceph_tpu.common.envutil import pythonpath_without_tpu_plugin
+        crush_env = {"JAX_PLATFORMS": "cpu",
+                     "PYTHONPATH": pythonpath_without_tpu_plugin()}
     crush = None
     if os.environ.get("BENCH_SKIP_CRUSH") != "1":
         reserve = 240 if tpu_up else 0

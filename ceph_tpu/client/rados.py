@@ -286,6 +286,20 @@ class IoCtx:
             raise ObjectOperationError(reply.ops[0].rval, oid)
         return reply.ops[0].outdata
 
+    async def exec(self, oid: str, cls: str, method: str,
+                   inbl: bytes = b"") -> bytes:
+        """Execute an object-class method server-side (librados exec /
+        CEPH_OSD_OP_CALL).  Raises ObjectOperationError on a negative
+        method rval; returns the method's output buffer."""
+        from ceph_tpu.osd.messages import OP_CALL
+        reply = await self._op(oid, [OSDOp(OP_CALL,
+                                           name=f"{cls}.{method}",
+                                           data=inbl)])
+        op = reply.ops[0]
+        if op.rval < 0:
+            raise ObjectOperationError(op.rval, oid)
+        return op.outdata
+
     async def setxattr(self, oid: str, name: str, value: bytes) -> None:
         await self._op(oid, [OSDOp(OP_SETXATTR, name=name, data=value)])
 

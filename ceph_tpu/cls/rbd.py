@@ -1,0 +1,89 @@
+"""cls_rbd: image header + directory methods executed on the OSD.
+
+Reference parity: src/cls/rbd/cls_rbd.cc — librbd never raw-writes its
+header; every header mutation is a class method next to the data, so
+concurrent clients (or a client racing rbd-mirror) serialize through
+the PG instead of losing read-modify-write races.  Subset: header
+create/get/set-size and the rbd_directory add/remove/list (the
+reference's dir_add_image/dir_remove_image over omap; ours uses omap
+too, so the directory object belongs on a replicated pool — the same
+place the reference's rbd_directory lives).
+
+Header layout matches services/rbd.py: xattrs rbd.size / rbd.order /
+rbd.stripe_unit / rbd.stripe_count on rbd_header.<id>.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+
+from ceph_tpu.cls import ClsContext, cls_method
+
+_FIELDS = ("size", "order", "stripe_unit", "stripe_count")
+
+
+@cls_method("rbd.create_header", writes=True)
+def create_header(hctx: ClsContext, inbl: bytes):
+    """in: {size, order, stripe_unit, stripe_count} — refuses to
+    clobber an existing image header (-EEXIST)."""
+    req = json.loads(inbl.decode())
+    if hctx.exists():
+        return -errno.EEXIST, b""
+    hctx.create()
+    for f in _FIELDS:
+        hctx.setxattr(f"rbd.{f}", str(int(req[f])).encode())
+    return 0, b""
+
+
+@cls_method("rbd.get_header", writes=False)
+def get_header(hctx: ClsContext, inbl: bytes):
+    """-> {size, order, stripe_unit, stripe_count} as json."""
+    out = {}
+    for f in _FIELDS:
+        raw = hctx.getxattr(f"rbd.{f}")
+        if raw is None:
+            return -errno.ENOENT, b""
+        out[f] = int(raw)
+    return 0, json.dumps(out).encode()
+
+
+@cls_method("rbd.set_size", writes=True)
+def set_size(hctx: ClsContext, inbl: bytes):
+    """in: {size} — guarded on the header existing (cls_rbd set_size)."""
+    req = json.loads(inbl.decode())
+    if hctx.getxattr("rbd.size") is None:
+        return -errno.ENOENT, b""
+    hctx.setxattr("rbd.size", str(int(req["size"])).encode())
+    return 0, b""
+
+
+# ---- rbd_directory (cls_rbd dir_add_image / dir_remove_image) ----
+
+@cls_method("rbd.dir_add", writes=True)
+def dir_add(hctx: ClsContext, inbl: bytes):
+    """in: {name} — atomic add-if-absent into the directory omap."""
+    req = json.loads(inbl.decode())
+    key = req["name"].encode()
+    if key in hctx.omap_get():
+        return -errno.EEXIST, b""
+    if not hctx.exists():
+        hctx.create()
+    hctx.omap_set({key: b"1"})
+    return 0, b""
+
+
+@cls_method("rbd.dir_remove", writes=True)
+def dir_remove(hctx: ClsContext, inbl: bytes):
+    req = json.loads(inbl.decode())
+    key = req["name"].encode()
+    if key not in hctx.omap_get():
+        return -errno.ENOENT, b""
+    hctx.omap_rm([key])
+    return 0, b""
+
+
+@cls_method("rbd.dir_list", writes=False)
+def dir_list(hctx: ClsContext, inbl: bytes):
+    names = sorted(k.decode() for k in hctx.omap_get())
+    return 0, json.dumps(names).encode()

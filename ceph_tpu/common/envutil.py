@@ -1,0 +1,38 @@
+"""Child-process environment helpers.
+
+One shared definition of "launch a python child without the TPU
+plugin": the plugin's site dir carries a sitecustomize that imports jax
+at interpreter startup (seconds of source compile per process with
+bytecode caching off, and a wedged device runtime can hang it), so
+every spawner of CPU-bound helper processes — vstart daemons, bench.py
+stages — must strip it the same way.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+# substring identifying the TPU plugin's site dir on PYTHONPATH
+_TPU_PLUGIN_MARK = "axon"
+
+
+def pythonpath_without_tpu_plugin(extra_first: str = "") -> str:
+    """Current PYTHONPATH minus the TPU plugin site dir, optionally with
+    `extra_first` prepended."""
+    parts = [p for p in os.environ.get("PYTHONPATH", "").split(":")
+             if p and _TPU_PLUGIN_MARK not in os.path.basename(p.rstrip("/"))]
+    if extra_first:
+        parts.insert(0, extra_first)
+    return ":".join(parts)
+
+
+def cpu_child_env(extra: Optional[Dict[str, str]] = None,
+                  pythonpath_first: str = "") -> Dict[str, str]:
+    """Environment for a CPU-only python child: TPU plugin stripped,
+    JAX_PLATFORMS forced to cpu (unless the caller overrides)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = pythonpath_without_tpu_plugin(pythonpath_first)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra or {})
+    return env

@@ -1,0 +1,316 @@
+"""CrushCompiler: the textual crushmap dialect, both directions.
+
+Reference parity: src/crush/CrushCompiler.cc + src/crush/grammar.h — the
+`crushtool -d` / `crushtool -c` text form:
+
+    # begin crush map
+    tunable choose_total_tries 50
+    device 0 osd.0
+    type 0 osd
+    type 1 host
+    host host0 {
+        id -1
+        alg straw2
+        hash 0  # rjenkins1
+        item osd.0 weight 1.000000
+    }
+    rule replicated_rule {
+        ruleset 0
+        type replicated
+        min_size 1
+        max_size 10
+        step take default
+        step chooseleaf firstn 0 type host
+        step emit
+    }
+    # end crush map
+
+Redesigned without boost::spirit: a line-oriented tokenizer (comments
+stripped, braces as block markers) feeding small per-section parsers.
+Weights print with 6 decimals so the 16.16 fixed-point values survive
+the text round-trip exactly (1/65536 ~ 1.5e-5 > 0.5e-6 print error);
+buckets must be defined before they are referenced, like the reference.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+from ceph_tpu.crush.builder import make_bucket
+from ceph_tpu.crush.constants import (
+    BUCKET_ALG_NAMES, HASH_RJENKINS1,
+    RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP, RULE_CHOOSE_FIRSTN,
+    RULE_CHOOSE_INDEP, RULE_EMIT, RULE_SET_CHOOSELEAF_STABLE,
+    RULE_SET_CHOOSELEAF_TRIES, RULE_SET_CHOOSELEAF_VARY_R,
+    RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES, RULE_SET_CHOOSE_LOCAL_TRIES,
+    RULE_SET_CHOOSE_TRIES, RULE_TAKE,
+)
+from ceph_tpu.crush.types import CrushMap, Rule, RuleStep
+
+_ALG_IDS = {name: alg for alg, name in BUCKET_ALG_NAMES.items()}
+_RULE_TYPE_NAMES = {1: "replicated", 3: "erasure"}
+_RULE_TYPE_IDS = {v: k for k, v in _RULE_TYPE_NAMES.items()}
+_SET_STEPS = {
+    "set_choose_tries": RULE_SET_CHOOSE_TRIES,
+    "set_chooseleaf_tries": RULE_SET_CHOOSELEAF_TRIES,
+    "set_choose_local_tries": RULE_SET_CHOOSE_LOCAL_TRIES,
+    "set_choose_local_fallback_tries": RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES,
+    "set_chooseleaf_vary_r": RULE_SET_CHOOSELEAF_VARY_R,
+    "set_chooseleaf_stable": RULE_SET_CHOOSELEAF_STABLE,
+}
+_SET_STEP_NAMES = {v: k for k, v in _SET_STEPS.items()}
+_CHOOSE_STEPS = {
+    ("choose", "firstn"): RULE_CHOOSE_FIRSTN,
+    ("choose", "indep"): RULE_CHOOSE_INDEP,
+    ("chooseleaf", "firstn"): RULE_CHOOSELEAF_FIRSTN,
+    ("chooseleaf", "indep"): RULE_CHOOSELEAF_INDEP,
+}
+_CHOOSE_STEP_NAMES = {v: k for k, v in _CHOOSE_STEPS.items()}
+
+_TUNABLES = ("choose_local_tries", "choose_local_fallback_tries",
+             "choose_total_tries", "chooseleaf_descend_once",
+             "chooseleaf_vary_r", "chooseleaf_stable",
+             "straw_calc_version")
+
+
+class CompileError(ValueError):
+    pass
+
+
+def _w2s(w: int) -> str:
+    return f"{w / 0x10000:.6f}"
+
+
+def _s2w(s: str) -> int:
+    return int(round(float(s) * 0x10000))
+
+
+# ---------------------------------------------------------------- decompile
+
+def decompile(m: CrushMap) -> str:
+    """CrushMap -> reference-dialect text (CrushCompiler::decompile)."""
+    out: List[str] = ["# begin crush map"]
+    for t in _TUNABLES:
+        out.append(f"tunable {t} {getattr(m.tunables, t)}")
+    out.append("")
+    out.append("# devices")
+    for dev in range(m.max_devices):
+        name = m.name_map.get(dev)
+        if name is not None:
+            out.append(f"device {dev} {name}")
+    out.append("")
+    out.append("# types")
+    for tid in sorted(m.type_map):
+        out.append(f"type {tid} {m.type_map[tid]}")
+    out.append("")
+    out.append("# buckets")
+    # definition must precede reference: emit leaf-most first (reverse
+    # id order matches builder output; fall back to dependency sort)
+    done: set = set()
+    order: List[int] = []
+
+    def visit(bid: int) -> None:
+        if bid in done:
+            return
+        done.add(bid)
+        b = m.bucket(bid)
+        if b is None:
+            return
+        for it in b.items:
+            if it < 0:
+                visit(it)
+        order.append(bid)
+
+    for b in m.buckets:
+        if b is not None:
+            visit(b.id)
+    for bid in order:
+        b = m.bucket(bid)
+        tname = m.type_map.get(b.type, str(b.type))
+        out.append(f"{tname} {m.name_of(b.id)} {{")
+        out.append(f"\tid {b.id}\t\t# do not change unnecessarily")
+        out.append(f"\t# weight {_w2s(b.weight)}")
+        out.append(f"\talg {BUCKET_ALG_NAMES[b.alg]}")
+        out.append(f"\thash {b.hash}\t# rjenkins1")
+        for it, w in zip(b.items, b.item_weights):
+            out.append(f"\titem {m.name_of(it)} weight {_w2s(w)}")
+        out.append("}")
+    out.append("")
+    out.append("# rules")
+    for rid, r in enumerate(m.rules):
+        if r is None:
+            continue
+        out.append(f"rule {m.rule_name_map.get(rid, f'rule{rid}')} {{")
+        out.append(f"\truleset {r.ruleset}")
+        out.append(f"\ttype {_RULE_TYPE_NAMES.get(r.type, str(r.type))}")
+        out.append(f"\tmin_size {r.min_size}")
+        out.append(f"\tmax_size {r.max_size}")
+        for s in r.steps:
+            if s.op == RULE_TAKE:
+                out.append(f"\tstep take {m.name_of(s.arg1)}")
+            elif s.op == RULE_EMIT:
+                out.append("\tstep emit")
+            elif s.op in _CHOOSE_STEP_NAMES:
+                kind, mode = _CHOOSE_STEP_NAMES[s.op]
+                tname = m.type_map.get(s.arg2, str(s.arg2))
+                out.append(f"\tstep {kind} {mode} {s.arg1} type {tname}")
+            elif s.op in _SET_STEP_NAMES:
+                out.append(f"\tstep {_SET_STEP_NAMES[s.op]} {s.arg1}")
+            else:
+                raise CompileError(f"cannot decompile step op {s.op}")
+        out.append("}")
+    out.append("")
+    out.append("# end crush map")
+    return "\n".join(out) + "\n"
+
+
+# ------------------------------------------------------------------ compile
+
+def compile_text(text: str) -> CrushMap:
+    """Reference-dialect text -> CrushMap (CrushCompiler::compile).
+
+    Buckets must be defined before they are referenced (same constraint
+    as the reference's single-pass grammar).
+    """
+    m = CrushMap()
+    m.type_map = {}
+    names: Dict[str, int] = {}          # item name -> id
+
+    # tokenize: strip comments, split into statements; `{...}` blocks
+    # become (header_tokens, [line_tokens...])
+    lines: List[List[str]] = []
+    for raw in text.splitlines():
+        line = re.sub(r"#.*", "", raw).strip()
+        if line:
+            lines.append(line.replace("{", " { ").replace("}", " } ")
+                         .split())
+    i = 0
+
+    def parse_block(start: int):
+        """-> (body_lines, next_index); start points at the header."""
+        if lines[start][-1] != "{":
+            raise CompileError(f"expected '{{' in {' '.join(lines[start])}")
+        body = []
+        j = start + 1
+        while j < len(lines) and lines[j] != ["}"]:
+            body.append(lines[j])
+            j += 1
+        if j >= len(lines):
+            raise CompileError("unterminated block")
+        return body, j + 1
+
+    while i < len(lines):
+        tok = lines[i]
+        if tok[0] == "tunable" and len(tok) == 3:
+            if tok[1] not in _TUNABLES:
+                raise CompileError(f"unknown tunable {tok[1]!r}")
+            setattr(m.tunables, tok[1], int(tok[2]))
+            i += 1
+        elif tok[0] == "device" and len(tok) >= 3:
+            dev = int(tok[1])
+            names[tok[2]] = dev
+            m.name_map[dev] = tok[2]
+            m.max_devices = max(m.max_devices, dev + 1)
+            i += 1
+        elif tok[0] == "type" and len(tok) == 3:
+            m.type_map[int(tok[1])] = tok[2]
+            i += 1
+        elif tok[0] == "rule" and len(tok) >= 2:
+            body, i = parse_block(i)
+            _parse_rule(m, tok[1] if len(tok) > 2 else "rule",
+                        body, names)
+        elif tok[0] in m.type_map.values() and len(tok) >= 2:
+            body, i = parse_block(i)
+            _parse_bucket(m, tok[0], tok[1], body, names)
+        else:
+            raise CompileError(f"cannot parse: {' '.join(tok)}")
+    return m
+
+
+def _parse_bucket(m: CrushMap, type_name: str, name: str,
+                  body: List[List[str]], names: Dict[str, int]) -> None:
+    type_id = next(t for t, n in m.type_map.items() if n == type_name)
+    bucket_id = 0
+    alg = "straw2"
+    hash_ = HASH_RJENKINS1
+    items: List[int] = []
+    weights: List[int] = []
+    for tok in body:
+        if tok[0] == "id":
+            bucket_id = int(tok[1])
+        elif tok[0] == "alg":
+            alg = tok[1]
+        elif tok[0] == "hash":
+            hash_ = int(tok[1])
+        elif tok[0] == "item":
+            if tok[1] not in names:
+                raise CompileError(
+                    f"bucket {name!r}: item {tok[1]!r} not defined yet")
+            items.append(names[tok[1]])
+            w = 0x10000
+            if len(tok) >= 4 and tok[2] == "weight":
+                w = _s2w(tok[3])
+            weights.append(w)
+        elif tok[0] == "weight":
+            pass                     # total is derived
+        else:
+            raise CompileError(f"bucket {name!r}: bad line {tok}")
+    if alg not in _ALG_IDS:
+        raise CompileError(f"bucket {name!r}: unknown alg {alg!r}")
+    b = make_bucket(m, _ALG_IDS[alg], type_id, items, weights,
+                    bucket_id=bucket_id, hash_=hash_)
+    names[name] = b.id
+    m.name_map[b.id] = name
+
+
+def _parse_rule(m: CrushMap, name: str, body: List[List[str]],
+                names: Dict[str, int]) -> None:
+    ruleset = len(m.rules)
+    rtype, min_size, max_size = 1, 1, 10
+    steps: List[RuleStep] = []
+    for tok in body:
+        if tok[0] == "ruleset":
+            ruleset = int(tok[1])
+        elif tok[0] == "type":
+            rtype = _RULE_TYPE_IDS.get(tok[1])
+            if rtype is None:
+                try:
+                    rtype = int(tok[1])
+                except ValueError:
+                    raise CompileError(f"rule {name!r}: bad type {tok[1]!r}")
+        elif tok[0] == "min_size":
+            min_size = int(tok[1])
+        elif tok[0] == "max_size":
+            max_size = int(tok[1])
+        elif tok[0] == "step":
+            steps.append(_parse_step(m, name, tok[1:], names))
+        else:
+            raise CompileError(f"rule {name!r}: bad line {tok}")
+    rid = m.add_rule(Rule(ruleset=ruleset, type=rtype, min_size=min_size,
+                          max_size=max_size, steps=steps))
+    m.rule_name_map[rid] = name
+
+
+def _parse_step(m: CrushMap, rule: str, tok: List[str],
+                names: Dict[str, int]) -> RuleStep:
+    if tok[0] == "take":
+        if tok[1] not in names:
+            raise CompileError(f"rule {rule!r}: take of undefined "
+                               f"{tok[1]!r}")
+        return RuleStep(RULE_TAKE, names[tok[1]])
+    if tok[0] == "emit":
+        return RuleStep(RULE_EMIT)
+    if tok[0] in ("choose", "chooseleaf"):
+        # step choose[leaf] firstn|indep N type T
+        op = _CHOOSE_STEPS.get((tok[0], tok[1]))
+        if op is None or len(tok) != 5 or tok[3] != "type":
+            raise CompileError(f"rule {rule!r}: bad step {tok}")
+        tid = next((t for t, n in m.type_map.items() if n == tok[4]),
+                   None)
+        if tid is None:
+            raise CompileError(f"rule {rule!r}: unknown type {tok[4]!r}")
+        return RuleStep(op, int(tok[2]), tid)
+    if tok[0] in _SET_STEPS:
+        return RuleStep(_SET_STEPS[tok[0]], int(tok[1]))
+    raise CompileError(f"rule {rule!r}: unknown step {tok[0]!r}")

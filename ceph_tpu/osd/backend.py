@@ -27,7 +27,8 @@ from ceph_tpu.osd.messages import (
     EVersion, MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDOp, MOSDRepOp, MOSDRepOpReply, MPGPush,
     OSDOp,
-    OP_APPEND, OP_ASSERT_EXISTS, OP_CMPXATTR, OP_CREATE, OP_DELETE,
+    OP_APPEND, OP_ASSERT_EXISTS, OP_CALL, OP_CMPXATTR, OP_CREATE,
+    OP_DELETE,
     OP_GETXATTR, OP_GETXATTRS, OP_LIST_SNAPS, OP_NOTIFY,
     OP_OMAP_GET_HEADER, OP_OMAP_GET_VALS, OP_OMAP_RM_KEYS, OP_OMAP_SET,
     OP_OMAP_SET_HEADER, OP_PGLS, OP_READ, OP_RMXATTR, OP_ROLLBACK,
@@ -258,6 +259,10 @@ def execute_read_op(store, cid, soid, op: OSDOp) -> int:
         elif op.op == OP_OMAP_GET_HEADER:
             op.outdata = store.omap_get(cid, soid)[0]
             op.rval = 0
+        elif op.op == OP_CALL:
+            from ceph_tpu import cls as cls_mod
+            hctx = cls_mod.ClsContext(store, cid, soid, staged=None)
+            op.rval, op.outdata = cls_mod.call(op.name, hctx, op.data)
         else:
             op.rval = -errno.EOPNOTSUPP
     except (NoSuchObject, NoSuchCollection):
@@ -351,10 +356,16 @@ class ReplicatedBackend(PGBackend):
             if src is not None:
                 txn.remove(pg.cid, soid)
                 txn.clone(pg.cid, src, soid)
-        result, deletes = build_write_txn(
+        # object-class write methods run HERE, against committed state,
+        # and their staged logical ops splice into the batch (cls)
+        from ceph_tpu import cls as cls_mod
+        rv, batch_ops = cls_mod.expand_write_calls(
             self.osd.store, pg.cid, soid,
-            [op for op in m.ops if op.op not in (OP_ROLLBACK, OP_WATCH)],
-            txn)
+            [op for op in m.ops if op.op not in (OP_ROLLBACK, OP_WATCH)])
+        if rv < 0:
+            return rv
+        result, deletes = build_write_txn(
+            self.osd.store, pg.cid, soid, batch_ops, txn)
         if result < 0:
             return result
         # object digest (data_digest role): full-object writes record the
@@ -521,7 +532,25 @@ class ECBackend(PGBackend):
                 rv = await self._read_op(m.oid, op, m.snapid)
                 if rv < 0:
                     return rv
-        writes = [op for op in m.ops
+        # cls write methods: xattr reads hit the local shard (xattrs
+        # replicate everywhere), object size comes from SIZE_XATTR, and
+        # whole-object data reads are refused (shards hold chunks) —
+        # staged ops then translate like client ops, so a method
+        # staging omap gets the same EOPNOTSUPP a client would
+        from ceph_tpu import cls as cls_mod
+
+        def _no_data_read(offset=0, length=-1):
+            raise cls_mod._DataReadUnsupported()
+
+        def _ec_size():
+            return int(self.osd.store.getattr(pg.cid, soid, SIZE_XATTR))
+
+        rv, batch_ops = cls_mod.expand_write_calls(
+            self.osd.store, pg.cid, soid, m.ops,
+            read_fn=_no_data_read, size_fn=_ec_size)
+        if rv < 0:
+            return rv
+        writes = [op for op in batch_ops
                   if op.is_write() and op.op != OP_WATCH]
         unsupported = {OP_WRITE, OP_APPEND, OP_ZERO, OP_OMAP_SET,
                        OP_OMAP_RM_KEYS, OP_OMAP_SET_HEADER}
@@ -671,6 +700,21 @@ class ECBackend(PGBackend):
                 op.rval = -errno.ENOENT
                 return op.rval
             snap = 0 if soid == head else soid.snap
+        if op.op == OP_CALL:
+            # read-class methods: local-shard xattrs/omap + SIZE_XATTR
+            # size; whole-object data reads are refused on EC
+            from ceph_tpu import cls as cls_mod
+
+            def _no_data_read(offset=0, length=-1):
+                raise cls_mod._DataReadUnsupported()
+
+            hctx = cls_mod.ClsContext(
+                self.osd.store, pg.cid, soid, staged=None,
+                read_fn=_no_data_read,
+                size_fn=lambda: int(self.osd.store.getattr(
+                    pg.cid, soid, SIZE_XATTR)))
+            op.rval, op.outdata = cls_mod.call(op.name, hctx, op.data)
+            return op.rval
         if op.op in (OP_GETXATTR, OP_GETXATTRS, OP_STAT, OP_CMPXATTR,
                      OP_ASSERT_EXISTS):
             # xattrs are replicated on every shard; size is in SIZE_XATTR

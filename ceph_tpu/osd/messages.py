@@ -41,6 +41,8 @@ OP_PGLS = 40          # list objects in pg (rados ls)
 OP_LIST_SNAPS = 41    # per-object SnapSet dump (librados list_snaps)
 OP_WATCH = 50         # op.offset: 1 = watch, 0 = unwatch
 OP_NOTIFY = 51        # fan payload out to watchers, gather acks
+OP_CALL = 60          # object-class method: op.name = "class.method",
+#                       op.data = input (objclass.h CEPH_OSD_OP_CALL)
 
 WRITE_OPS = {OP_WRITE, OP_WRITEFULL, OP_APPEND, OP_TRUNCATE, OP_ZERO,
              OP_DELETE, OP_CREATE, OP_ROLLBACK, OP_SETXATTR, OP_RMXATTR,
@@ -85,6 +87,11 @@ class OSDOp(Encodable):
         return o
 
     def is_write(self) -> bool:
+        if self.op == OP_CALL:
+            # write-ness comes from the method registry (the reference
+            # flags CLS_METHOD_WR at registration)
+            from ceph_tpu.cls import method_is_write
+            return method_is_write(self.name)
         return self.op in WRITE_OPS
 
 

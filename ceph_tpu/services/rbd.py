@@ -29,6 +29,15 @@ from ceph_tpu.services.striper import Layout, extents_by_object
 
 RBD_DIRECTORY = "rbd_directory"
 DEFAULT_ORDER = 22                  # 4 MiB objects
+LOCK_NAME = "rbd_lock"              # librbd RBD_LOCK_NAME
+LOCK_TTL = 30.0                     # exclusive-lock TTL; holders renew
+#                                     at TTL/3, so only DEAD holders age
+#                                     out (watch-liveness role)
+
+
+def os_urandom_hex(n: int = 8) -> str:
+    import os
+    return os.urandom(n).hex()
 
 
 class RBDError(Exception):
@@ -41,6 +50,55 @@ class ImageNotFound(RBDError):
 
 class ImageExists(RBDError):
     pass
+
+
+class ImageBusy(RBDError):
+    """Another client holds the image's exclusive lock."""
+
+
+def _client_entity(ioctx) -> str:
+    """A stable per-client lock identity (entity + messenger nonce)."""
+    ms = ioctx.rados.messenger
+    return f"{ms.name}:{ms.nonce}"
+
+
+async def _cls_lock(ioctx, oid: str, name: str, entity: str,
+                    cookie: str, duration: float = 0.0,
+                    wait: float = 0.0) -> None:
+    """Take the exclusive cls lock; duration > 0 adds a TTL (crashed
+    holders self-heal), wait > 0 retries EBUSY with backoff that long
+    (concurrent holders serialize instead of erroring)."""
+    import asyncio as _asyncio
+    import errno as _errno
+    import json as _json
+    import time as _time
+    from ceph_tpu.client.objecter import ObjectOperationError
+    deadline = _time.monotonic() + wait
+    while True:
+        try:
+            await ioctx.exec(oid, "lock", "lock", _json.dumps(
+                {"name": name, "type": "exclusive", "entity": entity,
+                 "cookie": cookie, "duration": duration}).encode())
+            return
+        except ObjectOperationError as e:
+            if e.retcode == -_errno.EEXIST:    # re-lock by us is fine
+                return
+            if e.retcode != -_errno.EBUSY:
+                raise
+            if _time.monotonic() >= deadline:
+                raise ImageBusy(oid)
+            await _asyncio.sleep(0.05)
+
+
+async def _cls_unlock(ioctx, oid: str, name: str, entity: str,
+                      cookie: str) -> None:
+    import json as _json
+    from ceph_tpu.client.objecter import ObjectOperationError
+    try:
+        await ioctx.exec(oid, "lock", "unlock", _json.dumps(
+            {"name": name, "entity": entity, "cookie": cookie}).encode())
+    except ObjectOperationError:
+        pass                                # already gone / object deleted
 
 
 def _header_oid(img_id: str) -> str:
@@ -71,22 +129,50 @@ class RBD:
     async def create(self, name: str, size: int,
                      order: int = DEFAULT_ORDER,
                      stripe_unit: int = 0, stripe_count: int = 1) -> None:
+        import errno as _errno
+        import json as _json
+        from ceph_tpu.client.objecter import ObjectOperationError
         if not (12 <= order <= 26):
             raise RBDError(f"order {order} out of range [12, 26]")
         object_size = 1 << order
         stripe_unit = stripe_unit or object_size
         Layout(stripe_unit, stripe_count, object_size).validate()
-        existing = await self.list()
-        if name in existing:
-            raise ImageExists(name)
         img_id = name                     # id == name (no rename support)
-        hdr = _header_oid(img_id)
-        await self.io.write_full(hdr, b"")
-        for k, v in (("size", size), ("order", order),
-                     ("stripe_unit", stripe_unit),
-                     ("stripe_count", stripe_count)):
-            await self.io.setxattr(hdr, f"rbd.{k}", str(v).encode())
-        await self._write_directory(existing + [name])
+        # header creation is a server-side class method: create-if-absent
+        # is atomic in the PG, so two racing creates can't both win
+        # (cls_rbd create role)
+        try:
+            await self.io.exec(
+                _header_oid(img_id), "rbd", "create_header",
+                _json.dumps({"size": size, "order": order,
+                             "stripe_unit": stripe_unit,
+                             "stripe_count": stripe_count}).encode())
+        except ObjectOperationError as e:
+            if e.retcode == -_errno.EEXIST:
+                raise ImageExists(name)
+            raise
+        await self._dir_update(add=name)
+
+    async def _dir_update(self, add: str = "", drop: str = "") -> None:
+        """Directory read-modify-write under a cls_lock: concurrent
+        create/remove serialize server-side instead of losing entries.
+        (The directory stays a data object — not omap — so it works on
+        EC pools; the reference's omap rbd_directory assumes a
+        replicated pool.)"""
+        entity = _client_entity(self.io)
+        cookie = f"dir-{os_urandom_hex()}"
+        # TTL'd + retried: a crashed client's lock expires instead of
+        # wedging every create/remove, and concurrent creates serialize
+        await _cls_lock(self.io, RBD_DIRECTORY, "rbd_dir", entity, cookie,
+                        duration=10.0, wait=30.0)
+        try:
+            names = [n for n in await self.list() if n != drop]
+            if add and add not in names:
+                names.append(add)
+            await self._write_directory(names)
+        finally:
+            await _cls_unlock(self.io, RBD_DIRECTORY, "rbd_dir", entity,
+                              cookie)
 
     async def remove(self, name: str) -> None:
         img = await Image.open(self.io, name)
@@ -104,8 +190,7 @@ class RBD:
             await self.io.remove(_header_oid(img.id))
         except Exception:
             pass
-        await self._write_directory(
-            [n for n in await self.list() if n != name])
+        await self._dir_update(drop=name)
 
 
 class Image:
@@ -128,6 +213,10 @@ class Image:
         self._obj_locks: Dict[str, asyncio.Lock] = {}
         self._cacher = None      # ObjectCacher when opened cached=True
         self._journal = None     # Journaler when opened journaling=True
+        # exclusive-lock feature (librbd ExclusiveLock): held from open
+        # to close; guards multi-client RMW on the same image
+        self._lock_cookie: Optional[str] = None
+        self._lock_task: Optional[asyncio.Task] = None
 
     def _obj_lock(self, oid: str) -> asyncio.Lock:
         lock = self._obj_locks.get(oid)
@@ -139,26 +228,41 @@ class Image:
     async def open(cls, ioctx, name: str, cached: bool = False,
                    cache_max_dirty: int = 8 << 20,
                    cache_max_bytes: int = 32 << 20,
-                   journaling: bool = False) -> "Image":
+                   journaling: bool = False,
+                   exclusive: bool = False) -> "Image":
         """cached=True puts an ObjectCacher (write-back) between the
         image and its data objects — librbd's rbd_cache=true
         (librbd/ImageCtx.cc object_cacher init).  Call close() to flush
         before dropping the handle.  journaling=True records every
         mutation to the image journal BEFORE applying it (the librbd
-        journaling feature rbd-mirror replays)."""
+        journaling feature rbd-mirror replays).  exclusive=True takes
+        the image's exclusive lock (cls_lock on the header, librbd
+        ExclusiveLock role) for the life of the handle — a second
+        exclusive open raises ImageBusy instead of silently racing
+        read-modify-writes."""
+        import json as _json
+        from ceph_tpu.client.objecter import ObjectOperationError
         img_id = name
         hdr = _header_oid(img_id)
-
-        async def attr(key):
-            return int(await ioctx.getxattr(hdr, f"rbd.{key}"))
         try:
-            size = await attr("size")
-            order = await attr("order")
-            layout = Layout(await attr("stripe_unit"),
-                            await attr("stripe_count"), 1 << order)
-        except Exception:
+            # one server-side call instead of four xattr round-trips
+            raw = await ioctx.exec(hdr, "rbd", "get_header")
+            h = _json.loads(raw.decode())
+        except ObjectOperationError:
             raise ImageNotFound(name)
-        img = cls(ioctx, name, img_id, size, order, layout)
+        order = h["order"]
+        layout = Layout(h["stripe_unit"], h["stripe_count"], 1 << order)
+        img = cls(ioctx, name, img_id, h["size"], order, layout)
+        if exclusive:
+            cookie = os_urandom_hex()
+            await _cls_lock(ioctx, hdr, LOCK_NAME,
+                            _client_entity(ioctx), cookie,
+                            duration=LOCK_TTL)
+            img._lock_cookie = cookie
+            # heartbeat: renew the TTL so only a DEAD holder's lock
+            # expires (librbd ExclusiveLock + watch liveness role)
+            img._lock_task = asyncio.get_running_loop().create_task(
+                img._renew_lock())
         if cached:
             from ceph_tpu.client.object_cacher import ObjectCacher
             img._cacher = ObjectCacher(
@@ -379,8 +483,9 @@ class Image:
                     except Exception:
                         pass
         self.size = new_size
-        await self.io.setxattr(_header_oid(self.id), "rbd.size",
-                               str(new_size).encode())
+        import json as _json
+        await self.io.exec(_header_oid(self.id), "rbd", "set_size",
+                           _json.dumps({"size": new_size}).encode())
 
     async def flush(self) -> None:
         """Uncached writes are synchronous acks; with the ObjectCacher
@@ -388,7 +493,30 @@ class Image:
         if self._cacher is not None:
             await self._cacher.flush_all()
 
+    async def _renew_lock(self) -> None:
+        import json as _json
+        from ceph_tpu.client.objecter import ObjectOperationError
+        while self._lock_cookie is not None:
+            await asyncio.sleep(LOCK_TTL / 3)
+            try:
+                await self.io.exec(
+                    _header_oid(self.id), "lock", "lock",
+                    _json.dumps({
+                        "name": LOCK_NAME, "type": "exclusive",
+                        "entity": _client_entity(self.io),
+                        "cookie": self._lock_cookie, "renew": True,
+                        "duration": LOCK_TTL}).encode())
+            except (ObjectOperationError, asyncio.CancelledError):
+                return
+
     async def close(self) -> None:
         if self._cacher is not None:
             await self._cacher.stop()     # flushes
             self._cacher = None
+        if self._lock_task is not None:
+            self._lock_task.cancel()
+            self._lock_task = None
+        if self._lock_cookie is not None:
+            await _cls_unlock(self.io, _header_oid(self.id), LOCK_NAME,
+                              _client_entity(self.io), self._lock_cookie)
+            self._lock_cookie = None

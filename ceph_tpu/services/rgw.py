@@ -17,9 +17,12 @@ Redesign notes:
     tools/rgw_admin.py.
   * Auth: AWS signature v2 (Authorization: AWS access:sig over the
     canonical string) — matching the reference at this vintage; v4 is
-    out of scope and documented as such.
-  * Multipart upload is not implemented (reference rgw_multi.cc);
-    PUTs are single-request.
+    out of scope and documented as such.  The canonical resource is the
+    unquoted path (subresource query strings are not signed here).
+  * Multipart upload (reference rgw_multi.cc): parts are striped
+    objects; Complete writes a MANIFEST into the bucket index instead
+    of copying bytes (RGWObjManifest role), and GET/range reads stitch
+    across parts.  ETag is the S3 md5-of-part-md5s "-N" form.
 """
 
 from __future__ import annotations
@@ -48,6 +51,14 @@ def _index_oid(bucket: str) -> str:
 
 def _data_soid(bucket: str, key: str) -> str:
     return f"{bucket}//{key}"
+
+
+def _upload_oid(bucket: str, upload_id: str) -> str:
+    return f".upload.{bucket}.{upload_id}"
+
+
+def _part_soid(bucket: str, key: str, upload_id: str, n: int) -> str:
+    return f"{bucket}//{key}.{upload_id}.part{n}"
 
 
 # --------------------------------------------------------------------- users
@@ -209,6 +220,28 @@ class S3Gateway:
                     return (200 if await self._bucket_exists(bucket)
                             else 404), {}, b""
                 return 405, {}, b""
+            q = {}
+            for kv in parts.query.split("&"):
+                k, _, v = kv.partition("=")
+                if k:
+                    q[k] = unquote(v)
+            if method == "POST" and "uploads" in q:
+                return await self._init_multipart(bucket, key)
+            if method == "POST" and "uploadId" in q:
+                return await self._complete_multipart(
+                    bucket, key, q["uploadId"], body)
+            if method == "PUT" and "uploadId" in q and "partNumber" in q:
+                try:
+                    part_no = int(q["partNumber"])
+                except ValueError:
+                    return 400, {}, _xml_error("InvalidArgument")
+                return await self._upload_part(
+                    bucket, key, q["uploadId"], part_no, body)
+            if method == "GET" and "uploadId" in q:
+                return await self._list_parts(bucket, key, q["uploadId"])
+            if method == "DELETE" and "uploadId" in q:
+                return await self._abort_multipart(bucket, key,
+                                                   q["uploadId"])
             if method == "PUT":
                 return await self._put_object(bucket, key, body, headers)
             if method == "GET":
@@ -297,10 +330,8 @@ class S3Gateway:
             return 404, {}, _xml_error("NoSuchBucket")
         st = RadosStriper(self.io)
         soid = _data_soid(bucket, key)
-        try:
-            await st.remove(soid)      # overwrite: drop old sub-objects
-        except StripedObjectNotFound:
-            pass
+        await self._drop_object_data(bucket, key)   # overwrite: old
+        #                              striped data OR manifest parts
         await st.write(soid, body)
         etag = hashlib.md5(body).hexdigest()
         await self.io.omap_set(_index_oid(bucket), {
@@ -315,6 +346,7 @@ class S3Gateway:
         if meta is None:
             return 404, {}, _xml_error("NoSuchKey")
         st = RadosStriper(self.io)
+        manifest = meta.get("manifest")
         rng = headers.get("range", "")
         if rng.startswith("bytes="):
             lo_s, _, hi_s = rng[6:].partition("-")
@@ -328,13 +360,20 @@ class S3Gateway:
                          meta["size"] - 1)
             if lo > hi:
                 return 400, {}, _xml_error("InvalidRange")
-            data = await st.read(_data_soid(bucket, key),
-                                 length=hi - lo + 1, offset=lo)
+            if manifest:
+                data = await self._read_manifest(manifest, lo,
+                                                 hi - lo + 1)
+            else:
+                data = await st.read(_data_soid(bucket, key),
+                                     length=hi - lo + 1, offset=lo)
             return 206, {
                 "Content-Range":
                     f"bytes {lo}-{hi}/{meta['size']}",
                 "ETag": f'"{meta["etag"]}"'}, data
-        data = await st.read(_data_soid(bucket, key))
+        if manifest:
+            data = await self._read_manifest(manifest, 0, meta["size"])
+        else:
+            data = await st.read(_data_soid(bucket, key))
         return 200, {"ETag": f'"{meta["etag"]}"'}, data
 
     async def _head_object(self, bucket: str, key: str):
@@ -348,10 +387,7 @@ class S3Gateway:
         meta = await self._obj_meta(bucket, key)
         if meta is None:
             return 404, {}, _xml_error("NoSuchKey")
-        try:
-            await RadosStriper(self.io).remove(_data_soid(bucket, key))
-        except StripedObjectNotFound:
-            pass
+        await self._drop_object_data(bucket, key)
         await self.io.omap_rm_keys(_index_oid(bucket), [key.encode()])
         return 204, {}, b""
 
@@ -362,6 +398,192 @@ class S3Gateway:
             return None
         raw = idx.get(key.encode())
         return json.loads(raw.decode()) if raw else None
+
+    # ------------------------------------------------------------ multipart
+    async def _init_multipart(self, bucket: str, key: str):
+        """InitiateMultipartUpload (rgw_multi.cc init): allocate an
+        upload id; part state lives in an omap object so an interrupted
+        upload is resumable/abortable."""
+        if not await self._bucket_exists(bucket):
+            return 404, {}, _xml_error("NoSuchBucket")
+        upload_id = hashlib.md5(
+            f"{bucket}/{key}/{time.time_ns()}".encode()).hexdigest()[:16]
+        await self.io.omap_set(_upload_oid(bucket, upload_id), {
+            b"_meta": json.dumps({"key": key,
+                                  "started": time.time()}).encode()})
+        xml = (f'<?xml version="1.0"?><InitiateMultipartUploadResult>'
+               f"<Bucket>{bucket}</Bucket><Key>{quote(key)}</Key>"
+               f"<UploadId>{upload_id}</UploadId>"
+               f"</InitiateMultipartUploadResult>")
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
+
+    async def _upload_state(self, bucket: str, upload_id: str,
+                            key: str) -> Optional[Dict[bytes, bytes]]:
+        try:
+            st = await self.io.omap_get(_upload_oid(bucket, upload_id))
+        except ObjectOperationError:
+            return None
+        meta = st.get(b"_meta")
+        if meta is None or json.loads(meta.decode())["key"] != key:
+            return None
+        return st
+
+    async def _upload_part(self, bucket: str, key: str, upload_id: str,
+                           n: int, body: bytes):
+        """UploadPart: each part is its own striped object; re-upload of
+        the same part number replaces it."""
+        state = await self._upload_state(bucket, upload_id, key)
+        if state is None:
+            return 404, {}, _xml_error("NoSuchUpload")
+        if n < 1 or n > 10000:
+            return 400, {}, _xml_error("InvalidPartNumber")
+        soid = _part_soid(bucket, key, upload_id, n)
+        st = RadosStriper(self.io)
+        try:
+            await st.remove(soid)
+        except StripedObjectNotFound:
+            pass
+        await st.write(soid, body)
+        etag = hashlib.md5(body).hexdigest()
+        await self.io.omap_set(_upload_oid(bucket, upload_id), {
+            f"{n:05d}".encode(): json.dumps(
+                {"size": len(body), "etag": etag}).encode()})
+        return 200, {"ETag": f'"{etag}"'}, b""
+
+    async def _list_parts(self, bucket: str, key: str, upload_id: str):
+        state = await self._upload_state(bucket, upload_id, key)
+        if state is None:
+            return 404, {}, _xml_error("NoSuchUpload")
+        rows = []
+        for k in sorted(state):
+            if k == b"_meta":
+                continue
+            meta = json.loads(state[k].decode())
+            rows.append(f"<Part><PartNumber>{int(k)}</PartNumber>"
+                        f"<ETag>&quot;{meta['etag']}&quot;</ETag>"
+                        f"<Size>{meta['size']}</Size></Part>")
+        xml = (f'<?xml version="1.0"?><ListPartsResult>'
+               f"<Bucket>{bucket}</Bucket><Key>{quote(key)}</Key>"
+               f"<UploadId>{upload_id}</UploadId>{''.join(rows)}"
+               f"</ListPartsResult>")
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
+
+    async def _complete_multipart(self, bucket: str, key: str,
+                                  upload_id: str, body: bytes):
+        """CompleteMultipartUpload: validate the client's part list,
+        then publish a MANIFEST in the index entry — no byte copying
+        (RGWObjManifest role).  ETag is md5(concat(part md5s))-N."""
+        import xml.etree.ElementTree as ET
+        state = await self._upload_state(bucket, upload_id, key)
+        if state is None:
+            return 404, {}, _xml_error("NoSuchUpload")
+        try:
+            root = ET.fromstring(body.decode())
+            want = []
+            for part in root.iter():
+                if part.tag.rsplit("}", 1)[-1] != "Part":
+                    continue
+                fields = {c.tag.rsplit("}", 1)[-1]: (c.text or "")
+                          for c in part}
+                want.append((int(fields["PartNumber"]),
+                             fields["ETag"].strip().strip('"')))
+        except (ET.ParseError, KeyError, ValueError):
+            return 400, {}, _xml_error("MalformedXML")
+        if not want:
+            return 400, {}, _xml_error("MalformedXML")
+        nums = [n for n, _ in want]
+        if any(b <= a for a, b in zip(nums, nums[1:])):
+            # strictly ascending, no duplicates (S3 InvalidPartOrder —
+            # a repeated part would double-count size and bytes)
+            return 400, {}, _xml_error("InvalidPartOrder")
+        manifest, total, md5s = [], 0, b""
+        for n, etag in want:
+            raw = state.get(f"{n:05d}".encode())
+            if raw is None:
+                return 400, {}, _xml_error("InvalidPart")
+            meta = json.loads(raw.decode())
+            if meta["etag"] != etag:
+                return 400, {}, _xml_error("InvalidPart")
+            manifest.append({"soid": _part_soid(bucket, key, upload_id, n),
+                             "size": meta["size"]})
+            total += meta["size"]
+            md5s += bytes.fromhex(meta["etag"])
+        final_etag = f"{hashlib.md5(md5s).hexdigest()}-{len(want)}"
+        # drop any previous incarnation's data before republishing
+        await self._drop_object_data(bucket, key)
+        await self.io.omap_set(_index_oid(bucket), {
+            key.encode(): json.dumps({
+                "size": total, "etag": final_etag,
+                "mtime": time.time(), "manifest": manifest}).encode()})
+        # unreferenced parts (uploaded but not listed in Complete) die now
+        listed = {m["soid"] for m in manifest}
+        for k2 in state:
+            if k2 == b"_meta":
+                continue
+            soid = _part_soid(bucket, key, upload_id, int(k2))
+            if soid not in listed:
+                try:
+                    await RadosStriper(self.io).remove(soid)
+                except StripedObjectNotFound:
+                    pass
+        await self.io.remove(_upload_oid(bucket, upload_id))
+        xml = (f'<?xml version="1.0"?><CompleteMultipartUploadResult>'
+               f"<Bucket>{bucket}</Bucket><Key>{quote(key)}</Key>"
+               f"<ETag>&quot;{final_etag}&quot;</ETag>"
+               f"</CompleteMultipartUploadResult>")
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
+
+    async def _abort_multipart(self, bucket: str, key: str,
+                               upload_id: str):
+        state = await self._upload_state(bucket, upload_id, key)
+        if state is None:
+            return 404, {}, _xml_error("NoSuchUpload")
+        for k in state:
+            if k == b"_meta":
+                continue
+            try:
+                await RadosStriper(self.io).remove(
+                    _part_soid(bucket, key, upload_id, int(k)))
+            except StripedObjectNotFound:
+                pass
+        await self.io.remove(_upload_oid(bucket, upload_id))
+        return 204, {}, b""
+
+    async def _drop_object_data(self, bucket: str, key: str) -> None:
+        """Remove the stored bytes behind an index entry (plain striped
+        object or manifest parts)."""
+        meta = await self._obj_meta(bucket, key)
+        st = RadosStriper(self.io)
+        if meta and meta.get("manifest"):
+            for part in meta["manifest"]:
+                try:
+                    await st.remove(part["soid"])
+                except StripedObjectNotFound:
+                    pass
+        else:
+            try:
+                await st.remove(_data_soid(bucket, key))
+            except StripedObjectNotFound:
+                pass
+
+    async def _read_manifest(self, manifest: List[dict], offset: int,
+                             length: int) -> bytes:
+        """Stitch a byte range across manifest parts."""
+        st = RadosStriper(self.io)
+        out, pos = [], 0
+        end = offset + length
+        for part in manifest:
+            lo, hi = pos, pos + part["size"]
+            pos = hi
+            if hi <= offset:
+                continue
+            if lo >= end:
+                break
+            plo = max(0, offset - lo)
+            plen = min(hi, end) - (lo + plo)
+            out.append(await st.read(part["soid"], length=plen,
+                                     offset=plo))
+        return b"".join(out)
 
 
 def _xml_error(code: str) -> bytes:

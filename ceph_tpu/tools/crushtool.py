@@ -30,32 +30,44 @@ def cmd_build(args) -> int:
     make_replicated_rule(m, "replicated_rule")
     make_erasure_rule(m, "erasure_rule", size=args.ec_size)
     data = m.to_bytes()
-    with open(args.output, "wb") as f:
+    out = args.output or "crushmap.bin"
+    with open(out, "wb") as f:
         f.write(data)
     print(f"built crush map: {args.build} osds, "
-          f"{args.osds_per_host}/host, {len(data)} bytes -> {args.output}")
+          f"{args.osds_per_host}/host, {len(data)} bytes -> {out}")
     return 0
 
 
 def cmd_decompile(args) -> int:
+    """Emit the reference text dialect (crushtool -d, CrushCompiler)."""
+    from ceph_tpu.crush.compiler import decompile
     with open(args.decompile, "rb") as f:
         m = CrushMap.from_bytes(f.read())
-    print(f"# devices: {m.max_devices}")
-    print(f"# tunables: {vars(m.tunables)}")
-    for b in m.buckets:
-        if b is None:
-            continue
-        t = m.type_map.get(b.type, str(b.type))
-        print(f"bucket {m.name_of(b.id)} id {b.id} type {t} alg {b.alg} "
-              f"weight {b.weight / 0x10000:.3f}")
-        for it, w in zip(b.items, b.item_weights):
-            print(f"    item {m.name_of(it)} weight {w / 0x10000:.3f}")
-    for rid, r in enumerate(m.rules):
-        if r is None:
-            continue
-        name = m.rule_name_map.get(rid, f"rule{rid}")
-        print(f"rule {name} id {rid} ruleset {r.ruleset} type {r.type} "
-              f"size [{r.min_size},{r.max_size}] steps {len(r.steps)}")
+    text = decompile(m)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+def cmd_compile(args) -> int:
+    """Compile the text dialect to a binary map (crushtool -c)."""
+    from ceph_tpu.crush.compiler import CompileError, compile_text
+    with open(args.compile) as f:
+        text = f.read()
+    try:
+        m = compile_text(text)
+    except CompileError as e:
+        print(f"crushtool: {e}", file=sys.stderr)
+        return 1
+    data = m.to_bytes()
+    out = args.output or "crushmap.bin"
+    with open(out, "wb") as f:
+        f.write(data)
+    print(f"compiled {args.compile}: {m.summary()} "
+          f"({len(data)} bytes) -> {out}")
     return 0
 
 
@@ -110,8 +122,11 @@ def main(argv=None) -> int:
     ap.add_argument("--build", type=int, help="build simple map: N osds")
     ap.add_argument("--osds-per-host", type=int, default=1)
     ap.add_argument("--ec-size", type=int, default=6)
-    ap.add_argument("-o", "--output", default="crushmap.bin")
-    ap.add_argument("-d", "--decompile", help="print a map")
+    ap.add_argument("-o", "--output", default=None,
+                    help="output file (compile default: crushmap.bin; "
+                         "decompile default: stdout)")
+    ap.add_argument("-d", "--decompile", help="print a map as text")
+    ap.add_argument("-c", "--compile", help="compile a text map")
     ap.add_argument("--test", help="map inputs through a rule")
     ap.add_argument("--rule", type=int, default=0)
     ap.add_argument("--num-rep", type=int, default=3)
@@ -123,6 +138,8 @@ def main(argv=None) -> int:
         return cmd_build(args)
     if args.decompile:
         return cmd_decompile(args)
+    if args.compile:
+        return cmd_compile(args)
     if args.test:
         return cmd_test(args)
     ap.print_help()

@@ -90,13 +90,21 @@ class VCluster:
                 f.write(f"{k} = {v}\n")
 
     def _spawn(self, kind: str, id_: str) -> None:
+        # Daemons run jax on the CPU backend (device work rides the
+        # primary's batch queue; tests are hermetic).  cpu_child_env
+        # strips the TPU plugin's site dir: its sitecustomize imports
+        # jax at INTERPRETER STARTUP in every child (seconds of source
+        # compile each with bytecode caching off) — N daemons spawning
+        # concurrently wedged whole vstart clusters on busy machines.
+        from ceph_tpu.common.envutil import cpu_child_env
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
         with open(os.path.join(self.dir, f"{kind}.{id_}.log"), "ab") as logf:
             p = subprocess.Popen(
                 [sys.executable, "-m", "ceph_tpu.tools.daemons", kind,
                  "--id", id_, "--dir", self.dir],
                 stdout=logf, stderr=subprocess.STDOUT,
-                env={**os.environ, "JAX_PLATFORMS":
-                     os.environ.get("JAX_PLATFORMS", "cpu")})
+                env=cpu_child_env(pythonpath_first=repo_root))
         self.procs[f"{kind}.{id_}"] = p
 
     def start_daemons(self) -> None:

@@ -1,0 +1,159 @@
+"""Object classes (cls): server-side methods, cls_lock, cls_rbd, and the
+RBD exclusive lock built on them.
+
+Mirrors the reference's src/test/cls_lock / cls_rbd unit tests plus the
+librbd ExclusiveLock behavior: racing clients serialize through the PG
+instead of losing read-modify-writes (osd/ClassHandler.cc,
+objclass/objclass.h:28-60, src/cls/lock/cls_lock.cc).
+"""
+
+import asyncio
+import errno
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from test_osd import Cluster  # noqa: E402
+
+from ceph_tpu.client.objecter import ObjectOperationError  # noqa: E402
+from ceph_tpu.services.rbd import RBD, Image, ImageBusy  # noqa: E402
+
+
+def test_cls_lock_and_dir_replicated():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("p", pg_num=8)
+        io = admin.open_ioctx("p")
+
+        # exclusive lock: second holder busy, unlock releases
+        req = {"name": "l1", "type": "exclusive", "entity": "a",
+               "cookie": "c1"}
+        await io.exec("obj", "lock", "lock", json.dumps(req).encode())
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("obj", "lock", "lock", json.dumps(
+                {**req, "entity": "b", "cookie": "c2"}).encode())
+        assert ei.value.retcode == -errno.EBUSY
+        info = json.loads(await io.exec(
+            "obj", "lock", "get_info", json.dumps({"name": "l1"}).encode()))
+        assert list(info["lockers"]) == ["a/c1"]
+        await io.exec("obj", "lock", "unlock", json.dumps(
+            {"name": "l1", "entity": "a", "cookie": "c1"}).encode())
+        await io.exec("obj", "lock", "lock", json.dumps(
+            {**req, "entity": "b", "cookie": "c2"}).encode())
+
+        # break_lock evicts a dead holder
+        await io.exec("obj", "lock", "break_lock", json.dumps(
+            {"name": "l1", "entity": "b", "cookie": "c2"}).encode())
+        info = json.loads(await io.exec(
+            "obj", "lock", "get_info", json.dumps({"name": "l1"}).encode()))
+        assert not info["lockers"]
+
+        # rbd directory methods (omap-backed, replicated pool)
+        await io.exec("dir", "rbd", "dir_add",
+                      json.dumps({"name": "img1"}).encode())
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("dir", "rbd", "dir_add",
+                          json.dumps({"name": "img1"}).encode())
+        assert ei.value.retcode == -errno.EEXIST
+        names = json.loads(await io.exec("dir", "rbd", "dir_list"))
+        assert names == ["img1"]
+
+        # unknown method fails loudly
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("obj", "nope", "method")
+        assert ei.value.retcode == -errno.EOPNOTSUPP
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_cls_lock_on_ec_pool():
+    """xattr-based cls methods must work on EC pools (staged logical
+    ops translate through the EC per-shard write path)."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(5)
+        await admin.pool_create("ecp", pg_num=8, pool_type="erasure",
+                                k=2, m=2)
+        io = admin.open_ioctx("ecp")
+        req = {"name": "l", "type": "exclusive", "entity": "a",
+               "cookie": "c"}
+        await io.exec("eobj", "lock", "lock", json.dumps(req).encode())
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("eobj", "lock", "lock", json.dumps(
+                {**req, "entity": "b"}).encode())
+        assert ei.value.retcode == -errno.EBUSY
+        info = json.loads(await io.exec(
+            "eobj", "lock", "get_info",
+            json.dumps({"name": "l"}).encode()))
+        assert list(info["lockers"]) == ["a/c"]
+        # a method staging omap gets the EC pool's EOPNOTSUPP
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("edir", "rbd", "dir_add",
+                          json.dumps({"name": "x"}).encode())
+        assert ei.value.retcode == -errno.EOPNOTSUPP
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_rbd_exclusive_lock_no_lost_updates():
+    """VERDICT r3 ask #6 done-criterion: two clients writing one image
+    concurrently must not lose updates.  With the exclusive lock, the
+    second writer can't even open until the first closes; its RMW then
+    sees the first writer's bytes."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(5)
+        await admin.pool_create("rbd", pg_num=8, pool_type="erasure",
+                                k=2, m=2)
+        io = admin.open_ioctx("rbd")
+        await RBD(io).create("disk", size=1 << 20, order=16)
+
+        img_a = await Image.open(io, "disk", exclusive=True)
+        with pytest.raises(ImageBusy):
+            await Image.open(io, "disk", exclusive=True)
+
+        # A writes the first half of an object, closes (releases lock)
+        await img_a.write(0, b"A" * 1000)
+        await img_a.close()
+
+        # B can now take the lock; its RMW of the SAME object must
+        # preserve A's bytes
+        img_b = await Image.open(io, "disk", exclusive=True)
+        await img_b.write(1000, b"B" * 1000)
+        got = await img_b.read(0, 2000)
+        assert got == b"A" * 1000 + b"B" * 1000, "lost update"
+        await img_b.close()
+
+        # lock is free again after close
+        img_c = await Image.open(io, "disk", exclusive=True)
+        await img_c.close()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_rbd_header_via_cls():
+    """Header create/get/set_size ride cls_rbd; double-create is
+    EEXIST server-side (no read-check-write race window)."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("rbd", pg_num=8)
+        io = admin.open_ioctx("rbd")
+        rbd = RBD(io)
+        await rbd.create("img", size=4 << 20, order=16)
+        from ceph_tpu.services.rbd import ImageExists
+        with pytest.raises(ImageExists):
+            await rbd.create("img", size=1 << 20, order=16)
+        img = await Image.open(io, "img")
+        assert img.size == 4 << 20 and img.order == 16
+        await img.resize(2 << 20)
+        img2 = await Image.open(io, "img")
+        assert img2.size == 2 << 20
+        assert await rbd.list() == ["img"]
+        await rbd.remove("img")
+        assert await rbd.list() == []
+        await cl.stop()
+    asyncio.run(run())

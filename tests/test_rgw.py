@@ -151,3 +151,124 @@ def test_s3_overwrite_and_missing():
         await gw.stop()
         await cl.stop()
     asyncio.run(run())
+
+
+def test_multipart_upload_round_trip():
+    """rgw_multi.cc role: init -> 6 parts -> ListParts -> Complete
+    (manifest, no copy) -> GET whole + ranges across part seams ->
+    overwrite cleans old parts; plus abort and error paths."""
+    import re
+
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create(".rgw", pg_num=8)
+        gw = S3Gateway(admin)
+        await UserDB(admin.open_ioctx(".rgw")).create("AKID", "sekrit")
+        port = await gw.start()
+        c = S3Client(port, "AKID", "sekrit")
+
+        st, _, _ = await c.request("PUT", "/mp")
+        assert st == 200
+
+        # init
+        st, _, body = await c.request("POST", "/mp/big?uploads")
+        assert st == 200, body
+        upload_id = re.search(rb"<UploadId>([^<]+)</UploadId>",
+                              body).group(1).decode()
+
+        # upload 6 parts of distinct content/pattern sizes
+        parts = [bytes([i]) * (1000 + 137 * i) for i in range(1, 7)]
+        etags = []
+        for i, data in enumerate(parts, 1):
+            st, h, _ = await c.request(
+                "PUT", f"/mp/big?partNumber={i}&uploadId={upload_id}",
+                body=data)
+            assert st == 200
+            etags.append(h["etag"].strip('"'))
+            assert etags[-1] == hashlib.md5(data).hexdigest()
+
+        # re-upload part 3 with different bytes (replace semantics)
+        parts[2] = b"\xAB" * 1999
+        st, h, _ = await c.request(
+            "PUT", f"/mp/big?partNumber=3&uploadId={upload_id}",
+            body=parts[2])
+        assert st == 200
+        etags[2] = h["etag"].strip('"')
+
+        # ListParts shows all six with sizes
+        st, _, body = await c.request(
+            "GET", f"/mp/big?uploadId={upload_id}")
+        assert st == 200
+        assert body.count(b"<Part>") == 6
+        assert f"<Size>{len(parts[2])}</Size>".encode() in body
+
+        # complete (client lists all 6 in order)
+        comp = "<CompleteMultipartUpload>" + "".join(
+            f"<Part><PartNumber>{i}</PartNumber>"
+            f'<ETag>"{etags[i - 1]}"</ETag></Part>'
+            for i in range(1, 7)) + "</CompleteMultipartUpload>"
+        st, _, body = await c.request(
+            "POST", f"/mp/big?uploadId={upload_id}", body=comp.encode())
+        assert st == 200, body
+        md5s = b"".join(bytes.fromhex(e) for e in etags)
+        want_etag = f"{hashlib.md5(md5s).hexdigest()}-6"
+        assert want_etag.encode() in body
+
+        # the upload is gone (complete is terminal)
+        st, _, _ = await c.request("GET", f"/mp/big?uploadId={upload_id}")
+        assert st == 404
+
+        # whole-object GET equals the concatenation
+        full = b"".join(parts)
+        st, h, got = await c.request("GET", "/mp/big")
+        assert st == 200 and got == full
+        assert h["etag"].strip('"') == want_etag
+
+        # range read across the part-1/part-2 seam and a suffix range
+        lo, hi = len(parts[0]) - 10, len(parts[0]) + 9
+        st, _, got = await c.request(
+            "GET", "/mp/big", headers={"Range": f"bytes={lo}-{hi}"})
+        assert st == 206 and got == full[lo:hi + 1]
+        st, _, got = await c.request(
+            "GET", "/mp/big", headers={"Range": "bytes=-25"})
+        assert st == 206 and got == full[-25:]
+
+        # listing shows the completed object with the multipart size
+        st, _, body = await c.request("GET", "/mp")
+        assert f"<Size>{len(full)}</Size>".encode() in body
+
+        # overwrite with a simple PUT removes manifest parts, reads back
+        st, _, _ = await c.request("PUT", "/mp/big", body=b"tiny")
+        assert st == 200
+        st, _, got = await c.request("GET", "/mp/big")
+        assert got == b"tiny"
+
+        # abort path: init + one part + abort -> NoSuchUpload afterwards
+        st, _, body = await c.request("POST", "/mp/die?uploads")
+        upload_id = re.search(rb"<UploadId>([^<]+)</UploadId>",
+                              body).group(1).decode()
+        await c.request("PUT", f"/mp/die?partNumber=1&uploadId={upload_id}",
+                        body=b"x" * 100)
+        st, _, _ = await c.request(
+            "DELETE", f"/mp/die?uploadId={upload_id}")
+        assert st == 204
+        st, _, _ = await c.request("GET", f"/mp/die?uploadId={upload_id}")
+        assert st == 404
+
+        # completing with a wrong etag is InvalidPart
+        st, _, body = await c.request("POST", "/mp/bad?uploads")
+        upload_id = re.search(rb"<UploadId>([^<]+)</UploadId>",
+                              body).group(1).decode()
+        await c.request("PUT", f"/mp/bad?partNumber=1&uploadId={upload_id}",
+                        body=b"data")
+        comp = ("<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+                f'<ETag>"{"0" * 32}"</ETag></Part>'
+                "</CompleteMultipartUpload>")
+        st, _, body = await c.request(
+            "POST", f"/mp/bad?uploadId={upload_id}", body=comp.encode())
+        assert st == 400 and b"InvalidPart" in body
+
+        await gw.stop()
+        await cl.stop()
+    asyncio.run(run())
