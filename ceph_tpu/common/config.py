@@ -267,7 +267,14 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("osd_op_num_threads_per_shard", "int", 2, ""),
     Option("osd_recovery_max_active", "int", 3, "parallel recovery ops"),
     Option("osd_max_object_size", "size", "128m", ""),
-    Option("osd_client_message_size_cap", "size", "500m", ""),
+    Option("osd_client_message_size_cap", "size", "500m",
+           "client op bytes in flight before intake blocks (Throttle)"),
+    Option("osd_backfill_scan_max", "int", 512,
+           "objects per backfill listing window (config_opts.h)"),
+    Option("osd_mesh_mode", "str", "off",
+           "on = co-located OSDs share a device mesh: EC writes encode "
+           "as one sharded program and shard bytes skip the messenger "
+           "(SURVEY §2.4 TPU-native data plane)"),
     Option("osd_scrub_interval", "float", 60.0, "light scrub cadence (test scale)"),
     Option("osd_deep_scrub_interval", "float", 300.0,
            "deep scrub cadence (reads + recomputes every digest)"),

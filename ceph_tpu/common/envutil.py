@@ -19,9 +19,13 @@ _TPU_PLUGIN_MARK = "axon"
 
 def pythonpath_without_tpu_plugin(extra_first: str = "") -> str:
     """Current PYTHONPATH minus the TPU plugin site dir, optionally with
-    `extra_first` prepended."""
+    `extra_first` prepended.  The mark matches ANYWHERE in the entry
+    (plugin layouts like /opt/axon/site-packages keep the mark in a
+    parent component); over-matching an unrelated path merely costs
+    that child an import path, under-matching brings the
+    startup-wedge back."""
     parts = [p for p in os.environ.get("PYTHONPATH", "").split(":")
-             if p and _TPU_PLUGIN_MARK not in os.path.basename(p.rstrip("/"))]
+             if p and _TPU_PLUGIN_MARK not in p]
     if extra_first:
         parts.insert(0, extra_first)
     return ":".join(parts)

@@ -52,39 +52,65 @@ class Throttle:
 
 
 class AsyncThrottle:
+    """Single-event-loop throttle: FIFO-fair async get, SYNC put (so
+    completion paths that aren't coroutines can release), perf-friendly
+    introspection.  An over-budget get still admits when the throttle
+    is empty (a single op larger than the cap must not deadlock) —
+    same escape hatch as the reference Throttle."""
+
     def __init__(self, name: str, max_: int):
         self.name = name
         self.max = max_
         self.cur = 0
-        self._cond: Optional[asyncio.Condition] = None
+        self.waited = 0               # times a get had to block
+        from collections import deque
+        self._waiters: "deque" = deque()   # (future, cost)
 
-    def _cv(self) -> asyncio.Condition:
-        if self._cond is None:
-            self._cond = asyncio.Condition()
-        return self._cond
+    def _room(self, c: int) -> bool:
+        return self.cur + c <= self.max or self.cur == 0
 
     async def get(self, c: int = 1) -> None:
         if self.max <= 0:
             return
-        cv = self._cv()
-        async with cv:
-            while self.cur + c > self.max and self.cur > 0:
-                await cv.wait()
+        if not self._waiters and self._room(c):
             self.cur += c
+            return
+        self.waited += 1
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append((fut, c))
+        try:
+            await fut
+        except asyncio.CancelledError:
+            if not fut.cancelled() and fut.done():
+                # admitted concurrently with cancellation: give it back
+                self.put(c)
+            else:
+                try:
+                    self._waiters.remove((fut, c))
+                except ValueError:
+                    pass
+            raise
 
     def get_or_fail(self, c: int = 1) -> bool:
         if self.max <= 0:
             return True
-        if self.cur + c > self.max and self.cur > 0:
+        if self._waiters or not self._room(c):
             return False
         self.cur += c
         return True
 
-    async def put(self, c: int = 1) -> None:
+    def put(self, c: int = 1) -> None:
         if self.max <= 0:
             return
-        cv = self._cv()
-        async with cv:
-            self.cur -= c
-            assert self.cur >= 0
-            cv.notify_all()
+        self.cur -= c
+        assert self.cur >= 0
+        while self._waiters:
+            fut, cost = self._waiters[0]
+            if fut.done():            # cancelled waiter
+                self._waiters.popleft()
+                continue
+            if not self._room(cost):
+                break
+            self._waiters.popleft()
+            self.cur += cost
+            fut.set_result(None)

@@ -170,92 +170,109 @@ def decompile(m: CrushMap) -> str:
 def compile_text(text: str) -> CrushMap:
     """Reference-dialect text -> CrushMap (CrushCompiler::compile).
 
-    Buckets must be defined before they are referenced (same constraint
-    as the reference's single-pass grammar).
-    """
+    Token-stream parse (newlines are just whitespace, exactly like the
+    reference's spirit grammar — `host h { id -1 ... }` on one line is
+    valid).  Buckets must be defined before they are referenced (same
+    constraint as the reference's single-pass grammar)."""
     m = CrushMap()
     m.type_map = {}
     names: Dict[str, int] = {}          # item name -> id
 
-    # tokenize: strip comments, split into statements; `{...}` blocks
-    # become (header_tokens, [line_tokens...])
-    lines: List[List[str]] = []
+    toks: List[str] = []
     for raw in text.splitlines():
-        line = re.sub(r"#.*", "", raw).strip()
-        if line:
-            lines.append(line.replace("{", " { ").replace("}", " } ")
-                         .split())
-    i = 0
+        line = re.sub(r"#.*", "", raw)
+        toks += line.replace("{", " { ").replace("}", " } ").split()
 
-    def parse_block(start: int):
-        """-> (body_lines, next_index); start points at the header."""
-        if lines[start][-1] != "{":
-            raise CompileError(f"expected '{{' in {' '.join(lines[start])}")
-        body = []
-        j = start + 1
-        while j < len(lines) and lines[j] != ["}"]:
-            body.append(lines[j])
+    def expect(i: int, what: str) -> None:
+        if i >= len(toks) or toks[i] != what:
+            got = toks[i] if i < len(toks) else "<eof>"
+            raise CompileError(f"expected {what!r}, got {got!r}")
+
+    def block_body(i: int):
+        """toks[i] must be '{'; -> (body tokens, index past '}')."""
+        expect(i, "{")
+        j = i + 1
+        depth = 1
+        while j < len(toks):
+            if toks[j] == "{":
+                depth += 1
+            elif toks[j] == "}":
+                depth -= 1
+                if depth == 0:
+                    return toks[i + 1:j], j + 1
             j += 1
-        if j >= len(lines):
-            raise CompileError("unterminated block")
-        return body, j + 1
+        raise CompileError("unterminated block")
 
-    while i < len(lines):
-        tok = lines[i]
-        if tok[0] == "tunable" and len(tok) == 3:
-            if tok[1] not in _TUNABLES:
-                raise CompileError(f"unknown tunable {tok[1]!r}")
-            setattr(m.tunables, tok[1], int(tok[2]))
-            i += 1
-        elif tok[0] == "device" and len(tok) >= 3:
-            dev = int(tok[1])
-            names[tok[2]] = dev
-            m.name_map[dev] = tok[2]
-            m.max_devices = max(m.max_devices, dev + 1)
-            i += 1
-        elif tok[0] == "type" and len(tok) == 3:
-            m.type_map[int(tok[1])] = tok[2]
-            i += 1
-        elif tok[0] == "rule" and len(tok) >= 2:
-            body, i = parse_block(i)
-            _parse_rule(m, tok[1] if len(tok) > 2 else "rule",
-                        body, names)
-        elif tok[0] in m.type_map.values() and len(tok) >= 2:
-            body, i = parse_block(i)
-            _parse_bucket(m, tok[0], tok[1], body, names)
-        else:
-            raise CompileError(f"cannot parse: {' '.join(tok)}")
+    i = 0
+    try:
+        while i < len(toks):
+            t = toks[i]
+            if t == "tunable":
+                if i + 2 >= len(toks) or toks[i + 1] not in _TUNABLES:
+                    raise CompileError(f"bad tunable at {toks[i:i + 3]}")
+                setattr(m.tunables, toks[i + 1], int(toks[i + 2]))
+                i += 3
+            elif t == "device":
+                dev = int(toks[i + 1])
+                names[toks[i + 2]] = dev
+                m.name_map[dev] = toks[i + 2]
+                m.max_devices = max(m.max_devices, dev + 1)
+                i += 3
+            elif t == "type":
+                m.type_map[int(toks[i + 1])] = toks[i + 2]
+                i += 3
+            elif t == "rule":
+                name = toks[i + 1]
+                body, i = block_body(i + 2)
+                _parse_rule(m, name, body, names)
+            elif t in m.type_map.values():
+                name = toks[i + 1]
+                body, i = block_body(i + 2)
+                _parse_bucket(m, t, name, body, names)
+            else:
+                raise CompileError(f"cannot parse at {toks[i:i + 4]}")
+    except (IndexError, ValueError) as e:
+        # truncated/malformed statements must fail as compile errors,
+        # never tracebacks (crushtool -c catches CompileError)
+        raise CompileError(f"malformed map text near token {i}: {e}")
     return m
 
 
 def _parse_bucket(m: CrushMap, type_name: str, name: str,
-                  body: List[List[str]], names: Dict[str, int]) -> None:
+                  body: List[str], names: Dict[str, int]) -> None:
     type_id = next(t for t, n in m.type_map.items() if n == type_name)
     bucket_id = 0
     alg = "straw2"
     hash_ = HASH_RJENKINS1
     items: List[int] = []
     weights: List[int] = []
-    for tok in body:
-        if tok[0] == "id":
-            bucket_id = int(tok[1])
-        elif tok[0] == "alg":
-            alg = tok[1]
-        elif tok[0] == "hash":
-            hash_ = int(tok[1])
-        elif tok[0] == "item":
-            if tok[1] not in names:
+    i = 0
+    while i < len(body):
+        t = body[i]
+        if t == "id":
+            bucket_id = int(body[i + 1])
+            i += 2
+        elif t == "alg":
+            alg = body[i + 1]
+            i += 2
+        elif t == "hash":
+            hash_ = int(body[i + 1])
+            i += 2
+        elif t == "item":
+            item_name = body[i + 1]
+            if item_name not in names:
                 raise CompileError(
-                    f"bucket {name!r}: item {tok[1]!r} not defined yet")
-            items.append(names[tok[1]])
+                    f"bucket {name!r}: item {item_name!r} not defined "
+                    f"yet")
+            items.append(names[item_name])
+            i += 2
             w = 0x10000
-            if len(tok) >= 4 and tok[2] == "weight":
-                w = _s2w(tok[3])
+            if i + 1 < len(body) and body[i] == "weight":
+                w = _s2w(body[i + 1])
+                i += 2
             weights.append(w)
-        elif tok[0] == "weight":
-            pass                     # total is derived
         else:
-            raise CompileError(f"bucket {name!r}: bad line {tok}")
+            raise CompileError(f"bucket {name!r}: bad token {t!r}")
     if alg not in _ALG_IDS:
         raise CompileError(f"bucket {name!r}: unknown alg {alg!r}")
     b = make_bucket(m, _ALG_IDS[alg], type_id, items, weights,
@@ -264,53 +281,66 @@ def _parse_bucket(m: CrushMap, type_name: str, name: str,
     m.name_map[b.id] = name
 
 
-def _parse_rule(m: CrushMap, name: str, body: List[List[str]],
+def _parse_rule(m: CrushMap, name: str, body: List[str],
                 names: Dict[str, int]) -> None:
     ruleset = len(m.rules)
     rtype, min_size, max_size = 1, 1, 10
     steps: List[RuleStep] = []
-    for tok in body:
-        if tok[0] == "ruleset":
-            ruleset = int(tok[1])
-        elif tok[0] == "type":
-            rtype = _RULE_TYPE_IDS.get(tok[1])
+    i = 0
+    while i < len(body):
+        t = body[i]
+        if t == "ruleset":
+            ruleset = int(body[i + 1])
+            i += 2
+        elif t == "type":
+            rtype = _RULE_TYPE_IDS.get(body[i + 1])
             if rtype is None:
                 try:
-                    rtype = int(tok[1])
+                    rtype = int(body[i + 1])
                 except ValueError:
-                    raise CompileError(f"rule {name!r}: bad type {tok[1]!r}")
-        elif tok[0] == "min_size":
-            min_size = int(tok[1])
-        elif tok[0] == "max_size":
-            max_size = int(tok[1])
-        elif tok[0] == "step":
-            steps.append(_parse_step(m, name, tok[1:], names))
+                    raise CompileError(
+                        f"rule {name!r}: bad type {body[i + 1]!r}")
+            i += 2
+        elif t == "min_size":
+            min_size = int(body[i + 1])
+            i += 2
+        elif t == "max_size":
+            max_size = int(body[i + 1])
+            i += 2
+        elif t == "step":
+            step, i = _parse_step(m, name, body, i + 1, names)
+            steps.append(step)
         else:
-            raise CompileError(f"rule {name!r}: bad line {tok}")
+            raise CompileError(f"rule {name!r}: bad token {t!r}")
     rid = m.add_rule(Rule(ruleset=ruleset, type=rtype, min_size=min_size,
                           max_size=max_size, steps=steps))
     m.rule_name_map[rid] = name
 
 
-def _parse_step(m: CrushMap, rule: str, tok: List[str],
-                names: Dict[str, int]) -> RuleStep:
-    if tok[0] == "take":
-        if tok[1] not in names:
+def _parse_step(m: CrushMap, rule: str, body: List[str], i: int,
+                names: Dict[str, int]):
+    """Parse one step starting at body[i]; -> (RuleStep, next index)."""
+    op = body[i]
+    if op == "take":
+        target = body[i + 1]
+        if target not in names:
             raise CompileError(f"rule {rule!r}: take of undefined "
-                               f"{tok[1]!r}")
-        return RuleStep(RULE_TAKE, names[tok[1]])
-    if tok[0] == "emit":
-        return RuleStep(RULE_EMIT)
-    if tok[0] in ("choose", "chooseleaf"):
+                               f"{target!r}")
+        return RuleStep(RULE_TAKE, names[target]), i + 2
+    if op == "emit":
+        return RuleStep(RULE_EMIT), i + 1
+    if op in ("choose", "chooseleaf"):
         # step choose[leaf] firstn|indep N type T
-        op = _CHOOSE_STEPS.get((tok[0], tok[1]))
-        if op is None or len(tok) != 5 or tok[3] != "type":
-            raise CompileError(f"rule {rule!r}: bad step {tok}")
-        tid = next((t for t, n in m.type_map.items() if n == tok[4]),
-                   None)
+        code = _CHOOSE_STEPS.get((op, body[i + 1]))
+        if code is None or i + 4 >= len(body) or body[i + 3] != "type":
+            raise CompileError(
+                f"rule {rule!r}: bad step {body[i:i + 5]}")
+        tid = next((t for t, n in m.type_map.items()
+                    if n == body[i + 4]), None)
         if tid is None:
-            raise CompileError(f"rule {rule!r}: unknown type {tok[4]!r}")
-        return RuleStep(op, int(tok[2]), tid)
-    if tok[0] in _SET_STEPS:
-        return RuleStep(_SET_STEPS[tok[0]], int(tok[1]))
-    raise CompileError(f"rule {rule!r}: unknown step {tok[0]!r}")
+            raise CompileError(
+                f"rule {rule!r}: unknown type {body[i + 4]!r}")
+        return RuleStep(code, int(body[i + 2]), tid), i + 5
+    if op in _SET_STEPS:
+        return RuleStep(_SET_STEPS[op], int(body[i + 1])), i + 2
+    raise CompileError(f"rule {rule!r}: unknown step {op!r}")

@@ -46,6 +46,10 @@ class Message(Encodable):
 
     TYPE = 0
     PRIORITY = PRIO_DEFAULT
+    # True = this type counts against the receiver's dispatch throttle
+    # (client data ops); control-plane messages stay unthrottled so
+    # backpressure can't deadlock maps/acks/heartbeats
+    THROTTLE_DISPATCH = False
 
     def __init__(self):
         # stamped on send / receive by the messenger

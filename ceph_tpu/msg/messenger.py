@@ -345,6 +345,10 @@ class Messenger:
         self.get_authorizer_cb = None
         self.verify_authorizer_cb = None
         self.require_authorizer = False
+        # optional intake backpressure (Throttle.h role): frames whose
+        # message class sets THROTTLE_DISPATCH block the reader while
+        # over budget; the handling daemon releases at op completion
+        self.dispatch_throttle = None
 
     # --- setup ---
     def add_dispatcher(self, d: Dispatcher) -> None:
@@ -498,9 +502,21 @@ class Messenger:
                                 f"message signature mismatch from "
                                 f"{peer_name}")
                             raise ConnectionError("bad message signature")
-                    self._handle_msg_frame(payload, peer_name, peer_addr,
-                                           conn_id, writer,
-                                           auth_ticket, transport_id)
+                    msg = self._parse_frame(payload, peer_name,
+                                            peer_addr, conn_id, writer,
+                                            auth_ticket, transport_id)
+                    if msg is not None:
+                        # dispatch throttle (Message.cc throttle hooks /
+                        # Policy throttler): stop READING this peer's
+                        # socket while the budget is full — TCP pushes
+                        # the backpressure to the sender.  Only message
+                        # types that opt in (client data ops) count.
+                        if (self.dispatch_throttle is not None
+                                and msg.THROTTLE_DISPATCH):
+                            cost = len(payload)
+                            await self.dispatch_throttle.get(cost)
+                            msg.throttle_cost = cost
+                        self._dispatch(msg)
                 elif tag == TAG_KEEPALIVE:
                     pass
         except (OSError, asyncio.IncompleteReadError, ConnectionError):
@@ -508,11 +524,12 @@ class Messenger:
         finally:
             writer.close()
 
-    def _handle_msg_frame(self, payload: bytes, peer_name: EntityName,
-                          peer_addr: EntityAddr, conn_id: int,
-                          writer: asyncio.StreamWriter,
-                          auth_ticket=None,
-                          transport_id: Optional[int] = None) -> None:
+    def _parse_frame(self, payload: bytes, peer_name: EntityName,
+                     peer_addr: EntityAddr, conn_id: int,
+                     writer: asyncio.StreamWriter,
+                     auth_ticket=None,
+                     transport_id: Optional[int] = None
+                     ) -> Optional[Message]:
         seq, mtype, crc = _MSG_HDR.unpack_from(payload, 0)
         body = payload[_MSG_HDR.size:]
         if zlib.crc32(body) != crc:
@@ -524,20 +541,20 @@ class Messenger:
             writer.write(_FRAME_HDR.pack(TAG_ACK, len(ack)) + ack)
         skey = (peer_addr.nonce, conn_id)
         if seq <= self._in_seq.get(skey, 0):
-            return   # replayed duplicate after sender reconnect
+            return None  # replayed duplicate after sender reconnect
         cls = message_class(mtype)
         if cls is None:
             # undecodable deterministically: consume the seq (replaying the
             # same bytes can never succeed) but keep the transport alive
             self.log.warning(f"unknown message type {mtype}")
             self._in_seq[skey] = seq
-            return
+            return None
         try:
             msg = cls.from_bytes(body)
         except Exception as e:
             self.log.warning(f"decode of {cls.__name__} failed: {e!r}")
             self._in_seq[skey] = seq
-            return
+            return None
         self._in_seq[skey] = seq   # delivered at-most-once from here on
         msg.seq = seq
         msg.src_name = peer_name
@@ -549,16 +566,32 @@ class Messenger:
             msg.auth_entity = auth_ticket.entity
             msg.auth_caps = auth_ticket.caps
         msg.recv_stamp = time.monotonic()
+        return msg
+
+    def _dispatch(self, msg: Message) -> None:
         self._msgs_received += 1
         for d in self.dispatchers:
             try:
                 if d.ms_dispatch(msg):
                     return
             except Exception:
-                # a buggy dispatcher must not kill the peer transport
+                # a buggy dispatcher must not kill the peer transport —
+                # but it must not leak the op's intake budget either, or
+                # enough failures wedge the whole daemon's intake
                 self.log.exception(f"dispatcher {d} failed on {msg}")
+                self.put_dispatch_throttle(msg)
                 return
         self.log.warning(f"unhandled message {msg}")
+        self.put_dispatch_throttle(msg)
+
+    def put_dispatch_throttle(self, msg: Message) -> None:
+        """Release a throttled message's budget; owners (the OSD op
+        path) call this when the op COMPLETES, unhandled messages
+        release immediately."""
+        cost = getattr(msg, "throttle_cost", 0)
+        if cost and self.dispatch_throttle is not None:
+            msg.throttle_cost = 0       # idempotent
+            self.dispatch_throttle.put(cost)
 
     # --- teardown ---
     async def shutdown(self) -> None:

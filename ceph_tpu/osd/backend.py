@@ -139,12 +139,21 @@ class PGBackend:
         pg.missing.items.pop(m.oid, None)
         if not pg.missing:
             pg.info.last_complete = pg.info.last_update
+        # backfill pushes arrive in sorted-name order: advance our
+        # durable cursor so a crash here resumes instead of restarting
+        # (no-op once complete — LB_MAX compares above every name)
+        if m.backfill_progress and \
+                m.backfill_progress > pg.info.last_backfill:
+            pg.info.last_backfill = m.backfill_progress
         pg.save_meta(txn)
         self.osd.store.apply_transaction(txn)
         return True
 
-    def push_object(self, peer: int, oid: str, at: EVersion) -> None:
-        """Send full object state to peer (fire-and-forget variant)."""
+    def push_object(self, peer: int, oid: str, at: EVersion,
+                    progress: str = "") -> None:
+        """Send full object state to peer (fire-and-forget variant).
+        `progress` stamps backfill pushes so the receiver's
+        last_backfill cursor advances durably."""
         pg = self.pg
         soid = pg.object_id(oid)
         try:
@@ -156,13 +165,16 @@ class PGBackend:
         except (NoSuchObject, NoSuchCollection):
             msg = MPGPush(pg.pgid.with_shard(pg.shard_of(peer)), oid, at,
                           from_osd=self.osd.whoami, deleted=True)
+        msg.backfill_progress = progress
         self.osd.send_osd(peer, msg)
 
-    async def _push_and_wait(self, peer: int, oid: str) -> None:
+    async def _push_and_wait(self, peer: int, oid: str,
+                             progress: str = "") -> None:
         fut = asyncio.get_running_loop().create_future()
         self.pg._push_acks[(peer, oid)] = fut
         try:
-            self.push_object(peer, oid, self.pg.info.last_update)
+            self.push_object(peer, oid, self.pg.info.last_update,
+                             progress)
             await asyncio.wait_for(fut, 20.0)
         finally:
             self.pg._push_acks.pop((peer, oid), None)
@@ -183,8 +195,9 @@ class PGBackend:
                 ent[1].set_result(m)
 
     async def recover_object(self, peer: int, oid: str,
-                             exclude=frozenset()) -> None:
-        await self._push_and_wait(peer, oid)
+                             exclude=frozenset(),
+                             progress: str = "") -> None:
+        await self._push_and_wait(peer, oid, progress)
 
     async def pull_object(self, peer: int, oid: str, epoch: int,
                           exclude=frozenset()) -> None:
@@ -501,8 +514,18 @@ class ECBackend(PGBackend):
     async def _encode_object(self, data: bytes) -> Dict[int, np.ndarray]:
         """Full-object encode, batched across PGs on the device queue
         when the codec exposes a plain generator matrix (rs/jerasure/isa
-        family); codec host path otherwise (lrc/shec layering)."""
+        family); codec host path otherwise (lrc/shec layering).  In
+        mesh mode the encode runs as ONE sharded device program where
+        each mesh device computes its own shard (all_gather over the
+        shard axis = the fan-out hop)."""
         gen = getattr(self.codec, "generator", None)
+        ex = getattr(self.osd, "mesh_exec", None)
+        if ex is not None and gen is not None:
+            try:
+                return await ex.encode_object(self.codec, data)
+            except Exception as e:
+                self.log_.warning(f"mesh encode failed ({e}); "
+                                  f"falling back to batch queue")
         q = getattr(self.osd, "ec_queue", None)
         if gen is None or q is None:
             return self.codec.encode(set(range(self.n)), data)
@@ -656,7 +679,13 @@ class ECBackend(PGBackend):
                     shard_txns[i].to_bytes(), entry_bytes, version,
                     self.osd.osdmap.epoch)))
         fut = self._ack_init(tid, peers)
+        ex = getattr(self.osd, "mesh_exec", None)
         for osd_id, msg in sends:
+            # mesh mode: co-located shard OSDs take the sub-op (chunk
+            # bytes included) in process; acks still ride the messenger
+            if ex is not None and ex.deliver(osd_id, msg,
+                                             self.osd.whoami):
+                continue
             self.osd.send_osd(osd_id, msg)
         if not await self._await_acks(fut):
             self._inflight.pop(tid, None)
@@ -745,25 +774,60 @@ class ECBackend(PGBackend):
         return op.rval
 
     def _stale_shards(self, oid: str) -> Set[int]:
-        """Acting positions whose osd still misses this object (recovery
-        window): their on-disk chunk predates the object's version and
-        must not feed a decode."""
+        """Acting positions whose osd must not feed a decode of `oid`:
+        still missing it (recovery window), or mid-backfill with the
+        per-object cursor short of this name — the reference routes
+        reads around backfill targets the same way
+        (is_backfill_target gating, ReplicatedPG.cc:1575)."""
+        from ceph_tpu.osd.pglog import LB_MAX
         pg = self.pg
         out = set()
         for i, osd_id in enumerate(pg.acting):
             pm = pg.peer_missing.get(osd_id)
             if pm is not None and oid in pm:
                 out.add(i)
+            pi = pg.peer_info.get(osd_id)
+            if pi is not None and pi.last_backfill != LB_MAX \
+                    and oid > pi.last_backfill:
+                out.add(i)
         return out
+
+    def _auth_version(self, oid: str) -> Optional[bytes]:
+        """The object's authoritative version per our log (None when the
+        object predates the log window): the guard that keeps a decode
+        from silently mixing or serving an older generation."""
+        e = self.pg.log.latest_entry_for(oid)
+        if e is None or e.is_delete():
+            return None
+        return e.version.to_bytes()
 
     async def _gather_shards(self, oid: str,
                              exclude: Set[int] = frozenset(),
-                             snap: int = 0
+                             snap: int = 0,
+                             want_version: Optional[bytes] = None
                              ) -> Optional[Tuple[Dict[int, np.ndarray],
                                                  Dict[str, bytes]]]:
-        """Collect >=k shard streams (minimum_to_decode role): local read
-        for our shard, sub-op reads for the rest.  Returns (streams,
-        attrs-from-any-shard) or None.  `snap` reads clone chunks."""
+        """Collect >=k consistent shard streams (minimum_to_decode
+        role).  First pass routes around _stale_shards (peers the
+        primary BELIEVES are missing/mid-backfill); if that guess
+        starves the gather below k, retry including them — the
+        peer_missing set is a log-delta over-approximation and peers
+        often hold the current version anyway (found by qa/rados_model:
+        two shards each excluded for the other's sake deadlocked
+        recovery, then reads, on a healthy object).  `want_version`
+        (from the primary's log) is the stale-serve guard either way."""
+        first = set(exclude) | self._stale_shards(oid)
+        got = await self._gather_once(oid, first, snap, want_version)
+        if got is None and first != set(exclude):
+            got = await self._gather_once(oid, set(exclude), snap,
+                                          want_version)
+        return got
+
+    async def _gather_once(self, oid: str, exclude: Set[int],
+                           snap: int,
+                           want_version: Optional[bytes]
+                           ) -> Optional[Tuple[Dict[int, np.ndarray],
+                                               Dict[str, bytes]]]:
         pg = self.pg
         soid = pg.object_id(oid)
         if snap:
@@ -771,7 +835,6 @@ class ECBackend(PGBackend):
         streams: Dict[int, np.ndarray] = {}
         attrs: Dict[str, bytes] = {}
         shard_vers: Dict[int, bytes] = {}
-        exclude = set(exclude) | self._stale_shards(oid)
         my = self.my_shard
         candidates: List[int] = []
         for i, osd_id in enumerate(pg.acting):
@@ -818,7 +881,12 @@ class ECBackend(PGBackend):
             return None
         lens = {len(s) for s in streams.values()}
         vers = {shard_vers.get(i, b"") for i in streams}
-        if len(lens) > 1 or len(vers) > 1:
+        if (want_version is not None and len(lens) == 1
+                and vers == {want_version}):
+            return streams, attrs        # exact generation, consistent
+        if len(lens) > 1 or len(vers) > 1 or (
+                want_version is not None
+                and vers != {want_version}):
             # mixed generations: a shard mid-recovery (or racing an
             # overwrite) returned a stale chunk.  Length alone can't
             # detect the common fixed-block (RBD) case — a same-size
@@ -851,6 +919,14 @@ class ECBackend(PGBackend):
             for i, s in streams.items():
                 cohorts.setdefault(
                     (len(s), shard_vers.get(i, b"")), {})[i] = s
+            if want_version is not None:
+                # authoritative version known (primary log): ONLY that
+                # generation may serve — a quorum of stale shards must
+                # fail the gather, never decode as if current
+                cohorts = {key: c for key, c in cohorts.items()
+                           if key[1] == want_version}
+                if not cohorts:
+                    return None
 
             def cohort_score(cohort):
                 # the NEWEST generation wins, cohort size breaks ties —
@@ -869,9 +945,26 @@ class ECBackend(PGBackend):
 
     async def _read_object(self, oid: str, size: int,
                            snap: int = 0) -> Optional[bytes]:
-        got = await self._gather_shards(oid, snap=snap)
-        if got is None:
-            return None
+        # a gather can transiently starve while shards are down or
+        # mid-recovery: WAIT like the reference (ReplicatedPG
+        # wait_for_degraded_object) instead of failing the read — an
+        # EIO here reads as data loss to the client during windows
+        # that heal themselves in under a second
+        pg = self.pg
+        epoch = pg.interval_epoch
+        deadline = asyncio.get_running_loop().time() + 8.0
+        while True:
+            got = await self._gather_shards(
+                oid, snap=snap,
+                want_version=None if snap else self._auth_version(oid))
+            if got is not None:
+                break
+            if epoch != pg.interval_epoch:
+                raise PGIntervalChanged(
+                    f"pg {pg.pgid} interval changed during read")
+            if asyncio.get_running_loop().time() >= deadline:
+                return None
+            await asyncio.sleep(0.2)
         streams, _ = got
         from ceph_tpu.ec.interface import ErasureCodeError
         try:
@@ -883,7 +976,8 @@ class ECBackend(PGBackend):
 
     # ----------------------------------------------------------- recovery
     async def recover_object(self, peer: int, oid: str,
-                             exclude=frozenset()) -> None:
+                             exclude=frozenset(),
+                             progress: str = "") -> None:
         """Rebuild the peer's shard from k others and push it
         (continue_recovery_op / minimum_to_decode role).  `exclude` adds
         shards scrub found corrupt, kept out of the gather."""
@@ -894,9 +988,12 @@ class ECBackend(PGBackend):
         try:
             attrs = self.osd.store.getattrs(pg.cid, soid)
         except (NoSuchObject, NoSuchCollection):
-            await self._push_and_wait(peer, oid)   # pushes deleted=True
+            await self._push_and_wait(peer, oid,
+                                      progress)   # pushes deleted=True
             return
-        got = await self._gather_shards(oid, exclude={target} | set(exclude))
+        got = await self._gather_shards(
+            oid, exclude={target} | set(exclude),
+            want_version=self._auth_version(oid))
         if got is None:
             raise RuntimeError(f"{pg.pgid}: cannot reconstruct {oid} "
                                f"for shard {target}: insufficient shards")
@@ -911,9 +1008,11 @@ class ECBackend(PGBackend):
         fut = asyncio.get_running_loop().create_future()
         pg._push_acks[(peer, oid)] = fut
         try:
-            self.osd.send_osd(peer, MPGPush(
+            msg = MPGPush(
                 pg.pgid.with_shard(target), oid, pg.info.last_update,
-                rebuilt.tobytes(), attrs, {}, b"", self.osd.whoami))
+                rebuilt.tobytes(), attrs, {}, b"", self.osd.whoami)
+            msg.backfill_progress = progress
+            self.osd.send_osd(peer, msg)
             await asyncio.wait_for(fut, 20.0)
         finally:
             pg._push_acks.pop((peer, oid), None)
@@ -926,7 +1025,9 @@ class ECBackend(PGBackend):
         pg = self.pg
         my = self.my_shard
         soid = pg.object_id(oid)
-        got = await self._gather_shards(oid, exclude={my} | set(exclude))
+        got = await self._gather_shards(
+            oid, exclude={my} | set(exclude),
+            want_version=self._auth_version(oid))
         if got is None:
             latest = pg.log.latest_entry_for(oid)
             if latest is not None and latest.is_delete():

@@ -71,6 +71,7 @@ class OSD(Dispatcher):
         self.op_tracker = OpTracker()
         self.admin_socket = None
         self._stats_task: Optional[asyncio.Task] = None
+        self.mesh_exec = None    # set when osd_mesh_mode=on (start())
 
     def next_tid(self) -> int:
         self._tid += 1
@@ -81,6 +82,19 @@ class OSD(Dispatcher):
         self.store.mount()
         if self.messenger.addr.is_blank():
             await self.messenger.bind()
+        # intake backpressure (OSD::client_throttler role): client op
+        # bytes in flight are bounded; over budget the messenger stops
+        # reading the client's socket and TCP pushes back
+        from ceph_tpu.common.throttle import AsyncThrottle
+        self.messenger.dispatch_throttle = AsyncThrottle(
+            "osd_client_bytes", self.cfg["osd_client_message_size_cap"])
+        if self.cfg["osd_mesh_mode"] == "on":
+            # device-mesh execution mode: co-located shard OSDs share
+            # one mesh; EC bulk bytes move by sharded device program +
+            # in-process delivery instead of messenger sends
+            from ceph_tpu.parallel import mesh_exec
+            self.mesh_exec = mesh_exec.enable()
+            self.mesh_exec.register(self)
         await self._authenticate()
         self.monc.on_osdmap(self._on_osdmap)
         self.monc.sub_want("osdmap", 0)
@@ -134,6 +148,8 @@ class OSD(Dispatcher):
 
     async def shutdown(self) -> None:
         self.running = False
+        if self.mesh_exec is not None:
+            self.mesh_exec.unregister(self.whoami)
         if self._hb_task:
             self._hb_task.cancel()
         if self._boot_task:
@@ -462,6 +478,7 @@ class OSD(Dispatcher):
     def _handle_client_op(self, m: MOSDOp) -> None:
         pg = self._pg_for(m.pgid)
         if pg is None:
+            self.messenger.put_dispatch_throttle(m)
             self.reply_to(m, MOSDOpReply(
                 m.tid, -errno.EAGAIN, map_epoch=self.osdmap.epoch))
             return
@@ -501,6 +518,7 @@ class OSD(Dispatcher):
         finally:
             if getattr(m, "_tracked", None) is not None:
                 self.op_tracker.finish(m._tracked)
+            self.messenger.put_dispatch_throttle(m)
 
     # -------------------------------------------------------- introspection
     async def _start_admin_socket(self) -> None:

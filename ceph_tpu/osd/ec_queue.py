@@ -58,13 +58,20 @@ class ECBatchQueue:
     """OSD-wide EC encode/decode coalescer (one per daemon)."""
 
     def __init__(self, ctx, mode: str = "auto", window_ms: float = 2.0,
-                 min_device_bytes: int = 64 * 1024):
+                 min_device_bytes: int = 64 * 1024,
+                 max_pending_bytes: int = 256 << 20):
         self.ctx = ctx
         self.logger = ctx.logger("ec")
         self.window = window_ms / 1000.0
         self.min_device_bytes = min_device_bytes
         self.mode = mode
         self._pending: List[_Req] = []
+        # bound the park lot: more encode bytes than this in flight and
+        # new apply() callers BLOCK (FIFO) until a batch drains — an
+        # unbounded pending list let a fast client balloon OSD memory
+        from ceph_tpu.common.throttle import AsyncThrottle
+        self._pending_throttle = AsyncThrottle("ec_pending_bytes",
+                                               max_pending_bytes)
         self._wake: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._pool = concurrent.futures.ThreadPoolExecutor(
@@ -129,6 +136,7 @@ class ECBatchQueue:
         loop = asyncio.get_running_loop()
         if self._wake is None:
             self._wake = asyncio.Event()
+        await self._pending_throttle.get(nbytes)
         fut = loop.create_future()
         self._pending.append(
             _Req((mat.shape, mat.tobytes()),
@@ -136,7 +144,10 @@ class ECBatchQueue:
         self._wake.set()
         if self._task is None or self._task.done():
             self._task = loop.create_task(self._collector())
-        return await fut
+        try:
+            return await fut
+        finally:
+            self._pending_throttle.put(nbytes)
 
     def _host_apply(self, mat, chunks, nbytes) -> np.ndarray:
         self.perf.inc("host_requests")

@@ -141,6 +141,7 @@ class MOSDOp(Message):
     snapid (0 = head), mirroring MOSDOp's snapc/snapid fields."""
     TYPE = 200
     STRUCT_V = 2
+    THROTTLE_DISPATCH = True     # client data ops bound OSD intake
 
     def __init__(self, pgid: Optional[PGId] = None, oid: str = "",
                  loc: Optional[ObjectLocator] = None,
@@ -460,18 +461,27 @@ class MPGLogRequest(Message):
         self.since = since or EVersion()
         self.from_osd = from_osd
         self.want_object = want_object
-        # ask for the peer's full object listing (backfill scan role)
+        # ask for a WINDOW of the peer's object listing (backfill scan
+        # role, bounded like the reference's BackfillInterval: names
+        # AFTER list_after, at most list_max — never the whole PG in
+        # one message)
         self.want_list = want_list
+        self.list_after = ""
+        self.list_max = 0
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid).u32(self.epoch).struct(self.since)
         enc.s32(self.from_osd).string(self.want_object)
         enc.boolean(self.want_list)
+        enc.string(self.list_after).u32(self.list_max)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGLogRequest":
-        return cls(dec.struct(PGId), dec.u32(), dec.struct(EVersion),
-                   dec.s32(), dec.string(), dec.boolean())
+        m = cls(dec.struct(PGId), dec.u32(), dec.struct(EVersion),
+                dec.s32(), dec.string(), dec.boolean())
+        m.list_after = dec.string()
+        m.list_max = dec.u32()
+        return m
 
 
 @register_message
@@ -497,17 +507,25 @@ class MPGLog(Message):
         # primary confirms every object was pushed — receiver may now
         # persist backfill_complete
         self.backfill_done = backfill_done
+        # cursor-resumed backfill: with full_resync, objects with name
+        # <= backfill_from are kept (log deltas cover them) and only
+        # names beyond the cursor are dropped for re-push
+        # (last_backfill resume, PG.h:1911)
+        self.backfill_from = ""
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid).u32(self.epoch).bytes_(self.info_bytes)
         enc.bytes_(self.log_bytes).s32(self.from_osd)
         enc.boolean(self.activate).boolean(self.full_resync)
         enc.boolean(self.backfill_done)
+        enc.string(self.backfill_from)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGLog":
-        return cls(dec.struct(PGId), dec.u32(), dec.bytes_(), dec.bytes_(),
-                   dec.s32(), dec.boolean(), dec.boolean(), dec.boolean())
+        m = cls(dec.struct(PGId), dec.u32(), dec.bytes_(), dec.bytes_(),
+                dec.s32(), dec.boolean(), dec.boolean(), dec.boolean())
+        m.backfill_from = dec.string()
+        return m
 
 
 # --------------------------------------------------------------- recovery
@@ -534,6 +552,10 @@ class MPGPush(Message):
         self.omap_header = omap_header
         self.from_osd = from_osd
         self.deleted = deleted
+        # BACKFILL pushes advance the receiver's persisted last_backfill
+        # cursor to this name (pushes arrive in sorted-name order), so a
+        # killed target resumes from the cursor instead of from scratch
+        self.backfill_progress = ""
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid).string(self.oid).struct(self.version)
@@ -544,14 +566,17 @@ class MPGPush(Message):
                  lambda e, v: e.bytes_(v))
         enc.bytes_(self.omap_header).s32(self.from_osd)
         enc.boolean(self.deleted)
+        enc.string(self.backfill_progress)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGPush":
-        return cls(dec.struct(PGId), dec.string(), dec.struct(EVersion),
-                   dec.bytes_(),
-                   dec.map_(lambda d: d.string(), lambda d: d.bytes_()),
-                   dec.map_(lambda d: d.bytes_(), lambda d: d.bytes_()),
-                   dec.bytes_(), dec.s32(), dec.boolean())
+        m = cls(dec.struct(PGId), dec.string(), dec.struct(EVersion),
+                dec.bytes_(),
+                dec.map_(lambda d: d.string(), lambda d: d.bytes_()),
+                dec.map_(lambda d: d.bytes_(), lambda d: d.bytes_()),
+                dec.bytes_(), dec.s32(), dec.boolean())
+        m.backfill_progress = dec.string()
+        return m
 
 
 @register_message
@@ -575,27 +600,37 @@ class MPGPushReply(Message):
 
 @register_message
 class MPGObjectList(Message):
-    """Peer's full object listing — the backfill both-sides scan
-    (reference BackfillInterval, osd/PG.h:1911)."""
+    """One WINDOW of a peer's sorted object listing — the backfill
+    both-sides scan (reference BackfillInterval, osd/PG.h:1911).
+    `truncated` means more names follow after names[-1]."""
     TYPE = 216
     PRIORITY = PRIO_HIGH
 
     def __init__(self, pgid: Optional[PGId] = None,
-                 names: Optional[list] = None, from_osd: int = -1):
+                 names: Optional[list] = None, from_osd: int = -1,
+                 truncated: bool = False, after: str = ""):
         super().__init__()
         self.pgid = pgid or PGId(0, 0)
         self.names = names or []
         self.from_osd = from_osd
+        self.truncated = truncated
+        # echoes the request's list_after: the requester correlates
+        # windows so a LATE reply from a timed-out earlier attempt
+        # can't masquerade as the current window (that aliasing lost
+        # objects: a stale partial listing drove the peer-only sweep)
+        self.after = after
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid)
         enc.list_(self.names, lambda e, v: e.string(v))
         enc.s32(self.from_osd)
+        enc.boolean(self.truncated)
+        enc.string(self.after)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGObjectList":
         return cls(dec.struct(PGId), dec.list_(lambda d: d.string()),
-                   dec.s32())
+                   dec.s32(), dec.boolean(), dec.string())
 
 
 # ------------------------------------------------------------------ scrub

@@ -36,8 +36,8 @@ from ceph_tpu.osd.messages import (
     EVersion, MOSDOp, MOSDOpReply, MPGLog, MPGLogRequest, MPGNotify,
     MPGObjectList, MPGPush, MPGPushReply, MPGQuery,
 )
-from ceph_tpu.osd.pglog import (LogEntry, MissingSet, PastInterval, PGInfo,
-                                PGLog)
+from ceph_tpu.osd.pglog import (LB_MAX, LogEntry, MissingSet, PastInterval,
+                                PGInfo, PGLog)
 from ceph_tpu.osd.types import NO_SHARD, PGId, PGPool
 from ceph_tpu.store.objectstore import Transaction
 from ceph_tpu.store.types import CollectionId, ObjectId
@@ -341,6 +341,8 @@ class PG:
             tracked = getattr(m, "_tracked", None)
             if tracked is not None and self.osd is not None:
                 self.osd.op_tracker.finish(tracked)
+            if self.osd is not None:
+                self.osd.messenger.put_dispatch_throttle(m)
 
     # ------------------------------------------------------------- peering
     async def _peer(self) -> None:
@@ -595,51 +597,117 @@ class PG:
     async def _full_resync_from(self, peer: int, auth_info: PGInfo,
                                 auth_log: PGLog, epoch: int) -> None:
         """Primary self-backfill: scan the auth peer's object list, drop
-        local objects it doesn't have, pull the rest, only then declare
+        local objects it doesn't have, pull the rest in sorted-name
+        order advancing the last_backfill cursor, only then declare
         ourselves complete (reference backfill, PG.h:1911 — both-sides
-        scan with a progress marker surviving interruption)."""
-        self.log_.info(f"{self.pgid}: full self-resync from osd.{peer} "
-                       f"(auth tail {auth_log.tail} > our "
-                       f"{self.info.last_update} or resync unfinished)")
-        # mark incomplete FIRST: a crash mid-resync must retry, not trust
-        self.info.backfill_complete = False
+        scan with a per-object cursor surviving interruption).
+
+        Resume: objects <= our persisted cursor were pulled by an
+        earlier attempt; they only need re-pulling if the auth log
+        shows them CHANGED since the scan position we had then.  The
+        honest scan position is min(last_update, last_complete):
+        last_complete stays CLAMPED at the pre-resync position until
+        this resync finishes, so a crash after adopting the new
+        last_update but before re-pulling the changed-under-cursor
+        objects still re-exposes that delta window to the next attempt
+        (instead of silently keeping stale bytes).  When the log window
+        has closed over that position the cursor is useless and the
+        resync restarts from scratch."""
+        prev_lu = min(self.info.last_update, self.info.last_complete)
+        resume_from = self.info.last_backfill
+        if resume_from == LB_MAX:
+            resume_from = ""
+        if resume_from and not auth_log.can_catch_up_from(prev_lu):
+            resume_from = ""
+        self.log_.info(
+            f"{self.pgid}: full self-resync from osd.{peer}"
+            + (f" (resume >{resume_from!r})" if resume_from else ""))
+        # mark the cursor position FIRST: a crash mid-resync must
+        # resume/retry, never trust a half-pulled copy
+        self.info.last_backfill = resume_from
         txn = Transaction()
         self.save_meta(txn)
         self.osd.store.apply_transaction(txn)
-        # both-sides scan: fetch the auth peer's object listing
-        fut = asyncio.get_running_loop().create_future()
-        self._list_waiters[peer] = fut
-        peer_shard = self._probe_shards.get(peer, self.shard_of(peer))
-        self.osd.send_osd(peer, MPGLogRequest(
-            self.pgid.with_shard(peer_shard), epoch,
-            EVersion.zero(), self.osd.whoami, want_list=True))
-        try:
-            names = await asyncio.wait_for(fut, 15.0)
-        finally:
-            self._list_waiters.pop(peer, None)
-        keep = set(names)
-        txn = Transaction()
-        for soid in self.osd.store.collection_list(self.cid):
-            if soid.name != self.meta_oid.name and soid.name not in keep:
-                txn.remove(self.cid, soid)
         # adopt the authoritative log/info wholesale
+        changed = {oid for oid, e in
+                   auth_log.objects_since(prev_lu).items()
+                   if not e.is_delete()} if resume_from else set()
         self.log = auth_log
         self.reqids = self.log.reqids()
         self.info.last_update = auth_info.last_update
-        self.info.last_complete = auth_info.last_update
+        # last_complete stays at the honest pre-resync position until
+        # the resync COMPLETES (see docstring: crash-window safety)
+        self.info.last_complete = min(prev_lu, auth_info.last_update)
+        txn = Transaction()
         self.save_meta(txn)
         self.osd.store.apply_transaction(txn)
-        for oid in names:
-            if epoch != self.interval_epoch:
-                return    # superseded; backfill_complete stays False
-            await self.backend.pull_object(peer, oid, epoch)
+        # both-sides scan in BOUNDED windows (osd_backfill_scan_max;
+        # the reference never ships a whole PG listing in one message)
+        local = sorted(s.name for s in
+                       self.osd.store.collection_list(self.cid)
+                       if s.name != self.meta_oid.name)
+        window = max(8, int(self.osd.cfg["osd_backfill_scan_max"]))
+        after = ""
+        pulled = total = 0
+        while True:
+            names, truncated = await self._fetch_list_window(
+                peer, epoch, after, window)
+            total += len(names)
+            # drop local objects inside this window's span the auth
+            # peer doesn't have (peer-only objects must not survive);
+            # `local` is sorted — bisect the span instead of rescanning
+            # the whole list per window
+            import bisect
+            span_end = names[-1] if truncated and names else LB_MAX
+            have = set(names)
+            lo = bisect.bisect_right(local, after)
+            hi = bisect.bisect_right(local, span_end)
+            txn = Transaction()
+            for n in local[lo:hi]:
+                if n not in have:
+                    txn.remove(self.cid, self.object_id(n))
+            self.osd.store.apply_transaction(txn)
+            for oid in names:
+                if epoch != self.interval_epoch:
+                    return  # superseded; the cursor survives for resume
+                if oid <= resume_from and oid not in changed:
+                    continue  # fresh from the previous attempt
+                await self.backend.pull_object(peer, oid, epoch)
+                pulled += 1
+                if oid > self.info.last_backfill:
+                    self.info.last_backfill = oid
+                    if pulled % 16 == 0:  # bound meta-write amplification
+                        t = Transaction()
+                        self.save_meta(t)
+                        self.osd.store.apply_transaction(t)
+            if not truncated or not names:
+                break
+            after = names[-1]
         self.missing = MissingSet()
-        self.info.backfill_complete = True
+        self.info.last_backfill = LB_MAX
+        self.info.last_complete = self.info.last_update
         txn = Transaction()
         self.save_meta(txn)
         self.osd.store.apply_transaction(txn)
         self.log_.info(f"{self.pgid}: self-resync complete "
-                       f"({len(names)} objects)")
+                       f"({pulled}/{total} objects pulled)")
+
+    async def _fetch_list_window(self, peer: int, epoch: int,
+                                 after: str, limit: int):
+        """One bounded listing window from the auth peer."""
+        fut = asyncio.get_running_loop().create_future()
+        self._list_waiters[peer] = (fut, after)
+        peer_shard = self._probe_shards.get(peer, self.shard_of(peer))
+        req = MPGLogRequest(
+            self.pgid.with_shard(peer_shard), epoch,
+            EVersion.zero(), self.osd.whoami, want_list=True)
+        req.list_after = after
+        req.list_max = limit
+        self.osd.send_osd(peer, req)
+        try:
+            return await asyncio.wait_for(fut, 15.0)
+        finally:
+            self._list_waiters.pop(peer, None)
 
     async def pull_object_via_push(self, peer: int, oid: str,
                                    epoch: int) -> None:
@@ -721,25 +789,41 @@ class PG:
             # last_complete < last_update; those objects get re-pushed)
             peer_from = min(pi.last_update, pi.last_complete)
             full_resync = not self._peer_in_sync(pi)
+            backfill_from = ""
             if not full_resync:
                 for oid, e in self.log.objects_since(peer_from).items():
                     if not e.is_delete():
                         pm.add(oid, e.version)
             else:
-                # too far behind: full resync (Backfill role).  The peer
-                # drops its own objects first (full_resync flag) so
-                # anything deleted beyond the log window can't survive
-                # there and resurrect later (reference backfill scans
-                # both sides; ADVICE r1).
+                # too far behind: backfill (reference Backfill role).
+                # A peer with a partial last_backfill cursor whose log
+                # position is still inside our window RESUMES: objects
+                # <= its cursor need only the log-window deltas, names
+                # beyond the cursor get the full scan-order push
+                # (PG.h:1911 last_backfill semantics).  Otherwise the
+                # peer drops everything and every object re-pushes, so
+                # deletions beyond the log window can't resurrect
+                # (reference backfill scans both sides; ADVICE r1).
+                if (pi.last_backfill and pi.last_backfill != LB_MAX
+                        and self.log.can_catch_up_from(peer_from)):
+                    backfill_from = pi.last_backfill
+                    for oid, e in self.log.objects_since(
+                            peer_from).items():
+                        if not e.is_delete() \
+                                and oid <= backfill_from:
+                            pm.add(oid, e.version)
                 for soid in self.osd.store.collection_list(self.cid):
-                    if soid.name != self.meta_oid.name:
+                    if soid.name != self.meta_oid.name \
+                            and soid.name > backfill_from:
                         pm.add(soid.name, self.info.last_update)
                 self._backfilling.add(p)
             self.peer_missing[p] = pm
-            self.osd.send_osd(p, MPGLog(
+            msg = MPGLog(
                 self.pgid.with_shard(self.shard_of(p)), epoch,
                 self.info.to_bytes(), self.log.to_bytes(), me,
-                activate=True, full_resync=full_resync))
+                activate=True, full_resync=full_resync)
+            msg.backfill_from = backfill_from
+            self.osd.send_osd(p, msg)
         if epoch != self.interval_epoch:
             return   # superseded meanwhile
         if not self.info.backfill_complete:
@@ -780,11 +864,24 @@ class PG:
         while epoch == self.interval_epoch:
             try:
                 for p, pm in list(self.peer_missing.items()):
-                    for oid in list(pm.items):
+                    backfilling = p in self._backfilling
+                    # backfill targets are fed in sorted-name order and
+                    # each push stamps the cursor so the target's
+                    # last_backfill advances durably (PG.h:1911)
+                    for oid in sorted(pm.items):
                         if epoch != self.interval_epoch:
                             return
-                        await self.backend.recover_object(p, oid)
+                        await self.backend.recover_object(
+                            p, oid,
+                            progress=oid if backfilling else "")
                         pm.items.pop(oid, None)
+                        if backfilling:
+                            # track the target's cursor primary-side
+                            # too: read routing consults peer_info
+                            pi = self.peer_info.get(p)
+                            if pi is not None \
+                                    and oid > pi.last_backfill:
+                                pi.last_backfill = oid
                     if p in self._backfilling and not pm.items \
                             and epoch == self.interval_epoch:
                         # every object pushed: the peer may now trust
@@ -865,11 +962,16 @@ class PG:
 
     def on_log_request(self, m: MPGLogRequest) -> None:
         if m.want_list:
-            names = [soid.name
-                     for soid in self.osd.store.collection_list(self.cid)
-                     if soid.name != self.meta_oid.name]
+            names = sorted(
+                soid.name
+                for soid in self.osd.store.collection_list(self.cid)
+                if soid.name != self.meta_oid.name
+                and soid.name > m.list_after)
+            limit = m.list_max or len(names)
+            truncated = len(names) > limit
             self.osd.send_osd(m.from_osd, MPGObjectList(
-                m.pgid, names, self.osd.whoami))
+                m.pgid, names[:limit], self.osd.whoami,
+                truncated=truncated, after=m.list_after))
             return
         if m.want_object:
             self.backend.push_object(m.from_osd, m.want_object,
@@ -886,11 +988,25 @@ class PG:
             new_log = PGLog.from_bytes(m.log_bytes)
             txn = Transaction()
             if m.full_resync:
-                # drop everything we hold — the primary re-pushes its
-                # full object set; peer-only objects must not survive
+                # drop what the primary will re-push: everything beyond
+                # the resume cursor.  Names <= the cursor were pushed by
+                # an earlier attempt and only need the log-window
+                # deltas (deletes/overwrites) the primary recovers via
+                # peer_missing — apply the deletes here so peer-only
+                # objects can't survive under the cursor either
+                cursor = m.backfill_from
                 for soid in self.osd.store.collection_list(self.cid):
-                    if soid.name != self.meta_oid.name:
+                    if soid.name != self.meta_oid.name \
+                            and soid.name > cursor:
                         txn.remove(self.cid, soid)
+                if cursor:
+                    scan_from = min(since, self.info.last_complete)
+                    if not new_log.can_catch_up_from(scan_from):
+                        scan_from = since
+                    for oid, e in new_log.objects_since(
+                            scan_from).items():
+                        if e.is_delete() and oid <= cursor:
+                            txn.remove(self.cid, self.object_id(oid))
             else:
                 # apply log-window deletions: adopting the log alone
                 # would leave the object bytes in our store; for the
@@ -923,19 +1039,30 @@ class PG:
                         self.missing.items.pop(oid, None)
                     else:
                         self.missing.add(oid, e.version)
-            prev_complete = self.info.backfill_complete
+            prev_lb = self.info.last_backfill
+            prev_lc = min(since, self.info.last_complete)
             self.info = PGInfo.from_bytes(m.info_bytes)
             self.info.pgid = self.pgid
             if self.missing and not m.full_resync:
                 self.info.last_complete = since   # honest cursor
             # the adopted info carries the PRIMARY's backfill state; ours
-            # is: mid-resync until the primary confirms every push landed
+            # is: mid-resync until the primary confirms every push
+            # landed — resuming from the agreed cursor (never reuse the
+            # primary's, and never regress a partial cursor to "")
             if m.full_resync:
-                self.info.backfill_complete = False
+                self.info.last_backfill = m.backfill_from
+                if m.backfill_from:
+                    # cursor-resumed: the under-cursor delta pushes are
+                    # still owed — keep last_complete clamped at the
+                    # pre-adoption position so a crash before they land
+                    # re-exposes the (prev_lc, lu] window to the next
+                    # primary instead of reading as fully caught up
+                    self.info.last_complete = prev_lc
             elif m.backfill_done:
                 self.info.backfill_complete = True
+                self.info.last_complete = self.info.last_update
             else:
-                self.info.backfill_complete = prev_complete
+                self.info.last_backfill = prev_lb
             self.log = new_log
             self.reqids = self.log.reqids()
             self.state = STATE_ACTIVE
@@ -958,9 +1085,14 @@ class PG:
             fut.set_result(True)
 
     def on_object_list(self, m: MPGObjectList) -> None:
-        fut = self._list_waiters.get(m.from_osd)
-        if fut is not None and not fut.done():
-            fut.set_result(list(m.names))
+        ent = self._list_waiters.get(m.from_osd)
+        if ent is None:
+            return
+        fut, want_after = ent
+        if m.after != want_after:
+            return   # stale window from a superseded attempt: drop
+        if not fut.done():
+            fut.set_result((list(m.names), m.truncated))
 
     def on_push_reply(self, m: MPGPushReply) -> None:
         fut = self._push_acks.get((m.from_osd, m.oid))
@@ -1011,6 +1143,8 @@ class PG:
         finally:
             if tracked is not None:
                 self.osd.op_tracker.finish(tracked)
+            # op done: release its intake budget (throttle backpressure)
+            self.osd.messenger.put_dispatch_throttle(m)
 
     async def _do_client_op_inner(self, m: MOSDOp) -> None:
         if not self.is_primary():

@@ -53,14 +53,20 @@ class LogEntry(Encodable):
                 f"{self.oid}@{self.version}")
 
 
+# the "backfill finished" cursor sentinel: compares greater than any
+# real object name (hobject_t::get_max / last_backfill == MAX role);
+# U+10FFFF is the maximum code point so no name can exceed it
+LB_MAX = "\U0010ffff"
+
+
 class PGInfo(Encodable):
     """pg_info_t distilled: identity + log bounds + interval history."""
 
-    STRUCT_V = 3
+    STRUCT_V = 4
 
     __slots__ = ("pgid", "last_update", "last_complete", "log_tail",
                  "last_epoch_started", "same_interval_since",
-                 "backfill_complete", "last_scrub_stamp",
+                 "last_backfill", "last_scrub_stamp",
                  "last_deep_scrub_stamp")
 
     def __init__(self, pgid: Optional[PGId] = None):
@@ -70,14 +76,26 @@ class PGInfo(Encodable):
         self.log_tail = EVersion()         # oldest log entry we hold
         self.last_epoch_started = 0        # last epoch the pg went active
         self.same_interval_since = 0       # epoch the acting set last changed
-        # full-resync progress marker (the last_backfill cursor role,
-        # PG.h:1911): False from the moment a full resync starts until
-        # the primary confirms every object was pushed, so an
-        # interrupted backfill is retried instead of trusted
-        self.backfill_complete = True
+        # per-object backfill cursor (pg_info_t last_backfill,
+        # PG.h:1911): every object with name <= last_backfill is
+        # up to date locally; names beyond it may be missing or stale.
+        # LB_MAX = fully backfilled; "" = a full resync just started.
+        # Backfill pushes objects in sorted-name order and advances
+        # this, so an interrupted backfill resumes from the cursor
+        # instead of starting over, and readers can route per object.
+        self.last_backfill = LB_MAX
         # scrub history (pg_info_t history.last_scrub_stamp role), ms
         self.last_scrub_stamp = 0
         self.last_deep_scrub_stamp = 0
+
+    @property
+    def backfill_complete(self) -> bool:
+        """Derived view of the cursor (the old PG-level boolean)."""
+        return self.last_backfill == LB_MAX
+
+    @backfill_complete.setter
+    def backfill_complete(self, value: bool) -> None:
+        self.last_backfill = LB_MAX if value else ""
 
     def is_empty(self) -> bool:
         return self.last_update == EVersion.zero()
@@ -88,6 +106,7 @@ class PGInfo(Encodable):
         enc.u32(self.last_epoch_started).u32(self.same_interval_since)
         enc.boolean(self.backfill_complete)
         enc.u64(self.last_scrub_stamp).u64(self.last_deep_scrub_stamp)
+        enc.string(self.last_backfill)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "PGInfo":
@@ -102,6 +121,8 @@ class PGInfo(Encodable):
         if struct_v >= 3:
             i.last_scrub_stamp = dec.u64()
             i.last_deep_scrub_stamp = dec.u64()
+        if struct_v >= 4:
+            i.last_backfill = dec.string()
         return i
 
     def __repr__(self):

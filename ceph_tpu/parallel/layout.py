@@ -12,8 +12,11 @@ jax device mesh:
 
 Collectives ride ICI: parity fan-out is a ppermute ring (the
 MOSDECSubOpWrite hop), scrub aggregation is a psum (the PGMap stat roll-up).
-This module is used by __graft_entry__.dryrun_multichip and by the OSD
-device-mesh execution mode.
+This module is used by __graft_entry__.dryrun_multichip; the live OSD
+device-mesh execution mode (osd_mesh_mode=on) lives in
+ceph_tpu/parallel/mesh_exec.py, which runs the same all_gather/row-sharded
+encode INSIDE the EC write path and hands shard bytes to co-located OSDs
+in process (tests/test_mesh_mode.py boots it end to end).
 """
 
 from __future__ import annotations

@@ -1,0 +1,178 @@
+"""OSD device-mesh execution mode: co-located shard OSDs share a jax
+device mesh, and the EC write path runs as ONE sharded device program
+instead of host encode + per-shard messenger sends.
+
+Reference mapping (SURVEY §2.4 TPU-native design): the bulk-data hop of
+ECBackend::submit_transaction — encode then MOSDECSubOpWrite to every
+shard OSD (/root/reference/src/osd/ECBackend.cc:1344,1773) — becomes
+
+  * a shard_map'd GF(2^8) encode where device i COMPUTES shard i's
+    bytes in place: data chunks all_gather along the mesh's "shard"
+    axis (the ICI hop that replaces the NCCL-less messenger fan-out),
+    each device applies its own generator row block, so when the
+    program ends every device holds exactly its shard;
+  * in-process delivery of the per-shard sub-op (log append + store
+    txn) to the co-located OSD — the chunk bytes never touch TCP.
+
+Control traffic (acks, maps, peering) stays on the messenger — the
+data/control split the survey prescribes.  OSDs not registered on the
+executor (remote hosts) still get messenger sends, so a partially
+co-located cluster degrades to the normal path per target.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Dict, Optional
+
+import numpy as np
+
+_EXECUTOR: Optional["MeshExecutor"] = None
+
+
+def enable() -> "MeshExecutor":
+    """Install the process-wide executor (vstart/in-process clusters)."""
+    global _EXECUTOR
+    if _EXECUTOR is None:
+        _EXECUTOR = MeshExecutor()
+    return _EXECUTOR
+
+
+def disable() -> None:
+    global _EXECUTOR
+    _EXECUTOR = None
+
+
+def current() -> Optional["MeshExecutor"]:
+    return _EXECUTOR
+
+
+@lru_cache(maxsize=32)
+def _mesh_encode_fn(n: int, k: int, mat_bytes: bytes):
+    """Jitted sharded encode for an n-device 1-D mesh: in [n, Lc] chunk
+    rows (parity rows zero), out [n, Lc] with device i holding shard i.
+    Cached per (geometry, generator)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+    from ceph_tpu.ec.gf256 import expand_to_bitmatrix
+
+    gen = np.frombuffer(mat_bytes, np.uint8).reshape(n, k)
+    # per-shard 8-row bit-matrix blocks: blocks[i] computes shard i
+    # from the k data chunks (identity passthrough for data shards)
+    bitmat = expand_to_bitmatrix(gen)              # [8n, 8k]
+    blocks = jnp.asarray(bitmat.reshape(n, 8, 8 * k), jnp.int8)
+
+    devs = jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(f"mesh mode needs {n} devices, "
+                           f"have {len(devs)}")
+    grid = np.empty(n, dtype=object)
+    grid[:] = devs[:n]
+    mesh = Mesh(grid, ("shard",))
+
+    def step(local):                                # local [1, Lc] uint8
+        # the ICI hop: every device receives all k data chunks
+        # (replaces the messenger's per-shard chunk send)
+        allg = jax.lax.all_gather(local, "shard")   # [n, 1, Lc]
+        data = allg[:k, 0]                          # [k, Lc]
+        idx = jax.lax.axis_index("shard")
+        blk = jnp.take(blocks, idx, axis=0)         # [8, 8k]
+        # unpack -> per-device row-block matmul -> mod2 -> pack
+        kk, L = data.shape
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        bits = ((data[:, None, :] >> shifts[None, :, None]) & 1) \
+            .reshape(kk * 8, L).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            blk, bits, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)       # [8, L]
+        planes = (acc & 1).astype(jnp.uint8)
+        out = planes[0]
+        for b in range(1, 8):
+            out = out | (planes[b] << b)
+        return out[None, :]                         # [1, L]
+
+    fn = shard_map(step, mesh=mesh,
+                   in_specs=(P("shard", None),),
+                   out_specs=P("shard", None),
+                   check_vma=False)
+    return jax.jit(fn), mesh
+
+
+class MeshExecutor:
+    """Process-wide registry of co-located OSDs + the sharded encode."""
+
+    def __init__(self):
+        import concurrent.futures
+        self.osds: Dict[int, object] = {}
+        self.launches = 0
+        self.inproc_subops = 0
+        # device dispatch (and the first-call jit compile) must never
+        # run on the shared event loop every co-located OSD lives on
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="mesh-exec")
+
+    def register(self, osd) -> None:
+        self.osds[osd.whoami] = osd
+
+    def unregister(self, osd_id: int) -> None:
+        self.osds.pop(osd_id, None)
+
+    def covers(self, osd_id: int) -> bool:
+        return osd_id in self.osds
+
+    # ------------------------------------------------------------- encode
+    async def encode_object(self, codec,
+                            data: bytes) -> Dict[int, np.ndarray]:
+        """Full-object encode as one sharded device program; returns
+        shard index -> chunk bytes (same contract as codec.encode).
+        The launch runs in the executor thread — the event loop only
+        awaits it."""
+        import asyncio
+        gen = getattr(codec, "generator", None)
+        if gen is None:
+            raise RuntimeError("codec exposes no generator matrix")
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+        chunks = codec.split_data(data)             # [k, Lc]
+        Lc = len(chunks[0])
+
+        def _launch():
+            fn, _mesh = _mesh_encode_fn(
+                n, k, np.ascontiguousarray(gen, np.uint8).tobytes())
+            inp = np.zeros((n, Lc), np.uint8)
+            for i in range(k):
+                inp[i] = chunks[i]
+            return np.asarray(fn(inp))
+
+        out = await asyncio.get_running_loop().run_in_executor(
+            self._pool, _launch)
+        self.launches += 1
+        return {i: out[i] for i in range(n)}
+
+    # ----------------------------------------------------------- delivery
+    def deliver(self, target_osd_id: int, msg, from_osd: int) -> bool:
+        """Hand a sub-op to a co-located OSD without the messenger (the
+        bulk-bytes hop).  Returns False if the target isn't local (the
+        caller falls back to a messenger send).  Acks ride the normal
+        messenger — only the chunk bytes skip TCP."""
+        osd = self.osds.get(target_osd_id)
+        if osd is None or not osd.running:
+            return False
+        # stamp what the transport would have (replies address src_name)
+        import time as _time
+        from ceph_tpu.msg.types import EntityName
+        msg.recv_stamp = _time.monotonic()
+        msg.src_name = EntityName("osd", str(from_osd))
+        sender = self.osds.get(from_osd)
+        if sender is not None:
+            msg.src_addr = sender.messenger.addr
+        self.inproc_subops += 1
+        try:
+            return bool(osd.ms_dispatch(msg))
+        except Exception:
+            return False

@@ -1,0 +1,91 @@
+"""OSD device-mesh execution mode (SURVEY §2.4 TPU-native data plane).
+
+Boots a co-located cluster with osd_mesh_mode=on on the 8-device
+virtual CPU mesh: EC writes encode as ONE sharded device program
+(all_gather over the mesh's shard axis replaces the messenger chunk
+fan-out; each device computes its own shard), sub-ops deliver in
+process, and reads come back through the normal client path.  Verifies
+VERDICT r3 ask #4's done-criteria: librados write -> per-shard
+placement + parity bytes checked against the codec ground truth.
+"""
+
+import asyncio
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from test_osd import Cluster  # noqa: E402
+
+from ceph_tpu.parallel import mesh_exec  # noqa: E402
+from ceph_tpu.qa.cluster import make_ctx  # noqa: E402
+
+
+def _mesh_ctx(name):
+    c = make_ctx(name)
+    c.config.set("osd_mesh_mode", "on")
+    return c
+
+
+def test_mesh_mode_ec_write_placement_and_parity():
+    async def run():
+        mesh_exec.disable()
+        cl = Cluster(ctx_factory=_mesh_ctx)
+        admin = await cl.start(5)
+        ex = mesh_exec.current()
+        assert ex is not None and len(ex.osds) == 5, \
+            "all co-located osds must register on the executor"
+        await admin.pool_create("ecm", pg_num=4, pool_type="erasure",
+                                k=2, m=2)
+        io = admin.open_ioctx("ecm")
+        payloads = {f"mobj{i}": bytes([i + 1]) * (4096 + 512 * i)
+                    for i in range(6)}
+        for oid, data in payloads.items():
+            await io.write_full(oid, data)
+        # the sharded program ran and sub-ops skipped the messenger
+        assert ex.launches >= len(payloads), \
+            f"mesh encode launches: {ex.launches}"
+        assert ex.inproc_subops > 0
+        # reads come back through the normal client path
+        for oid, data in payloads.items():
+            assert await io.read(oid) == data
+
+        # per-shard placement + parity ground truth: find each object's
+        # pg, locate every shard osd's store copy, compare with the
+        # codec's own split/parity
+        from ceph_tpu.ec.registry import factory
+        from ceph_tpu.ec import gf256
+        from ceph_tpu.client.objecter import ObjectLocator
+        from ceph_tpu.store.types import CollectionId, ObjectId
+        m = admin.monc.osdmap
+        pool_id = m.lookup_pool("ecm")
+        pool = m.pools[pool_id]
+        profile = dict(m.ec_profiles[pool.ec_profile])
+        profile.setdefault("k", "2")
+        profile.setdefault("m", "2")
+        profile.pop("plugin", None)
+        codec = factory("rs", profile)
+        k, n = 2, 4
+        checked_parity = 0
+        for oid, data in payloads.items():
+            pgid = pool.raw_pg_to_pg(
+                m.object_locator_to_pg(oid, ObjectLocator(pool_id)))
+            up, _, acting, _ = m.pg_to_up_acting_osds(pgid)
+            chunks = codec.split_data(data)
+            gen = codec.generator
+            parity = gf256.host_apply(gen[k:], chunks)
+            want = {i: (chunks[i] if i < k else parity[i - k])
+                    for i in range(n)}
+            for i, osd_id in enumerate(acting):
+                osd = cl.osds[osd_id]
+                cid = CollectionId.pg(pool_id, pgid.seed, i)
+                raw = osd.store.read(cid, ObjectId(oid, pool=pool_id))
+                got = np.frombuffer(raw, np.uint8)
+                assert np.array_equal(got, want[i]), \
+                    f"{oid} shard {i} on osd.{osd_id} mismatch"
+                if i >= k:
+                    checked_parity += 1
+        assert checked_parity >= len(payloads) * 2
+        await cl.stop()
+        mesh_exec.disable()
+    asyncio.run(run())

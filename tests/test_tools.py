@@ -212,3 +212,23 @@ rule replicated_rule {
         compile_text("type 0 osd\ntype 10 root\n"
                      "root default { id -1 alg straw2 hash 0 "
                      "item ghost weight 1.000000 }\n")
+
+
+def test_crush_compiler_single_line_blocks():
+    """The reference grammar treats newlines as whitespace: single-line
+    bucket/rule blocks must compile."""
+    from ceph_tpu.crush.compiler import compile_text
+    from ceph_tpu.crush.mapper import do_rule
+    one = ("type 0 osd type 1 host type 10 root "
+           "device 0 osd.0 device 1 osd.1 "
+           "host h { id -1 alg straw2 hash 0 "
+           "item osd.0 weight 1.000000 item osd.1 weight 1.000000 } "
+           "root default { id -2 alg straw2 hash 0 "
+           "item h weight 2.000000 } "
+           "rule r { ruleset 0 type replicated min_size 1 max_size 10 "
+           "step take default step chooseleaf firstn 0 type osd "
+           "step emit }")
+    ms = compile_text(one)
+    assert ms.max_devices == 2
+    got = do_rule(ms, 0, 7, 2, [0x10000] * 2)
+    assert sorted(got) == [0, 1]
