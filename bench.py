@@ -14,10 +14,15 @@ whole 20-minute budget and the contract line never printed):
   * the ORCHESTRATOR (no --stage argument) never imports jax.  Each bench
     stage runs in its own subprocess with a hard timeout; a wedged TPU
     runtime loses only that stage's budget.
-  * the TPU backend is probed exactly ONCE (<=75 s subprocess); on failure
-    every later stage runs with JAX_PLATFORMS=cpu and the device benches
-    are skipped — the hang is paid at most once.
-  * CPU + CRUSH benches run FIRST; device benches run LAST.
+  * the TPU backend is probed in bounded subprocesses with RETRIES spread
+    across the run (75s, 150s, and a late 180s attempt) — one flaky
+    runtime init must not erase the round's headline metric; on failure
+    every later stage runs with JAX_PLATFORMS=cpu (+ plugin site dir
+    stripped) and the device benches fall back to the last successful
+    TPU measurement persisted in BENCH_TPU_CACHE.json, explicitly
+    labeled stale.
+  * CPU + host-engine CRUSH benches run FIRST (jax-free, scrubbed env);
+    device benches run LAST.
   * a global deadline (default 19 min, env BENCH_DEADLINE_SEC) shrinks each
     stage's timeout; whatever was measured by then is emitted.
 
@@ -52,11 +57,14 @@ CHUNK = STRIPE // K                    # 128 KiB chunks
 BATCH = 32                             # stripes per dispatch (batch the op
                                        # queue, survey §7 "hard parts")
 
-CRUSH_N = 1_000_000
+CRUSH_N = int(os.environ.get("BENCH_CRUSH_N", "1000000"))
 CRUSH_HOSTS, CRUSH_PER_HOST = 128, 8
 # round-1 measured single-core reference C rates on this container class
-# (BASELINE.md row 4); used only if compiling the reference fails
-REF_CRUSH_FALLBACK = {"firstn_per_sec": 53238.0, "indep_per_sec": 32898.0}
+# (BASELINE.md row 4); used only if compiling the reference fails.  The
+# 3-level figure approximates with the 2-level rate (never measured on
+# the recorded container; ref_kind="recorded" labels the whole set).
+REF_CRUSH_FALLBACK = {"firstn_per_sec": 53238.0, "indep_per_sec": 32898.0,
+                      "firstn3l_per_sec": 53238.0}
 REF = pathlib.Path("/root/reference")
 
 DEADLINE = float(os.environ.get("BENCH_DEADLINE_SEC", "1140"))
@@ -174,61 +182,105 @@ def _bench_ref_crush():
         return dict(REF_CRUSH_FALLBACK), "recorded"
 
 
-def stage_crush():
-    """CRUSH jax engine: 1M mappings, firstn x3 + indep x6.  Runs on
-    whatever backend JAX_PLATFORMS selects (the orchestrator sets cpu
-    when the TPU probe failed)."""
-    import jax
+def _crush_ref():
+    """Reference numbers: from BENCH_CRUSH_REF (orchestrator measured
+    once, passed down) or measured/recorded here."""
+    blob = os.environ.get("BENCH_CRUSH_REF")
+    if blob:
+        d = json.loads(blob)
+        return d["ref"], d["kind"]
+    return _bench_ref_crush()
+
+
+def _crush_workload():
     from ceph_tpu.crush.builder import (build_hierarchy, make_erasure_rule,
                                         make_replicated_rule)
-    from ceph_tpu.crush.mapper import do_rule
     from ceph_tpu.crush.types import CrushMap
-    from ceph_tpu.ops.crush_kernel import batch_do_rule_arrays, warmup
-
-    backend = jax.default_backend()
     n_osd = CRUSH_HOSTS * CRUSH_PER_HOST
     m = CrushMap()
     m.max_devices = n_osd
     build_hierarchy(m, n_osd, CRUSH_PER_HOST)
     rep = make_replicated_rule(m, "rep")
     ec = make_erasure_rule(m, "ec", size=6)
+    # 3-level variant: same 1024 osds behind root->rack->host (16 racks)
+    m3 = CrushMap()
+    m3.max_devices = n_osd
+    build_hierarchy(m3, n_osd, CRUSH_PER_HOST, hosts_per_rack=8)
+    rep3 = make_replicated_rule(m3, "rep3")
     w = [0x10000] * n_osd
+    return m, rep, ec, m3, rep3, w
+
+
+def _stage_crush_engine(engine, backend_label):
+    """1M mappings, firstn x3 + indep x6, on one kernel engine."""
+    from ceph_tpu.crush.mapper import do_rule
+    from ceph_tpu.ops.crush_kernel import batch_do_rule_arrays, warmup
+
+    m, rep, ec, m3, rep3, w = _crush_workload()
     xs = np.arange(CRUSH_N)
-    ref, ref_kind = _bench_ref_crush()
+    ref, ref_kind = _crush_ref()
+    ref.setdefault("firstn3l_per_sec", ref["firstn_per_sec"])
     log(f"reference C crush_do_rule ({ref_kind}): "
         f"firstn {ref['firstn_per_sec']:.0f}/s, "
-        f"indep {ref['indep_per_sec']:.0f}/s")
+        f"indep {ref['indep_per_sec']:.0f}/s, "
+        f"firstn3l {ref['firstn3l_per_sec']:.0f}/s")
 
     rates = {}
-    for name, rule, nr in (("firstn", rep, 3), ("indep", ec, 6)):
-        t0 = time.perf_counter()
-        warmup(m, rule, nr, w, sizes=(len(xs),))
-        log(f"crush {name} warmup (jit): {time.perf_counter() - t0:.0f}s")
+    for name, mm, rule, nr in (("firstn", m, rep, 3),
+                               ("indep", m, ec, 6),
+                               ("firstn3l", m3, rep3, 3)):
+        if engine == "jax":
+            t0 = time.perf_counter()
+            warmup(mm, rule, nr, w, sizes=(len(xs),))
+            log(f"crush {name} warmup (jit): "
+                f"{time.perf_counter() - t0:.0f}s")
         best = 0.0
         for trial in range(3):       # trial 0 absorbs one-time concat jits
             t0 = time.perf_counter()
-            osds, cnt = batch_do_rule_arrays(m, rule, xs, nr, w,
-                                             engine="jax")
+            osds, cnt = batch_do_rule_arrays(mm, rule, xs, nr, w,
+                                             engine=engine)
             dt = time.perf_counter() - t0
             best = max(best, CRUSH_N / dt)
-            log(f"crush {name} trial{trial}: {CRUSH_N / dt:,.0f}/s")
+            log(f"crush {name} [{engine}] trial{trial}: "
+                f"{CRUSH_N / dt:,.0f}/s")
         # bit-exactness spot check vs scalar host mapper
         for x in (0, 1234, CRUSH_N - 1):
-            want = do_rule(m, rule, x, nr, w)
+            want = do_rule(mm, rule, x, nr, w)
             got = ([int(o) for o in osds[x, :cnt[x]]] if cnt is not None
                    else [int(o) for o in osds[x]])
-            assert got == want, f"jax {name} mapping != host at x={x}"
+            assert got == want, f"{engine} {name} mapping != host at x={x}"
         rates[name] = best
+    sfx = "" if engine == "jax" else f"_{engine}"   # jax keeps the
+    # r1-r4 metric names so rounds stay comparable
     return {"metrics": [
-        {"metric": "crush_firstn3_mappings_per_sec",
+        {"metric": f"crush_firstn3_mappings_per_sec{sfx}",
          "value": round(rates["firstn"]),
-         "unit": "mappings/s", "backend": backend,
+         "unit": "mappings/s", "backend": backend_label,
          "vs_baseline": round(rates["firstn"] / ref["firstn_per_sec"], 2)},
-        {"metric": "crush_indep6_mappings_per_sec",
+        {"metric": f"crush_indep6_mappings_per_sec{sfx}",
          "value": round(rates["indep"]),
-         "unit": "mappings/s", "backend": backend,
+         "unit": "mappings/s", "backend": backend_label,
          "vs_baseline": round(rates["indep"] / ref["indep_per_sec"], 2)},
+        {"metric": f"crush_3level_firstn3_mappings_per_sec{sfx}",
+         "value": round(rates["firstn3l"]),
+         "unit": "mappings/s", "backend": backend_label,
+         "vs_baseline": round(rates["firstn3l"]
+                              / ref["firstn3l_per_sec"], 2)},
     ], "ref_kind": ref_kind}
+
+
+def stage_crush():
+    """CRUSH jax engine on whatever backend JAX_PLATFORMS selects (the
+    orchestrator sets cpu when the TPU probe failed)."""
+    import jax
+    return _stage_crush_engine("jax", jax.default_backend())
+
+
+def stage_crush_host():
+    """CRUSH numpy+native-C host engine: no jax import anywhere, so a
+    wedged TPU runtime cannot take this stage down (VERDICT r4 weak#2:
+    report the host engine every round)."""
+    return _stage_crush_engine("host", "host_native")
 
 
 # ---------------------------------------------------------- stage: tpu_ec
@@ -360,8 +412,44 @@ def stage_ec_e2e():
 
 
 STAGES = {"cpu": stage_cpu, "probe": stage_probe,
-          "crush": stage_crush, "tpu_ec": stage_tpu_ec,
-          "ec_e2e": stage_ec_e2e}
+          "crush": stage_crush, "crush_host": stage_crush_host,
+          "tpu_ec": stage_tpu_ec, "ec_e2e": stage_ec_e2e}
+
+
+# ------------------------------------------------------- TPU result cache
+
+CACHE_PATH = pathlib.Path(__file__).parent / "BENCH_TPU_CACHE.json"
+
+
+def cache_store(tpu, crush):
+    """Persist the last SUCCESSFUL TPU measurement so a wedged runtime
+    in a later round degrades to 'stale, labeled' instead of 'absent'
+    (VERDICT r4 ask #1)."""
+    try:
+        head = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            cwd=pathlib.Path(__file__).parent, timeout=10,
+        ).stdout.decode().strip()
+    except Exception:
+        head = "unknown"
+    blob = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "git": head, "tpu_ec": tpu,
+            "crush_tpu": crush if crush else None}
+    try:
+        CACHE_PATH.write_text(json.dumps(blob, indent=1))
+        log(f"TPU cache updated ({blob['ts']})")
+    except OSError as e:
+        log(f"TPU cache write failed: {e}")
+
+
+def cache_load():
+    try:
+        blob = json.loads(CACHE_PATH.read_text())
+        if blob.get("tpu_ec", {}).get("encode"):
+            return blob
+    except Exception:
+        pass
+    return None
 
 
 # ------------------------------------------------------------ orchestrator
@@ -419,35 +507,71 @@ def main():
         return
 
     notes = []
-    cpu, n = run_stage("cpu", 240)
+    from ceph_tpu.common.envutil import pythonpath_without_tpu_plugin
+    scrub_env = {"JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": pythonpath_without_tpu_plugin()}
+
+    # reference C measured ONCE here (pure gcc subprocess, no jax) and
+    # handed to both crush stages
+    ref, ref_kind = _bench_ref_crush()
+    ref_env = {"BENCH_CRUSH_REF": json.dumps({"ref": ref,
+                                              "kind": ref_kind})}
+
+    # the cpu stage never needs jax — run it with the TPU plugin's site
+    # dir stripped so a wedged runtime can't eat its budget at
+    # interpreter startup (ADVICE r4)
+    cpu, n = run_stage("cpu", 240, scrub_env)
     if n:
         notes.append(n)
     cpu = cpu or {}
 
-    probe, n = run_stage("probe", 75)
-    tpu_up = bool(probe and probe.get("platform") not in (None, "cpu"))
-    if n:
-        notes.append(n)
+    skip_crush = os.environ.get("BENCH_SKIP_CRUSH") == "1"
+
+    # host-engine CRUSH (numpy+native C): also jax-free, also scrubbed —
+    # a TPU-down round still reports the engine that beats the C
+    # baseline (VERDICT r4 weak#2)
+    crush_host = None
+    if not skip_crush:
+        crush_host, n = run_stage("crush_host", 300,
+                                  {**scrub_env, **ref_env})
+        if n:
+            notes.append(n)
+
+    # TPU probe: retry with growing budgets — one flaky runtime init
+    # must not erase the round's headline metric (VERDICT r4 ask #1)
+    probe = None
+    for budget in (75, 150):
+        p, n = run_stage("probe", budget)
+        if n:
+            notes.append(n)
+        if p and p.get("platform") not in (None, "cpu"):
+            probe = p
+            break
+    tpu_up = probe is not None
     log(f"tpu probe: {'UP ' + str(probe) if tpu_up else 'DOWN'}")
 
-    # CRUSH before device benches; force the CPU backend if the probe
-    # failed so a wedged TPU runtime can't stall the jax import.  The
-    # TPU plugin can hang at REGISTRATION (plain `import jax` with the
-    # plugin on PYTHONPATH wedges even under JAX_PLATFORMS=cpu), so the
-    # CPU fallback must also strip the plugin's site dir.  Only reserve
-    # tail budget for tpu_ec when it will actually run.
-    if tpu_up:
-        crush_env = {}
-    else:
-        from ceph_tpu.common.envutil import pythonpath_without_tpu_plugin
-        crush_env = {"JAX_PLATFORMS": "cpu",
-                     "PYTHONPATH": pythonpath_without_tpu_plugin()}
+    # jax-engine CRUSH; force the scrubbed CPU backend if the probe
+    # failed so a wedged TPU runtime can't stall the jax import (the
+    # plugin can hang at REGISTRATION: plain `import jax` with the
+    # plugin on PYTHONPATH wedges even under JAX_PLATFORMS=cpu).
     crush = None
-    if os.environ.get("BENCH_SKIP_CRUSH") != "1":
-        reserve = 240 if tpu_up else 0
+    if not skip_crush:
+        crush_env = dict(ref_env) if tpu_up \
+            else {**scrub_env, **ref_env}
+        reserve = 360 if tpu_up else 120
         crush, n = run_stage("crush", remaining() - reserve, crush_env)
         if n:
             notes.append(n)
+
+    # late probe retry: the runtime may have come back since the early
+    # attempts (they are minutes apart)
+    if not tpu_up and remaining() > 420:
+        p, n = run_stage("probe", 180)
+        if n:
+            notes.append(n)
+        if p and p.get("platform") not in (None, "cpu"):
+            probe, tpu_up = p, True
+            log(f"tpu probe: UP on late retry {probe}")
 
     tpu = None
     if tpu_up:
@@ -456,6 +580,19 @@ def main():
             notes.append(n)
     else:
         notes.append("tpu_ec: skipped, probe down")
+
+    # persist fresh TPU evidence / fall back to labeled stale cache
+    cached = None
+    if tpu and tpu.get("encode"):
+        tpu_crush_rows = [r for r in (crush or {}).get("metrics", [])
+                          if r.get("backend") not in ("cpu",
+                                                      "host_native")]
+        cache_store(tpu, tpu_crush_rows)
+    else:
+        cached = cache_load()
+        if cached:
+            notes.append(f"tpu_ec: STALE cache from {cached['ts']} "
+                         f"(git {cached['git']})")
 
     # end-to-end EC pool under load (device-queue proof); runs on the
     # TPU when up, CPU otherwise — the counter split is the point
@@ -472,6 +609,10 @@ def main():
     cpu_backend = "cpu_simd" if cpu.get("encode_simd") else "cpu_scalar"
     if tpu and tpu.get("encode"):
         value, backend = tpu["encode"], "tpu_pallas"
+        vs = value / baseline if baseline else 1.0
+    elif cached:
+        value = cached["tpu_ec"]["encode"]
+        backend = "tpu_pallas_cached_stale"
         vs = value / baseline if baseline else 1.0
     else:
         value, backend = baseline or 0.0, cpu_backend
@@ -491,14 +632,31 @@ def main():
                       "backend": "tpu_pallas",
                       "vs_baseline": round(tpu["decode"] / dec_base, 2)
                       if dec_base else 1.0})
+    elif cached and cached["tpu_ec"].get("decode"):
+        extra.append({"metric": "ec_decode_rs_k8m4_2erasures",
+                      "value": round(cached["tpu_ec"]["decode"], 1),
+                      "unit": "MB/s",
+                      "backend": "tpu_pallas_cached_stale",
+                      "cached_from": cached["ts"],
+                      "vs_baseline": round(cached["tpu_ec"]["decode"]
+                                           / dec_base, 2)
+                      if dec_base else 1.0})
     elif dec_base:
         extra.append({"metric": "ec_decode_rs_k8m4_2erasures",
                       "value": round(dec_base, 1), "unit": "MB/s",
                       "backend": ("cpu_simd" if cpu.get("decode_simd")
                                   else "cpu_scalar"),
                       "vs_baseline": 1.0})
+    if crush_host:
+        extra += crush_host["metrics"]
     if crush:
         extra += crush["metrics"]
+    if cached and not (crush and any(
+            r.get("backend") not in ("cpu", "host_native")
+            for r in crush.get("metrics", []))):
+        for r in cached.get("crush_tpu") or []:
+            extra.append({**r, "backend": f"{r['backend']}_cached_stale",
+                          "cached_from": cached["ts"]})
     if e2e:
         on, off = e2e["on"], e2e["off"]
         extra.append({
@@ -512,7 +670,7 @@ def main():
             "device_byte_fraction": on["device_frac"],
         })
 
-    print(json.dumps({
+    line = {
         "metric": "ec_encode_rs_k8m4_1MiB_stripes",
         "value": round(value, 1),
         "unit": "MB/s",
@@ -521,7 +679,10 @@ def main():
         "baseline": baseline_name,
         "extra": extra,
         "notes": notes,
-    }))
+    }
+    if cached:
+        line["cached_from"] = cached["ts"]
+    print(json.dumps(line))
     if any("CORRECTNESS" in n for n in notes):
         sys.exit(2)   # evidence banked above, but wrong bytes are loud
 

@@ -28,9 +28,10 @@ class ObjectOperationError(Exception):
 
 
 class _InFlight:
-    __slots__ = ("tid", "oid", "loc", "ops", "fut", "attempts", "snapid")
+    __slots__ = ("tid", "oid", "loc", "ops", "fut", "attempts", "snapid",
+                 "snapc")
 
-    def __init__(self, tid, oid, loc, ops, fut, snapid=0):
+    def __init__(self, tid, oid, loc, ops, fut, snapid=0, snapc=None):
         self.tid = tid
         self.oid = oid
         self.loc = loc
@@ -38,6 +39,7 @@ class _InFlight:
         self.fut = fut
         self.attempts = 0
         self.snapid = snapid
+        self.snapc = snapc      # (seq, [snapids]) selfmanaged override
 
 
 class Objecter(Dispatcher):
@@ -96,8 +98,24 @@ class Objecter(Dispatcher):
         pg, acting, primary = m.object_to_acting(oid, loc)
         return pg, primary
 
+    def _effective_loc(self, loc: ObjectLocator,
+                       ops: List[OSDOp]) -> ObjectLocator:
+        """Cache-tier overlay redirection (Objecter::_calc_target
+        respecting pg_pool_t read_tier/write_tier): ops against a base
+        pool with an overlay route to the cache pool transparently."""
+        pool = self.osdmap.pools.get(loc.pool)
+        if pool is None:
+            return loc
+        tier = (pool.write_tier if any(o.is_write() for o in ops)
+                else pool.read_tier)
+        if tier >= 0 and tier in self.osdmap.pools:
+            return ObjectLocator(tier, loc.key, loc.namespace,
+                                 loc.hash_pos)
+        return loc
+
     def _send(self, op: _InFlight) -> None:
-        pg, primary = self._calc_target(op.oid, op.loc)
+        loc = self._effective_loc(op.loc, op.ops)
+        pg, primary = self._calc_target(op.oid, loc)
         if primary < 0:
             return   # no primary yet: next map triggers a resend
         addr = self.osdmap.get_addr(primary)
@@ -107,20 +125,26 @@ class Objecter(Dispatcher):
         # snap context rides every write from the CURRENT map's pool
         # snap state (Objecter::_op_submit snapc handling); reads carry
         # the caller's snapid
-        pool = self.osdmap.pools.get(op.loc.pool)
+        pool = self.osdmap.pools.get(loc.pool)
         snap_seq, snaps = 0, []
-        if pool is not None and any(o.is_write() for o in op.ops):
-            snap_seq = pool.snap_seq
-            snaps = sorted(pool.snaps, reverse=True)
+        if any(o.is_write() for o in op.ops):
+            if op.snapc is not None:
+                # self-managed snap context (librados
+                # selfmanaged_snap_set_write_ctx): the client — librbd
+                # analog — owns the per-image snap set
+                snap_seq, snaps = op.snapc
+            elif pool is not None:
+                snap_seq = pool.snap_seq
+                snaps = sorted(pool.snaps, reverse=True)
         self.messenger.send_message(
-            MOSDOp(pg, op.oid, op.loc, op.ops, op.tid,
+            MOSDOp(pg, op.oid, loc, op.ops, op.tid,
                    self.osdmap.epoch, reqid, snap_seq=snap_seq,
                    snaps=snaps, snapid=op.snapid), addr,
             peer_type="osd")
 
     async def op_submit(self, oid: str, loc: ObjectLocator,
                         ops: List[OSDOp], timeout: float = 120.0,
-                        snapid: int = 0) -> MOSDOpReply:
+                        snapid: int = 0, snapc=None) -> MOSDOpReply:
         # The reference Objecter never deadlines an op — it waits and
         # resends across map changes (Objecter::handle_osd_map). The
         # generous default here only bounds true wedges; first-touch
@@ -131,7 +155,7 @@ class Objecter(Dispatcher):
         self._tid += 1
         tid = self._tid
         fut = asyncio.get_running_loop().create_future()
-        op = _InFlight(tid, oid, loc, ops, fut, snapid)
+        op = _InFlight(tid, oid, loc, ops, fut, snapid, snapc)
         self._inflight[tid] = op
         self._send(op)
         try:

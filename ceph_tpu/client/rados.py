@@ -160,6 +160,12 @@ class IoCtx:
         self.namespace = ""
         self.locator_key = ""
         self.snap_read = 0        # 0 = head; set via set_snap_read
+        self.write_snapc = None   # (seq, [ids]) selfmanaged write ctx
+
+    def dup(self) -> "IoCtx":
+        """An independent context on the same pool (own snap state) —
+        what librbd does per ImageCtx."""
+        return IoCtx(self.rados, self.pool_id, self.pool_name)
 
     def _loc(self) -> ObjectLocator:
         return ObjectLocator(self.pool_id, self.locator_key, self.namespace)
@@ -167,7 +173,8 @@ class IoCtx:
     async def _op(self, oid: str, ops: List[OSDOp], timeout=30.0):
         reply = await self.objecter.op_submit(oid, self._loc(), ops,
                                               timeout,
-                                              snapid=self.snap_read)
+                                              snapid=self.snap_read,
+                                              snapc=self.write_snapc)
         if reply.result < 0:
             raise ObjectOperationError(reply.result, oid)
         return reply
@@ -177,6 +184,31 @@ class IoCtx:
         """Subsequent reads target this snap (0 = head) —
         librados set_read."""
         self.snap_read = snapid
+
+    def set_write_snapc(self, seq: int, snaps: List[int]) -> None:
+        """Self-managed snap context for writes (librados
+        selfmanaged_snap_set_write_ctx): `snaps` newest-first."""
+        self.write_snapc = (seq, list(snaps))
+
+    async def selfmanaged_snap_create(self) -> int:
+        """Allocate a self-managed snap id (pool snap_seq bump, no
+        named pool snap)."""
+        ack = await self.rados.mon_command(
+            {"prefix": "osd pool selfmanaged-mksnap",
+             "pool": self.pool_name})
+        sid = int(ack.outs)
+        await self._wait_snap(lambda p: p.snap_seq >= sid)
+        return sid
+
+    async def selfmanaged_snap_remove(self, snapid: int) -> None:
+        """Retire a self-managed snap: OSDs trim its clones."""
+        await self.rados.mon_command(
+            {"prefix": "osd pool selfmanaged-rmsnap",
+             "pool": self.pool_name, "snapid": snapid})
+        await self._wait_snap(lambda p: snapid in p.removed_snaps)
+
+    async def selfmanaged_rollback(self, oid: str, snapid: int) -> None:
+        await self._op(oid, [OSDOp(OP_ROLLBACK, offset=snapid)])
 
     def snap_lookup(self, name: str) -> int:
         pool = self.rados.monc.osdmap.pools[self.pool_id]

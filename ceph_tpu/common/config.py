@@ -276,6 +276,8 @@ DEFAULT_OPTIONS: List[Option] = [
            "as one sharded program and shard bytes skip the messenger "
            "(SURVEY §2.4 TPU-native data plane)"),
     Option("osd_scrub_interval", "float", 60.0, "light scrub cadence (test scale)"),
+    Option("osd_tier_agent_interval", "float", 2.0,
+           "cache-tier agent pass cadence (flush/evict scheduling)"),
     Option("osd_deep_scrub_interval", "float", 300.0,
            "deep scrub cadence (reads + recomputes every digest)"),
     Option("osd_mon_report_interval", "float", 2.0,
@@ -283,11 +285,16 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("mon_cluster_log_file", "str", "",
            "cluster log sink path on the mon ('' = memory only)"),
     Option("osd_ec_batch_device", "str", "auto",
-           "EC encode device routing: auto (accelerator only), on, off"),
+           "EC encode device routing: auto/on (real accelerator only; a "
+           "cpu jax backend bypasses to the native SIMD kernel), "
+           "force (any jax backend, for tests), off"),
     Option("osd_ec_batch_window_ms", "float", 2.0,
            "batch-collector fill window before a device launch"),
     Option("osd_ec_batch_min_bytes", "size", "64k",
            "lone requests below this take the host SIMD kernel"),
+    Option("osd_ec_batch_flush_bytes", "size", "4m",
+           "flush the collector early once this many pending encode "
+           "bytes accumulate (bytes-quorum; window is the ceiling)"),
     Option("objectstore", "str", "memstore",
            "backend: memstore|filestore|blockstore"),
     Option("blockstore_compression", "str", "",

@@ -390,8 +390,15 @@ class OSDMonitor(PaxosService):
             self.pending_inc.old_ec_profiles.append(name)
             self._propose_and_ack(m, outs=f"profile {name!r} removed")
         elif prefix in ("osd pool mksnap", "osd pool rmsnap",
-                        "osd pool lssnap"):
+                        "osd pool lssnap", "osd pool selfmanaged-mksnap",
+                        "osd pool selfmanaged-rmsnap"):
             self._cmd_pool_snap(m, prefix.rsplit(" ", 1)[1])
+        elif prefix in ("osd tier add", "osd tier remove",
+                        "osd tier cache-mode", "osd tier set-overlay",
+                        "osd tier remove-overlay"):
+            self._cmd_tier(m, prefix.rsplit(" ", 1)[1])
+        elif prefix == "osd pool set":
+            self._cmd_pool_set(m)
         elif prefix in ("pg scrub", "pg deep-scrub"):
             # route to the PG's acting primary (reference
             # OSDMonitor/MOSDScrub path)
@@ -454,6 +461,27 @@ class OSDMonitor(PaxosService):
                 [{"id": sid, "name": n}
                  for sid, n in sorted(pool.snaps.items())])))
             return
+        if verb == "selfmanaged-mksnap":
+            # allocate a snap id WITHOUT registering a pool snap: the
+            # client (librbd analog) owns the snap context and attaches
+            # it to its writes (OSDMonitor prepare_pool_op
+            # POOL_OP_CREATE_UNMANAGED_SNAP)
+            pool.snap_seq += 1
+            self.pending_inc.new_pools[pid] = pool
+            self._propose_and_ack(m, outs=str(pool.snap_seq))
+            return
+        if verb == "selfmanaged-rmsnap":
+            sid = int(cmd.get("snapid", 0))
+            if sid <= 0 or sid in pool.snaps:
+                self.mon.reply(m, MMonCommandAck(
+                    m.tid, -errno.EINVAL,
+                    f"snapid {sid} is not a self-managed snap"))
+                return
+            if sid not in pool.removed_snaps:
+                pool.removed_snaps.append(sid)
+                self.pending_inc.new_pools[pid] = pool
+            self._propose_and_ack(m, outs=f"removed snap {sid}")
+            return
         if verb == "mksnap":
             if snap in pool.snaps.values():
                 self.mon.reply(m, MMonCommandAck(
@@ -477,6 +505,144 @@ class OSDMonitor(PaxosService):
             self.pending_inc.new_pools[pid] = pool
             self._propose_and_ack(m, outs=f"removed pool {name} snap "
                                           f"{snap}")
+
+    def _cmd_tier(self, m: MMonCommand, verb: str) -> None:
+        """Cache-tier pool linkage (OSDMonitor 'osd tier *' commands:
+        add/remove set tier_of + tiers; set-overlay/remove-overlay set
+        the base pool's read_tier/write_tier the Objecter redirects on;
+        cache-mode gates the OSD's promote/agent machinery)."""
+        import copy
+        cmd = m.cmd
+
+        def ack(rc, msg):
+            self.mon.reply(m, MMonCommandAck(m.tid, rc, msg))
+
+        def pool_of(key):
+            name = cmd.get(key, "")
+            pid = self.osdmap.lookup_pool(name)
+            if pid < 0:
+                ack(-errno.ENOENT, f"no pool {name!r}")
+                return None, None
+            p = copy.deepcopy(self.pending_inc.new_pools.get(
+                pid, self.osdmap.pools[pid]))
+            return pid, p
+
+        if verb == "add":
+            base_id, base = pool_of("pool")
+            if base is None:
+                return
+            tier_id, tier = pool_of("tierpool")
+            if tier is None:
+                return
+            if tier_id == base_id:
+                ack(-errno.EINVAL, "a pool cannot tier itself")
+                return
+            if not tier.is_replicated():
+                ack(-errno.EINVAL, "cache pools must be replicated")
+                return
+            if tier.is_tier() or tier_id in base.tiers:
+                ack(-errno.EEXIST, "already a tier")
+                return
+            tier.tier_of = base_id
+            base.tiers = sorted(set(base.tiers) | {tier_id})
+            self.pending_inc.new_pools[base_id] = base
+            self.pending_inc.new_pools[tier_id] = tier
+            self._propose_and_ack(m, outs="tier added")
+        elif verb == "remove":
+            base_id, base = pool_of("pool")
+            if base is None:
+                return
+            tier_id, tier = pool_of("tierpool")
+            if tier is None:
+                return
+            if tier.tier_of != base_id or tier_id not in base.tiers:
+                ack(-errno.EINVAL,
+                    f"{cmd.get('tierpool')!r} is not a tier of "
+                    f"{cmd.get('pool')!r}")
+                return
+            if base.read_tier == tier_id or base.write_tier == tier_id:
+                ack(-errno.EBUSY, "remove the overlay first")
+                return
+            tier.tier_of = -1
+            tier.cache_mode = "none"
+            base.tiers = [t for t in base.tiers if t != tier_id]
+            self.pending_inc.new_pools[base_id] = base
+            self.pending_inc.new_pools[tier_id] = tier
+            self._propose_and_ack(m, outs="tier removed")
+        elif verb == "cache-mode":
+            tier_id, tier = pool_of("pool")
+            if tier is None:
+                return
+            mode = cmd.get("mode", "")
+            if mode not in ("none", "writeback"):
+                ack(-errno.EINVAL, f"unsupported cache mode {mode!r} "
+                    f"(writeback|none)")
+                return
+            if not tier.is_tier():
+                ack(-errno.EINVAL, "pool is not a tier")
+                return
+            tier.cache_mode = mode
+            self.pending_inc.new_pools[tier_id] = tier
+            self._propose_and_ack(m, outs=f"cache-mode {mode}")
+        elif verb == "set-overlay":
+            base_id, base = pool_of("pool")
+            if base is None:
+                return
+            tier_id, tier = pool_of("overlaypool")
+            if tier is None:
+                return
+            if tier_id not in base.tiers:
+                ack(-errno.EINVAL, "overlay pool is not a tier of pool")
+                return
+            base.read_tier = tier_id
+            base.write_tier = tier_id
+            self.pending_inc.new_pools[base_id] = base
+            self._propose_and_ack(m, outs="overlay set")
+        else:   # remove-overlay
+            base_id, base = pool_of("pool")
+            if base is None:
+                return
+            base.read_tier = -1
+            base.write_tier = -1
+            self.pending_inc.new_pools[base_id] = base
+            self._propose_and_ack(m, outs="overlay removed")
+
+    _POOL_SET_FIELDS = {
+        "hit_set_count": int, "hit_set_period": float,
+        "hit_set_fpp": float, "target_max_objects": int,
+        "cache_target_dirty_ratio": float,
+        "cache_target_full_ratio": float, "size": int,
+        "min_size": int,
+    }
+
+    def _cmd_pool_set(self, m: MMonCommand) -> None:
+        """osd pool set <pool> <var> <val> — the tiering/agent knobs +
+        size (OSDMonitor prepare_command pool set)."""
+        import copy
+        cmd = m.cmd
+        name = cmd.get("pool", "")
+        pid = self.osdmap.lookup_pool(name)
+        if pid < 0:
+            self.mon.reply(m, MMonCommandAck(
+                m.tid, -errno.ENOENT, f"no pool {name!r}"))
+            return
+        var = cmd.get("var", "")
+        conv = self._POOL_SET_FIELDS.get(var)
+        if conv is None:
+            self.mon.reply(m, MMonCommandAck(
+                m.tid, -errno.EINVAL, f"unknown pool option {var!r}"))
+            return
+        try:
+            val = conv(cmd.get("val", ""))
+        except (TypeError, ValueError):
+            self.mon.reply(m, MMonCommandAck(
+                m.tid, -errno.EINVAL, f"bad value for {var!r}"))
+            return
+        pool = copy.deepcopy(self.pending_inc.new_pools.get(
+            pid, self.osdmap.pools[pid]))
+        setattr(pool, var, val)
+        self.pending_inc.new_pools[pid] = pool
+        self._propose_and_ack(m, outs=f"set pool {name} {var} = {val}")
 
     def _cmd_pool_create(self, m: MMonCommand) -> None:
         cmd = m.cmd

@@ -388,7 +388,9 @@ class ReplicatedBackend(PGBackend):
         from ceph_tpu.osd.scrub import CRC_XATTR
         digest_ops = {OP_WRITEFULL: None, OP_WRITE: b"", OP_APPEND: b"",
                       OP_TRUNCATE: b"", OP_ZERO: b""}
-        for op in m.ops:
+        # over batch_ops (post cls-expansion), not m.ops: a cls method
+        # staging write_full must refresh the digest too
+        for op in batch_ops:
             if not op.is_write() or op.op not in digest_ops:
                 continue
             if op.op == OP_WRITEFULL:
@@ -396,6 +398,14 @@ class ReplicatedBackend(PGBackend):
                             str(crc32c(op.data)).encode())
             else:
                 txn.setattr(pg.cid, soid, CRC_XATTR, b"")
+        if (pg.pool.is_tier() and pg.pool.cache_mode == "writeback"
+                and not deletes
+                and not getattr(m, "_tier_internal", False)):
+            # cache-tier dirty mark rides the same replicated txn as
+            # the data (object_info_t dirty flag role); the agent
+            # clears it after flushing to the base pool
+            from ceph_tpu.osd.tiering import DIRTY_XATTR
+            txn.setattr(pg.cid, soid, DIRTY_XATTR, b"1")
         version = pg.next_version()
         entry = LogEntry(LOG_DELETE if deletes else LOG_MODIFY, m.oid,
                          version, pg.info.last_update, m.reqid)

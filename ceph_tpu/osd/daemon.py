@@ -61,7 +61,8 @@ class OSD(Dispatcher):
         self.ec_queue = ECBatchQueue(
             ctx, mode=self.cfg["osd_ec_batch_device"],
             window_ms=self.cfg["osd_ec_batch_window_ms"],
-            min_device_bytes=self.cfg["osd_ec_batch_min_bytes"])
+            min_device_bytes=self.cfg["osd_ec_batch_min_bytes"],
+            flush_bytes=self.cfg["osd_ec_batch_flush_bytes"])
         self.perf_scrub = ctx.perf.create("osd_scrub")
         for key in ("scrubs_light", "scrubs_deep", "scrub_errors",
                     "scrub_repaired"):
@@ -113,6 +114,11 @@ class OSD(Dispatcher):
             self._scrub_scheduler())
         self._stats_task = asyncio.get_running_loop().create_task(
             self._report_stats())
+        # cache-tier client + agent (ReplicatedPG agent_work scheduler)
+        from ceph_tpu.osd.tiering import TierClient
+        self.tier_client = TierClient(self)
+        self._tier_task = asyncio.get_running_loop().create_task(
+            self._tier_agent_loop())
         # cluster log -> mon (LogClient role)
         self.ctx.cluster_log.set_sink(self._send_cluster_log)
         await self._start_admin_socket()
@@ -158,6 +164,8 @@ class OSD(Dispatcher):
             self._scrub_task.cancel()
         if self._stats_task:
             self._stats_task.cancel()
+        if getattr(self, "_tier_task", None):
+            self._tier_task.cancel()
         if self.admin_socket is not None:
             await self.admin_socket.stop()
         for pg in self.pgs.values():
@@ -473,6 +481,12 @@ class OSD(Dispatcher):
         if isinstance(m, MOSDPing):
             self._handle_ping(m)
             return True
+        if isinstance(m, MOSDOpReply):
+            # replies to the embedded tier client's cross-pool ops
+            tc = getattr(self, "tier_client", None)
+            if tc is not None:
+                return tc.on_reply(m)
+            return False
         return False
 
     def _handle_client_op(self, m: MOSDOp) -> None:
@@ -663,6 +677,22 @@ class OSD(Dispatcher):
                     pg.queue_op(MPGScrub(pg.pgid, deep=False))
 
     # ----------------------------------------------------------- heartbeats
+    async def _tier_agent_loop(self) -> None:
+        """Periodic cache-tier agent: enqueue an agent pass on every
+        primary cache-pool PG's worker (serializes with client ops)."""
+        from ceph_tpu.osd import tiering
+        from ceph_tpu.osd.pg import STATE_ACTIVE
+        interval = self.cfg["osd_tier_agent_interval"]
+        while self.running:
+            await asyncio.sleep(interval)
+            for pg in list(self.pgs.values()):
+                if (pg.is_primary() and pg.pool.is_tier()
+                        and pg.pool.cache_mode == "writeback"
+                        and pg.state == STATE_ACTIVE):
+                    def make(p):
+                        return lambda: tiering.agent_work(p)
+                    pg.queue_op(make(pg))
+
     def _hb_peers(self) -> List[int]:
         peers = set()
         for pg in self.pgs.values():

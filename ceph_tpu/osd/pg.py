@@ -7,13 +7,17 @@ execute_ctx op interpreter :1575,1716,3036,4317), with the strategy
 split behind PGBackend (osd/PGBackend.h) in backend.py.
 
 Redesign notes (vs the boost::statechart original):
-- Peering queries the CURRENT up∪acting peers for infos and adopts the
-  best (highest last_update, ties by longer log) as authoritative; the
-  primary first heals itself (log merge + whole-object pulls), then
-  ships logs and pushes missing objects to peers.  The reference's
-  past-interval walk (PriorSet) is collapsed into this: correctness
-  holds whenever some member of the last active interval is reachable,
-  which min_size-gated writes guarantee.
+- Peering probes a real PriorSet (PG::PriorSet / build_prior): the
+  current up∪acting PLUS the acting members of every maybe-went-rw
+  past interval since last_epoch_started (past_intervals are rebuilt
+  from stored map history in generate_past_intervals, exactly the
+  reference's generate_past_intervals role).  The best info (highest
+  last_update, ties by longer log) becomes authoritative; peering
+  BLOCKS while a maybe-rw interval has no live, non-lost member —
+  stale survivors of an older interval can never serve over newer
+  writes they missed (tests/test_peering.py stale-survivor cascade).
+  The primary first heals itself (log merge + whole-object pulls),
+  then ships logs and pushes missing objects to peers.
 - Divergent local entries are rewound (PGLog.rewind_to) and the objects
   re-pulled from the authoritative peer — the reference's
   rewind_divergent_log.
@@ -97,6 +101,10 @@ class PG:
         self._notify_acks: Dict[int, Tuple[Set[str], asyncio.Future,
                                            List]] = {}
         self._trimmed_snaps: Set[int] = set()
+        # cache tiering (lazy: a pool can become a tier after creation)
+        self._hitset = None
+        self._perf_tier = None
+        self._hitset_rotated = 0.0
         from ceph_tpu.osd.backend import ECBackend, ReplicatedBackend
         self.backend = (ECBackend(self) if pool.is_erasure()
                         else ReplicatedBackend(self))
@@ -1100,6 +1108,46 @@ class PG:
             fut.set_result(True)
 
     # ------------------------------------------------------------- op path
+    # ------------------------------------------------------ cache tiering
+    @property
+    def hitset(self):
+        if self._hitset is None:
+            from ceph_tpu.osd.hitset import HitSetTracker
+            p = self.pool
+            self._hitset = HitSetTracker(p.hit_set_count,
+                                         fpp=p.hit_set_fpp)
+            import time as _time
+            self._hitset_rotated = _time.monotonic()
+        return self._hitset
+
+    @property
+    def perf_tier(self):
+        if self._perf_tier is None:
+            self._perf_tier = self.osd.ctx.perf.create(
+                f"tier_{self.pgid}")
+            for k in ("promotes", "promote_bytes", "flushes",
+                      "flush_bytes", "evicts"):
+                self._perf_tier.add_u64(k)
+        return self._perf_tier
+
+    def _hitset_tick(self) -> None:
+        import time as _time
+        now = _time.monotonic()
+        if now - self._hitset_rotated >= self.pool.hit_set_period:
+            self.hitset.rotate()
+            self._hitset_rotated = now
+
+    async def _maybe_handle_cache(self, m: MOSDOp) -> None:
+        """ReplicatedPG::maybe_handle_cache distilled: record the hit,
+        rotate hit sets on period, promote on miss (writeback)."""
+        from ceph_tpu.osd import tiering
+        if not m.oid:
+            return                      # pool-level op (pgls): no object
+        self._hitset_tick()
+        self.hitset.insert(m.oid)
+        if self.pool.cache_mode == "writeback":
+            await tiering.maybe_promote(self, m)
+
     def queue_op(self, m) -> None:
         self._op_queue.put_nowait(m)
 
@@ -1109,7 +1157,11 @@ class PG:
         while True:
             m = await self._op_queue.get()
             try:
-                if isinstance(m, MOSDOp):
+                if callable(m):
+                    # internal work item (tier agent pass): serialized
+                    # with client ops on the same queue
+                    await m()
+                elif isinstance(m, MOSDOp):
                     await self._do_client_op(m)
                 elif isinstance(m, MPGScrub):
                     # scrub rides the op queue: no client write can
@@ -1178,10 +1230,16 @@ class PG:
                 # our OWN copy of this object is still owed a recovery
                 # pull (log adopted before data): serving now would
                 # return ENOENT for committed data — heal it first
-                # (the reference's wait_for_missing_object)
+                # (the reference's wait_for_missing_object).  MUST run
+                # before any cache promote: a missing dirty cache
+                # object looks absent to store.exists and a promote
+                # would clobber it with stale base-pool bytes
                 src = next((p for p in self.actual_peers()), -1)
                 if src >= 0:
                     await self._heal_missing(src, self.interval_epoch)
+            if self.pool.is_tier() \
+                    and not getattr(m, "_tier_internal", False):
+                await self._maybe_handle_cache(m)
             if has_write:
                 # recover-before-write: peers must have the current object
                 # before a mutation lands on top of it

@@ -118,9 +118,11 @@ class ObjectLocator(Encodable):
 class PGPool(Encodable):
     """pg_pool_t: per-pool placement + redundancy parameters + pool
     snapshots (snap_seq/snaps/removed_snaps — osd_types.h pg_pool_t
-    snap state; v2)."""
+    snap state; v2) + cache tiering linkage (tier_of/read_tier/
+    write_tier/cache_mode/hit-set + agent targets — osd_types.h
+    pg_pool_t:1230-1234; v3)."""
 
-    STRUCT_V = 2
+    STRUCT_V = 3
 
     def __init__(self, type_: int = POOL_TYPE_REPLICATED, size: int = 3,
                  min_size: int = 0, crush_ruleset: int = 0,
@@ -140,6 +142,24 @@ class PGPool(Encodable):
         self.last_change = 0             # epoch of last modification
         self.snaps: Dict[int, str] = {}  # snapid -> name (pool snaps)
         self.removed_snaps: List[int] = []   # await osd trim
+        # cache tiering (pg_pool_t tier linkage)
+        self.tiers: List[int] = []       # pools that tier in front of us
+        self.tier_of = -1                # pool we are a cache for
+        self.read_tier = -1              # overlay: reads route here
+        self.write_tier = -1             # overlay: writes route here
+        self.cache_mode = "none"         # none|writeback|readonly
+        self.hit_set_count = 4           # retained hit sets
+        self.hit_set_period = 30.0       # seconds per hit set
+        self.hit_set_fpp = 0.05          # bloom false-positive rate
+        self.target_max_objects = 0      # agent: object budget (0=off)
+        self.cache_target_dirty_ratio = 0.4
+        self.cache_target_full_ratio = 0.8
+
+    def is_tier(self) -> bool:
+        return self.tier_of >= 0
+
+    def has_tiers(self) -> bool:
+        return bool(self.tiers)
 
     # -- masks (osd_types.cc:1193 calc_pg_masks) --
     @property
@@ -191,6 +211,14 @@ class PGPool(Encodable):
         enc.map_(self.snaps, lambda e, k: e.u64(k),
                  lambda e, v: e.string(v))
         enc.list_(self.removed_snaps, lambda e, v: e.u64(v))
+        enc.list_(self.tiers, lambda e, v: e.s64(v))
+        enc.s64(self.tier_of).s64(self.read_tier).s64(self.write_tier)
+        enc.string(self.cache_mode)
+        enc.u32(self.hit_set_count).f64(self.hit_set_period)
+        enc.f64(self.hit_set_fpp)
+        enc.u64(self.target_max_objects)
+        enc.f64(self.cache_target_dirty_ratio)
+        enc.f64(self.cache_target_full_ratio)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "PGPool":
@@ -201,6 +229,18 @@ class PGPool(Encodable):
         if struct_v >= 2:
             p.snaps = dec.map_(lambda d: d.u64(), lambda d: d.string())
             p.removed_snaps = dec.list_(lambda d: d.u64())
+        if struct_v >= 3:
+            p.tiers = dec.list_(lambda d: d.s64())
+            p.tier_of = dec.s64()
+            p.read_tier = dec.s64()
+            p.write_tier = dec.s64()
+            p.cache_mode = dec.string()
+            p.hit_set_count = dec.u32()
+            p.hit_set_period = dec.f64()
+            p.hit_set_fpp = dec.f64()
+            p.target_max_objects = dec.u64()
+            p.cache_target_dirty_ratio = dec.f64()
+            p.cache_target_full_ratio = dec.f64()
         return p
 
 

@@ -174,8 +174,80 @@ class RBD:
             await _cls_unlock(self.io, RBD_DIRECTORY, "rbd_dir", entity,
                               cookie)
 
+    async def clone(self, parent_name: str, snap_name: str,
+                    clone_name: str, clone_ioctx=None) -> None:
+        """COW clone of a protected snapshot (librbd::clone).  The
+        child starts as pure metadata: reads fall through to the parent
+        snap, first writes copy the backing object up
+        (CopyupRequest)."""
+        import errno as _errno
+        import json as _json
+        from ceph_tpu.client.objecter import ObjectOperationError
+        c_io = clone_ioctx or self.io
+        parent = await Image.open(self.io, parent_name)
+        try:
+            snap = next((s for s in parent.snaps
+                         if s["name"] == snap_name), None)
+            if snap is None:
+                raise ImageNotFound(f"{parent_name}@{snap_name}")
+            if not snap.get("protected"):
+                raise RBDError(f"snap {snap_name!r} is not protected")
+            if parent.layout.stripe_count != 1:
+                raise RBDError("clone requires stripe_count=1 parents")
+            try:
+                await c_io.exec(
+                    _header_oid(clone_name), "rbd", "create_header",
+                    _json.dumps({
+                        "size": snap["size"], "order": parent.order,
+                        "stripe_unit": 1 << parent.order,
+                        "stripe_count": 1}).encode())
+            except ObjectOperationError as e:
+                if e.retcode == -_errno.EEXIST:
+                    raise ImageExists(clone_name)
+                raise
+            await c_io.exec(
+                _header_oid(clone_name), "rbd", "set_parent",
+                _json.dumps({
+                    "pool": self.io.pool_id,
+                    "pool_name": self.io.pool_name,
+                    "image": parent_name, "snap_id": snap["id"],
+                    "snap_name": snap_name,
+                    "overlap": snap["size"]}).encode())
+            await self.io.exec(
+                _header_oid(parent_name), "rbd", "child_add",
+                _json.dumps({"snap_id": snap["id"],
+                             "child": clone_name}).encode())
+            await RBD(c_io)._dir_update(add=clone_name)
+        finally:
+            await parent.close()
+
+    async def children(self, parent_name: str,
+                       snap_name: str) -> List[str]:
+        import json as _json
+        parent = await Image.open(self.io, parent_name)
+        try:
+            snap = next((s for s in parent.snaps
+                         if s["name"] == snap_name), None)
+            if snap is None:
+                raise ImageNotFound(f"{parent_name}@{snap_name}")
+            out = await self.io.exec(
+                _header_oid(parent_name), "rbd", "child_list",
+                _json.dumps({"snap_id": snap["id"]}).encode())
+            return _json.loads(out.decode())
+        finally:
+            await parent.close()
+
     async def remove(self, name: str) -> None:
+        import json as _json
         img = await Image.open(self.io, name)
+        if img.snaps:
+            await img.close()
+            raise RBDError(f"image {name!r} has snapshots")
+        out = await self.io.exec(_header_oid(name), "rbd", "child_list",
+                                 b"")
+        if _json.loads(out.decode()):
+            await img.close()
+            raise RBDError(f"image {name!r} has clone children")
         max_obj = (max(img.size - 1, 0) >> img.order) + 1 \
             if img.size else 0
         per_set = img.layout.stripe_count
@@ -190,7 +262,28 @@ class RBD:
             await self.io.remove(_header_oid(img.id))
         except Exception:
             pass
+        if img.parent is not None:
+            # sever the child registration so the parent snap can be
+            # unprotected again
+            pio = self.io.rados.open_ioctx(img.parent["pool_name"])
+            try:
+                await pio.exec(
+                    _header_oid(img.parent["image"]), "rbd", "child_rm",
+                    _json.dumps({"snap_id": img.parent["snap_id"],
+                                 "child": name}).encode())
+            except Exception:
+                pass
+        await img.close()
         await self._dir_update(drop=name)
+
+
+class ReadOnlyImage(RBDError):
+    """Mutation attempted on a snapshot-opened handle."""
+
+
+class LockLost(RBDError):
+    """The exclusive lock was definitively lost (another holder took
+    it); the handle refuses further mutation instead of racing."""
 
 
 class Image:
@@ -217,6 +310,32 @@ class Image:
         # to close; guards multi-client RMW on the same image
         self._lock_cookie: Optional[str] = None
         self._lock_task: Optional[asyncio.Task] = None
+        self._lock_lost = False
+        # snapshots + clone parent (librbd snap_create/clone features)
+        self.snaps: List[Dict] = []       # [{id,name,size,protected}]
+        self.snap_id = 0                  # >0: handle opened at a snap
+        self.parent: Optional[Dict] = None
+        self._parent_img: Optional["Image"] = None
+
+    # ------------------------------------------------------- snap context
+    def _apply_snapc(self) -> None:
+        """Writes carry the image's self-managed snap context so the
+        OSDs clone-on-write heads that predate the newest snap
+        (ReplicatedPG make_writeable via osd/snaps.prepare_cow)."""
+        ids = sorted((s["id"] for s in self.snaps), reverse=True)
+        self.io.set_write_snapc(ids[0] if ids else 0, ids)
+
+    def _check_mutable(self) -> None:
+        if self.snap_id:
+            raise ReadOnlyImage(f"{self.name}@{self._snap_name()}")
+        if self._lock_lost:
+            raise LockLost(self.name)
+
+    def _snap_name(self) -> str:
+        for s in self.snaps:
+            if s["id"] == self.snap_id:
+                return s["name"]
+        return str(self.snap_id)
 
     def _obj_lock(self, oid: str) -> asyncio.Lock:
         lock = self._obj_locks.get(oid)
@@ -229,7 +348,8 @@ class Image:
                    cache_max_dirty: int = 8 << 20,
                    cache_max_bytes: int = 32 << 20,
                    journaling: bool = False,
-                   exclusive: bool = False) -> "Image":
+                   exclusive: bool = False,
+                   snap_name: Optional[str] = None) -> "Image":
         """cached=True puts an ObjectCacher (write-back) between the
         image and its data objects — librbd's rbd_cache=true
         (librbd/ImageCtx.cc object_cacher init).  Call close() to flush
@@ -239,9 +359,11 @@ class Image:
         the image's exclusive lock (cls_lock on the header, librbd
         ExclusiveLock role) for the life of the handle — a second
         exclusive open raises ImageBusy instead of silently racing
-        read-modify-writes."""
+        read-modify-writes.  snap_name opens a READ-ONLY handle at that
+        snapshot (librbd snap_set)."""
         import json as _json
         from ceph_tpu.client.objecter import ObjectOperationError
+        ioctx = ioctx.dup()      # own snap state per handle (ImageCtx)
         img_id = name
         hdr = _header_oid(img_id)
         try:
@@ -253,6 +375,20 @@ class Image:
         order = h["order"]
         layout = Layout(h["stripe_unit"], h["stripe_count"], 1 << order)
         img = cls(ioctx, name, img_id, h["size"], order, layout)
+        img.snaps = h.get("snaps", [])
+        img.parent = h.get("parent")
+        if snap_name is not None:
+            snap = next((s for s in img.snaps
+                         if s["name"] == snap_name), None)
+            if snap is None:
+                raise ImageNotFound(f"{name}@{snap_name}")
+            img.snap_id = snap["id"]
+            img.size = snap["size"]
+            ioctx.set_snap_read(snap["id"])
+            if cached or journaling or exclusive:
+                raise RBDError("snapshot handles are plain read-only")
+            return img
+        img._apply_snapc()
         if exclusive:
             cookie = os_urandom_hex()
             await _cls_lock(ioctx, hdr, LOCK_NAME,
@@ -276,6 +412,53 @@ class Image:
                 await img._journal.create()
         return img
 
+    # ------------------------------------------------- clone parent I/O
+    async def _parent(self) -> "Image":
+        """Open (lazily) the parent image at its snap (librbd
+        ImageCtx::parent)."""
+        if self._parent_img is None:
+            pio = self.io.rados.open_ioctx(self.parent["pool_name"])
+            self._parent_img = await Image.open(
+                pio, self.parent["image"],
+                snap_name=self.parent["snap_name"])
+        return self._parent_img
+
+    def _object_base(self, object_no: int) -> int:
+        # clones require stripe_count == 1 (enforced at clone()), so an
+        # object's bytes are the contiguous logical range at its base
+        return object_no << self.order
+
+    async def _parent_object_bytes(self, object_no: int) -> bytes:
+        """The parent's bytes backing this child object (clamped to the
+        overlap), zero-filled; b'' when wholly beyond the overlap."""
+        base = self._object_base(object_no)
+        overlap = int(self.parent.get("overlap", 0))
+        if base >= overlap:
+            return b""
+        length = min(1 << self.order, overlap - base)
+        parent = await self._parent()
+        return await parent.read(base, length)
+
+    async def _ensure_copyup(self, object_no: int) -> None:
+        """First write to a clone object copies the parent's backing
+        bytes up into the child (librbd CopyupRequest) so partial
+        writes compose with inherited data.  All-zero parent ranges
+        skip the write: an absent child object then reads zeros from
+        the parent fallback anyway — equivalent bytes, sparser image."""
+        if self.parent is None:
+            return
+        oid = _data_oid(self.id, object_no)
+        async with self._obj_lock(oid):
+            try:
+                await self.io.stat(oid)
+                return                     # already copied up / written
+            except Exception:
+                pass
+            data = await self._parent_object_bytes(object_no)
+            data = data.rstrip(b"\x00")
+            if data:
+                await self.io.write_full(oid, data)
+
     # cacher backend: oid-granular IO with sparse/EC handling
     async def _backend_read(self, oid: str, off: int,
                             length: int) -> bytes:
@@ -285,11 +468,17 @@ class Image:
             return await self.io.read(oid, length=length, offset=off)
         except ObjectOperationError as e:
             if e.retcode == -_errno.ENOENT:
+                if self.parent is not None:
+                    object_no = int(oid.rsplit(".", 1)[1], 16)
+                    pdata = await self._parent_object_bytes(object_no)
+                    return pdata[off:off + length]
                 return b""      # absent object: genuine hole
             raise               # transient errors must NOT cache as zeros
 
     async def _backend_write(self, oid: str, off: int,
                              data: bytes) -> None:
+        if self.parent is not None:
+            await self._ensure_copyup(int(oid.rsplit(".", 1)[1], 16))
         if self._ec_pool:
             from ceph_tpu.services.striper import Extent as _E
             await self._rmw_object(oid, [_E(0, off, len(data), off)],
@@ -328,7 +517,12 @@ class Image:
                     data = await self.io.read(oid, length=hi - lo,
                                               offset=lo)
                 except Exception:
-                    return                # sparse object: zeros
+                    if self.parent is None:
+                        return            # sparse object: zeros
+                    # clone: an absent child object reads through to
+                    # the parent snap (librbd parent overlap read)
+                    pdata = await self._parent_object_bytes(object_no)
+                    data = pdata[lo:hi]
             for e in extents:
                 piece = data[e.offset - lo:e.offset - lo + e.length]
                 buf[e.logical - offset:
@@ -340,6 +534,7 @@ class Image:
 
     async def write(self, offset: int, data: bytes) -> int:
         """Striped write fan-out (AioImageRequest write)."""
+        self._check_mutable()
         if offset + len(data) > self.size:
             raise RBDError(f"write past image end "
                            f"({offset + len(data)} > {self.size})")
@@ -357,6 +552,8 @@ class Image:
                         data[e.logical - offset:
                              e.logical - offset + e.length])
                 return
+            if self.parent is not None:
+                await self._ensure_copyup(object_no)
             if self._ec_pool:
                 await self._rmw_object(oid, extents, data, offset)
                 return
@@ -397,7 +594,10 @@ class Image:
 
     async def discard(self, offset: int, length: int) -> None:
         """Zero a range: remove objects the range fully covers (sparse
-        reads return zeros for free), RMW-zero the partial edges."""
+        reads return zeros for free), RMW-zero the partial edges.
+        Clone objects inside the parent overlap are never REMOVED —
+        that would resurrect the parent's bytes — they are zeroed."""
+        self._check_mutable()
         if self._journal is not None and not getattr(
                 self, "_in_resize", False):
             # resize journals ONE event; its internal tail-zeroing
@@ -415,15 +615,20 @@ class Image:
         async def discard_obj(object_no, extents):
             oid = _data_oid(self.id, object_no)
             covered = sum(e.length for e in extents)
-            if covered >= object_size or (
+            in_overlap = (self.parent is not None
+                          and self._object_base(object_no)
+                          < int(self.parent.get("overlap", 0)))
+            if not in_overlap and (covered >= object_size or (
                     len(extents) == 1 and extents[0].offset == 0
                     and await self._object_tail_beyond(
-                        object_no, extents[0].length)):
+                        object_no, extents[0].length))):
                 try:
                     await self.io.remove(oid)
                 except Exception:
                     pass
                 return
+            if in_overlap:
+                await self._ensure_copyup(object_no)
             zeros = bytes(max(e.length for e in extents))
             async with self._obj_lock(oid):
                 try:
@@ -450,6 +655,7 @@ class Image:
             return True
 
     async def resize(self, new_size: int) -> None:
+        self._check_mutable()
         if self._journal is not None:
             from ceph_tpu.services.rbd_mirror import encode_resize_event
             await self._journal.append(encode_resize_event(new_size))
@@ -493,21 +699,188 @@ class Image:
         if self._cacher is not None:
             await self._cacher.flush_all()
 
-    async def _renew_lock(self) -> None:
+    # ------------------------------------------------------- snapshots
+    # librbd snap_create/snap_remove/snap_rollback/snap_protect
+    # (librbd/internal.cc) over the RADOS self-managed snap machinery
+    # (osd/snaps.py clone-on-write + trim).
+
+    def snap_list(self) -> List[Dict]:
+        return [dict(s) for s in self.snaps]
+
+    async def snap_create(self, name: str) -> None:
+        self._check_mutable()
         import json as _json
+        await self.flush()
+        sid = await self.io.selfmanaged_snap_create()
+        try:
+            await self.io.exec(
+                _header_oid(self.id), "rbd", "snap_add",
+                _json.dumps({"id": sid, "name": name,
+                             "size": self.size}).encode())
+        except Exception:
+            # id allocated but unused: retire it so trim forgets it
+            await self.io.selfmanaged_snap_remove(sid)
+            raise
+        self.snaps.append({"id": sid, "name": name, "size": self.size,
+                           "protected": False})
+        self._apply_snapc()   # subsequent writes clone-on-write
+        if self._journal is not None:
+            # journaled AFTER the op commits: a failed snap must never
+            # leave a phantom event for the mirror to replay
+            from ceph_tpu.services.rbd_mirror import encode_snap_event
+            await self._journal.append(encode_snap_event(True, name))
+
+    async def snap_remove(self, name: str) -> None:
+        self._check_mutable()
+        import json as _json
+        out = await self.io.exec(_header_oid(self.id), "rbd", "snap_rm",
+                                 _json.dumps({"name": name}).encode())
+        sid = _json.loads(out.decode())["id"]
+        self.snaps = [s for s in self.snaps if s["name"] != name]
+        self._apply_snapc()
+        if self._journal is not None:
+            # after the op commits (see snap_create)
+            from ceph_tpu.services.rbd_mirror import encode_snap_event
+            await self._journal.append(encode_snap_event(False, name))
+        # retire the snap id: OSDs trim its clones autonomously
+        await self.io.selfmanaged_snap_remove(sid)
+
+    async def snap_protect(self, name: str) -> None:
+        import json as _json
+        await self.io.exec(_header_oid(self.id), "rbd", "snap_protect",
+                           _json.dumps({"name": name}).encode())
+        for s in self.snaps:
+            if s["name"] == name:
+                s["protected"] = True
+
+    async def snap_unprotect(self, name: str) -> None:
+        import json as _json
+        await self.io.exec(_header_oid(self.id), "rbd",
+                           "snap_unprotect",
+                           _json.dumps({"name": name}).encode())
+        for s in self.snaps:
+            if s["name"] == name:
+                s["protected"] = False
+
+    async def snap_rollback(self, name: str) -> None:
+        """Restore head to the snapshot's content (librbd
+        snap_rollback): every object rolls back to its clone at the
+        snap; objects with no state at the snap are removed."""
+        self._check_mutable()
+        import errno as _errno
+        from ceph_tpu.client.objecter import ObjectOperationError
+        snap = next((s for s in self.snaps if s["name"] == name), None)
+        if snap is None:
+            raise ImageNotFound(f"{self.name}@{name}")
+        await self._cache_barrier()
+        span = max(self.size, snap["size"])
+        n_objs = ((max(span - 1, 0) >> self.order) + 1) if span else 0
+
+        async def roll(object_no):
+            oid = _data_oid(self.id, object_no)
+            try:
+                await self.io.selfmanaged_rollback(oid, snap["id"])
+            except ObjectOperationError as e:
+                if e.retcode != -_errno.ENOENT:
+                    raise
+                try:      # no state at snap: head must not exist either
+                    await self.io.remove(oid)
+                except ObjectOperationError:
+                    pass
+
+        await asyncio.gather(*[roll(n) for n in range(n_objs)])
+        if snap["size"] != self.size:
+            import json as _json
+            await self.io.exec(
+                _header_oid(self.id), "rbd", "set_size",
+                _json.dumps({"size": snap["size"]}).encode())
+            self.size = snap["size"]
+
+    # ----------------------------------------------------------- clone
+    def parent_info(self) -> Optional[Dict]:
+        return dict(self.parent) if self.parent else None
+
+    async def flatten(self) -> None:
+        """Copy every parent-backed object up into the child, then
+        sever the parent link (librbd flatten)."""
+        self._check_mutable()
+        if self.parent is None:
+            raise RBDError(f"{self.name} has no parent")
+        import json as _json
+        overlap = int(self.parent.get("overlap", 0))
+        n_objs = ((max(overlap - 1, 0) >> self.order) + 1) \
+            if overlap else 0
+        sem = asyncio.Semaphore(16)
+
+        async def one(object_no):
+            async with sem:
+                await self._ensure_copyup(object_no)
+
+        await asyncio.gather(*[one(n) for n in range(n_objs)])
+        parent = self.parent
+        self.parent = None       # new reads/writes stop looking up
+        await self.io.exec(_header_oid(self.id), "rbd", "remove_parent",
+                           b"")
+        # deregister from the parent's children index
+        pio = self.io.rados.open_ioctx(parent["pool_name"])
+        await pio.exec(_header_oid(parent["image"]), "rbd", "child_rm",
+                       _json.dumps({"snap_id": parent["snap_id"],
+                                    "child": self.name}).encode())
+        if self._parent_img is not None:
+            await self._parent_img.close()
+            self._parent_img = None
+
+    async def _renew_lock(self) -> None:
+        """Exclusive-lock heartbeat.  Transient renew failures RETRY
+        with short backoff (a lapse under peering/event-loop stall must
+        not silently drop the protection); a definitive loss — another
+        holder owns the lock — marks the handle lock-lost so further
+        writes raise instead of racing the new holder (librbd blocks IO
+        on lock loss)."""
+        import errno as _errno
+        import json as _json
+        import time as _time
         from ceph_tpu.client.objecter import ObjectOperationError
         while self._lock_cookie is not None:
             await asyncio.sleep(LOCK_TTL / 3)
-            try:
-                await self.io.exec(
-                    _header_oid(self.id), "lock", "lock",
-                    _json.dumps({
-                        "name": LOCK_NAME, "type": "exclusive",
-                        "entity": _client_entity(self.io),
-                        "cookie": self._lock_cookie, "renew": True,
-                        "duration": LOCK_TTL}).encode())
-            except (ObjectOperationError, asyncio.CancelledError):
-                return
+            deadline = _time.monotonic() + LOCK_TTL
+            while self._lock_cookie is not None:
+                try:
+                    await self.io.exec(
+                        _header_oid(self.id), "lock", "lock",
+                        _json.dumps({
+                            "name": LOCK_NAME, "type": "exclusive",
+                            "entity": _client_entity(self.io),
+                            "cookie": self._lock_cookie, "renew": True,
+                            "duration": LOCK_TTL}).encode())
+                    break                       # renewed
+                except asyncio.CancelledError:
+                    return
+                except ObjectOperationError as e:
+                    if e.retcode == -_errno.EBUSY:
+                        self._lock_lost = True  # someone else holds it
+                        self._lock_cookie = None
+                        return
+                    if _time.monotonic() >= deadline:
+                        # TTL burned on transient errors: try a fresh
+                        # acquire once; failure = definitively lost
+                        try:
+                            await _cls_lock(
+                                self.io, _header_oid(self.id),
+                                LOCK_NAME, _client_entity(self.io),
+                                self._lock_cookie, duration=LOCK_TTL)
+                            break
+                        except Exception:
+                            self._lock_lost = True
+                            self._lock_cookie = None
+                            return
+                    await asyncio.sleep(0.2)
+                except Exception:
+                    if _time.monotonic() >= deadline:
+                        self._lock_lost = True
+                        self._lock_cookie = None
+                        return
+                    await asyncio.sleep(0.2)
 
     async def close(self) -> None:
         if self._cacher is not None:
@@ -520,3 +893,6 @@ class Image:
             await _cls_unlock(self.io, _header_oid(self.id), LOCK_NAME,
                               _client_entity(self.io), self._lock_cookie)
             self._lock_cookie = None
+        if self._parent_img is not None:
+            await self._parent_img.close()
+            self._parent_img = None

@@ -22,6 +22,7 @@ from ceph_tpu.journal import Journaler
 from ceph_tpu.services.rbd import RBD, Image, ImageNotFound
 
 EVENT_WRITE, EVENT_DISCARD, EVENT_RESIZE = 1, 2, 3
+EVENT_SNAP_CREATE, EVENT_SNAP_REMOVE = 4, 5
 
 
 def encode_write_event(off: int, data: bytes) -> bytes:
@@ -42,6 +43,16 @@ def encode_resize_event(size: int) -> bytes:
     return enc.getvalue()
 
 
+def encode_snap_event(create: bool, name: str) -> bytes:
+    """Snapshot create/remove (librbd journal SnapCreateEvent /
+    SnapRemoveEvent): the secondary allocates its OWN snap ids from its
+    own pool; only the name replicates."""
+    enc = Encoder()
+    enc.u8(EVENT_SNAP_CREATE if create else EVENT_SNAP_REMOVE)
+    enc.bytes_(name.encode())
+    return enc.getvalue()
+
+
 async def apply_event(img: Image, payload: bytes) -> None:
     dec = Decoder(payload)
     t = dec.u8()
@@ -57,6 +68,10 @@ async def apply_event(img: Image, payload: bytes) -> None:
         await img.discard(dec.u64(), dec.u64())
     elif t == EVENT_RESIZE:
         await img.resize(dec.u64())
+    elif t == EVENT_SNAP_CREATE:
+        await img.snap_create(dec.bytes_().decode())
+    elif t == EVENT_SNAP_REMOVE:
+        await img.snap_remove(dec.bytes_().decode())
     else:
         raise ValueError(f"unknown journal event type {t}")
 

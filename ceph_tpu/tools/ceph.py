@@ -44,7 +44,13 @@ async def run(args, extra) -> int:
 
 
 def build_command(args, extra) -> dict:
-    words = args.command
+    # peel k=v arguments off the command words (reference ceph.in style:
+    # `ceph osd tier add pool=cold tierpool=hot`) so the prefix is only
+    # the verb phrase
+    words = [w for w in args.command
+             if "=" not in w or w.startswith("-")]
+    extra = [w for w in args.command
+             if "=" in w and not w.startswith("-")] + list(extra)
     cmd = {"prefix": " ".join(words)}
     if words[0] in ("status", "health", "quorum_status", "mon"):
         return cmd

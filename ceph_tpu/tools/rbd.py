@@ -138,6 +138,51 @@ async def run(args) -> int:
             n = await rep.replay_once()
             print(f"mirrored {args.args[0]!r} -> pool "
                   f"{args.args[1]!r} ({n} events replayed)")
+        elif args.op == "snap":
+            # snap create|ls|rm|rollback|protect|unprotect IMAGE@SNAP
+            verb = args.args[0]
+            spec = args.args[1]
+            name, _, snap = spec.partition("@")
+            img = await Image.open(io, name)
+            try:
+                if verb == "create":
+                    await img.snap_create(snap)
+                elif verb == "ls":
+                    for s in img.snap_list():
+                        flag = " (protected)" if s.get("protected") \
+                            else ""
+                        print(f"{s['id']}\t{s['name']}\t"
+                              f"{s['size']}{flag}")
+                elif verb == "rm":
+                    await img.snap_remove(snap)
+                elif verb == "rollback":
+                    await img.snap_rollback(snap)
+                elif verb == "protect":
+                    await img.snap_protect(snap)
+                elif verb == "unprotect":
+                    await img.snap_unprotect(snap)
+                else:
+                    print(f"unknown snap verb {verb}", file=sys.stderr)
+                    return 2
+            finally:
+                await img.close()
+        elif args.op == "clone":
+            # clone PARENT@SNAP CHILD [--dest-pool POOL]
+            pspec, child = args.args[0], args.args[1]
+            pname, _, snap = pspec.partition("@")
+            c_io = r.open_ioctx(args.dest_pool) if args.dest_pool \
+                else None
+            await rbd.clone(pname, snap, child, clone_ioctx=c_io)
+        elif args.op == "flatten":
+            img = await Image.open(io, args.args[0])
+            try:
+                await img.flatten()
+            finally:
+                await img.close()
+        elif args.op == "children":
+            pname, _, snap = args.args[0].partition("@")
+            for c in await rbd.children(pname, snap):
+                print(c)
         elif args.op == "bench":
             img = await Image.open(io, args.args[0], cached=args.cached)
             try:
@@ -175,8 +220,11 @@ def main(argv=None) -> int:
                     help="use the client ObjectCacher (rbd_cache=true)")
     ap.add_argument("--workload", choices=("write", "read"),
                     default="write")
+    ap.add_argument("--dest-pool", default=None,
+                    help="clone: pool for the child image")
     ap.add_argument("op",
-                    help="create|ls|info|rm|resize|import|export|bench")
+                    help="create|ls|info|rm|resize|import|export|bench|"
+                         "snap|clone|flatten|children")
     ap.add_argument("args", nargs="*")
     args = ap.parse_args(argv)
     return asyncio.run(run(args))

@@ -276,6 +276,99 @@ int main(void) {
     emit_rule_results(m, 1, 12, weight, osd, 512, 0);
   }
 
+  /* ---- scenario G: THREE-level straw2 (root->rack->host->osd), jewel;
+   *      chooseleaf firstn to host, chooseleaf indep to host, and
+   *      chooseleaf firstn to RACK (leaf descent through 2 levels) --- */
+  {
+    struct crush_map *m = crush_create();
+    set_tunables(m, 1);
+    int rackids[4], rw[4];
+    int osd = 0;
+    for (int rk = 0; rk < 4; rk++) {
+      int hostids[3], hw[3];
+      for (int h = 0; h < 3; h++) {
+        int items[2], w[2];
+        for (int i = 0; i < 2; i++) {
+          items[i] = osd++;
+          w[i] = 0x10000 + (int)(lcg() % 0x10000);
+        }
+        mk(m, CRUSH_BUCKET_STRAW2, 1, 2, items, w, &hostids[h]);
+        hw[h] = m->buckets[-1 - hostids[h]]->weight;
+      }
+      mk(m, CRUSH_BUCKET_STRAW2, 2, 3, hostids, hw, &rackids[rk]);
+      rw[rk] = m->buckets[-1 - rackids[rk]]->weight;
+    }
+    int rootid;
+    mk(m, CRUSH_BUCKET_STRAW2, 10, 4, rackids, rw, &rootid);
+    struct crush_rule *r = crush_make_rule(3, 0, 1, 1, 10);
+    crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, rootid, 0);
+    crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1);
+    crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(m, r, 0);
+    struct crush_rule *r2 = crush_make_rule(3, 1, 3, 1, 10);
+    crush_rule_set_step(r2, 0, CRUSH_RULE_TAKE, rootid, 0);
+    crush_rule_set_step(r2, 1, CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1);
+    crush_rule_set_step(r2, 2, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(m, r2, 1);
+    struct crush_rule *r3 = crush_make_rule(3, 0, 1, 1, 10);
+    crush_rule_set_step(r3, 0, CRUSH_RULE_TAKE, rootid, 0);
+    crush_rule_set_step(r3, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 2);
+    crush_rule_set_step(r3, 2, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(m, r3, 2);
+    crush_finalize(m);
+    __u32 weight[32];
+    for (int i = 0; i < osd; i++) weight[i] = 0x10000;
+    weight[3] = 0; weight[11] = 0x9000; weight[17] = 0;
+    emit_rule_results(m, 0, 3, weight, osd, 512, 0);
+    emit_rule_results(m, 1, 5, weight, osd, 512, 0);
+    emit_rule_results(m, 2, 3, weight, osd, 512, 0);
+  }
+
+  /* ---- scenario H: MULTI-TAKE rule over two roots (primary pool +
+   *      secondary pool pattern): take A chooseleaf 2, emit,
+   *      take B chooseleaf 2, emit; plus an indep variant ---- */
+  {
+    struct crush_map *m = crush_create();
+    set_tunables(m, 1);
+    int rootids[2];
+    int osd = 0;
+    for (int rt = 0; rt < 2; rt++) {
+      int hostids[3], hw[3];
+      for (int h = 0; h < 3; h++) {
+        int items[3], w[3];
+        for (int i = 0; i < 3; i++) {
+          items[i] = osd++;
+          w[i] = 0x10000 + (int)(lcg() % 0x8000);
+        }
+        mk(m, CRUSH_BUCKET_STRAW2, 1, 3, items, w, &hostids[h]);
+        hw[h] = m->buckets[-1 - hostids[h]]->weight;
+      }
+      mk(m, CRUSH_BUCKET_STRAW2, 10, 3, hostids, hw, &rootids[rt]);
+    }
+    struct crush_rule *r = crush_make_rule(6, 0, 1, 1, 10);
+    crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, rootids[0], 0);
+    crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 2, 1);
+    crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+    crush_rule_set_step(r, 3, CRUSH_RULE_TAKE, rootids[1], 0);
+    crush_rule_set_step(r, 4, CRUSH_RULE_CHOOSELEAF_FIRSTN, 2, 1);
+    crush_rule_set_step(r, 5, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(m, r, 0);
+    struct crush_rule *r2 = crush_make_rule(6, 1, 3, 1, 10);
+    crush_rule_set_step(r2, 0, CRUSH_RULE_TAKE, rootids[0], 0);
+    crush_rule_set_step(r2, 1, CRUSH_RULE_CHOOSELEAF_INDEP, 2, 1);
+    crush_rule_set_step(r2, 2, CRUSH_RULE_EMIT, 0, 0);
+    crush_rule_set_step(r2, 3, CRUSH_RULE_TAKE, rootids[1], 0);
+    crush_rule_set_step(r2, 4, CRUSH_RULE_CHOOSELEAF_INDEP, 2, 1);
+    crush_rule_set_step(r2, 5, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(m, r2, 1);
+    crush_finalize(m);
+    __u32 weight[32];
+    for (int i = 0; i < osd; i++) weight[i] = 0x10000;
+    weight[2] = 0; weight[12] = 0xa000;
+    emit_rule_results(m, 0, 4, weight, osd, 512, 0);
+    emit_rule_results(m, 1, 4, weight, osd, 512, 0);
+  }
+
   printf("]}\n");
   return 0;
 }

@@ -168,3 +168,16 @@ def test_osdmap_batch_matches_scalar():
         batch = m.map_pgs_batch(pool)
         for pg, up, upp, acting, actp in batch:
             assert (up, upp, acting, actp) == m.pg_to_up_acting_osds(pg)
+
+
+def test_indep_numrep_exceeds_result_max_keeps_r_stride():
+    """crush_do_rule splits out_size (slots: min(numrep, result_max))
+    from numrep (the r stride: r = rep + numrep*ftotal, mapper.c:668).
+    A 'chooseleaf indep 6' rule queried with result_max=4 must keep the
+    6-stride retry sequence — conflating the two diverges from the
+    scalar mapper whenever any retry fires."""
+    m, _, ec = build(12, 2, ec_size=6)      # rule arg numrep = 6
+    # degraded weights force retries so the stride actually matters
+    for wname, wfn in WEIGHT_CASES:
+        assert_match(m, ec, 4, wfn(12))
+        assert_match(m, ec, 2, wfn(12))

@@ -124,3 +124,72 @@ def test_jax_more_reps_than_hosts():
     m, rep, ec = build(6, 2, ec_size=6)   # only 3 hosts
     assert_jax_match(m, rep, 5, [0x10000] * 6)
     assert_jax_match(m, ec, 6, [0x10000] * 6)
+
+
+def build3(n_racks=3, hosts_per_rack=3, per_host=2, ec_size=4):
+    """Three-level map: root -> rack -> host -> osd."""
+    n = n_racks * hosts_per_rack * per_host
+    m = CrushMap()
+    m.max_devices = n
+    build_hierarchy(m, n, per_host, hosts_per_rack=hosts_per_rack)
+    rep = make_replicated_rule(m, "rep")               # chooseleaf host
+    ec = make_erasure_rule(m, "ec", size=ec_size)
+    rep_rack = make_replicated_rule(m, "rep_rack",
+                                    failure_domain="rack")
+    return m, rep, ec, rep_rack
+
+
+def test_jax_three_level_bit_exact():
+    m, rep, ec, rep_rack = build3()
+    n = m.max_devices
+    for wname, wfn in WEIGHT_CASES:
+        w = wfn(n)
+        assert_jax_match(m, rep, 3, w)
+        assert_jax_match(m, ec, 4, w)
+        assert_jax_match(m, rep_rack, 3, w)     # 2-level leaf descent
+
+
+def test_jax_multi_take_bit_exact():
+    from ceph_tpu.crush.builder import make_bucket
+    from ceph_tpu.crush.constants import (BUCKET_STRAW2,
+                                          RULE_CHOOSELEAF_FIRSTN,
+                                          RULE_EMIT, RULE_TAKE)
+    from ceph_tpu.crush.types import Rule, RuleStep
+    m = CrushMap()
+    m.max_devices = 12
+    roots = []
+    osd = 0
+    for _ in range(2):
+        hosts = []
+        for _h in range(3):
+            items = [osd, osd + 1]
+            osd += 2
+            hosts.append(make_bucket(m, BUCKET_STRAW2, 1, items,
+                                     [0x10000] * 2))
+        roots.append(make_bucket(m, BUCKET_STRAW2, 10,
+                                 [h.id for h in hosts],
+                                 [h.weight for h in hosts]))
+    rid = m.add_rule(Rule(0, 1, 1, 10, [
+        RuleStep(RULE_TAKE, roots[0].id),
+        RuleStep(RULE_CHOOSELEAF_FIRSTN, 2, 1),
+        RuleStep(RULE_EMIT),
+        RuleStep(RULE_TAKE, roots[1].id),
+        RuleStep(RULE_CHOOSELEAF_FIRSTN, 2, 1),
+        RuleStep(RULE_EMIT)]))
+    assert compile_rule(m, rid) is not None
+    for wname, wfn in WEIGHT_CASES:
+        assert_jax_match(m, rid, 4, wfn(12))
+
+
+def test_fallback_is_counted_and_logged(caplog):
+    import logging
+    m, rep, _ = build(8, 2)
+    m.tunables.chooseleaf_stable = 0          # unsupported shape
+    assert compile_rule(m, rep) is None
+    before = crush_kernel.fallback_count()
+    with caplog.at_level(logging.WARNING, logger="ceph_tpu.crush"):
+        got = batch_do_rule(m, rep, list(range(16)), 3, [0x10000] * 8)
+    want = [do_rule(m, rep, x, 3, [0x10000] * 8) for x in range(16)]
+    assert got == want
+    assert crush_kernel.fallback_count() == before + 1
+    assert any("not vectorizable" in r.message for r in caplog.records)

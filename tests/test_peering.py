@@ -209,3 +209,110 @@ def test_restart_survivor_unblocks_without_lost():
                 await asyncio.sleep(0.2)
         await cl.stop()
     asyncio.run(run())
+
+
+def test_stale_survivor_cascade_blocks_until_newest_interval_heard():
+    """The cascade the reference's build_prior guards against
+    (/root/reference/src/osd/PG.cc build_prior): interval I1 = {A,B}
+    writes v1; I2 = {C,D} (A,B down) writes v2; then C,D die and A,B
+    come BACK with stale v1.  The PG must NOT serve v1 — it blocks on
+    {C,D} (the newest maybe-rw interval) until one returns, then serves
+    v2."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(4)
+        await admin.pool_create("p", pg_num=8, size=2)
+        io = admin.open_ioctx("p")
+        oid = None
+        for i in range(64):
+            cand = f"obj{i}"
+            _, acting, _ = _pg_of(admin, "p", cand)
+            if len(acting) == 2:
+                oid = cand
+                break
+        assert oid is not None
+        await io.write_full(oid, b"v1")
+        pgid, acting, _ = _pg_of(admin, "p", oid)
+        a, b = acting
+        cd = [o for o in cl.osds if o not in (a, b)]
+        assert len(cd) == 2
+        c, d = cd
+
+        # ---- interval 2: {a,b} down+out -> pg remaps to {c,d} ----
+        store_a = await cl.kill_osd(a)
+        await cl.mark_down_and_wait(admin, a)
+        store_b = await cl.kill_osd(b)
+        await cl.mark_down_and_wait(admin, b)
+        for o in (a, b):
+            await admin.mon_command({"prefix": "osd out", "id": o})
+        deadline = asyncio.get_running_loop().time() + 20
+        while True:
+            _, new_acting, _ = _pg_of(admin, "p", oid)
+            if new_acting and not (set(new_acting) & {a, b}):
+                break
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.1)
+        # {a,b} were killed, not lost: {c,d} block first, then unblock
+        # via `osd lost` (their data is in our hands as store_a/store_b,
+        # which the cluster will never see again)
+        for o in (a, b):
+            await admin.mon_command({"prefix": "osd lost", "id": o,
+                                     "yes_i_really_mean_it": True})
+        # v2 lands on the NEW interval {c,d}
+        deadline = asyncio.get_running_loop().time() + 25
+        while True:
+            try:
+                await asyncio.wait_for(io.write_full(oid, b"v2"), 3.0)
+                break
+            except (asyncio.TimeoutError, ObjectOperationError):
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "write never succeeded on the new interval"
+                await asyncio.sleep(0.2)
+
+        # ---- the cascade: {c,d} die; stale {a,b} come back ----
+        store_c = await cl.kill_osd(c)
+        await cl.mark_down_and_wait(admin, c)
+        store_d = await cl.kill_osd(d)
+        await cl.mark_down_and_wait(admin, d)
+        for o in (c, d):
+            await admin.mon_command({"prefix": "osd out", "id": o})
+        # revive a,b with their STALE stores; mark them in again
+        await cl.start_osd(a, store=store_a)
+        await cl.start_osd(b, store=store_b)
+        for o in (a, b):
+            await admin.mon_command({"prefix": "osd in", "id": o})
+        # the pg must map to live members again
+        deadline = asyncio.get_running_loop().time() + 20
+        while True:
+            _, new_acting, np_ = _pg_of(admin, "p", oid)
+            if new_acting and not (set(new_acting) & {c, d}) \
+                    and np_ >= 0:
+                break
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.1)
+
+        # a stale read MUST NOT be served: v1 would be silent data loss
+        try:
+            got = await io.read(oid, timeout=3.0)
+            assert got == b"v2", \
+                f"STALE DATA SERVED: read {got!r}, newest was b'v2'"
+            served_early = True
+        except asyncio.TimeoutError:
+            served_early = False     # blocked, as required
+        if not served_early:
+            # bring one member of the newest interval back: the pg must
+            # unblock and serve v2
+            await cl.start_osd(c, store=store_c)
+            deadline = asyncio.get_running_loop().time() + 30
+            while True:
+                try:
+                    got = await io.read(oid, timeout=3.0)
+                    assert got == b"v2", f"read {got!r} != v2"
+                    break
+                except (asyncio.TimeoutError, ObjectOperationError):
+                    assert asyncio.get_running_loop().time() < deadline, \
+                        "pg never served v2 after C returned"
+                    await asyncio.sleep(0.2)
+        del store_d
+        await cl.stop()
+    asyncio.run(run())

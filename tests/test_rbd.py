@@ -263,3 +263,216 @@ def test_rbd_cli_and_bench_on_cluster():
             assert open(dst, "rb").read() == open(src, "rb").read()
         finally:
             cl.stop()
+
+
+# ------------------------------------------------- snapshots and clones
+
+def test_rbd_snapshot_create_read_rollback_remove():
+    """Snap data survives overwrites (RADOS clone-on-write), snap-opened
+    handles are read-only, rollback restores, remove trims."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("rbd", pg_num=8)
+        io = admin.open_ioctx("rbd")
+        rbd = RBD(io)
+        await rbd.create("disk", 2 << 20, order=16)
+        img = await Image.open(io, "disk")
+        rng = np.random.default_rng(5)
+        v1 = rng.integers(0, 256, 200000, dtype=np.uint8).tobytes()
+        await img.write(1000, v1)
+        await img.snap_create("s1")
+        # image with snapshots refuses removal
+        with pytest.raises(RBDError):
+            await rbd.remove("disk")
+        # overwrite after the snap
+        v2 = rng.integers(0, 256, 200000, dtype=np.uint8).tobytes()
+        await img.write(1000, v2)
+        assert await img.read(1000, len(v2)) == v2
+        # the snap still reads v1
+        snap = await Image.open(io, "disk", snap_name="s1")
+        assert await snap.read(1000, len(v1)) == v1
+        from ceph_tpu.services.rbd import ReadOnlyImage
+        with pytest.raises(ReadOnlyImage):
+            await snap.write(0, b"x")
+        await snap.close()
+        # a fresh handle sees the snap in the header
+        img2 = await Image.open(io, "disk")
+        assert [s["name"] for s in img2.snap_list()] == ["s1"]
+        # rollback restores v1 on the head
+        await img2.snap_rollback("s1")
+        assert await img2.read(1000, len(v1)) == v1
+        await img2.close()
+        await img.close()
+        # remove the snap: trim runs, image becomes removable
+        img3 = await Image.open(io, "disk")
+        await img3.snap_remove("s1")
+        assert img3.snap_list() == []
+        await img3.close()
+        await rbd.remove("disk")
+        assert await rbd.list() == []
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_rbd_snapshot_rollback_removes_later_objects():
+    # objects written AFTER the snap (absent at snap time) vanish on
+    # rollback; size reverts to the snap's size
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("rbd", pg_num=8)
+        io = admin.open_ioctx("rbd")
+        rbd = RBD(io)
+        await rbd.create("disk", 1 << 20, order=16)
+        img = await Image.open(io, "disk")
+        await img.write(0, b"A" * 1000)
+        await img.snap_create("s1")
+        await img.resize(2 << 20)
+        await img.write(1 << 20, b"B" * 1000)   # new object post-snap
+        await img.snap_rollback("s1")
+        assert img.size == 1 << 20
+        assert await img.read(0, 1000) == b"A" * 1000
+        await img.close()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_rbd_snapshot_on_ec_pool():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(6)
+        await admin.pool_create("ecp", pg_num=8, pool_type="erasure",
+                                k=2, m=1)
+        io = admin.open_ioctx("ecp")
+        rbd = RBD(io)
+        await rbd.create("disk", 1 << 20, order=16)
+        img = await Image.open(io, "disk")
+        rng = np.random.default_rng(7)
+        v1 = rng.integers(0, 256, 150000, dtype=np.uint8).tobytes()
+        await img.write(0, v1)
+        await img.snap_create("s1")
+        v2 = rng.integers(0, 256, 150000, dtype=np.uint8).tobytes()
+        await img.write(0, v2)
+        snap = await Image.open(io, "disk", snap_name="s1")
+        assert await snap.read(0, len(v1)) == v1
+        await snap.close()
+        assert await img.read(0, len(v2)) == v2
+        await img.close()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_rbd_clone_copyup_and_flatten():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("rbd", pg_num=8)
+        io = admin.open_ioctx("rbd")
+        rbd = RBD(io)
+        await rbd.create("parent", 1 << 20, order=16)  # 16 objects
+        pimg = await Image.open(io, "parent")
+        rng = np.random.default_rng(9)
+        base = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+        await pimg.write(0, base)
+        await pimg.snap_create("gold")
+        # clone requires a protected snap
+        with pytest.raises(RBDError):
+            await rbd.clone("parent", "gold", "child")
+        await pimg.snap_protect("gold")
+        await rbd.clone("parent", "gold", "child")
+        assert "child" in await rbd.list()
+        assert await rbd.children("parent", "gold") == ["child"]
+        # protected snap can't be unprotected while children exist
+        with pytest.raises(Exception):
+            await pimg.snap_unprotect("gold")
+        child = await Image.open(io, "child")
+        assert child.parent_info()["image"] == "parent"
+        # reads fall through to the parent
+        assert await child.read(0, 1 << 20) == base
+        # partial write copies the object up, composing with parent data
+        await child.write(70000, b"X" * 100)
+        want = bytearray(base)
+        want[70000:70100] = b"X" * 100
+        assert await child.read(0, 1 << 20) == bytes(want)
+        # the parent is untouched
+        assert await pimg.read(70000, 100) == base[70000:70100]
+        # parent writes after the clone don't leak into the child
+        await pimg.write(200000, b"Z" * 100)
+        assert (await child.read(200000, 100)) == base[200000:200100]
+        # flatten severs the lineage; bytes stay identical
+        await child.flatten()
+        assert child.parent_info() is None
+        assert await child.read(0, 1 << 20) == bytes(want)
+        assert await rbd.children("parent", "gold") == []
+        await pimg.snap_unprotect("gold")   # no children left: allowed
+        await child.close()
+        await pimg.close()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_rbd_clone_on_ec_pool_and_discard_no_resurrect():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(6)
+        await admin.pool_create("ecp", pg_num=8, pool_type="erasure",
+                                k=2, m=1)
+        io = admin.open_ioctx("ecp")
+        rbd = RBD(io)
+        await rbd.create("parent", 1 << 19, order=16)
+        pimg = await Image.open(io, "parent")
+        rng = np.random.default_rng(11)
+        base = rng.integers(0, 256, 1 << 19, dtype=np.uint8).tobytes()
+        await pimg.write(0, base)
+        await pimg.snap_create("gold")
+        await pimg.snap_protect("gold")
+        await rbd.clone("parent", "gold", "child")
+        child = await Image.open(io, "child")
+        assert await child.read(0, 1 << 19) == base
+        # discard inside the overlap must ZERO, not resurrect parent
+        await child.discard(0, 1 << 16)     # exactly object 0
+        got = await child.read(0, 1 << 17)
+        assert got[:1 << 16] == b"\x00" * (1 << 16)
+        assert got[1 << 16:] == base[1 << 16:1 << 17]
+        # child removal deregisters from the parent
+        await child.close()
+        await rbd.remove("child")
+        assert await rbd.children("parent", "gold") == []
+        await pimg.close()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_rbd_snap_events_replicate_through_mirror():
+    """Journaling images replicate snap_create/remove by NAME; the
+    secondary allocates its own snap ids."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("rbd", pg_num=8)
+        await admin.pool_create("rbd_b", pg_num=8)
+        io = admin.open_ioctx("rbd")
+        dst_io = admin.open_ioctx("rbd_b")
+        rbd = RBD(io)
+        await rbd.create("disk", 1 << 20, order=16)
+        img = await Image.open(io, "disk", journaling=True)
+        await img.write(0, b"A" * 1000)
+        from ceph_tpu.services.rbd_mirror import ImageReplayer
+        rep = ImageReplayer(io, dst_io, "disk")
+        await rep.bootstrap()           # full-syncs current content (A)
+        # events AFTER bootstrap replay in order: the snap captures A,
+        # then B lands on the head
+        await img.snap_create("s1")
+        await img.write(0, b"B" * 1000)
+        await img.close()
+        await rep.replay_once()
+        mirrored = await Image.open(dst_io, "disk")
+        assert [s["name"] for s in mirrored.snap_list()] == ["s1"]
+        assert await mirrored.read(0, 1000) == b"B" * 1000
+        msnap = await Image.open(dst_io, "disk", snap_name="s1")
+        assert await msnap.read(0, 1000) == b"A" * 1000
+        await msnap.close()
+        await mirrored.close()
+        await cl.stop()
+    asyncio.run(run())

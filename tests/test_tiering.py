@@ -1,0 +1,217 @@
+"""Cache tiering: bloom HitSets, overlay redirection, promote on miss,
+agent flush/evict (reference osd/HitSet.h, ReplicatedPG.cc:12008
+agent_work, maybe_handle_cache; pool linkage osd_types.h:1230-1234).
+"""
+
+import asyncio
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from test_osd import Cluster  # noqa: E402
+
+from ceph_tpu.osd.hitset import BloomHitSet, HitSetTracker  # noqa: E402
+
+
+# ------------------------------------------------------------- unit: bloom
+
+def test_bloom_no_false_negatives_and_low_fp():
+    hs = BloomHitSet(target_size=512, fpp=0.01)
+    ins = [f"obj{i}" for i in range(512)]
+    hs.insert_many(ins)
+    assert hs.contains_many(ins).all()          # zero false negatives
+    others = [f"other{i}" for i in range(2000)]
+    fp = hs.contains_many(others).mean()
+    assert fp < 0.05, f"false-positive rate {fp:.3f}"
+
+
+def test_bloom_roundtrip_encoding():
+    hs = BloomHitSet(target_size=64)
+    hs.insert_many(["a", "b", "c"])
+    blob = hs.to_bytes()
+    hs2 = BloomHitSet.from_bytes(blob)
+    assert hs2.contains("a") and hs2.contains("b")
+    assert hs2.nbits == hs.nbits and hs2.k == hs.k
+
+
+def test_hitset_tracker_window():
+    tr = HitSetTracker(count=2, target_size=64)
+    tr.insert("hot1")
+    tr.rotate()
+    tr.insert("hot2")
+    assert tr.contains("hot1") and tr.contains("hot2")
+    tr.rotate()            # hot1's set falls out of the 2-set window
+    tr.rotate()
+    assert not tr.contains("hot1")
+
+
+# ------------------------------------------------------- e2e: live cluster
+
+def _base_pool_heads(cl, pool_id):
+    """Objects present in any OSD's store for the given pool."""
+    names = set()
+    for osd in cl.osds.values():
+        for cid in osd.store.list_collections():
+            if cid.name.startswith(f"{pool_id}."):
+                for o in osd.store.collection_list(cid):
+                    if o.is_head() and not o.name.startswith("_"):
+                        names.add(o.name)
+    return names
+
+
+async def _setup_tiered(cl, base_type="replicated", n=3):
+    admin = await cl.start(n)
+    if base_type == "erasure":
+        await admin.pool_create("base", pg_num=4, pool_type="erasure",
+                                k=2, m=1)
+    else:
+        await admin.pool_create("base", pg_num=4)
+    await admin.pool_create("cache", pg_num=4)
+    await admin.mon_command({"prefix": "osd tier add", "pool": "base",
+                             "tierpool": "cache"})
+    await admin.mon_command({"prefix": "osd tier cache-mode",
+                             "pool": "cache", "mode": "writeback"})
+    await admin.mon_command({"prefix": "osd tier set-overlay",
+                             "pool": "base", "overlaypool": "cache"})
+    # wait for the overlay to land in the client's map
+    base_id = admin.monc.osdmap.lookup_pool("base")
+    while admin.monc.osdmap.pools[base_id].read_tier < 0:
+        await asyncio.sleep(0.05)
+    return admin
+
+
+def test_overlay_redirects_writes_to_cache_pool():
+    async def run():
+        cl = Cluster()
+        admin = await _setup_tiered(cl)
+        base_id = admin.monc.osdmap.lookup_pool("base")
+        cache_id = admin.monc.osdmap.lookup_pool("cache")
+        io = admin.open_ioctx("base")
+        rng = np.random.default_rng(1)
+        payloads = {f"o{i}": rng.integers(0, 256, 4096,
+                                          dtype=np.uint8).tobytes()
+                    for i in range(8)}
+        for k, v in payloads.items():
+            await io.write_full(k, v)
+        for k, v in payloads.items():
+            assert await io.read(k) == v
+        # bytes landed in the CACHE pool, not the base pool
+        assert _base_pool_heads(cl, cache_id) >= set(payloads)
+        assert not (_base_pool_heads(cl, base_id) & set(payloads))
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_agent_flushes_and_evicts_then_promote_serves_reads():
+    async def run():
+        cl = Cluster()
+        admin = await _setup_tiered(cl)
+        base_id = admin.monc.osdmap.lookup_pool("base")
+        cache_id = admin.monc.osdmap.lookup_pool("cache")
+        # tiny budget so the agent must flush+evict almost everything
+        await admin.mon_command({"prefix": "osd pool set",
+                                 "pool": "cache",
+                                 "var": "target_max_objects",
+                                 "val": "4"})
+        io = admin.open_ioctx("base")
+        rng = np.random.default_rng(2)
+        payloads = {f"o{i:02d}": rng.integers(0, 256, 8192,
+                                              dtype=np.uint8).tobytes()
+                    for i in range(16)}
+        for k, v in payloads.items():
+            await io.write_full(k, v)
+        # agent passes run every osd_tier_agent_interval: wait until the
+        # base pool holds flushed copies and the cache shrank
+        for _ in range(200):
+            flushed = _base_pool_heads(cl, base_id) & set(payloads)
+            cached = _base_pool_heads(cl, cache_id) & set(payloads)
+            if len(flushed) >= 12 and len(cached) <= 8:
+                break
+            await asyncio.sleep(0.25)
+        else:
+            raise AssertionError(
+                f"agent never converged: flushed={len(flushed)} "
+                f"cached={len(cached)}")
+        # every object still reads back bit-exact: evicted ones
+        # re-promote from the base pool on miss
+        for k, v in payloads.items():
+            assert await io.read(k) == v, f"{k} corrupted by tiering"
+        promotes = 0
+        for osd in cl.osds.values():
+            for pg in osd.pgs.values():
+                if pg.pool_id == cache_id and pg._perf_tier is not None:
+                    promotes += pg._perf_tier.dump().get("promotes", 0)
+        assert promotes > 0, "no promote ever ran"
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_tiering_over_ec_base_pool():
+    """The flagship layout: replicated cache in front of an EC base."""
+    async def run():
+        cl = Cluster()
+        admin = await _setup_tiered(cl, base_type="erasure", n=4)
+        base_id = admin.monc.osdmap.lookup_pool("base")
+        await admin.mon_command({"prefix": "osd pool set",
+                                 "pool": "cache",
+                                 "var": "target_max_objects",
+                                 "val": "4"})
+        io = admin.open_ioctx("base")
+        rng = np.random.default_rng(3)
+        payloads = {f"e{i:02d}": rng.integers(0, 256, 16384,
+                                              dtype=np.uint8).tobytes()
+                    for i in range(12)}
+        for k, v in payloads.items():
+            await io.write_full(k, v)
+        for _ in range(200):
+            if len(_base_pool_heads(cl, base_id)
+                   & set(payloads)) >= 8:
+                break
+            await asyncio.sleep(0.25)
+        else:
+            raise AssertionError("no flushes to the EC base pool")
+        for k, v in payloads.items():
+            assert await io.read(k) == v
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_tier_commands_validate():
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(4)
+        await admin.pool_create("base", pg_num=4)
+        await admin.pool_create("ecache", pg_num=4,
+                                pool_type="erasure", k=2, m=1)
+        from ceph_tpu.mon.client import CommandError
+        # EC pools can't be cache tiers
+        with pytest.raises(CommandError):
+            await admin.mon_command({"prefix": "osd tier add",
+                                     "pool": "base",
+                                     "tierpool": "ecache"})
+        await admin.pool_create("cache", pg_num=4)
+        await admin.mon_command({"prefix": "osd tier add",
+                                 "pool": "base", "tierpool": "cache"})
+        # cache-mode on a non-tier pool refuses
+        with pytest.raises(CommandError):
+            await admin.mon_command({"prefix": "osd tier cache-mode",
+                                     "pool": "base",
+                                     "mode": "writeback"})
+        await admin.mon_command({"prefix": "osd tier set-overlay",
+                                 "pool": "base", "overlaypool": "cache"})
+        # removing a tier under an overlay refuses
+        with pytest.raises(CommandError):
+            await admin.mon_command({"prefix": "osd tier remove",
+                                     "pool": "base",
+                                     "tierpool": "cache"})
+        await admin.mon_command({"prefix": "osd tier remove-overlay",
+                                 "pool": "base"})
+        await admin.mon_command({"prefix": "osd tier remove",
+                                 "pool": "base", "tierpool": "cache"})
+        base_id = admin.monc.osdmap.lookup_pool("base")
+        while admin.monc.osdmap.pools[base_id].tiers:
+            await asyncio.sleep(0.05)
+        await cl.stop()
+    asyncio.run(run())
