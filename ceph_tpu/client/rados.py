@@ -171,6 +171,12 @@ class IoCtx:
         return ObjectLocator(self.pool_id, self.locator_key, self.namespace)
 
     async def _op(self, oid: str, ops: List[OSDOp], timeout=30.0):
+        from ceph_tpu.osd.pglog import valid_object_name
+        if not valid_object_name(oid):
+            # U+10FFFF is the backfill-cursor sentinel: a name sorting
+            # at/above it would corrupt cursor invariants on the OSDs
+            raise ObjectOperationError(-errno.EINVAL,
+                                       f"invalid object name {oid!r}")
         reply = await self.objecter.op_submit(oid, self._loc(), ops,
                                               timeout,
                                               snapid=self.snap_read,

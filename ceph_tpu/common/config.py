@@ -278,6 +278,9 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("osd_scrub_interval", "float", 60.0, "light scrub cadence (test scale)"),
     Option("osd_tier_agent_interval", "float", 2.0,
            "cache-tier agent pass cadence (flush/evict scheduling)"),
+    Option("osd_op_queue", "str", "wpq",
+           "PG op scheduler: wpq (weighted class round-robin, "
+           "WeightedPriorityQueue.h) | fifo"),
     Option("osd_deep_scrub_interval", "float", 300.0,
            "deep scrub cadence (reads + recomputes every digest)"),
     Option("osd_mon_report_interval", "float", 2.0,

@@ -1,0 +1,85 @@
+"""Weighted priority queue for OSD op scheduling.
+
+Reference parity: common/WeightedPriorityQueue.h (the osd_op_queue=wpq
+scheduler, config_opts.h:706): ops are enqueued in CLASSES (client,
+recovery, scrub, agent...) and dequeued by weighted round-robin so a
+flood of client ops cannot starve recovery, and recovery traffic cannot
+crowd out client latency.  Strict items (peering machinery) preempt
+everything, FIFO among themselves.
+
+Redesign notes: the reference interleaves by a cost/priority token
+scheme inside ShardedOpWQ's lock; here the asyncio single-consumer PG
+worker makes the structure trivial — per-class deques + a credit
+counter round-robin, one Event for wakeup.  Within a class, order is
+strictly FIFO (per-PG op ordering is sacred)."""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Dict, Optional
+
+#: default class weights: ~ osd_client_op_priority (63, covering client
+#: ops AND their replica sub-ops) vs scrub/agent housekeeping
+DEFAULT_WEIGHTS = {"client": 63, "scrub": 2, "agent": 2}
+
+
+class WeightedPriorityQueue:
+    def __init__(self, weights: Optional[Dict[str, int]] = None):
+        self.weights = dict(weights or DEFAULT_WEIGHTS)
+        self._classes: Dict[str, deque] = {k: deque()
+                                           for k in self.weights}
+        self._order = list(self.weights)    # round-robin cycle
+        self._cursor = 0
+        self._credit = 0
+        self._event = asyncio.Event()
+        self._size = 0
+
+    def qsize(self) -> int:
+        return self._size
+
+    def empty(self) -> bool:
+        return self._size == 0
+
+    def put_nowait(self, item, klass: str = "client") -> None:
+        q = self._classes.get(klass)
+        if q is None:
+            q = self._classes[klass] = deque()
+            self.weights.setdefault(klass, 1)
+            self._order.append(klass)
+        q.append(item)
+        self._size += 1
+        self._event.set()
+
+    def _pop(self):
+        """Next item by policy; caller guarantees non-empty."""
+        # weighted round-robin: spend up to weight[k] credits on class
+        # k before advancing; empty classes forfeit their turn
+        for _ in range(len(self._order) + 1):
+            k = self._order[self._cursor]
+            q = self._classes[k]
+            if q and self._credit < self.weights.get(k, 1):
+                self._credit += 1
+                self._size -= 1
+                return q.popleft()
+            self._cursor = (self._cursor + 1) % len(self._order)
+            self._credit = 0
+        # only unknown-class leftovers remain (cannot happen: every
+        # class is registered in _order) — drain deterministically
+        for q in self._classes.values():
+            if q:
+                self._size -= 1
+                return q.popleft()
+        raise IndexError("pop from empty WeightedPriorityQueue")
+
+    def get_nowait(self):
+        """asyncio.Queue-compatible non-blocking pop (PG.stop drain)."""
+        if self._size == 0:
+            raise asyncio.QueueEmpty
+        return self._pop()
+
+    async def get(self):
+        while self._size == 0:
+            self._event.clear()
+            await self._event.wait()
+        return self._pop()

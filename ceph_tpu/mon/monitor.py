@@ -78,7 +78,10 @@ class Monitor(Dispatcher):
         self.osdmon = OSDMonitor(self)
         from ceph_tpu.mon.auth_monitor import AuthMonitor
         self.authmon = AuthMonitor(self)
-        self.services: List[PaxosService] = [self.osdmon, self.authmon]
+        from ceph_tpu.mon.fs_monitor import FSMonitor
+        self.fsmon = FSMonitor(self)
+        self.services: List[PaxosService] = [self.osdmon, self.authmon,
+                                             self.fsmon]
         self.auth_required = (self.cfg["auth_supported"] == "cephx")
         if self.auth_required:
             self._arm_auth_hooks()
@@ -398,24 +401,10 @@ class Monitor(Dispatcher):
                        "quorum_names": [self.monmap.name_of_rank(r)
                                         for r in self.quorum]}
                 self.reply(m, MMonCommandAck(m.tid, 0, json.dumps(out)))
-            elif prefix == "mds boot":
-                # FSMonitor-lite (mon/MDSMonitor.cc beacon role): the
-                # mds registers its address; clients resolve via
-                # `mds dump` instead of side-channel files.  Replicated
-                # through paxos like every map mutation — a leader
-                # failover must not lose registrations
-                import time as _time
-                txn = KVTransaction()
-                txn.set("fsmap", m.cmd["name"], json.dumps({
-                    "addr": m.cmd["addr"],
-                    "stamp": _time.time()}).encode())
-                self._propose_kv(m, txn, "registered")
-            elif prefix == "mds dump":
-                out = {}
-                for k in self.store.keys("fsmap"):
-                    out[k.decode()] = json.loads(
-                        self.store_get("fsmap", k).decode())
-                self.reply(m, MMonCommandAck(m.tid, 0, json.dumps(out)))
+            elif prefix in ("mds boot", "mds dump"):
+                # FSMap service (mon/MDSMonitor.cc): a PaxosService peer
+                # of the OSD/Auth monitors with pending/propose batching
+                self.fsmon.dispatch(m)
             elif prefix == "config-key set":
                 txn = KVTransaction()
                 txn.set("config-key", m.cmd["key"],

@@ -51,6 +51,13 @@ STATE_PEERING = "peering"
 STATE_ACTIVE = "active"
 
 
+class _FifoQueue(asyncio.Queue):
+    """osd_op_queue=fifo: plain queue ignoring the class tag."""
+
+    def put_nowait(self, item, klass: str = "client") -> None:
+        super().put_nowait(item)
+
+
 class PG:
     def __init__(self, osd, pgid: PGId, pool_id: int, pool: PGPool):
         self.osd = osd
@@ -82,7 +89,15 @@ class PG:
         self.interval_epoch = 0
         self._active_event = asyncio.Event()
         self._peering_task: Optional[asyncio.Task] = None
-        self._op_queue: asyncio.Queue = asyncio.Queue()
+        # op scheduler (osd_op_queue, config_opts.h:706): wpq arbitrates
+        # client ops vs scrub vs tier-agent passes on the PG worker so
+        # neither housekeeping class starves client latency nor a client
+        # flood starves housekeeping (WeightedPriorityQueue.h role)
+        if osd.cfg["osd_op_queue"] == "wpq":
+            from ceph_tpu.common.wpq import WeightedPriorityQueue
+            self._op_queue = WeightedPriorityQueue()
+        else:
+            self._op_queue = _FifoQueue()
         self._worker_task: Optional[asyncio.Task] = None
         # request/reply matching for peering + recovery
         self._notify_waiters: Dict[int, asyncio.Future] = {}
@@ -1149,7 +1164,17 @@ class PG:
             await tiering.maybe_promote(self, m)
 
     def queue_op(self, m) -> None:
-        self._op_queue.put_nowait(m)
+        from ceph_tpu.osd.messages import MPGScrub, MPGScrubScan
+        if callable(m):
+            klass = "agent"
+        elif isinstance(m, (MPGScrub, MPGScrubScan)):
+            klass = "scrub"
+        else:
+            # MOSDOp AND replica sub-ops: replica work carries the
+            # client's priority (a deprioritized sub-op would stall the
+            # primary awaiting its ack)
+            klass = "client"
+        self._op_queue.put_nowait(m, klass)
 
     async def _worker(self) -> None:
         from ceph_tpu.osd.messages import MPGScrub, MPGScrubScan
@@ -1211,6 +1236,13 @@ class PG:
                 self.osd.reply_to(m, MOSDOpReply(
                     m.tid, -errno.EAGAIN, map_epoch=self.osd.osdmap.epoch))
                 return
+        from ceph_tpu.osd.pglog import valid_object_name
+        if m.oid and not valid_object_name(m.oid):
+            # defense in depth vs a client that skipped the IoCtx check
+            # (LB_MAX backfill-cursor sentinel, ADVICE r4)
+            self.osd.reply_to(m, MOSDOpReply(
+                m.tid, -errno.EINVAL, map_epoch=self.osd.osdmap.epoch))
+            return
         has_write = any(o.is_write() for o in m.ops)
         if has_write and len(
                 [o for o in self.acting if o != CRUSH_ITEM_NONE]) \

@@ -56,7 +56,17 @@ class LogEntry(Encodable):
 # the "backfill finished" cursor sentinel: compares greater than any
 # real object name (hobject_t::get_max / last_backfill == MAX role);
 # U+10FFFF is the maximum code point so no name can exceed it
+#: backfill-cursor sentinel: compares above every VALID object name.
+#: Names containing U+10FFFF are rejected at client intake
+#: (IoCtx._op) and at the OSD (submit_client_write) — otherwise a name
+#: sorting above the sentinel would knock a completed PG's
+#: last_backfill off LB_MAX and sit forever beyond the cursor
+#: (ADVICE r4).
 LB_MAX = "\U0010ffff"
+
+
+def valid_object_name(oid: str) -> bool:
+    return LB_MAX not in oid
 
 
 class PGInfo(Encodable):

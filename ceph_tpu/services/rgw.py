@@ -94,6 +94,30 @@ class UserDB:
 
 # ---------------------------------------------------------------------- auth
 
+#: query subresources that are part of the v2 canonical resource
+#: (rgw_auth_s3.cc sub_resources[]): a signature over /bucket/key must
+#: not be replayable as a different subresource operation
+V2_SUBRESOURCES = (
+    "acl", "cors", "delete", "lifecycle", "location", "logging",
+    "notification", "partNumber", "policy", "requestPayment", "torrent",
+    "uploadId", "uploads", "versionId", "versioning", "versions",
+    "website",
+)
+
+
+def v2_canonical_resource(path: str, query: str) -> str:
+    """path + sorted signed subresources (rgw_auth_s3.cc
+    get_canon_resource)."""
+    subs = []
+    for kv in query.split("&"):
+        k, eq, v = kv.partition("=")
+        if k in V2_SUBRESOURCES:
+            subs.append(f"{k}={v}" if eq else k)
+    if subs:
+        return path + "?" + "&".join(sorted(subs))
+    return path
+
+
 def sign_v2(secret: str, method: str, content_md5: str, content_type: str,
             date: str, canonical_resource: str) -> str:
     """AWS signature v2 (rgw_auth_s3.cc string-to-sign)."""
@@ -101,6 +125,120 @@ def sign_v2(secret: str, method: str, content_md5: str, content_type: str,
                      canonical_resource])
     mac = hmac.new(secret.encode(), sts.encode(), hashlib.sha1)
     return base64.b64encode(mac.digest()).decode()
+
+
+# ---- AWS signature v4 (rgw_auth_s3.cc get_v4_canonical_request /
+#      rgw_rest_s3.cc authorize_v4) ----
+
+def _sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _hmac256(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def v4_canonical_query(query: str) -> str:
+    """Sorted, URI-encoded canonical query string."""
+    from urllib.parse import quote
+    pairs = []
+    for kv in query.split("&"):
+        if not kv:
+            continue
+        k, _, v = kv.partition("=")
+        pairs.append((quote(unquote(k), safe="-_.~"),
+                      quote(unquote(v), safe="-_.~")))
+    return "&".join(f"{k}={v}" for k, v in sorted(pairs))
+
+
+def v4_canonical_request(method: str, uri: str, query: str,
+                         headers: Dict[str, str],
+                         signed_headers: List[str],
+                         payload_hash: str) -> str:
+    canon_headers = "".join(
+        f"{h}:{' '.join(headers.get(h, '').split())}\n"
+        for h in signed_headers)
+    return "\n".join([method, uri, v4_canonical_query(query),
+                      canon_headers, ";".join(signed_headers),
+                      payload_hash])
+
+
+def v4_signing_key(secret: str, date: str, region: str,
+                   service: str) -> bytes:
+    k = _hmac256(("AWS4" + secret).encode(), date)
+    k = _hmac256(k, region)
+    k = _hmac256(k, service)
+    return _hmac256(k, "aws4_request")
+
+
+def sign_v4(secret: str, method: str, uri: str, query: str,
+            headers: Dict[str, str], signed_headers: List[str],
+            amz_date: str, scope: str, payload_hash: str) -> str:
+    """Final hex signature for a header-signed v4 request.  `scope` is
+    'date/region/service/aws4_request'."""
+    creq = v4_canonical_request(method, uri, query, headers,
+                                signed_headers, payload_hash)
+    sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                     _sha256_hex(creq.encode())])
+    date, region, service, _ = scope.split("/")
+    key = v4_signing_key(secret, date, region, service)
+    return hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+
+
+def v4_chunk_signature(secret: str, scope: str, amz_date: str,
+                       prev_sig: str, chunk: bytes) -> str:
+    """aws-chunked (STREAMING-AWS4-HMAC-SHA256-PAYLOAD) per-chunk
+    signature chain (rgw_auth_s3.cc chunked upload)."""
+    sts = "\n".join(["AWS4-HMAC-SHA256-PAYLOAD", amz_date, scope,
+                     prev_sig, _sha256_hex(b""), _sha256_hex(chunk)])
+    date, region, service, _ = scope.split("/")
+    key = v4_signing_key(secret, date, region, service)
+    return hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+
+
+def decode_aws_chunked(body: bytes, secret: Optional[str] = None,
+                       scope: str = "", amz_date: str = "",
+                       seed_sig: str = "") -> Optional[bytes]:
+    """Decode an aws-chunked payload, verifying the chunk-signature
+    chain when `secret` is given (an unauthenticated gateway still has
+    to STRIP the framing).  None on bad framing, a bad signature, or a
+    stream that ends without the signed terminal 0-byte chunk — a
+    truncation at a chunk boundary must not pass as a complete
+    upload."""
+    out = bytearray()
+    prev = seed_sig
+    pos = 0
+    terminated = False
+    while pos < len(body):
+        nl = body.find(b"\r\n", pos)
+        if nl < 0:
+            return None
+        head = body[pos:nl].decode("ascii", "replace")
+        size_hex, _, ext = head.partition(";")
+        try:
+            size = int(size_hex, 16)
+        except ValueError:
+            return None
+        sig = ""
+        if ext.startswith("chunk-signature="):
+            sig = ext[len("chunk-signature="):]
+        data = body[nl + 2:nl + 2 + size]
+        if len(data) != size:
+            return None
+        if secret is not None:
+            want = v4_chunk_signature(secret, scope, amz_date, prev,
+                                      data)
+            if not hmac.compare_digest(want, sig):
+                return None
+        prev = sig
+        out += data
+        pos = nl + 2 + size + 2          # skip trailing \r\n
+        if size == 0:
+            terminated = True
+            break
+    if not terminated:
+        return None
+    return bytes(out)
 
 
 # ------------------------------------------------------------------- gateway
@@ -172,24 +310,78 @@ class S3Gateway:
         writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + payload)
 
     # ----------------------------------------------------------------- auth
-    async def _authenticate(self, method: str, path: str,
-                            headers: Dict[str, str]) -> Optional[str]:
-        """-> access key of the verified caller, else None."""
+    async def _authenticate(self, method: str, path: str, query: str,
+                            headers: Dict[str, str], body: bytes
+                            ) -> Tuple[Optional[str], bytes]:
+        """-> (access key of the verified caller | None, body — decoded
+        from aws-chunked framing when the request streamed it)."""
         auth = headers.get("authorization", "")
+        if auth.startswith("AWS4-HMAC-SHA256 "):
+            return await self._auth_v4(method, path, query, headers,
+                                       body)
         if not auth.startswith("AWS "):
-            return None
+            return None, body
         try:
             access, got_sig = auth[4:].split(":", 1)
         except ValueError:
-            return None
+            return None, body
         user = await self.users.get(access)
         if user is None:
-            return None
+            return None, body
         want = sign_v2(user["secret"], method,
                        headers.get("content-md5", ""),
                        headers.get("content-type", ""),
-                       headers.get("date", ""), path)
-        return access if hmac.compare_digest(want, got_sig) else None
+                       headers.get("date", ""),
+                       v2_canonical_resource(path, query))
+        ok = hmac.compare_digest(want, got_sig)
+        return (access if ok else None), body
+
+    async def _auth_v4(self, method: str, path: str, query: str,
+                       headers: Dict[str, str], body: bytes
+                       ) -> Tuple[Optional[str], bytes]:
+        """AWS SigV4 header auth (+ aws-chunked payload verification) —
+        rgw_rest_s3.cc authorize_v4."""
+        auth = headers.get("authorization", "")
+        fields = {}
+        for part in auth[len("AWS4-HMAC-SHA256 "):].split(","):
+            k, _, v = part.strip().partition("=")
+            fields[k] = v
+        cred = fields.get("Credential", "")
+        got_sig = fields.get("Signature", "")
+        signed = [h for h in fields.get("SignedHeaders", "").split(";")
+                  if h]
+        try:
+            access, date, region, service, term = cred.split("/")
+        except ValueError:
+            return None, body
+        if term != "aws4_request" or service != "s3":
+            return None, body
+        user = await self.users.get(access)
+        if user is None:
+            return None, body
+        amz_date = headers.get("x-amz-date", headers.get("date", ""))
+        scope = f"{date}/{region}/{service}/aws4_request"
+        payload_hash = headers.get("x-amz-content-sha256",
+                                   "UNSIGNED-PAYLOAD")
+        # canonical URI = the path AS SENT (S3 signs single-encoded
+        # paths verbatim; re-encoding would collapse %2F etc.)
+        want = sign_v4(user["secret"], method, path, query, headers,
+                       signed, amz_date, scope, payload_hash)
+        if not hmac.compare_digest(want, got_sig):
+            return None, body
+        if payload_hash == "STREAMING-AWS4-HMAC-SHA256-PAYLOAD":
+            decoded = decode_aws_chunked(body, user["secret"], scope,
+                                         amz_date, got_sig)
+            if decoded is None:
+                return None, body       # bad chunk chain / truncated
+            want_len = headers.get("x-amz-decoded-content-length")
+            if want_len is not None and int(want_len) != len(decoded):
+                return None, body       # signed length disagrees
+            return access, decoded
+        if payload_hash not in ("UNSIGNED-PAYLOAD",) \
+                and payload_hash != _sha256_hex(body):
+            return None, body           # payload tampered after signing
+        return access, body
 
     # -------------------------------------------------------------- routing
     async def _route(self, method: str, target: str,
@@ -198,9 +390,20 @@ class S3Gateway:
         parts = urlsplit(target)
         path = unquote(parts.path)
         if self.require_auth:
-            who = await self._authenticate(method, path, headers)
+            # signatures cover the path AS SENT (raw), not the decoded
+            # form the router uses
+            who, body = await self._authenticate(
+                method, parts.path, parts.query, headers, body)
             if who is None:
                 return 403, {}, _xml_error("AccessDenied")
+        elif headers.get("x-amz-content-sha256") \
+                == "STREAMING-AWS4-HMAC-SHA256-PAYLOAD":
+            # auth off: still strip the aws-chunked framing, or the
+            # framing bytes would be stored as object data
+            decoded = decode_aws_chunked(body)
+            if decoded is None:
+                return 400, {}, _xml_error("IncompleteBody")
+            body = decoded
         segs = [s for s in path.split("/") if s]
         try:
             if not segs:

@@ -1,0 +1,232 @@
+"""Encoding-corpus sample builders (ceph-dencoder / ceph-object-corpus
+role, reference src/test/encoding/readable.sh): one representative,
+deterministic instance per Encodable type.
+
+`samples()` returns {dotted_type_name: instance}.  tests/corpus/ holds
+the committed encodings; test_encoding_corpus.py round-trips both ways
+so a later round cannot silently break an on-disk or wire format —
+changing a format requires BUMPING STRUCT_V (old bytes must still
+decode) and regenerating the corpus with `python tests/corpus_gen.py`.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+CORPUS_DIR = pathlib.Path(__file__).parent / "corpus"
+
+#: Encodable subclasses deliberately NOT in the corpus, with reasons
+EXCLUDED = {
+    "ceph_tpu.msg.message.Message": "abstract base",
+    "ceph_tpu.common.encoding.Encodable": "abstract base",
+}
+
+
+def _crush_map():
+    from ceph_tpu.crush.builder import (build_hierarchy,
+                                        make_erasure_rule,
+                                        make_replicated_rule)
+    from ceph_tpu.crush.types import CrushMap
+    m = CrushMap()
+    m.max_devices = 12
+    build_hierarchy(m, 12, 2, hosts_per_rack=3)
+    make_replicated_rule(m, "rep")
+    make_erasure_rule(m, "ec", size=4)
+    return m
+
+
+def _osdmap():
+    from ceph_tpu.osd.osdmap import OSDMap
+    from ceph_tpu.osd.types import PGPool
+    from ceph_tpu.msg.types import EntityAddr
+    m = OSDMap()
+    m.epoch = 7
+    m.crush = _crush_map()
+    m.set_max_osd(12)
+    pool = PGPool(pg_num=8, size=3)
+    pool.snap_seq = 3
+    pool.snaps = {2: "snapA"}
+    pool.removed_snaps = [1]
+    pool.tiers = [2]
+    pool.read_tier = 2
+    pool.write_tier = 2
+    m.pools[1] = pool
+    cache = PGPool(pg_num=8, size=2)
+    cache.tier_of = 1
+    cache.cache_mode = "writeback"
+    cache.target_max_objects = 1000
+    m.pools[2] = cache
+    m.pool_names = {1: "data", 2: "hot"}
+    m.osd_addrs[0] = EntityAddr("127.0.0.1", 6800, 1)
+    return m
+
+
+def samples():
+    """Deterministic instances, keyed by dotted type name."""
+    from ceph_tpu.crush.types import Bucket, Rule, RuleStep
+    from ceph_tpu.crush.constants import (BUCKET_STRAW2, RULE_TAKE,
+                                          RULE_CHOOSELEAF_FIRSTN,
+                                          RULE_EMIT)
+    from ceph_tpu.msg.types import EntityAddr, EntityName
+    from ceph_tpu.msg.message import MPing
+    from ceph_tpu.mon import messages as monm
+    from ceph_tpu.mon.monmap import MonMap
+    from ceph_tpu.osd import messages as osdm
+    from ceph_tpu.osd.hitset import BloomHitSet
+    from ceph_tpu.osd.messages import EVersion, OSDOp, ScrubEntry
+    from ceph_tpu.osd.osdmap import Incremental
+    from ceph_tpu.osd.pglog import (LogEntry, PGInfo, PGLog,
+                                    PastInterval)
+    from ceph_tpu.osd.snaps import SnapSet
+    from ceph_tpu.osd.types import (ObjectLocator, OSDInfo, PGId,
+                                    PGPool)
+    from ceph_tpu.services.mds import MClientReply, MClientRequest
+    from ceph_tpu.store.blockstore import Extent, Onode
+    from ceph_tpu.store.objectstore import Transaction, TxOp
+    from ceph_tpu.store.types import CollectionId, ObjectId
+
+    pgid = PGId(1, 3, 2)
+    ev = EVersion(5, 42)
+    oloc = ObjectLocator(1, "lockey", "ns", -1)
+    osd_op = OSDOp(1, offset=4096, length=512, name="xa",
+                   data=b"payload", kv={b"k": b"v"}, keys=[b"k1"])
+    oid = ObjectId("obj-α", pool=1, snap=4)
+    cid = CollectionId("1.3s2")
+
+    txn = Transaction()
+    txn.create_collection(cid)
+    txn.touch(cid, oid)
+    txn.write(cid, oid, 0, b"bytes")
+    txn.setattr(cid, oid, "name", b"val")
+    txn.omap_setkeys(cid, oid, {b"ok": b"ov"})
+    txn.clone(cid, oid, oid.with_snap(9))
+
+    log_entry = LogEntry(1, "obj1", ev, EVersion(5, 41),
+                         "client.4121:7")
+    pginfo = PGInfo(pgid)
+    pginfo.last_update = ev
+    pginfo.last_epoch_started = 4
+    pglog = PGLog()
+    pglog.entries.append(log_entry)
+
+    snapset = SnapSet()
+    snapset.seq = 4
+    snapset.clones = [2, 4]
+    snapset.clone_snaps = {2: [1, 2], 4: [3, 4]}
+
+    hs = BloomHitSet(target_size=64, fpp=0.05)
+    hs.insert_many(["a", "b", "c"])
+
+    bucket = Bucket(id=-2, alg=BUCKET_STRAW2, hash=0, type=1,
+                    items=[0, 1], item_weights=[65536, 65536])
+
+    inc = Incremental()
+    inc.epoch = 8
+    inc.new_up[3] = EntityAddr("127.0.0.1", 6801, 2)
+    inc.new_weights = getattr(inc, "new_weights", {})
+
+    mosdop = osdm.MOSDOp(pgid, "obj1", oloc, [osd_op], tid=9,
+                         map_epoch=7, reqid="abc.9", snap_seq=4,
+                         snaps=[4, 2], snapid=0)
+
+    out = {
+        "ceph_tpu.crush.types.Bucket": bucket,
+        "ceph_tpu.crush.types.CrushMap": _crush_map(),
+        "ceph_tpu.crush.types.Rule": Rule(0, 1, 1, 10, [
+            RuleStep(RULE_TAKE, -1),
+            RuleStep(RULE_CHOOSELEAF_FIRSTN, 0, 1),
+            RuleStep(RULE_EMIT)]),
+        "ceph_tpu.crush.types.RuleStep": RuleStep(RULE_TAKE, -1),
+        "ceph_tpu.mon.messages.MAuth": monm.MAuth(),
+        "ceph_tpu.mon.messages.MAuthReply": monm.MAuthReply(),
+        "ceph_tpu.mon.messages.MLog": monm.MLog(),
+        "ceph_tpu.mon.messages.MMonCommand": monm.MMonCommand(
+            {"prefix": "osd tree"}, 3),
+        "ceph_tpu.mon.messages.MMonCommandAck": monm.MMonCommandAck(
+            3, 0, "ok", b"blob"),
+        "ceph_tpu.mon.messages.MMonElection": monm.MMonElection(),
+        "ceph_tpu.mon.messages.MMonGetMap": monm.MMonGetMap(),
+        "ceph_tpu.mon.messages.MMonMap": monm.MMonMap(),
+        "ceph_tpu.mon.messages.MMonPaxos": monm.MMonPaxos(),
+        "ceph_tpu.mon.messages.MMonSubscribe": monm.MMonSubscribe(
+            {"osdmap": 3}),
+        "ceph_tpu.mon.messages.MMonSubscribeAck": monm.MMonSubscribeAck(),
+        "ceph_tpu.mon.messages.MOSDAlive": monm.MOSDAlive(),
+        "ceph_tpu.mon.messages.MOSDBoot": monm.MOSDBoot(),
+        "ceph_tpu.mon.messages.MOSDFailure": monm.MOSDFailure(),
+        "ceph_tpu.mon.messages.MOSDMap": monm.MOSDMap(),
+        "ceph_tpu.mon.messages.MPGStats": monm.MPGStats(),
+        "ceph_tpu.mon.messages.MPGTemp": monm.MPGTemp(),
+        "ceph_tpu.mon.monmap.MonMap": MonMap(),
+        "ceph_tpu.msg.message.MPing": MPing(),
+        "ceph_tpu.msg.types.EntityAddr": EntityAddr("10.0.0.1", 6789,
+                                                    77),
+        "ceph_tpu.msg.types.EntityName": EntityName("osd", "3"),
+        "ceph_tpu.osd.hitset.BloomHitSet": hs,
+        "ceph_tpu.osd.messages.EVersion": ev,
+        "ceph_tpu.osd.messages.MOSDECSubOpRead":
+            osdm.MOSDECSubOpRead(),
+        "ceph_tpu.osd.messages.MOSDECSubOpReadReply":
+            osdm.MOSDECSubOpReadReply(),
+        "ceph_tpu.osd.messages.MOSDECSubOpWrite":
+            osdm.MOSDECSubOpWrite(),
+        "ceph_tpu.osd.messages.MOSDECSubOpWriteReply":
+            osdm.MOSDECSubOpWriteReply(),
+        "ceph_tpu.osd.messages.MOSDOp": mosdop,
+        "ceph_tpu.osd.messages.MOSDOpReply": osdm.MOSDOpReply(
+            9, 0, [osd_op], 7),
+        "ceph_tpu.osd.messages.MOSDPing": osdm.MOSDPing(),
+        "ceph_tpu.osd.messages.MOSDRepOp": osdm.MOSDRepOp(),
+        "ceph_tpu.osd.messages.MOSDRepOpReply": osdm.MOSDRepOpReply(),
+        "ceph_tpu.osd.messages.MPGLog": osdm.MPGLog(),
+        "ceph_tpu.osd.messages.MPGLogRequest": osdm.MPGLogRequest(),
+        "ceph_tpu.osd.messages.MPGNotify": osdm.MPGNotify(),
+        "ceph_tpu.osd.messages.MPGObjectList": osdm.MPGObjectList(),
+        "ceph_tpu.osd.messages.MPGPush": osdm.MPGPush(),
+        "ceph_tpu.osd.messages.MPGPushReply": osdm.MPGPushReply(),
+        "ceph_tpu.osd.messages.MPGQuery": osdm.MPGQuery(),
+        "ceph_tpu.osd.messages.MPGRemove": osdm.MPGRemove(),
+        "ceph_tpu.osd.messages.MPGScrub": osdm.MPGScrub(),
+        "ceph_tpu.osd.messages.MPGScrubMap": osdm.MPGScrubMap(),
+        "ceph_tpu.osd.messages.MPGScrubScan": osdm.MPGScrubScan(),
+        "ceph_tpu.osd.messages.MWatchNotify": osdm.MWatchNotify(),
+        "ceph_tpu.osd.messages.MWatchNotifyAck":
+            osdm.MWatchNotifyAck(),
+        "ceph_tpu.osd.messages.OSDOp": osd_op,
+        "ceph_tpu.osd.messages.ScrubEntry": ScrubEntry(),
+        "ceph_tpu.osd.osdmap.Incremental": inc,
+        "ceph_tpu.osd.osdmap.OSDMap": _osdmap(),
+        "ceph_tpu.osd.pglog.LogEntry": log_entry,
+        "ceph_tpu.osd.pglog.PGInfo": pginfo,
+        "ceph_tpu.osd.pglog.PGLog": pglog,
+        "ceph_tpu.osd.pglog.PastInterval": PastInterval(
+            3, 6, [0, 1], [1, 0], 1, True),
+        "ceph_tpu.osd.snaps.SnapSet": snapset,
+        "ceph_tpu.osd.types.OSDInfo": OSDInfo(1, 2, 3, 4, 5, 6),
+        "ceph_tpu.osd.types.ObjectLocator": oloc,
+        "ceph_tpu.osd.types.PGId": pgid,
+        "ceph_tpu.osd.types.PGPool": _osdmap().pools[1],
+        "ceph_tpu.services.mds.MClientReply": MClientReply(),
+        "ceph_tpu.services.mds.MClientRequest": MClientRequest(),
+        "ceph_tpu.store.blockstore.Extent": Extent(0, 4096),
+        "ceph_tpu.store.blockstore.Onode": Onode(),
+        "ceph_tpu.store.objectstore.Transaction": txn,
+        "ceph_tpu.store.objectstore.TxOp": txn.ops[0],
+        "ceph_tpu.store.types.CollectionId": cid,
+        "ceph_tpu.store.types.ObjectId": oid,
+    }
+    return out
+
+
+def regenerate():
+    CORPUS_DIR.mkdir(exist_ok=True)
+    for name, obj in sorted(samples().items()):
+        blob = obj.to_bytes()
+        (CORPUS_DIR / f"{name}.bin").write_bytes(blob)
+        print(f"{name}: {len(blob)} bytes (v{obj.STRUCT_V})")
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+    regenerate()

@@ -286,3 +286,42 @@ def test_monclient_rerequests_full_on_unbridgeable_incrementals():
         assert rec.sent, "must re-send the subscription"
         await stop_all(mons, [msgr])
     asyncio.run(run())
+
+
+import sys as _sys
+_sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from test_osd import Cluster  # noqa: E402
+
+
+def test_fsmonitor_is_a_paxos_service():
+    """mds boot commits through the FSMap PaxosService (epoch-versioned
+    store state), and dump resolves it — mon/MDSMonitor.cc role."""
+    async def run():
+        import json
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.mon_command({"prefix": "mds boot", "name": "mds.x",
+                                 "addr": "127.0.0.1:7777/1"})
+        ack = await admin.mon_command({"prefix": "mds dump"})
+        out = json.loads(ack.outs)
+        assert out["mds.x"]["addr"] == "127.0.0.1:7777/1"
+        # committed as an epoch-versioned map under the fsmap prefix
+        mon = cl.mons[0]
+        assert mon.fsmon.epoch >= 1
+        blob = mon.store_get("fsmap", f"full_{mon.fsmon.epoch}")
+        assert blob and "mds.x" in blob.decode()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_object_names_with_cursor_sentinel_rejected():
+    async def run():
+        from ceph_tpu.client.objecter import ObjectOperationError
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("p", pg_num=4)
+        io = admin.open_ioctx("p")
+        with pytest.raises(ObjectOperationError):
+            await io.write_full("bad\U0010ffffname", b"x")
+        await cl.stop()
+    asyncio.run(run())

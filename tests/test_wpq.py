@@ -1,0 +1,78 @@
+"""Weighted priority op queue (common/WeightedPriorityQueue.h role)."""
+
+import asyncio
+
+from ceph_tpu.common.wpq import WeightedPriorityQueue
+
+
+def test_fifo_within_class():
+    async def run():
+        q = WeightedPriorityQueue()
+        for i in range(5):
+            q.put_nowait(("c", i), "client")
+        got = [await q.get() for _ in range(5)]
+        assert got == [("c", i) for i in range(5)]
+    asyncio.run(run())
+
+
+def test_get_nowait_drains_like_asyncio_queue():
+    async def run():
+        q = WeightedPriorityQueue()
+        q.put_nowait("a", "client")
+        q.put_nowait("b", "scrub")
+        drained = []
+        try:
+            while True:
+                drained.append(q.get_nowait())
+        except asyncio.QueueEmpty:
+            pass
+        assert sorted(drained) == ["a", "b"] and q.empty()
+    asyncio.run(run())
+
+
+def test_no_starvation_under_client_flood():
+    """A scrub item enqueued behind a flood of client ops must be
+    served within ~one client-weight cycle, not after the flood."""
+    async def run():
+        q = WeightedPriorityQueue({"client": 10, "recovery": 3,
+                                   "scrub": 2, "agent": 2})
+        for i in range(1000):
+            q.put_nowait(("c", i), "client")
+        q.put_nowait(("s", 0), "scrub")
+        q.put_nowait(("a", 0), "agent")
+        drained = []
+        for _ in range(40):
+            drained.append(await q.get())
+        assert ("s", 0) in drained, "scrub starved by client flood"
+        assert ("a", 0) in drained, "agent starved by client flood"
+        # clients still dominate throughput by ~their weight share
+        n_client = sum(1 for x in drained if x[0] == "c")
+        assert n_client >= 25
+    asyncio.run(run())
+
+
+def test_weight_shares_between_busy_classes():
+    async def run():
+        q = WeightedPriorityQueue({"client": 6, "recovery": 2,
+                                   "scrub": 1, "agent": 1})
+        for i in range(300):
+            q.put_nowait(("c", i), "client")
+            q.put_nowait(("r", i), "recovery")
+        drained = [await q.get() for _ in range(200)]
+        n_c = sum(1 for x in drained if x[0] == "c")
+        n_r = sum(1 for x in drained if x[0] == "r")
+        assert 2.0 < n_c / n_r < 4.0, (n_c, n_r)   # ~6:2
+    asyncio.run(run())
+
+
+def test_async_consumer_wakes_on_put():
+    async def run():
+        q = WeightedPriorityQueue()
+
+        async def producer():
+            await asyncio.sleep(0.05)
+            q.put_nowait("x", "client")
+
+        asyncio.get_running_loop().create_task(producer())
+        assert await asyncio.wait_for(q.get(), 2.0) == "x"
+    asyncio.run(run())
