@@ -328,9 +328,16 @@ def _tpu_apply_rate(mat, folded):
 def stage_tpu_ec():
     import jax
     from ceph_tpu.ec import gf256
+    from ceph_tpu.ec.kernel import autotune
     dev = jax.devices()[0]
     log(f"device: {dev.device_kind} ({dev.platform})")
     gen, folded = _workload()
+
+    # sweep the fused-kernel variant space on the live chip and install
+    # the winner before measuring (tile length x plane layout x pack
+    # engine — ec/kernel.py TUNE_SPACE)
+    tuned = autotune(gen[K:], length=1 << 24, trials=2)
+    log(f"autotune winner: {tuned}")
 
     enc_rate, got = _tpu_apply_rate(gen[K:], folded)
     want = gf256.host_apply(gen[K:], folded[:, :65536])
@@ -344,7 +351,8 @@ def stage_tpu_ec():
         "TPU decode != original data"
     log(f"tpu decode: {dec_rate:,.0f} MB/s")
     return {"encode": enc_rate, "decode": dec_rate,
-            "platform": dev.platform, "kind": dev.device_kind}
+            "platform": dev.platform, "kind": dev.device_kind,
+            "tuned": tuned}
 
 
 # ---------------------------------------------------------- stage: ec_e2e

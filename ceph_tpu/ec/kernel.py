@@ -62,53 +62,175 @@ def _apply_bitmatrix(bitmat: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
 # the [8r, L] int32 accumulator) in HBM — ~8x the stripe's data traffic.
 # The pallas kernel fuses unpack -> matmul -> mod2 -> pack inside VMEM:
 # per L-tile, HBM sees only the [k, T] byte read and [r, T] byte write.
+#
+# The kernel is PARAMETERIZED (tile length, plane layout, pack engine)
+# and bench.py's tpu_ec stage autotunes over the variants at run time —
+# the r1/r2 measurements (~4.6 GB/s) sat far below the v5e HBM roof, so
+# the bottleneck is the VPU unpack/pack + Mosaic relayouts, exactly what
+# these axes change:
+#   * layout="cb": planes in (chunk, bit) order — B used as-is, but the
+#     stack(axis=1).reshape interleave is a relayout-heavy shuffle
+#   * layout="bc": planes in (bit, chunk) order — a plain concatenation
+#     (stack(axis=0)); B's COLUMNS are permuted on the host to match,
+#     and its ROWS are permuted so the output planes also come out
+#     (bit, chunk)-major for the cheap pack
+#   * pack="vpu": reshape+scale+sum on the vector unit
+#   * pack="mxu": packed = P @ planes as a second tiny matmul (P holds
+#     the 2^b weights), riding the otherwise idle MXU
 
-_EC_TILE = 8192           # lanes per grid step (multiple of 128); 8192
-                          # saturates HBM on v5e (see bench.py sweep)
+_EC_TILE = 8192           # default lanes per grid step (mult. of 128)
+_EC_LAYOUT = "cb"
+_EC_PACK = "vpu"
 
 
-def _ec_fused_kernel(bm_ref, data_ref, out_ref):
+def set_fused_config(tile: int = None, layout: str = None,
+                     pack: str = None) -> dict:
+    """Set the process-wide fused-kernel variant (bench autotune)."""
+    global _EC_TILE, _EC_LAYOUT, _EC_PACK
+    if tile:
+        _EC_TILE = int(tile)
+    if layout:
+        _EC_LAYOUT = layout
+    if pack:
+        _EC_PACK = pack
+    return {"tile": _EC_TILE, "layout": _EC_LAYOUT, "pack": _EC_PACK}
+
+
+def _perm_cb_to_bc(n_bytes: int) -> np.ndarray:
+    """Index map taking (chunk,bit)-ordered planes to (bit,chunk)."""
+    idx = np.arange(8 * n_bytes).reshape(n_bytes, 8).T.reshape(-1)
+    return idx
+
+
+def _ec_fused_kernel(bm_ref, data_ref, out_ref, *, layout: str,
+                     pack: str):
     """One L-tile: data [k, T] uint8 -> out [r, T] uint8 in VMEM."""
     data = data_ref[...].astype(jnp.int32)              # [k, T]
     k, T = data.shape
     r8 = bm_ref.shape[0]
-    # unpack to (chunk, bit)-ordered planes [8k, T]
-    bits = jnp.stack([(data >> b) & 1 for b in range(8)],
-                     axis=1).reshape(k * 8, T).astype(jnp.int8)
+    r = r8 // 8
+    if layout == "cb":
+        # (chunk, bit) interleaved planes
+        bits = jnp.stack([(data >> b) & 1 for b in range(8)],
+                         axis=1).reshape(k * 8, T).astype(jnp.int8)
+    else:
+        # (bit, chunk): plain concatenation along a new leading axis —
+        # no interleave; bm columns/rows were pre-permuted to match
+        bits = jnp.stack([(data >> b) & 1 for b in range(8)],
+                         axis=0).reshape(8 * k, T).astype(jnp.int8)
     acc = jax.lax.dot_general(
         bm_ref[...], bits, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)               # [8r, T]
     planes = acc & 1
-    # pack: out byte i = sum_b planes[8i+b] << b
-    w = (jnp.int32(1) << jax.lax.broadcasted_iota(jnp.int32, (8, 1), 0))
-    packed = jnp.sum(planes.reshape(r8 // 8, 8, T) * w[None, :, :],
-                     axis=1)
+    if layout == "cb":
+        grouped = planes.reshape(r, 8, T)               # rows (chunk,bit)
+    else:
+        grouped = planes.reshape(8, r, T).transpose(1, 0, 2)
+    if pack == "vpu":
+        w = (jnp.int32(1)
+             << jax.lax.broadcasted_iota(jnp.int32, (8, 1), 0))
+        packed = jnp.sum(grouped * w[None, :, :], axis=1)
+    else:
+        # MXU pack: [r*T rows? no — fold bit axis via dot] P [1,8]
+        w = (jnp.int32(1)
+             << jax.lax.broadcasted_iota(jnp.int32, (1, 8), 1)
+             ).astype(jnp.float32)
+        packed = jax.lax.dot_general(
+            w, grouped.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)[0].astype(jnp.int32)
     out_ref[...] = packed.astype(jnp.uint8)
 
 
-@partial(jax.jit, static_argnames=("interpret",))
 def _apply_bitmatrix_pallas(bitmat: jnp.ndarray, data: jnp.ndarray,
-                            interpret: bool = False) -> jnp.ndarray:
+                            interpret: bool = False,
+                            tile: Optional[int] = None,
+                            layout: Optional[str] = None,
+                            pack: Optional[str] = None) -> jnp.ndarray:
+    """Thin unjitted wrapper: the process-wide config globals are
+    resolved HERE, outside jit, so set_fused_config/autotune changes
+    reach every later call — resolving them inside the traced function
+    would bake the values active at first trace into the cached
+    executable forever."""
+    return _apply_bitmatrix_pallas_jit(
+        bitmat, data, interpret, tile or _EC_TILE,
+        layout or _EC_LAYOUT, pack or _EC_PACK)
+
+
+@partial(jax.jit,
+         static_argnames=("interpret", "tile", "layout", "pack"))
+def _apply_bitmatrix_pallas_jit(bitmat: jnp.ndarray, data: jnp.ndarray,
+                                interpret: bool, tile: int,
+                                layout: str, pack: str) -> jnp.ndarray:
     from jax.experimental import pallas as pl
     r8, k8 = bitmat.shape
     k, L = data.shape
     r = r8 // 8
-    pad = (-L) % _EC_TILE
+    if layout == "bc":
+        # permute B's columns to consume (bit, chunk) planes and its
+        # rows to produce them
+        bitmat = bitmat[:, _perm_cb_to_bc(k)][_perm_cb_to_bc(r)]
+    pad = (-L) % tile
     if pad:
         data = jnp.pad(data, ((0, 0), (0, pad)))
     Lp = L + pad
     out = pl.pallas_call(
-        _ec_fused_kernel,
-        grid=(Lp // _EC_TILE,),
+        partial(_ec_fused_kernel, layout=layout, pack=pack),
+        grid=(Lp // tile,),
         in_specs=[
             pl.BlockSpec((r8, k8), lambda i: (0, 0)),
-            pl.BlockSpec((k, _EC_TILE), lambda i: (0, i)),
+            pl.BlockSpec((k, tile), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((r, _EC_TILE), lambda i: (0, i)),
+        out_specs=pl.BlockSpec((r, tile), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((r, Lp), jnp.uint8),
         interpret=interpret,
     )(bitmat, data)
     return out[:, :L] if pad else out
+
+
+#: autotune search space: (tile, layout, pack)
+TUNE_SPACE = [(t, lay, pk)
+              for t in (4096, 8192, 16384, 32768)
+              for lay in ("cb", "bc")
+              for pk in ("vpu", "mxu")]
+
+
+def autotune(mat: np.ndarray, length: int = 1 << 22,
+             trials: int = 3) -> dict:
+    """Time every fused variant on the live device and install the
+    winner (bench.py tpu_ec runs this before measuring).  Returns
+    {config, rate_mb_s} of the winner."""
+    import time
+    from ceph_tpu.ec.gf256 import expand_to_bitmatrix
+    bm = jnp.asarray(expand_to_bitmatrix(np.asarray(mat, np.uint8)),
+                     jnp.int8)
+    k = mat.shape[1]
+    rng = np.random.default_rng(3)
+    data = jax.device_put(jnp.asarray(
+        rng.integers(0, 256, (k, length // k), dtype=np.uint8)))
+    nbytes = k * (length // k)
+    best = None
+    for tile, lay, pk in TUNE_SPACE:
+        try:
+            fetch = jax.jit(lambda d, t=tile, l=lay, p=pk:
+                            _apply_bitmatrix_pallas(
+                                bm, d, tile=t, layout=l, pack=p)
+                            .astype(jnp.int32).sum())
+            int(fetch(data))              # compile + warm
+            t_best = float("inf")
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                int(fetch(data))
+                t_best = min(t_best, time.perf_counter() - t0)
+            rate = nbytes / t_best / 1e6
+            if best is None or rate > best["rate_mb_s"]:
+                best = {"tile": tile, "layout": lay, "pack": pk,
+                        "rate_mb_s": round(rate, 1)}
+        except Exception:
+            continue                      # variant unsupported: skip
+    if best:
+        set_fused_config(best["tile"], best["layout"], best["pack"])
+    return best or {}
 
 
 def _pallas_supported() -> bool:

@@ -105,12 +105,12 @@ class Journaler:
 
     # -------------------------------------------------------------- clients
     async def register_client(self, client_id: str) -> None:
-        """A tailer that participates in trim decisions
-        (JournalMetadata::register_client)."""
-        if await self.get_commit(client_id) == 0:
-            cur = await self._get_client_raw(client_id)
-            if cur is None:
-                await self._put_key(f"client.{client_id}", "0")
+        """A tailer that participates in trim decisions — atomic
+        register-if-absent on the OSD (cls_journal client_register)."""
+        import json as _json
+        await self.io.exec(_hdr_oid(self.jid), "journal",
+                           "client_register",
+                           _json.dumps({"id": client_id}).encode())
 
     async def unregister_client(self, client_id: str) -> None:
         await self.io.omap_rm_keys(_hdr_oid(self.jid),
@@ -125,10 +125,14 @@ class Journaler:
         return int(raw.decode()) if raw is not None else None
 
     async def commit(self, client_id: str, seq: int) -> None:
-        """Record replay progress (commit position; monotonic)."""
-        cur = await self._get_client_raw(client_id) or 0
-        if seq > cur:
-            await self._put_key(f"client.{client_id}", str(seq))
+        """Record replay progress — the monotonic guard runs ON the OSD
+        (cls_journal client_commit), so concurrent replayers can never
+        rewind each other's positions."""
+        import json as _json
+        await self.io.exec(_hdr_oid(self.jid), "journal",
+                           "client_commit",
+                           _json.dumps({"id": client_id,
+                                        "seq": seq}).encode())
 
     async def get_commit(self, client_id: str) -> int:
         return await self._get_client_raw(client_id) or 0
@@ -178,9 +182,25 @@ class Journaler:
                                 offset=self._obj_bytes)
             self._obj_bytes += len(rec)
             if self._obj_bytes >= self.object_size:
-                self._obj += 1
-                self._obj_bytes = 0
-                await self._put_key("active_obj", str(self._obj))
+                # CAS rotation (cls_journal advance_active): a stale
+                # second appender gets ESTALE and refreshes instead of
+                # double-advancing the pointer
+                import errno as _errno
+                import json as _json
+                try:
+                    await self.io.exec(
+                        _hdr_oid(self.jid), "journal", "advance_active",
+                        _json.dumps({"expect": self._obj,
+                                     "to": self._obj + 1}).encode())
+                    self._obj += 1
+                    self._obj_bytes = 0
+                except ObjectOperationError as e:
+                    if e.retcode != -_errno.ESTALE:
+                        raise
+                    # another appender won the rotation: recover the
+                    # REAL tail (object, byte offset, top seq) — blindly
+                    # assuming offset 0 would overwrite its records
+                    await self._recover_appender()
             return self._seq
 
     # --------------------------------------------------------------- replay
@@ -236,5 +256,7 @@ class Journaler:
             else:
                 break
         if removed:
-            await self._put_key("first_obj", str(n))
+            import json as _json
+            await self.io.exec(_hdr_oid(self.jid), "journal", "trim_to",
+                               _json.dumps({"to": n}).encode())
         return removed

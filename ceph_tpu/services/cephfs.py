@@ -7,10 +7,13 @@ directly into the data pool using the file layout (<ino>.<block>
 objects, here via RadosStriper on soid `<ino hex>`), sizes propagate
 back to the MDS on close/flush (cap flush role).
 
-Redesign notes: no capabilities/leases — every metadata op consults the
-MDS (write-through MDS makes this correct, just chattier than the
-reference's cap-cached fast paths); single active MDS addressed
-directly instead of an mdsmap.
+Redesign notes: dentry LEASES (the client-caps fast path,
+client/Client.cc lease handling + mds/Locker.cc): lookups return a TTL
+lease and cache locally, so repeated stats are RPC-free; the MDS
+revokes leases (MClientLease) when another client mutates the dentry,
+and local mutations invalidate the local cache (prefix-wide, so a
+renamed directory drops its cached subtree).  Single active MDS
+addressed directly instead of an mdsmap.
 """
 
 from __future__ import annotations
@@ -22,7 +25,8 @@ from typing import Dict, List, Optional
 from ceph_tpu.client.rados_striper import (RadosStriper,
                                            StripedObjectNotFound)
 from ceph_tpu.msg.messenger import Dispatcher
-from ceph_tpu.services.mds import MClientReply, MClientRequest
+from ceph_tpu.services.mds import (MClientLease, MClientReply,
+                                   MClientRequest, norm_path)
 
 
 class CephFSError(OSError):
@@ -45,6 +49,10 @@ class CephFS(Dispatcher):
         import random
         self._tid = random.getrandbits(32) << 20
         self._pending: Dict[int, asyncio.Future] = {}
+        # dentry lease cache: norm path -> (ent, expiry)
+        self._leases: Dict[str, tuple] = {}
+        self._revoke_epoch = 0       # bumps on every MClientLease
+        self.lease_hits = 0          # observability for tests/perf
 
     # ------------------------------------------------------------ transport
     def ms_dispatch(self, m) -> bool:
@@ -55,7 +63,32 @@ class CephFS(Dispatcher):
             if not fut.done():
                 fut.set_result(m)
             return True
+        if isinstance(m, MClientLease):
+            for p in m.paths:
+                self._leases.pop(p, None)
+            # a lookup reply may already be resolved but its coroutine
+            # not yet resumed: bump the epoch so its late cache insert
+            # is discarded (revoke means drop NOW, not drop-then-recache)
+            self._revoke_epoch += 1
+            return True
         return False
+
+    # --------------------------------------------------------------- leases
+    def _lease_get(self, path: str) -> Optional[dict]:
+        import time
+        ent = self._leases.get(norm_path(path))
+        if ent is not None and ent[1] > time.time():
+            self.lease_hits += 1
+            return ent[0]
+        return None
+
+    def _lease_drop(self, *paths: str) -> None:
+        """Local mutation: drop the paths AND anything cached under
+        them (a renamed dir invalidates its subtree)."""
+        keys = [norm_path(p) for p in paths]
+        for lp in list(self._leases):
+            if any(lp == k or lp.startswith(k + "/") for k in keys):
+                del self._leases[lp]
 
     async def _request(self, op: str, timeout: float = 30.0,
                        **args) -> dict:
@@ -94,14 +127,25 @@ class CephFS(Dispatcher):
         return sorted(data["entries"])
 
     async def stat(self, path: str) -> dict:
+        cached = self._lease_get(path)
+        if cached is not None:
+            return cached
+        epoch = self._revoke_epoch
         data = await self._request("lookup", path=path)
+        if data.get("lease_ttl") and epoch == self._revoke_epoch:
+            # no revoke raced the lookup: safe to cache
+            import time
+            self._leases[norm_path(path)] = (
+                data["ent"], time.time() + data["lease_ttl"])
         return data["ent"]
 
     async def rename(self, src: str, dst: str) -> None:
         await self._request("rename", src=src, dst=dst)
+        self._lease_drop(src, dst)
 
     async def unlink(self, path: str) -> None:
         data = await self._request("unlink", path=path)
+        self._lease_drop(path)
         # the MDS dropped the dentry; the data objects are ours to reap
         # (client-driven purge, the reference queues this on the MDS
         # PurgeQueue — acceptable divergence, documented)
@@ -113,6 +157,7 @@ class CephFS(Dispatcher):
 
     async def rmdir(self, path: str) -> None:
         await self._request("rmdir", path=path)
+        self._lease_drop(path)
 
     # ------------------------------------------------------------ file io
     async def open(self, path: str, mode: str = "r") -> "File":
@@ -196,6 +241,7 @@ class File:
 
     async def flush(self) -> None:
         if self._dirty_size:
+            self.fs._lease_drop(self.path)
             await self.fs._request("setattr", path=self.path,
                                    size=self.size)
             self._dirty_size = False

@@ -8,11 +8,17 @@ numbers, and src/client/Client.cc's request/reply protocol distilled to
 MClientRequest/MClientReply.
 
 Redesign notes:
-  * ONE active MDS, no clustering: subtree partitioning, migration and
-    the journal/MDLog are out of scope — metadata mutations go straight
-    to RADOS omap (a crash loses nothing committed; in-flight requests
-    are retried by clients).  The reference needs the MDLog because its
-    cache is write-back; this MDS is write-through.
+  * ONE active MDS (subtree partitioning/migration are out of scope),
+    but with the reference's MDLog write-back design (mds/MDLog.cc +
+    journal/EMetaBlob): every mutation journals its dentry-level
+    EFFECTS (EMetaBlob role) to a RADOS journal (journal/journaler.py
+    — the same machinery rbd-mirror and rgw multisite ride), applies
+    them to an in-memory dirty cache, and acks the client; a flusher
+    batches dirty dentries back to the omap dir objects and advances
+    the journal commit position (trim).  Crash recovery replays
+    uncommitted events against omap — idempotent dentry sets/removes
+    (MDLog::replay).  mds_log=False degrades to round-3's
+    write-through mode.
   * Directories: object `dir.<ino>` in the metadata pool, omap
     name -> json{ino, type, size, mtime}.  Root is ino 1.
   * Inode numbers from `mds_inotable` (omap key "next"), the InoTable
@@ -35,6 +41,30 @@ from ceph_tpu.common.encoding import Decoder, Encoder
 
 ROOT_INO = 1
 INOTABLE_OID = "mds_inotable"
+LEASE_TTL = 5.0         # dentry lease seconds (mds_lease default role)
+
+
+def norm_path(path: str) -> str:
+    return "/" + "/".join(p for p in path.split("/") if p)
+
+
+@register_message
+class MClientLease(Message):
+    """MDS -> client dentry-lease revoke (messages/MClientLease.h /
+    the CEPH_MDS_LEASE_REVOKE flavor): the named paths must drop out
+    of the client's lease cache NOW — another client mutated them."""
+    TYPE = 242
+
+    def __init__(self, paths: Optional[List[str]] = None):
+        super().__init__()
+        self.paths = paths or []
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.list_(self.paths, lambda e, p: e.string(p))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int):
+        return cls(dec.list_(lambda d: d.string()))
 
 
 def dir_oid(ino: int) -> str:
@@ -85,20 +115,39 @@ class MDS(Dispatcher):
     """The metadata server: owns the metadata pool, answers
     MClientRequest."""
 
-    def __init__(self, ctx, messenger, rados, metadata_pool: str):
+    def __init__(self, ctx, messenger, rados, metadata_pool: str,
+                 mds_log: bool = True,
+                 log_flush_interval: float = 1.0,
+                 log_flush_events: int = 64):
         self.ctx = ctx
         self.log = ctx.logger("mds")
         self.messenger = messenger
         messenger.add_dispatcher(self)
         self.rados = rados
         self.io = rados.open_ioctx(metadata_pool)
-        # one mutation at a time: inode allocation and dentry updates
-        # are read-modify-write against omap (the reference serializes
-        # through the MDLog; this MDS is write-through so a mutex is the
-        # equivalent ordering point).  Built through the lockdep factory
-        # so `lockdep = true` catches ordering cycles as locks multiply
+        # one mutation at a time: the MDLog is the ordering point in
+        # the reference; here the mutex serializes journal append +
+        # cache apply.  Built through the lockdep factory so
+        # `lockdep = true` catches ordering cycles as locks multiply
         from ceph_tpu.common.lockdep import make_lock
         self._mutex = make_lock(ctx, "mds.mutex")
+        # ---- MDLog write-back state ----
+        self.mds_log = mds_log
+        self._mdlog = None              # Journaler, lazy
+        self._dirs: Dict[int, Dict[str, dict]] = {}   # loaded dirs
+        self._dirty: Dict[int, set] = {}    # dir ino -> dirty names
+        self._removed: Dict[int, set] = {}  # dir ino -> removed names
+        self._gone_dirs: set = set()        # rmdir'd dir inos
+        self._next_ino: Optional[int] = None
+        self._ino_dirty = False
+        self._unflushed = 0                 # events since last flush
+        self._last_seq = 0
+        self._flush_interval = log_flush_interval
+        self._flush_events = log_flush_events
+        self._flush_task = None
+        # dentry leases (Locker.cc client-lease role): path -> holders
+        # {addr_key: (addr, expiry)}; mutations revoke other holders
+        self._leases: Dict[str, Dict[str, tuple]] = {}
 
     # ------------------------------------------------------------ lifecycle
     async def create_fs(self) -> None:
@@ -110,7 +159,177 @@ class MDS(Dispatcher):
             await self.io.write_full(INOTABLE_OID, b"")
             await self.io.omap_set(INOTABLE_OID, {b"next": b"2"})
 
+    async def start(self) -> None:
+        """Open the MDLog: recover (replay uncommitted events against
+        omap — MDLog::replay) and start the write-back flusher."""
+        if not self.mds_log:
+            return
+        import asyncio
+        from ceph_tpu.journal import Journaler
+        self._mdlog = Journaler(self.io, "mdlog")
+        if not await self._mdlog.exists():
+            await self._mdlog.create()
+        await self._mdlog.register_client("mds")
+        pos = await self._mdlog.get_commit("mds")
+        replayed = 0
+        async for e in self._mdlog.replay(pos):
+            await self._apply_effects_to_store(
+                json.loads(e.payload.decode()))
+            pos = e.seq
+            replayed += 1
+        if replayed:
+            await self._mdlog.commit("mds", pos)
+            await self._mdlog.trim()
+            self.log.info(f"mdlog replayed {replayed} events")
+        self._last_seq = pos
+        self._flush_task = asyncio.get_running_loop().create_task(
+            self._flush_loop())
+
+    async def stop(self) -> None:
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            self._flush_task = None
+        if self._mdlog is not None:
+            await self.flush()
+
+    # ----------------------------------------------------- MDLog machinery
+    async def _commit_effects(self, eff: dict) -> None:
+        """Journal the mutation's dentry-level effects (EMetaBlob),
+        then apply them to the dirty cache; the client is acked as soon
+        as the JOURNAL append is durable — the omap write-back happens
+        later (MDLog submit_entry + LogSegment flush)."""
+        if self._mdlog is None:
+            await self._apply_effects_to_store(eff)
+            return
+        self._last_seq = await self._mdlog.append(
+            json.dumps(eff).encode())
+        for ino, name, ent in eff.get("set", []):
+            self._dirs.setdefault(ino, {})[name] = ent
+            self._dirty.setdefault(ino, set()).add(name)
+            self._removed.get(ino, set()).discard(name)
+        for ino, name in eff.get("rm", []):
+            self._dirs.setdefault(ino, {}).pop(name, None)
+            self._removed.setdefault(ino, set()).add(name)
+            self._dirty.get(ino, set()).discard(name)
+        for ino in eff.get("mkdir", []):
+            self._dirs.setdefault(ino, {})
+            self._gone_dirs.discard(ino)
+        for ino in eff.get("rmdir", []):
+            self._dirs.pop(ino, None)
+            self._dirty.pop(ino, None)
+            self._removed.pop(ino, None)
+            self._gone_dirs.add(ino)
+        if eff.get("next_ino"):
+            self._next_ino = eff["next_ino"]
+            self._ino_dirty = True
+        self._unflushed += 1
+        if self._unflushed >= self._flush_events:
+            # caller already holds the MDS mutex (_handle): use the
+            # locked flavor — flush() re-acquiring would self-deadlock
+            await self._flush_locked()
+
+    async def _apply_effects_to_store(self, eff: dict) -> None:
+        """Idempotent omap application (replay path / write-through)."""
+        for ino in eff.get("mkdir", []):
+            try:
+                await self.io.omap_get(dir_oid(ino))
+            except ObjectOperationError:
+                await self.io.write_full(dir_oid(ino), b"")
+        for ino, name, ent in eff.get("set", []):
+            await self.io.omap_set(dir_oid(ino), {
+                name.encode(): json.dumps(ent).encode()})
+        for ino, name in eff.get("rm", []):
+            try:
+                await self.io.omap_rm_keys(dir_oid(ino),
+                                           [name.encode()])
+            except ObjectOperationError:
+                pass
+        for ino in eff.get("rmdir", []):
+            try:
+                await self.io.remove(dir_oid(ino))
+            except ObjectOperationError:
+                pass
+        if eff.get("next_ino"):
+            omap = await self.io.omap_get(INOTABLE_OID)
+            cur = int(omap.get(b"next", b"2"))
+            if eff["next_ino"] > cur:
+                await self.io.omap_set(INOTABLE_OID, {
+                    b"next": str(eff["next_ino"]).encode()})
+
+    async def flush(self) -> None:
+        """Write back every dirty dentry, then advance the MDLog commit
+        position and trim (LogSegment::try_to_expire role)."""
+        if self._mdlog is None:
+            return
+        async with self._mutex:
+            await self._flush_locked()
+
+    async def _flush_locked(self) -> None:
+        """Write-back under the MDS mutex (caller holds it).
+
+        The mutex stays held across the omap writes so reads never see
+        the window where dirty state is neither in the overlay nor in
+        omap; dirty bookkeeping is cleared only AFTER every write
+        lands — a failed write leaves the names dirty (and the journal
+        uncommitted), so nothing acked can ever be lost to a transient
+        store error."""
+        if self._mdlog is None or not self._unflushed:
+            return
+        seq = self._last_seq
+        for ino, names in list(self._dirty.items()):
+            ents = self._dirs.get(ino, {})
+            kv = {n.encode(): json.dumps(ents[n]).encode()
+                  for n in names if n in ents}
+            if not kv:
+                continue
+            try:
+                await self.io.omap_get(dir_oid(ino))
+            except ObjectOperationError:
+                await self.io.write_full(dir_oid(ino), b"")
+            await self.io.omap_set(dir_oid(ino), kv)
+        for ino, names in list(self._removed.items()):
+            if ino in self._gone_dirs or not names:
+                continue
+            try:
+                await self.io.omap_rm_keys(
+                    dir_oid(ino), [n.encode() for n in names])
+            except ObjectOperationError:
+                pass
+        for ino in list(self._gone_dirs):
+            try:
+                await self.io.remove(dir_oid(ino))
+            except ObjectOperationError:
+                pass
+        if self._ino_dirty and self._next_ino:
+            await self.io.omap_set(INOTABLE_OID, {
+                b"next": str(self._next_ino).encode()})
+        # everything durable: clear bookkeeping, commit + trim the log
+        self._dirty.clear()
+        self._removed.clear()
+        self._gone_dirs.clear()
+        self._ino_dirty = False
+        self._unflushed = 0
+        if seq:
+            await self._mdlog.commit("mds", seq)
+            await self._mdlog.trim()
+
+    async def _flush_loop(self) -> None:
+        import asyncio
+        while True:
+            await asyncio.sleep(self._flush_interval)
+            try:
+                await self.flush()
+            except Exception:
+                self.log.exception("mdlog flush failed")
+
     async def _alloc_ino(self) -> int:
+        if self._mdlog is not None:
+            if self._next_ino is None:
+                omap = await self.io.omap_get(INOTABLE_OID)
+                self._next_ino = int(omap.get(b"next", b"2"))
+            ino = self._next_ino
+            self._next_ino = ino + 1
+            return ino
         omap = await self.io.omap_get(INOTABLE_OID)
         nxt = int(omap.get(b"next", b"2"))
         await self.io.omap_set(INOTABLE_OID,
@@ -119,12 +338,26 @@ class MDS(Dispatcher):
 
     # -------------------------------------------------------------- helpers
     async def _dir_entries(self, ino: int) -> Dict[str, dict]:
+        """Entries as seen through the write-back cache (CDir)."""
+        if ino in self._gone_dirs:
+            raise FileNotFoundError(ino)
         try:
             omap = await self.io.omap_get(dir_oid(ino))
         except ObjectOperationError:
+            if self._mdlog is not None and ino in self._dirs:
+                return dict(self._dirs[ino])   # created, not yet flushed
             raise FileNotFoundError(ino)
-        return {k.decode(): json.loads(v.decode())
+        ents = {k.decode(): json.loads(v.decode())
                 for k, v in omap.items()}
+        if self._mdlog is not None:
+            # overlay unflushed cache state
+            for n in self._removed.get(ino, ()):  # removed, not flushed
+                ents.pop(n, None)
+            for n in self._dirty.get(ino, ()):
+                cached = self._dirs.get(ino, {}).get(n)
+                if cached is not None:
+                    ents[n] = cached
+        return ents
 
     async def _dentry(self, ino: int, name: str) -> Optional[dict]:
         try:
@@ -132,10 +365,6 @@ class MDS(Dispatcher):
         except FileNotFoundError:
             return None
         return ents.get(name)
-
-    async def _set_dentry(self, ino: int, name: str, ent: dict) -> None:
-        await self.io.omap_set(dir_oid(ino),
-                               {name.encode(): json.dumps(ent).encode()})
 
     async def _resolve(self, path: str) -> Tuple[int, dict]:
         """-> (parent dir ino of final component, dentry dict) for the
@@ -171,10 +400,47 @@ class MDS(Dispatcher):
             return True
         return False
 
+    # ------------------------------------------------------------- leases
+    MUTATORS = ("mkdir", "create", "setattr", "unlink", "rmdir",
+                "rename")
+
+    def _grant_lease(self, path: str, m: MClientRequest,
+                     data: dict) -> None:
+        key = norm_path(path)
+        holders = self._leases.setdefault(key, {})
+        holders[str(m.src_name)] = (m.src_addr,
+                                    time.time() + LEASE_TTL)
+        data["lease_ttl"] = LEASE_TTL
+
+    def _revoke_leases(self, m: MClientRequest, paths: List[str]) -> None:
+        """Mutation: every OTHER holder of a lease on (or under) an
+        affected path gets a revoke (Locker::revoke_client_leases)."""
+        keys = [norm_path(p) for p in paths]
+        victims: Dict[str, tuple] = {}
+        now = time.time()
+        for lp in list(self._leases):
+            if any(lp == k or lp.startswith(k + "/") for k in keys):
+                for who, (addr, exp) in self._leases.pop(lp).items():
+                    if who != str(m.src_name) and exp > now:
+                        ent = victims.setdefault(who, (addr, []))
+                        if lp not in ent[1]:
+                            ent[1].append(lp)
+        for who, (addr, paths_) in victims.items():
+            self.messenger.send_message(MClientLease(paths_), addr,
+                                        peer_type="client")
+
     async def _handle(self, m: MClientRequest) -> None:
         try:
             async with self._mutex:
                 data = await self._execute(m.op, m.args)
+                if m.op == "lookup":
+                    self._grant_lease(m.args["path"], m, data)
+                elif m.op in self.MUTATORS:
+                    if m.op == "rename":
+                        self._revoke_leases(m, [m.args["src"],
+                                                m.args["dst"]])
+                    else:
+                        self._revoke_leases(m, [m.args["path"]])
             reply = MClientReply(m.tid, 0, data)
         except FileNotFoundError:
             reply = MClientReply(m.tid, -errno.ENOENT)
@@ -212,10 +478,11 @@ class MDS(Dispatcher):
             if await self._dentry(pent["ino"], name) is not None:
                 raise FileExistsError(a["path"])
             ino = await self._alloc_ino()
-            await self.io.write_full(dir_oid(ino), b"")
             ent = {"ino": ino, "type": "dir", "size": 0,
                    "mtime": time.time()}
-            await self._set_dentry(pent["ino"], name, ent)
+            await self._commit_effects({
+                "mkdir": [ino], "set": [[pent["ino"], name, ent]],
+                "next_ino": self._next_ino})
             return {"ent": ent}
         if op == "create":
             parent_path, name = self._split(a["path"])
@@ -232,7 +499,9 @@ class MDS(Dispatcher):
             ino = await self._alloc_ino()
             ent = {"ino": ino, "type": "file", "size": 0,
                    "mtime": time.time()}
-            await self._set_dentry(pent["ino"], name, ent)
+            await self._commit_effects({
+                "set": [[pent["ino"], name, ent]],
+                "next_ino": self._next_ino})
             return {"ent": ent}
         if op == "setattr":
             parent_path, name = self._split(a["path"])
@@ -243,7 +512,8 @@ class MDS(Dispatcher):
             if "size" in a:
                 ent["size"] = a["size"]
             ent["mtime"] = time.time()
-            await self._set_dentry(pent["ino"], name, ent)
+            await self._commit_effects({
+                "set": [[pent["ino"], name, ent]]})
             return {"ent": ent}
         if op == "unlink":
             parent_path, name = self._split(a["path"])
@@ -253,8 +523,7 @@ class MDS(Dispatcher):
                 raise FileNotFoundError(a["path"])
             if ent["type"] == "dir":
                 raise IsADirectoryError(a["path"])
-            await self.io.omap_rm_keys(dir_oid(pent["ino"]),
-                                       [name.encode()])
+            await self._commit_effects({"rm": [[pent["ino"], name]]})
             return {"ent": ent}   # client punches the data objects
         if op == "rmdir":
             parent_path, name = self._split(a["path"])
@@ -266,12 +535,8 @@ class MDS(Dispatcher):
                 raise NotADirectoryError(a["path"])
             if await self._dir_entries(ent["ino"]):
                 raise OSError(errno.ENOTEMPTY, "directory not empty")
-            await self.io.omap_rm_keys(dir_oid(pent["ino"]),
-                                       [name.encode()])
-            try:
-                await self.io.remove(dir_oid(ent["ino"]))
-            except ObjectOperationError:
-                pass
+            await self._commit_effects({
+                "rm": [[pent["ino"], name]], "rmdir": [ent["ino"]]})
             return {}
         if op == "rename":
             sp, sn = self._split(a["src"])
@@ -284,8 +549,10 @@ class MDS(Dispatcher):
             dst_ent = await self._dentry(dpent["ino"], dn)
             if dst_ent is not None and dst_ent["type"] == "dir":
                 raise IsADirectoryError(a["dst"])
-            await self._set_dentry(dpent["ino"], dn, ent)
-            await self.io.omap_rm_keys(dir_oid(spent["ino"]),
-                                       [sn.encode()])
+            if spent["ino"] == dpent["ino"] and sn == dn:
+                return {"ent": ent}      # no-op: rm would eat the set
+            await self._commit_effects({
+                "set": [[dpent["ino"], dn, ent]],
+                "rm": [[spent["ino"], sn]]})
             return {"ent": ent}
         raise OSError(errno.EOPNOTSUPP, f"mds op {op!r}")

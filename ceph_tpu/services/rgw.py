@@ -15,10 +15,16 @@ Redesign notes:
   * Users live in one omap object (.rgw.users: access_key ->
     json{secret, display}); radosgw-admin's user create/rm surface is
     tools/rgw_admin.py.
-  * Auth: AWS signature v2 (Authorization: AWS access:sig over the
-    canonical string) — matching the reference at this vintage; v4 is
-    out of scope and documented as such.  The canonical resource is the
-    unquoted path (subresource query strings are not signed here).
+  * Auth: AWS signature v2 (canonical resource incl. signed
+    subresources, rgw_auth_s3.cc) AND SigV4 — header signing verified
+    against the AWS documented vectors, plus aws-chunked
+    (STREAMING-AWS4-HMAC-SHA256-PAYLOAD) per-chunk signature chains.
+  * Swift dialect (rgw_rest_swift.cc / tempauth): /auth/v1.0 token
+    issue + /swift/v1 account/container/object REST over the SAME
+    store — two personalities, one RGWRados, like the reference.
+  * Multisite: mutations append to a zone datalog journal; sync agents
+    (services/rgw_sync.py) tail it to replicate zones asynchronously
+    (rgw_data_sync.cc role).
   * Multipart upload (reference rgw_multi.cc): parts are striped
     objects; Complete writes a MANIFEST into the bucket index instead
     of copying bytes (RGWObjManifest role), and GET/range reads stitch
@@ -245,13 +251,28 @@ def decode_aws_chunked(body: bytes, secret: Optional[str] = None,
 
 class S3Gateway:
     def __init__(self, rados, pool: str = ".rgw",
-                 require_auth: bool = True):
+                 require_auth: bool = True, datalog: bool = False):
         self.rados = rados
         self.io = rados.open_ioctx(pool)
         self.users = UserDB(self.io)
         self.require_auth = require_auth
         self._server: Optional[asyncio.AbstractServer] = None
         self.port = 0
+        # multisite: mutations append to a zone datalog journal that
+        # sync agents tail (rgw_data_sync.cc datalog role)
+        self.datalog = None
+        if datalog:
+            from ceph_tpu.journal import Journaler
+            self.datalog = Journaler(self.io, "rgw.datalog")
+
+    async def _log_change(self, op: str, bucket: str,
+                          key: str = "") -> None:
+        if self.datalog is None:
+            return
+        if not await self.datalog.exists():
+            await self.datalog.create()
+        await self.datalog.append(json.dumps(
+            {"op": op, "b": bucket, "k": key}).encode())
 
     # ------------------------------------------------------------ lifecycle
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
@@ -389,6 +410,11 @@ class S3Gateway:
                      ) -> Tuple[int, Dict[str, str], bytes]:
         parts = urlsplit(target)
         path = unquote(parts.path)
+        if path == "/auth/v1.0" or path == "/swift/v1" \
+                or path.startswith("/swift/v1/"):
+            # Swift dialect rides its own token auth, not AWS signatures
+            return await self._route_swift(method, path, parts.query,
+                                           headers, body)
         if self.require_auth:
             # signatures cover the path AS SENT (raw), not the decoded
             # form the router uses
@@ -461,6 +487,125 @@ class S3Gateway:
             # or delete raced a read)
             return 404, {}, _xml_error("NoSuchKey")
 
+    # ---------------------------------------------------------------- swift
+    # Swift REST dialect (rgw_rest_swift.cc / rgw_swift_auth.cc
+    # tempauth): /auth/v1.0 issues X-Auth-Token; /swift/v1 is the
+    # account; containers/objects map onto the same bucket/object store
+    # as S3 — one RGWRados, two REST personalities, like the reference.
+
+    SWIFT_TOKEN_TTL = 3600.0
+
+    async def _route_swift(self, method: str, path: str, query: str,
+                           headers: Dict[str, str], body: bytes):
+        if not hasattr(self, "_swift_tokens"):
+            self._swift_tokens: Dict[str, Tuple[str, float]] = {}
+        if path == "/auth/v1.0":
+            user = headers.get("x-auth-user", "")
+            key = headers.get("x-auth-key", "")
+            u = await self.users.get(user)
+            if u is None or not hmac.compare_digest(u["secret"], key):
+                return 401, {}, b""
+            from ceph_tpu.services.rbd import os_urandom_hex
+            token = "AUTH_tk" + os_urandom_hex(16)
+            self._swift_tokens[token] = (user,
+                                         time.time()
+                                         + self.SWIFT_TOKEN_TTL)
+            return 204, {"X-Storage-Url":
+                         f"http://127.0.0.1:{self.port}/swift/v1",
+                         "X-Auth-Token": token}, b""
+        if self.require_auth:
+            tok = headers.get("x-auth-token", "")
+            ent = self._swift_tokens.get(tok)
+            if ent is None or ent[1] < time.time():
+                self._swift_tokens.pop(tok, None)
+                return 401, {}, b""
+        segs = [s for s in path[len("/swift/v1"):].split("/") if s]
+        q = {}
+        for kv in query.split("&"):
+            k, _, v = kv.partition("=")
+            if k:
+                q[k] = unquote(v)
+        try:
+            if not segs:                      # account: list containers
+                if method != "GET":
+                    return 405, {}, b""
+                try:
+                    omap = await self.io.omap_get(BUCKETS_OID)
+                except ObjectOperationError:
+                    omap = {}
+                names = sorted(k.decode() for k in omap)
+                if q.get("format") == "json":
+                    out = json.dumps([{"name": n} for n in names])
+                    return 200, {"Content-Type": "application/json"}, \
+                        out.encode()
+                text = ("\n".join(names) + "\n").encode() if names \
+                    else b""
+                return 200, {"Content-Type": "text/plain"}, text
+            cont = segs[0]
+            obj = "/".join(segs[1:])
+            if not obj:
+                return await self._swift_container(method, cont, q)
+            return await self._swift_object(method, cont, obj, body,
+                                            headers)
+        except ObjectOperationError:
+            return 404, {}, b""
+        except StripedObjectNotFound:
+            return 404, {}, b""
+
+    async def _swift_container(self, method: str, cont: str, q: dict):
+        if method == "PUT":
+            st, _, _ = await self._put_bucket(cont)
+            return (201 if st == 200 else 202), {}, b""  # 202 = existed
+        if method == "DELETE":
+            st, _, _ = await self._delete_bucket(cont)
+            return (204 if st == 204 else st), {}, b""
+        if method == "HEAD":
+            return (204 if await self._bucket_exists(cont) else 404), \
+                {}, b""
+        if method == "GET":
+            if not await self._bucket_exists(cont):
+                return 404, {}, b""
+            idx = await self.io.omap_get(_index_oid(cont))
+            prefix = q.get("prefix", "")
+            rows = []
+            for k in sorted(idx):
+                key = k.decode()
+                if not key.startswith(prefix):
+                    continue
+                meta = json.loads(idx[k].decode())
+                rows.append({"name": key, "bytes": meta["size"],
+                             "hash": meta["etag"]})
+            if q.get("format") == "json":
+                return 200, {"Content-Type": "application/json"}, \
+                    json.dumps(rows).encode()
+            return 200, {"Content-Type": "text/plain"}, \
+                ("".join(r["name"] + "\n" for r in rows)).encode()
+        return 405, {}, b""
+
+    async def _swift_object(self, method: str, cont: str, obj: str,
+                            body: bytes, headers: Dict[str, str]):
+        if method == "PUT":
+            st, h, payload = await self._put_object(cont, obj, body,
+                                                    headers)
+            if st != 200:
+                return st == 404 and (404, {}, b"") or (st, {}, payload)
+            return 201, {"Etag": h["ETag"].strip('"')}, b""
+        if method == "GET":
+            st, h, payload = await self._get_object(cont, obj, headers)
+            if st not in (200, 206):
+                return 404, {}, b""
+            h = dict(h)
+            if "ETag" in h:
+                h["Etag"] = h.pop("ETag").strip('"')
+            return st, h, payload
+        if method == "HEAD":
+            st, h, _ = await self._head_object(cont, obj)
+            return (204 if st == 200 else 404), {}, b""
+        if method == "DELETE":
+            st, _, _ = await self._delete_object(cont, obj)
+            return (204 if st in (200, 204) else 404), {}, b""
+        return 405, {}, b""
+
     # -------------------------------------------------------------- buckets
     async def _bucket_exists(self, bucket: str) -> bool:
         try:
@@ -488,6 +633,7 @@ class S3Gateway:
             bucket.encode(): json.dumps(
                 {"created": time.time()}).encode()})
         await self.io.write_full(_index_oid(bucket), b"")
+        await self._log_change("mkb", bucket)
         return 200, {}, b""
 
     async def _delete_bucket(self, bucket: str):
@@ -501,6 +647,7 @@ class S3Gateway:
             await self.io.remove(_index_oid(bucket))
         except ObjectOperationError:
             pass
+        await self._log_change("rmb", bucket)
         return 204, {}, b""
 
     async def _list_objects(self, bucket: str, query: str):
@@ -541,6 +688,7 @@ class S3Gateway:
             key.encode(): json.dumps({
                 "size": len(body), "etag": etag,
                 "mtime": time.time()}).encode()})
+        await self._log_change("put", bucket, key)
         return 200, {"ETag": f'"{etag}"'}, b""
 
     async def _get_object(self, bucket: str, key: str,
@@ -592,6 +740,7 @@ class S3Gateway:
             return 404, {}, _xml_error("NoSuchKey")
         await self._drop_object_data(bucket, key)
         await self.io.omap_rm_keys(_index_oid(bucket), [key.encode()])
+        await self._log_change("del", bucket, key)
         return 204, {}, b""
 
     async def _obj_meta(self, bucket: str, key: str) -> Optional[dict]:
@@ -730,6 +879,7 @@ class S3Gateway:
                 except StripedObjectNotFound:
                     pass
         await self.io.remove(_upload_oid(bucket, upload_id))
+        await self._log_change("put", bucket, key)
         xml = (f'<?xml version="1.0"?><CompleteMultipartUploadResult>'
                f"<Bucket>{bucket}</Bucket><Key>{quote(key)}</Key>"
                f"<ETag>&quot;{final_etag}&quot;</ETag>"

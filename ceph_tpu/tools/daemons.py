@@ -93,6 +93,7 @@ async def run_mds(args) -> None:
     addr = await msgr.bind()
     mds = MDS(ctx, msgr, r, "cephfs_metadata")
     await mds.create_fs()
+    await mds.start()          # MDLog recovery + write-back flusher
     # register with the mon (FSMonitor beacon) + a file fallback for
     # offline inspection; a transient registration failure must not
     # kill the daemon — clients fall back to the file

@@ -207,6 +207,9 @@ def samples():
         "ceph_tpu.osd.types.ObjectLocator": oloc,
         "ceph_tpu.osd.types.PGId": pgid,
         "ceph_tpu.osd.types.PGPool": _osdmap().pools[1],
+        "ceph_tpu.services.mds.MClientLease": __import__(
+            "ceph_tpu.services.mds", fromlist=["MClientLease"]
+        ).MClientLease(["/a/b", "/c"]),
         "ceph_tpu.services.mds.MClientReply": MClientReply(),
         "ceph_tpu.services.mds.MClientRequest": MClientRequest(),
         "ceph_tpu.store.blockstore.Extent": Extent(0, 4096),

@@ -29,6 +29,7 @@ async def _start_mds(cl, admin, mds_id="a"):
     addr = await msgr.bind()
     mds = MDS(ctx, msgr, r, "cephfs_metadata")
     await mds.create_fs()
+    await mds.start()
     return mds, msgr, addr
 
 
@@ -137,5 +138,89 @@ def test_cephfs_two_clients_share_namespace():
         inos = {(await c1.stat(f"/shared/{e}"))["ino"] for e in ents}
         assert len(inos) == 17
         await msgr.shutdown()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_mdlog_crash_recovery_replays_unflushed_mutations():
+    """MDLog role (mds/MDLog.cc): mutations are acked once journaled;
+    an MDS that dies BEFORE write-back must lose nothing — a fresh MDS
+    replays the journal into omap on start."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        # huge flush thresholds: nothing reaches omap before the crash
+        ctx = make_ctx("mds.a")
+        r = await cl.client(name="mds.a")
+        for pool in ("cephfs_metadata", "cephfs_data"):
+            if admin.monc.osdmap.lookup_pool(pool) < 0:
+                await admin.pool_create(pool, pg_num=8)
+        msgr = Messenger(ctx, EntityName("mds", "a"))
+        addr = await msgr.bind()
+        mds = MDS(ctx, msgr, r, "cephfs_metadata",
+                  log_flush_interval=3600.0, log_flush_events=10**9)
+        await mds.create_fs()
+        await mds.start()
+        fs = CephFS(admin, addr, "cephfs_data")
+        await fs.makedirs("/deep/tree")
+        await fs.write_file("/deep/tree/f.txt", b"journaled bytes")
+        await fs.rename("/deep/tree/f.txt", "/deep/tree/g.txt")
+        # CRASH: tear down the messenger without flushing the MDLog
+        if mds._flush_task is not None:
+            mds._flush_task.cancel()
+        await msgr.shutdown()
+        # omap must NOT yet hold the entries (they were only journaled)
+        from ceph_tpu.services.mds import ROOT_INO, dir_oid
+        meta_io = admin.open_ioctx("cephfs_metadata")
+        root = await meta_io.omap_get(dir_oid(ROOT_INO))
+        assert b"deep" not in root, "write-back flushed too early"
+
+        # a fresh MDS on the same pool replays the journal
+        ctx2 = make_ctx("mds.b")
+        r2 = await cl.client(name="mds.b")
+        msgr2 = Messenger(ctx2, EntityName("mds", "b"))
+        addr2 = await msgr2.bind()
+        mds2 = MDS(ctx2, msgr2, r2, "cephfs_metadata")
+        await mds2.create_fs()
+        await mds2.start()      # replay happens here
+        fs2 = CephFS(admin, addr2, "cephfs_data")
+        assert await fs2.read_file("/deep/tree/g.txt") \
+            == b"journaled bytes"
+        assert sorted(await fs2.listdir("/deep/tree")) == ["g.txt"]
+        await mds2.stop()
+        await msgr2.shutdown()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_dentry_leases_cache_and_revoke():
+    """Client-caps fast path (Locker.cc leases): repeated stats are
+    served from the lease cache; a SECOND client's mutation revokes the
+    first client's lease so it re-fetches fresh metadata."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        mds, msgr, addr = await _start_mds(cl, admin)
+        fs1 = CephFS(admin, addr, "cephfs_data")
+        # second mount on its OWN messenger/identity
+        c2 = await cl.client(name="client.m2")
+        fs2 = CephFS(c2, addr, "cephfs_data")
+
+        await fs1.write_file("/doc.txt", b"version one")
+        ent1 = await fs1.stat("/doc.txt")
+        hits0 = fs1.lease_hits
+        ent1b = await fs1.stat("/doc.txt")      # served by the lease
+        assert fs1.lease_hits == hits0 + 1 and ent1b == ent1
+
+        # fs2 rewrites the file: fs1's lease must be revoked
+        await fs2.write_file("/doc.txt", b"version two, longer")
+        for _ in range(50):
+            if "/doc.txt" not in fs1._leases:
+                break
+            await asyncio.sleep(0.05)
+        assert "/doc.txt" not in fs1._leases, "lease never revoked"
+        ent2 = await fs1.stat("/doc.txt")       # fresh RPC
+        assert ent2["size"] == len(b"version two, longer")
+        assert await fs1.read_file("/doc.txt") == b"version two, longer"
         await cl.stop()
     asyncio.run(run())

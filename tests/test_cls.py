@@ -157,3 +157,70 @@ def test_rbd_header_via_cls():
         assert await rbd.list() == []
         await cl.stop()
     asyncio.run(run())
+
+
+def test_cls_refcount_get_put_delete():
+    """cls_refcount (src/cls/refcount/cls_refcount.cc role): the object
+    survives while any tag holds a ref; the last put deletes it."""
+    async def run():
+        import json
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("p", pg_num=4)
+        io = admin.open_ioctx("p")
+        await io.write_full("shared", b"tail bytes")
+        await io.exec("shared", "refcount", "get",
+                      json.dumps({"tag": "copyA"}).encode())
+        await io.exec("shared", "refcount", "get",
+                      json.dumps({"tag": "copyB"}).encode())
+        refs = json.loads((await io.exec("shared", "refcount", "read",
+                                         b"")).decode())
+        assert set(refs) == {"#implicit", "copyA", "copyB"}
+        # drop implicit + A: object stays
+        for tag in ("#implicit", "copyA"):
+            out = json.loads((await io.exec(
+                "shared", "refcount", "put",
+                json.dumps({"tag": tag}).encode())).decode())
+            assert out["deleted"] is False
+        assert await io.read("shared") == b"tail bytes"
+        # last ref: object goes
+        out = json.loads((await io.exec(
+            "shared", "refcount", "put",
+            json.dumps({"tag": "copyB"}).encode())).decode())
+        assert out["deleted"] is True
+        from ceph_tpu.client.objecter import ObjectOperationError
+        with pytest.raises(ObjectOperationError):
+            await io.read("shared")
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_cls_journal_commit_monotonic_and_cas():
+    """cls_journal guards: commits never rewind; active-object rotation
+    is CAS (src/cls/journal/cls_journal.cc role)."""
+    async def run():
+        import errno
+        import json
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("p", pg_num=4)
+        io = admin.open_ioctx("p")
+        from ceph_tpu.journal import Journaler
+        jr = Journaler(io, "img")
+        await jr.create()
+        await jr.register_client("m1")
+        await jr.commit("m1", 7)
+        await jr.commit("m1", 3)          # stale: must not rewind
+        assert await jr.get_commit("m1") == 7
+        # unknown client refuses
+        from ceph_tpu.client.objecter import ObjectOperationError
+        with pytest.raises(ObjectOperationError):
+            await io.exec("journal.img", "journal", "client_commit",
+                          json.dumps({"id": "ghost", "seq": 1}).encode())
+        # CAS rotation: stale expect -> ESTALE
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("journal.img", "journal", "advance_active",
+                          json.dumps({"expect": 5, "to": 6}).encode())
+        assert ei.value.retcode == -errno.ESTALE
+        await cl.stop()
+    asyncio.run(run())

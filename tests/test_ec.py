@@ -381,3 +381,22 @@ def test_bitmatrix_liberation_q_block_weight():
         Q = B[w:, j * w:(j + 1) * w]
         assert np.array_equal(P, np.eye(w, dtype=np.uint8))
         assert Q.sum() == (w if j == 0 else w + 1)
+
+
+def test_pallas_variant_space_bit_exact():
+    """Every autotune variant (layout x pack) must produce identical
+    bytes — the tuner may install any of them."""
+    import jax.numpy as jnp
+    from ceph_tpu.ec import gf256
+    from ceph_tpu.ec.kernel import _apply_bitmatrix_pallas
+    gen = gf256.rs_vandermonde_matrix(4, 3)
+    bm = jnp.asarray(gf256.expand_to_bitmatrix(gen[4:]), jnp.int8)
+    rng = np.random.default_rng(13)
+    chunks = rng.integers(0, 256, (4, 1024), dtype=np.uint8)
+    want = gf256.host_apply(gen[4:], chunks)
+    for layout in ("cb", "bc"):
+        for pack in ("vpu", "mxu"):
+            got = np.asarray(_apply_bitmatrix_pallas(
+                bm, jnp.asarray(chunks), interpret=True, tile=512,
+                layout=layout, pack=pack))
+            assert np.array_equal(got, want), (layout, pack)
