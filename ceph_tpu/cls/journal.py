@@ -67,13 +67,13 @@ def advance_active(hctx: ClsContext, inbl: bytes):
 
 @cls_method("journal.trim_to", writes=True)
 def trim_to(hctx: ClsContext, inbl: bytes):
-    """in: {to} — advance first_obj monotonically, but never past the
-    minimum committed position's object as recorded by the caller; the
-    committed-min computation happens HERE against the live client set
-    so a client registering mid-trim is honored.
-    in.to is the caller's candidate; out: the granted first_obj."""
+    """in: {to} — advance first_obj, monotonically (a stale trimmer can
+    never move it backwards).  The caller computes the committed
+    minimum; a client REGISTERING concurrently starts at commit
+    position 0 and bootstraps full state first (rgw_sync/ImageReplayer
+    contract), so it never depends on events below the new first_obj.
+    out: the granted first_obj."""
     req = json.loads(inbl.decode())
-    omap = hctx.omap_get()
     first = _geti(hctx, "first_obj")
     if first is None:
         return -errno.ENOENT, b""

@@ -80,6 +80,9 @@ def _bind(lib: ctypes.CDLL) -> Optional[ctypes.CDLL]:
         lib.ceph_straw2_winner_shared.argtypes = [
             i32p, i64p, ctypes.c_int32, u32p, u32p, ctypes.c_int64, i64p,
             i32p]
+        lib.ceph_straw2_winner_rows_indexed.argtypes = [
+            i32p, i64p, i64p, ctypes.c_int64, ctypes.c_int32, u32p,
+            u32p, i64p, i32p]
     except AttributeError:
         # stale prebuilt .so missing newer symbols (no compiler to
         # rebuild): degrade to unavailable, never raise out of _load —
@@ -183,6 +186,39 @@ def straw2_winner_rows(items: np.ndarray, weights: np.ndarray,
     lib.ceph_straw2_winner_rows(
         items.ctypes.data_as(i32p), weights.ctypes.data_as(i64p),
         X, I, xs.ctypes.data_as(u32p), rs.ctypes.data_as(u32p),
+        ln_tab.ctypes.data_as(i64p), out.ctypes.data_as(i32p))
+    return out.astype(np.int64)
+
+
+def straw2_winner_rows_indexed(items_tab: np.ndarray,
+                               weights_tab: np.ndarray,
+                               rows: np.ndarray, xs: np.ndarray,
+                               rs: np.ndarray,
+                               ln_tab: np.ndarray) -> np.ndarray:
+    """Level-table straw2 argmax: items/weights [N, I] shared table,
+    rows [X] lane->row indices -> chosen ITEM ids [X].  Skips the
+    [X, I] gather the plain rows kernel needs (multi-level descent
+    hot path, ops/crush_kernel._descend)."""
+    lib = _load()
+    assert lib is not None
+    assert items_tab.dtype == np.int32 and items_tab.flags.c_contiguous
+    assert weights_tab.dtype == np.int64 \
+        and weights_tab.flags.c_contiguous
+    rows = np.ascontiguousarray(rows, np.int64)
+    xs = np.ascontiguousarray(xs, np.uint32)
+    rs = np.ascontiguousarray(rs, np.uint32)
+    ln_tab = np.ascontiguousarray(ln_tab, np.int64)
+    _, I = items_tab.shape
+    X = len(rows)
+    out = np.empty(X, np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.ceph_straw2_winner_rows_indexed(
+        items_tab.ctypes.data_as(i32p),
+        weights_tab.ctypes.data_as(i64p),
+        rows.ctypes.data_as(i64p), X, I,
+        xs.ctypes.data_as(u32p), rs.ctypes.data_as(u32p),
         ln_tab.ctypes.data_as(i64p), out.ctypes.data_as(i32p))
     return out.astype(np.int64)
 

@@ -326,6 +326,40 @@ void ceph_straw2_winner_rows(const int32_t* items,    // [X*I]
 
 // Shared-bucket variant: every lane draws from the SAME item list (the
 // root bucket case) — avoids materializing [X, I] copies in python.
+void ceph_straw2_winner_rows_indexed(
+    const int32_t* items,    // [N*I] level bucket table
+    const int64_t* weights,  // [N*I]
+    const int64_t* rows,     // [X] row of each lane's bucket
+    int64_t X, int32_t I,
+    const uint32_t* xs,      // [X]
+    const uint32_t* rs,      // [X]
+    const int64_t* ln_tab,   // [65536]
+    int32_t* out_item) {     // [X] chosen ITEM id (not index)
+  // Multi-level descent inner loop: lanes index a shared per-level
+  // bucket table, so the [X, I] items/weights gather numpy would
+  // materialize never exists — each lane streams its row in-place.
+#pragma omp parallel for schedule(static) if (X > 4096)
+  for (int64_t i = 0; i < X; i++) {
+    const int32_t* it = items + rows[i] * I;
+    const int64_t* w = weights + rows[i] * I;
+    uint32_t xi = xs[i], ri = rs[i];
+    int32_t high = 0;
+    int64_t high_draw = 0;
+    for (int32_t j = 0; j < I; j++) {
+      int64_t draw;
+      if (w[j] > 0) {
+        uint32_t u = ceph_rjenkins3(xi, (uint32_t)it[j], ri) & 0xffffu;
+        int64_t ln = ln_tab[u] - 0x1000000000000LL;
+        draw = -((-ln) / w[j]);
+      } else {
+        draw = INT64_MIN;
+      }
+      if (j == 0 || draw > high_draw) { high = j; high_draw = draw; }
+    }
+    out_item[i] = it[high];
+  }
+}
+
 void ceph_straw2_winner_shared(const int32_t* items,   // [I]
                                const int64_t* weights, // [I]
                                int32_t I, const uint32_t* xs,

@@ -63,7 +63,7 @@ class Level:
     bucket_straw2_choose's first-max scan).  rows maps (-1 - bucket_id)
     -> row for the ids produced by the PREVIOUS level's draw."""
 
-    __slots__ = ("items", "weights", "rows")
+    __slots__ = ("items", "weights", "rows", "items32")
 
     def __init__(self, buckets):
         imax = max(b.size for b in buckets)
@@ -75,6 +75,9 @@ class Level:
             self.items[row, :b.size] = b.items
             self.weights[row, :b.size] = b.item_weights
             self.rows[-1 - b.id] = row
+        # int32 view for the native indexed-rows kernel (item ids are
+        # 32-bit in crush)
+        self.items32 = np.ascontiguousarray(self.items, np.int32)
 
     @property
     def shared(self) -> bool:
@@ -324,6 +327,23 @@ def _is_out(weights_vec: np.ndarray, item: np.ndarray,
     return out | (item < 0) | (item >= len(weights_vec))
 
 
+def _level_draw(lv: "Level", rows: np.ndarray, x: np.ndarray,
+                r: np.ndarray) -> np.ndarray:
+    """Chosen ITEM ids for one level: each lane draws from the bucket
+    at its `rows` index.  The native indexed kernel streams the shared
+    level table row-in-place — the numpy fallback materializes the
+    [X, I] gather."""
+    nat = _native()
+    if nat and x.ndim == 1:
+        rr = np.broadcast_to(r, x.shape)
+        return nat.straw2_winner_rows_indexed(
+            lv.items32, lv.weights, rows, x, rr, _ln())
+    items = lv.items[rows]                  # [X, I]
+    weights = lv.weights[rows]
+    idx = _straw2_draw(items, weights, x, r)
+    return np.take_along_axis(items, idx[:, None], 1)[:, 0]
+
+
 def _descend(levels: List["Level"], x: np.ndarray,
              r: np.ndarray) -> np.ndarray:
     """One full descent through `levels` with the SAME r at every level
@@ -335,11 +355,7 @@ def _descend(levels: List["Level"], x: np.ndarray,
             idx = _straw2_draw(lv.items[0], lv.weights[0], x, r)
             cand = lv.items[0][idx]
         else:
-            rows = lv.rows[-1 - cand]
-            items = lv.items[rows]          # [X, I]
-            weights = lv.weights[rows]
-            idx = _straw2_draw(items, weights, x, r)
-            cand = np.take_along_axis(items, idx[:, None], 1)[:, 0]
+            cand = _level_draw(lv, lv.rows[-1 - cand], x, r)
     return cand
 
 
@@ -387,10 +403,7 @@ def _descend_from(levels: List["Level"], rows: np.ndarray, x: np.ndarray,
     for ln, lv in enumerate(levels):
         if ln > 0:
             rows = lv.rows[-1 - cand]
-        items = lv.items[rows]              # [X, I]
-        weights = lv.weights[rows]
-        idx = _straw2_draw(items, weights, x, r)
-        cand = np.take_along_axis(items, idx[:, None], 1)[:, 0]
+        cand = _level_draw(lv, rows, x, r)
     return cand
 
 

@@ -120,6 +120,8 @@ class PG:
         self._hitset = None
         self._perf_tier = None
         self._hitset_rotated = 0.0
+        self._hitset_seq = 0
+        self._hitsets_loaded = False
         from ceph_tpu.osd.backend import ECBackend, ReplicatedBackend
         self.backend = (ECBackend(self) if pool.is_erasure()
                         else ReplicatedBackend(self))
@@ -1145,20 +1147,63 @@ class PG:
                 self._perf_tier.add_u64(k)
         return self._perf_tier
 
-    def _hitset_tick(self) -> None:
+    async def _hitset_tick(self) -> None:
+        """Rotate on period; the sealed set PERSISTS as a replicated
+        internal object (_hitset_<n>) so a failover primary inherits
+        the recency window (ReplicatedPG::hit_set_persist)."""
         import time as _time
         now = _time.monotonic()
-        if now - self._hitset_rotated >= self.pool.hit_set_period:
-            self.hitset.rotate()
-            self._hitset_rotated = now
+        if now - self._hitset_rotated < self.pool.hit_set_period:
+            return
+        sealed = self.hitset.current
+        self.hitset.rotate()
+        self._hitset_rotated = now
+        from ceph_tpu.osd import tiering
+        from ceph_tpu.osd.messages import OP_DELETE, OP_WRITEFULL, OSDOp
+        self._hitset_seq += 1
+        try:
+            await tiering.internal_write(
+                self, f"_hitset_{self._hitset_seq:016x}",
+                [OSDOp(OP_WRITEFULL, data=sealed.to_bytes())])
+            old = self._hitset_seq - (self.pool.hit_set_count - 1)
+            if old > 0:
+                await tiering.internal_write(
+                    self, f"_hitset_{old:016x}", [OSDOp(OP_DELETE)])
+        except Exception:
+            self.log_.exception(f"{self.pgid} hitset persist failed")
+
+    async def _load_hitsets(self) -> None:
+        """New primary: adopt the persisted hit-set window
+        (ReplicatedPG::hit_set_setup)."""
+        self._hitsets_loaded = True
+        from ceph_tpu.osd.hitset import BloomHitSet
+        try:
+            names = sorted(
+                (o.name for o in self.osd.store.collection_list(self.cid)
+                 if o.is_head() and o.name.startswith("_hitset_")),
+                reverse=True)
+        except Exception:
+            return
+        hs = self.hitset
+        for name in names[:hs.count - 1]:
+            try:
+                blob = self.osd.store.read(self.cid,
+                                           self.object_id(name))
+                hs.archive.append(BloomHitSet.from_bytes(blob))
+                self._hitset_seq = max(self._hitset_seq,
+                                       int(name.rsplit("_", 1)[1], 16))
+            except Exception:
+                pass
 
     async def _maybe_handle_cache(self, m: MOSDOp) -> None:
         """ReplicatedPG::maybe_handle_cache distilled: record the hit,
         rotate hit sets on period, promote on miss (writeback)."""
         from ceph_tpu.osd import tiering
-        if not m.oid:
-            return                      # pool-level op (pgls): no object
-        self._hitset_tick()
+        if not m.oid or m.oid.startswith("_hitset_"):
+            return              # pool-level op / internal object
+        if not self._hitsets_loaded:
+            await self._load_hitsets()
+        await self._hitset_tick()
         self.hitset.insert(m.oid)
         if self.pool.cache_mode == "writeback":
             await tiering.maybe_promote(self, m)

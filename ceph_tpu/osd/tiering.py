@@ -153,7 +153,11 @@ async def agent_work(pg) -> None:
         return
     try:
         heads = [o for o in store.collection_list(pg.cid)
-                 if o.is_head()]
+                 if o.is_head()
+                 and not o.name.startswith("_hitset_")
+                 and o.name != "_pgmeta_"]
+        # ONLY the actual internal objects are excluded — a user object
+        # legitimately named "_foo" still flushes/evicts normally
     except Exception:
         return
     per_pg_target = max(1, target // max(1, pool.pg_num))

@@ -138,6 +138,7 @@ class MDS(Dispatcher):
         self._dirty: Dict[int, set] = {}    # dir ino -> dirty names
         self._removed: Dict[int, set] = {}  # dir ino -> removed names
         self._gone_dirs: set = set()        # rmdir'd dir inos
+        self._new_dirs: set = set()         # mkdir'd, not yet flushed
         self._next_ino: Optional[int] = None
         self._ino_dirty = False
         self._unflushed = 0                 # events since last flush
@@ -214,10 +215,12 @@ class MDS(Dispatcher):
         for ino in eff.get("mkdir", []):
             self._dirs.setdefault(ino, {})
             self._gone_dirs.discard(ino)
+            self._new_dirs.add(ino)
         for ino in eff.get("rmdir", []):
             self._dirs.pop(ino, None)
             self._dirty.pop(ino, None)
             self._removed.pop(ino, None)
+            self._new_dirs.discard(ino)
             self._gone_dirs.add(ino)
         if eff.get("next_ino"):
             self._next_ino = eff["next_ino"]
@@ -276,6 +279,14 @@ class MDS(Dispatcher):
         if self._mdlog is None or not self._unflushed:
             return
         seq = self._last_seq
+        for ino in list(self._new_dirs):
+            # mkdir'd dirs flush even when EMPTY — the journal is about
+            # to be trimmed and an absent dir object would be ENOENT
+            # forever after restart
+            try:
+                await self.io.omap_get(dir_oid(ino))
+            except ObjectOperationError:
+                await self.io.write_full(dir_oid(ino), b"")
         for ino, names in list(self._dirty.items()):
             ents = self._dirs.get(ino, {})
             kv = {n.encode(): json.dumps(ents[n]).encode()
@@ -307,6 +318,7 @@ class MDS(Dispatcher):
         self._dirty.clear()
         self._removed.clear()
         self._gone_dirs.clear()
+        self._new_dirs.clear()
         self._ino_dirty = False
         self._unflushed = 0
         if seq:
@@ -417,11 +429,14 @@ class MDS(Dispatcher):
         affected path gets a revoke (Locker::revoke_client_leases)."""
         keys = [norm_path(p) for p in paths]
         victims: Dict[str, tuple] = {}
-        now = time.time()
+        # revoke REGARDLESS of MDS-side expiry: the client's
+        # clock stamps its lease AFTER the reply round-trip, so its
+        # expiry is always later than ours — skipping "expired" holders
+        # would leave a stale-read window at the TTL boundary
         for lp in list(self._leases):
             if any(lp == k or lp.startswith(k + "/") for k in keys):
                 for who, (addr, exp) in self._leases.pop(lp).items():
-                    if who != str(m.src_name) and exp > now:
+                    if who != str(m.src_name):
                         ent = victims.setdefault(who, (addr, []))
                         if lp not in ent[1]:
                             ent[1].append(lp)

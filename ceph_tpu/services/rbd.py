@@ -105,6 +105,96 @@ def _header_oid(img_id: str) -> str:
     return f"rbd_header.{img_id}"
 
 
+def _object_map_oid(img_id: str) -> str:
+    return f"rbd_object_map.{img_id}"
+
+
+class ObjectMap:
+    """Per-image object-existence bitmap (librbd/ObjectMap.cc): 1 bit
+    per data object, persisted as rbd_object_map.<id>.  Lets reads on
+    clones and bulk ops (remove/resize/flatten) skip per-object ENOENT
+    round-trips.  Maintained only under the exclusive lock — the same
+    dependency the reference enforces — and rebuilt on demand by a
+    stat scan (rbd object-map rebuild)."""
+
+    def __init__(self, ioctx, img_id: str, n_objs: int):
+        import numpy as _np
+        self.io = ioctx
+        self.oid = _object_map_oid(img_id)
+        self.n_objs = n_objs
+        self.bits = _np.zeros((n_objs + 7) // 8, _np.uint8)
+        self.dirty = False
+
+    async def load(self) -> bool:
+        """-> True only when a CLEANLY-CLOSED map was loaded.  Format:
+        [flag byte: 1=clean, 0=in-use][bitmap].  A map left in-use by a
+        crashed holder may be missing _om_mark bits that were never
+        saved — trusting it would read zeros over real data, so the
+        caller must rebuild (librbd FLAG_OBJECT_MAP_INVALID role)."""
+        import numpy as _np
+        try:
+            raw = await self.io.read(self.oid)
+        except Exception:
+            return False
+        if not raw or raw[0] != 1:
+            return False               # absent or crashed-dirty map
+        need = (self.n_objs + 7) // 8
+        buf = _np.frombuffer(raw[1:], _np.uint8).copy()
+        if len(buf) < need:
+            buf = _np.concatenate([buf, _np.zeros(need - len(buf),
+                                                  _np.uint8)])
+        self.bits = buf[:need]
+        return True
+
+    async def save(self, clean: bool = False) -> None:
+        """Persist; clean=True only on orderly close — while a holder
+        is live the stored flag stays 0 so a crash invalidates the
+        map."""
+        await self.io.write_full(
+            self.oid, bytes([1 if clean else 0]) + self.bits.tobytes())
+        self.dirty = False
+
+    def exists(self, n: int) -> bool:
+        return n < self.n_objs and bool((self.bits[n >> 3]
+                                         >> (n & 7)) & 1)
+
+    def set_exists(self, n: int, val: bool = True) -> None:
+        if n >= self.n_objs:
+            return
+        if val:
+            self.bits[n >> 3] |= 1 << (n & 7)
+        else:
+            self.bits[n >> 3] &= ~(1 << (n & 7)) & 0xFF
+        self.dirty = True
+
+    def resize(self, n_objs: int) -> None:
+        import numpy as _np
+        need = (n_objs + 7) // 8
+        if need > len(self.bits):
+            self.bits = _np.concatenate(
+                [self.bits, _np.zeros(need - len(self.bits), _np.uint8)])
+        else:
+            self.bits = self.bits[:need]
+            if n_objs & 7:     # clear bits past the new end
+                self.bits[-1] &= (1 << (n_objs & 7)) - 1
+        self.n_objs = n_objs
+        self.dirty = True
+
+    async def rebuild(self, img: "Image") -> None:
+        """Stat scan (ObjectMap::aio_resize + rebuild_object_map)."""
+        import asyncio as _asyncio
+
+        async def probe(n):
+            try:
+                await img.io.stat(_data_oid(img.id, n))
+                self.set_exists(n, True)
+            except Exception:
+                self.set_exists(n, False)
+
+        await _asyncio.gather(*[probe(n) for n in range(self.n_objs)])
+        self.dirty = True
+
+
 def _data_oid(img_id: str, object_no: int) -> str:
     return f"rbd_data.{img_id}.{object_no:016x}"
 
@@ -311,6 +401,7 @@ class Image:
         self._lock_cookie: Optional[str] = None
         self._lock_task: Optional[asyncio.Task] = None
         self._lock_lost = False
+        self.object_map: Optional[ObjectMap] = None
         # snapshots + clone parent (librbd snap_create/clone features)
         self.snaps: List[Dict] = []       # [{id,name,size,protected}]
         self.snap_id = 0                  # >0: handle opened at a snap
@@ -399,6 +490,13 @@ class Image:
             # expires (librbd ExclusiveLock + watch liveness role)
             img._lock_task = asyncio.get_running_loop().create_task(
                 img._renew_lock())
+            # object map rides the exclusive lock (librbd ObjectMap
+            # feature dependency): load it, or rebuild by stat scan
+            om = ObjectMap(ioctx, img_id, img._n_objs())
+            if not await om.load():
+                await om.rebuild(img)
+            await om.save(clean=False)     # mark in-use: a crash from
+            img.object_map = om            # here on invalidates the map
         if cached:
             from ceph_tpu.client.object_cacher import ObjectCacher
             img._cacher = ObjectCacher(
@@ -458,6 +556,7 @@ class Image:
             data = data.rstrip(b"\x00")
             if data:
                 await self.io.write_full(oid, data)
+                self._om_mark(object_no)
 
     # cacher backend: oid-granular IO with sparse/EC handling
     async def _backend_read(self, oid: str, off: int,
@@ -477,6 +576,7 @@ class Image:
 
     async def _backend_write(self, oid: str, off: int,
                              data: bytes) -> None:
+        self._om_mark(int(oid.rsplit(".", 1)[1], 16))
         if self.parent is not None:
             await self._ensure_copyup(int(oid.rsplit(".", 1)[1], 16))
         if self._ec_pool:
@@ -485,6 +585,16 @@ class Image:
                                    data, off)
         else:
             await self.io.write(oid, data, offset=off)
+
+    def _n_objs(self) -> int:
+        max_obj = (max(self.size - 1, 0) >> self.order) + 1 \
+            if self.size else 0
+        sc = self.layout.stripe_count
+        return ((max_obj + sc - 1) // sc) * sc
+
+    def _om_mark(self, object_no: int, exists: bool = True) -> None:
+        if self.object_map is not None:
+            self.object_map.set_exists(object_no, exists)
 
     def stat(self) -> Dict:
         return {"size": self.size, "order": self.order,
@@ -512,6 +622,14 @@ class Image:
             hi = max(e.offset + e.length for e in extents)
             if self._cacher is not None:
                 data = await self._cacher.read(oid, lo, hi - lo)
+            elif self.object_map is not None \
+                    and not self.object_map.exists(object_no):
+                # object-map fast path: known-absent, skip the ENOENT
+                # round-trip (librbd ObjectMap read shortcut)
+                if self.parent is None:
+                    return
+                pdata = await self._parent_object_bytes(object_no)
+                data = pdata[lo:hi]
             else:
                 try:
                     data = await self.io.read(oid, length=hi - lo,
@@ -556,12 +674,14 @@ class Image:
                 await self._ensure_copyup(object_no)
             if self._ec_pool:
                 await self._rmw_object(oid, extents, data, offset)
+                self._om_mark(object_no)
                 return
             for e in extents:
                 await self.io.write(
                     oid, data[e.logical - offset:
                               e.logical - offset + e.length],
                     offset=e.offset)
+            self._om_mark(object_no)
 
         await asyncio.gather(*[write_obj(o, ex)
                                for o, ex in per_obj.items()])
@@ -626,6 +746,7 @@ class Image:
                     await self.io.remove(oid)
                 except Exception:
                     pass
+                self._om_mark(object_no, False)
                 return
             if in_overlap:
                 await self._ensure_copyup(object_no)
@@ -689,6 +810,8 @@ class Image:
                     except Exception:
                         pass
         self.size = new_size
+        if self.object_map is not None:
+            self.object_map.resize(self._n_objs())
         import json as _json
         await self.io.exec(_header_oid(self.id), "rbd", "set_size",
                            _json.dumps({"size": new_size}).encode())
@@ -698,6 +821,8 @@ class Image:
         this drains every dirty buffer (librbd::flush)."""
         if self._cacher is not None:
             await self._cacher.flush_all()
+        if self.object_map is not None:
+            await self.object_map.save()
 
     # ------------------------------------------------------- snapshots
     # librbd snap_create/snap_remove/snap_rollback/snap_protect
@@ -795,6 +920,9 @@ class Image:
                 _header_oid(self.id), "rbd", "set_size",
                 _json.dumps({"size": snap["size"]}).encode())
             self.size = snap["size"]
+        if self.object_map is not None:
+            self.object_map.resize(self._n_objs())
+            await self.object_map.rebuild(self)
 
     # ----------------------------------------------------------- clone
     def parent_info(self) -> Optional[Dict]:
@@ -889,6 +1017,11 @@ class Image:
         if self._lock_task is not None:
             self._lock_task.cancel()
             self._lock_task = None
+        if self.object_map is not None:
+            try:
+                await self.object_map.save(clean=True)
+            except Exception:
+                pass
         if self._lock_cookie is not None:
             await _cls_unlock(self.io, _header_oid(self.id), LOCK_NAME,
                               _client_entity(self.io), self._lock_cookie)

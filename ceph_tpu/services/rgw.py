@@ -276,6 +276,10 @@ class S3Gateway:
 
     # ------------------------------------------------------------ lifecycle
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        if self.datalog is not None and not await self.datalog.exists():
+            # eager create: a sync agent may bootstrap before the first
+            # mutation ever appends
+            await self.datalog.create()
         self._server = await asyncio.start_server(self._client, host, port)
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port

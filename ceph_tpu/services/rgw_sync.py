@@ -51,9 +51,13 @@ class ZoneSyncAgent:
         that exists, register at the pre-copy position."""
         jr = self._journal()
         if not await jr.exists():
-            raise RuntimeError(
-                "source gateway has no datalog: start it with "
-                "S3Gateway(..., datalog=True)")
+            if self.src.datalog is None:
+                raise RuntimeError(
+                    "source gateway has no datalog: start it with "
+                    "S3Gateway(..., datalog=True)")
+            # gateway configured but never started/mutated: create the
+            # log now so registration + tailing work from t=0
+            await jr.create()
         await jr.register_client(self.client_id)
         start_seq = await jr.tail_seq()
         from ceph_tpu.services.rgw import BUCKETS_OID, _index_oid

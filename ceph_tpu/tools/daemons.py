@@ -118,12 +118,50 @@ async def _run_until_signal() -> None:
     await stop.wait()
 
 
+def daemonize(pidfile: str, logfile: str) -> None:
+    """Classic double-fork daemonization (global/global_init.cc
+    global_init_daemonize role): detach from the controlling terminal,
+    write a pidfile, point stdio at the log."""
+    if os.fork() > 0:
+        os._exit(0)                      # parent returns to the shell
+    os.setsid()
+    if os.fork() > 0:
+        os._exit(0)                      # session leader exits
+    os.chdir("/")
+    fd = os.open(logfile, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                 0o644)
+    null = os.open(os.devnull, os.O_RDONLY)
+    os.dup2(null, 0)
+    os.dup2(fd, 1)
+    os.dup2(fd, 2)
+    os.close(null)
+    if fd > 2:
+        os.close(fd)
+    with open(pidfile, "w") as f:
+        f.write(str(os.getpid()))
+    import atexit
+    atexit.register(lambda: os.path.exists(pidfile)
+                    and os.unlink(pidfile))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ceph-tpu-daemon")
     ap.add_argument("kind", choices=["mon", "osd", "mds"])
     ap.add_argument("--id", required=True)
     ap.add_argument("--dir", required=True, help="cluster directory")
+    ap.add_argument("-d", "--daemonize", action="store_true",
+                    help="double-fork into the background with a "
+                         "pidfile + log redirect (global_init role)")
+    ap.add_argument("--pid-file", default="",
+                    help="pidfile path (default: "
+                         "<dir>/<kind>.<id>.pid)")
     args = ap.parse_args(argv)
+    if args.daemonize:
+        pidfile = args.pid_file or os.path.join(
+            args.dir, f"{args.kind}.{args.id}.pid")
+        logfile = os.path.join(args.dir,
+                               f"{args.kind}.{args.id}.daemon.log")
+        daemonize(pidfile, logfile)
     runner = {"mon": run_mon, "osd": run_osd,
               "mds": run_mds}[args.kind]
     asyncio.run(runner(args))

@@ -1,0 +1,101 @@
+"""ceph-dencoder analog: inspect/round-trip versioned encodings.
+
+Reference parity: src/tools/ceph-dencoder (src/test/encoding/
+readable.sh harness) — `list_types`, `type T encode export`,
+`type T import F decode dump_json`.  The committed corpus under
+tests/corpus/ is generated/validated by tests/corpus_gen.py +
+tests/test_encoding_corpus.py; this CLI is the operator-facing probe.
+
+    python -m ceph_tpu.tools.dencoder list_types
+    python -m ceph_tpu.tools.dencoder type ceph_tpu.osd.types.PGPool \
+        encode --out /tmp/pool.bin
+    python -m ceph_tpu.tools.dencoder type ceph_tpu.osd.types.PGPool \
+        decode /tmp/pool.bin
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+
+
+def _load_type(dotted: str):
+    mod, _, cls = dotted.rpartition(".")
+    return getattr(importlib.import_module(mod), cls)
+
+
+def _samples():
+    import pathlib
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve()
+                           .parents[2] / "tests"))
+    import corpus_gen
+    return corpus_gen.samples()
+
+
+def _dump(obj) -> dict:
+    out = {"_type": type(obj).__name__,
+           "_struct_v": obj.STRUCT_V}
+    slots = getattr(obj, "__slots__", None)
+    names = slots if slots else [a for a in vars(obj)
+                                 if not a.startswith("_")]
+    for a in names:
+        try:
+            v = getattr(obj, a)
+        except AttributeError:
+            continue
+        if isinstance(v, bytes):
+            v = f"<{len(v)} bytes>"
+        elif not isinstance(v, (str, int, float, bool, type(None),
+                                list, dict)):
+            v = repr(v)
+        out[a] = v
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="dencoder")
+    ap.add_argument("verb", choices=["list_types", "type"])
+    ap.add_argument("args", nargs="*")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args(argv)
+    if args.verb == "list_types":
+        for name in sorted(_samples()):
+            print(name)
+        return 0
+    if len(args.args) < 2:
+        print("usage: type <dotted.Type> encode|decode [file]",
+              file=sys.stderr)
+        return 2
+    tname, op = args.args[0], args.args[1]
+    if op == "encode":
+        obj = _samples().get(tname)
+        if obj is None:
+            print(f"no sample for {tname}", file=sys.stderr)
+            return 1
+        blob = obj.to_bytes()
+        if args.out:
+            with open(args.out, "wb") as f:
+                f.write(blob)
+            print(f"wrote {len(blob)} bytes (v{obj.STRUCT_V})")
+        else:
+            sys.stdout.buffer.write(blob)
+        return 0
+    if op == "decode":
+        cls = _load_type(tname)
+        path = args.args[2] if len(args.args) > 2 else "-"
+        blob = (sys.stdin.buffer.read() if path == "-"
+                else open(path, "rb").read())
+        obj = cls.from_bytes(blob)
+        print(json.dumps(_dump(obj), indent=2, default=str))
+        return 0
+    print(f"unknown op {op!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:     # `| head` closed the pipe: not an error
+        sys.exit(0)

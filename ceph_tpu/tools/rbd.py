@@ -173,6 +173,24 @@ async def run(args) -> int:
             c_io = r.open_ioctx(args.dest_pool) if args.dest_pool \
                 else None
             await rbd.clone(pname, snap, child, clone_ioctx=c_io)
+        elif args.op == "object-map":
+            # object-map rebuild IMAGE (librbd rebuild_object_map)
+            verb, name = args.args[0], args.args[1]
+            if verb != "rebuild":
+                print(f"unknown object-map verb {verb}",
+                      file=sys.stderr)
+                return 2
+            from ceph_tpu.services.rbd import ObjectMap
+            img = await Image.open(io, name)
+            try:
+                om = ObjectMap(img.io, img.id, img._n_objs())
+                await om.rebuild(img)
+                await om.save(clean=True)
+                n = sum(om.exists(i) for i in range(om.n_objs))
+                print(f"object map rebuilt: {n}/{om.n_objs} objects "
+                      f"present")
+            finally:
+                await img.close()
         elif args.op == "flatten":
             img = await Image.open(io, args.args[0])
             try:

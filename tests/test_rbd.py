@@ -476,3 +476,68 @@ def test_rbd_snap_events_replicate_through_mirror():
         await mirrored.close()
         await cl.stop()
     asyncio.run(run())
+
+
+def test_object_map_tracks_existence_and_serves_clone_reads():
+    """librbd ObjectMap feature: exclusive handles maintain a
+    per-object existence bitmap; reads consult it (no ENOENT probes)
+    and it survives close/reopen."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("rbd", pg_num=8)
+        io = admin.open_ioctx("rbd")
+        rbd = RBD(io)
+        await rbd.create("om", 1 << 20, order=16)    # 16 objects
+        img = await Image.open(io, "om", exclusive=True)
+        assert img.object_map is not None
+        await img.write(0, b"A" * 1000)              # object 0
+        await img.write(3 << 16, b"B" * 1000)        # object 3
+        assert img.object_map.exists(0)
+        assert img.object_map.exists(3)
+        assert not img.object_map.exists(7)
+        # discard of a whole object clears its bit
+        await img.discard(3 << 16, 1 << 16)
+        assert not img.object_map.exists(3)
+        await img.close()                            # persists the map
+        img2 = await Image.open(io, "om", exclusive=True)
+        assert img2.object_map.exists(0)
+        assert not img2.object_map.exists(3)
+        assert await img2.read(0, 1000) == b"A" * 1000
+        assert await img2.read(3 << 16, 1000) == b"\x00" * 1000
+        await img2.close()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_object_map_invalidated_by_unclean_shutdown():
+    """A map left in-use by a crashed holder must NOT be trusted on
+    reopen (librbd FLAG_OBJECT_MAP_INVALID): the new holder rebuilds by
+    stat scan, so bits the crash never saved are recovered."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("rbd", pg_num=8)
+        io = admin.open_ioctx("rbd")
+        rbd = RBD(io)
+        await rbd.create("cr", 1 << 20, order=16)
+        img = await Image.open(io, "cr", exclusive=True)
+        await img.write(5 << 16, b"D" * 100)        # object 5
+        # CRASH: no close(), no object-map save; drop the lock so the
+        # next opener isn't blocked by the TTL
+        if img._lock_task:
+            img._lock_task.cancel()
+        from ceph_tpu.services.rbd import (LOCK_NAME, _cls_unlock,
+                                           _client_entity, _header_oid)
+        await _cls_unlock(io, _header_oid("cr"), LOCK_NAME,
+                          _client_entity(img.io), img._lock_cookie)
+        img._lock_cookie = None
+        # reopen: the stored map is flagged in-use -> rebuild finds
+        # object 5 even though the crash never persisted its bit
+        img2 = await Image.open(io, "cr", exclusive=True)
+        assert img2.object_map.exists(5), \
+            "stale object map trusted after crash"
+        assert await img2.read(5 << 16, 100) == b"D" * 100
+        await img2.close()
+        await cl.stop()
+    asyncio.run(run())

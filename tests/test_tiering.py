@@ -215,3 +215,45 @@ def test_tier_commands_validate():
             await asyncio.sleep(0.05)
         await cl.stop()
     asyncio.run(run())
+
+
+def test_hitset_window_survives_primary_failover():
+    """Persisted hit sets (_hitset_<n> replicated objects): a new
+    primary inherits the recency window instead of starting cold
+    (ReplicatedPG::hit_set_persist/hit_set_setup)."""
+    async def run():
+        cl = Cluster()
+        admin = await _setup_tiered(cl, n=4)
+        cache_id = admin.monc.osdmap.lookup_pool("cache")
+        io = admin.open_ioctx("base")
+        # tiny period so rotation (and persistence) actually happens
+        for osd in cl.osds.values():
+            for pg in osd.pgs.values():
+                if pg.pool_id == cache_id:
+                    pg.pool.hit_set_period = 0.2
+        rng = np.random.default_rng(4)
+        data = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+        for i in range(6):
+            await io.write_full(f"h{i}", data)
+            await asyncio.sleep(0.08)
+        for i in range(6):
+            assert await io.read(f"h{i}") == data
+        await asyncio.sleep(0.5)
+        await io.write_full("kick", data)   # forces a rotate+persist
+        persisted = 0
+        for osd in cl.osds.values():
+            for cid in osd.store.list_collections():
+                if cid.name.startswith(f"{cache_id}."):
+                    persisted += sum(
+                        1 for o in osd.store.collection_list(cid)
+                        if o.name.startswith("_hitset_"))
+        assert persisted > 0, "no hit set was ever persisted"
+        # fresh PG object on another OSD loads the window
+        src = next(pg for osd in cl.osds.values()
+                   for pg in osd.pgs.values()
+                   if pg.pool_id == cache_id and pg.is_primary()
+                   and pg._hitset_seq > 0)
+        await src._load_hitsets()
+        assert src.hitset.archive, "persisted window not loaded"
+        await cl.stop()
+    asyncio.run(run())
