@@ -180,16 +180,23 @@ class ObjectMap:
         self.n_objs = n_objs
         self.dirty = True
 
+    #: cap on concurrent stat probes during a rebuild — an unbounded
+    #: gather over a large image would hold one in-flight op per data
+    #: object at once
+    REBUILD_CONCURRENCY = 64
+
     async def rebuild(self, img: "Image") -> None:
         """Stat scan (ObjectMap::aio_resize + rebuild_object_map)."""
         import asyncio as _asyncio
+        sem = _asyncio.Semaphore(self.REBUILD_CONCURRENCY)
 
         async def probe(n):
-            try:
-                await img.io.stat(_data_oid(img.id, n))
-                self.set_exists(n, True)
-            except Exception:
-                self.set_exists(n, False)
+            async with sem:
+                try:
+                    await img.io.stat(_data_oid(img.id, n))
+                    self.set_exists(n, True)
+                except Exception:
+                    self.set_exists(n, False)
 
         await _asyncio.gather(*[probe(n) for n in range(self.n_objs)])
         self.dirty = True
@@ -821,7 +828,7 @@ class Image:
         this drains every dirty buffer (librbd::flush)."""
         if self._cacher is not None:
             await self._cacher.flush_all()
-        if self.object_map is not None:
+        if self.object_map is not None and self.object_map.dirty:
             await self.object_map.save()
 
     # ------------------------------------------------------- snapshots

@@ -97,6 +97,19 @@ class UserDB:
             return []
         return sorted(k.decode() for k in omap)
 
+    async def set_quota(self, access: str, max_size: int = -1,
+                        max_objects: int = -1) -> bool:
+        """User quota caps total usage across the user's buckets
+        (rgw_quota.h RGWQuotaInfo user scope)."""
+        user = await self.get(access)
+        if user is None:
+            return False
+        user["quota"] = {"max_size": int(max_size),
+                        "max_objects": int(max_objects)}
+        await self.io.omap_set(USERS_OID, {
+            access.encode(): json.dumps(user).encode()})
+        return True
+
 
 # ---------------------------------------------------------------------- auth
 
@@ -251,13 +264,23 @@ def decode_aws_chunked(body: bytes, secret: Optional[str] = None,
 
 class S3Gateway:
     def __init__(self, rados, pool: str = ".rgw",
-                 require_auth: bool = True, datalog: bool = False):
+                 require_auth: bool = True, datalog: bool = False,
+                 gc_min_wait: float = 0.0, gc_interval: float = 0.0,
+                 lc_interval: float = 0.0):
         self.rados = rados
         self.io = rados.open_ioctx(pool)
         self.users = UserDB(self.io)
         self.require_auth = require_auth
         self._server: Optional[asyncio.AbstractServer] = None
         self.port = 0
+        # deferred deletion of data chains (rgw_gc.cc role); workers
+        # run only when an interval is configured — tests drive
+        # gc.process()/lc_process() directly
+        from ceph_tpu.services.rgw_gc import GarbageCollector
+        self.gc = GarbageCollector(self.io, min_wait=gc_min_wait)
+        self.gc_interval = gc_interval
+        self.lc_interval = lc_interval
+        self._workers: List[asyncio.Task] = []
         # multisite: mutations append to a zone datalog journal that
         # sync agents tail (rgw_data_sync.cc datalog role)
         self.datalog = None
@@ -282,9 +305,26 @@ class S3Gateway:
             await self.datalog.create()
         self._server = await asyncio.start_server(self._client, host, port)
         self.port = self._server.sockets[0].getsockname()[1]
+        if self.gc_interval > 0:
+            self._workers.append(asyncio.ensure_future(
+                self._periodic(self.gc_interval, self.gc.process)))
+        if self.lc_interval > 0:
+            self._workers.append(asyncio.ensure_future(
+                self._periodic(self.lc_interval, self.lc_process)))
         return self.port
 
+    async def _periodic(self, interval: float, fn) -> None:
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await fn()
+            except Exception:
+                pass                    # workers must outlive hiccups
+
     async def stop(self) -> None:
+        for t in self._workers:
+            t.cancel()
+        self._workers.clear()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -419,6 +459,7 @@ class S3Gateway:
             # Swift dialect rides its own token auth, not AWS signatures
             return await self._route_swift(method, path, parts.query,
                                            headers, body)
+        who: Optional[str] = None
         if self.require_auth:
             # signatures cover the path AS SENT (raw), not the decoded
             # form the router uses
@@ -442,9 +483,23 @@ class S3Gateway:
                 return 405, {}, b""
             bucket = segs[0]
             key = "/".join(segs[1:])
+            q = {}
+            for kv in parts.query.split("&"):
+                k, _, v = kv.partition("=")
+                if k:
+                    q[k] = unquote(v)
             if not key:
+                if "lifecycle" in q:
+                    if method == "PUT":
+                        return await self._put_lifecycle(bucket, body)
+                    if method == "GET":
+                        return await self._get_lifecycle(bucket)
+                    if method == "DELETE":
+                        return await self._delete_lifecycle(bucket)
+                    return 405, {}, b""
                 if method == "PUT":
-                    return await self._put_bucket(bucket)
+                    return await self._put_bucket(bucket,
+                                                  owner=who or "")
                 if method == "DELETE":
                     return await self._delete_bucket(bucket)
                 if method == "GET":
@@ -453,11 +508,6 @@ class S3Gateway:
                     return (200 if await self._bucket_exists(bucket)
                             else 404), {}, b""
                 return 405, {}, b""
-            q = {}
-            for kv in parts.query.split("&"):
-                k, _, v = kv.partition("=")
-                if k:
-                    q[k] = unquote(v)
             if method == "POST" and "uploads" in q:
                 return await self._init_multipart(bucket, key)
             if method == "POST" and "uploadId" in q:
@@ -618,6 +668,174 @@ class S3Gateway:
             return False
         return bucket.encode() in omap
 
+    async def _bucket_rec(self, bucket: str) -> Optional[dict]:
+        """The bucket's metadata row: created/owner/quota/usage/
+        lifecycle (rgw_bucket.cc RGWBucketInfo role)."""
+        try:
+            omap = await self.io.omap_get(BUCKETS_OID)
+        except ObjectOperationError:
+            return None
+        raw = omap.get(bucket.encode())
+        return json.loads(raw.decode()) if raw else None
+
+    async def _save_bucket_rec(self, bucket: str, rec: dict) -> None:
+        await self.io.omap_set(BUCKETS_OID, {
+            bucket.encode(): json.dumps(rec).encode()})
+
+    async def _usage_apply(self, bucket: str, dsize: int,
+                           dcount: int) -> None:
+        """Account a publish/delete into the bucket's usage counters
+        (rgw_quota.cc stats update role)."""
+        rec = await self._bucket_rec(bucket)
+        if rec is None:
+            return
+        u = rec.setdefault("usage", {"size": 0, "count": 0})
+        u["size"] = max(0, u.get("size", 0) + dsize)
+        u["count"] = max(0, u.get("count", 0) + dcount)
+        await self._save_bucket_rec(bucket, rec)
+
+    async def _check_quota(self, bucket: str, add_size: int,
+                           add_count: int) -> bool:
+        """Prospective bucket + owner quota check before a write
+        (rgw_quota.cc check_quota)."""
+        from ceph_tpu.services.rgw_gc import QuotaInfo
+        rec = await self._bucket_rec(bucket)
+        if rec is None:
+            return True
+        u = rec.get("usage", {})
+        bq = QuotaInfo.from_dict(rec.get("quota"))
+        if not bq.allows(u.get("size", 0), u.get("count", 0),
+                         add_size, add_count):
+            return False
+        owner = rec.get("owner", "")
+        if owner:
+            user = await self.users.get(owner)
+            if user and user.get("quota"):
+                uq = QuotaInfo.from_dict(user["quota"])
+                tsize = tcount = 0
+                try:
+                    omap = await self.io.omap_get(BUCKETS_OID)
+                except ObjectOperationError:
+                    omap = {}
+                for v in omap.values():
+                    r2 = json.loads(v.decode())
+                    if r2.get("owner", "") == owner:
+                        u2 = r2.get("usage", {})
+                        tsize += u2.get("size", 0)
+                        tcount += u2.get("count", 0)
+                if not uq.allows(tsize, tcount, add_size, add_count):
+                    return False
+        return True
+
+    async def set_bucket_quota(self, bucket: str, max_size: int = -1,
+                               max_objects: int = -1) -> bool:
+        rec = await self._bucket_rec(bucket)
+        if rec is None:
+            return False
+        rec["quota"] = {"max_size": int(max_size),
+                        "max_objects": int(max_objects)}
+        await self._save_bucket_rec(bucket, rec)
+        return True
+
+    # ------------------------------------------------------------ lifecycle
+    # Bucket lifecycle configuration + expiration worker
+    # (rgw_lc.cc / rgw_lc_s3.cc roles).
+
+    async def _put_lifecycle(self, bucket: str, body: bytes):
+        from ceph_tpu.services.rgw_gc import parse_lifecycle_xml
+        rec = await self._bucket_rec(bucket)
+        if rec is None:
+            return 404, {}, _xml_error("NoSuchBucket")
+        try:
+            rules = parse_lifecycle_xml(body)
+        except ValueError:
+            return 400, {}, _xml_error("MalformedXML")
+        rec["lifecycle"] = rules
+        await self._save_bucket_rec(bucket, rec)
+        return 200, {}, b""
+
+    async def _get_lifecycle(self, bucket: str):
+        from ceph_tpu.services.rgw_gc import lifecycle_to_xml
+        rec = await self._bucket_rec(bucket)
+        if rec is None:
+            return 404, {}, _xml_error("NoSuchBucket")
+        if not rec.get("lifecycle"):
+            return 404, {}, _xml_error("NoSuchLifecycleConfiguration")
+        return 200, {"Content-Type": "application/xml"}, \
+            lifecycle_to_xml(rec["lifecycle"])
+
+    async def _delete_lifecycle(self, bucket: str):
+        rec = await self._bucket_rec(bucket)
+        if rec is None:
+            return 404, {}, _xml_error("NoSuchBucket")
+        rec.pop("lifecycle", None)
+        await self._save_bucket_rec(bucket, rec)
+        return 204, {}, b""
+
+    async def lc_process(self, now: Optional[float] = None) -> dict:
+        """One lifecycle pass over every bucket: expire matching
+        objects (through the normal delete path, so chains hit the gc
+        queue) and abort stale incomplete multipart uploads
+        (rgw_lc.cc RGWLC::bucket_lc_process)."""
+        from ceph_tpu.services.rgw_gc import rule_expires
+        now = time.time() if now is None else now
+        expired = aborted = 0
+        try:
+            buckets = await self.io.omap_get(BUCKETS_OID)
+        except ObjectOperationError:
+            buckets = {}
+        for braw, vraw in buckets.items():
+            bucket = braw.decode()
+            rules = json.loads(vraw.decode()).get("lifecycle") or []
+            if not rules:
+                continue
+            exp_rules = [r for r in rules
+                         if r.get("days") is not None
+                         or r.get("date") is not None]
+            if exp_rules:
+                try:
+                    idx = await self.io.omap_get(_index_oid(bucket))
+                except ObjectOperationError:
+                    idx = {}
+                for kraw in sorted(idx):
+                    key = kraw.decode()
+                    meta = json.loads(idx[kraw].decode())
+                    if any(rule_expires(r, meta["mtime"], key, now)
+                           for r in exp_rules):
+                        st, _, _ = await self._delete_object(bucket,
+                                                             key)
+                        if st == 204:
+                            expired += 1
+            abort_rules = [r for r in rules
+                           if r.get("abort_days") is not None
+                           and r.get("status") == "Enabled"]
+            if abort_rules:
+                prefix = f".upload.{bucket}."
+                for oid in await self.io.list_objects():
+                    if not oid.startswith(prefix):
+                        continue
+                    upload_id = oid[len(prefix):]
+                    try:
+                        st = await self.io.omap_get(oid)
+                    except ObjectOperationError:
+                        continue
+                    meta = st.get(b"_meta")
+                    if meta is None:
+                        continue
+                    info = json.loads(meta.decode())
+                    if info.get("bucket", bucket) != bucket:
+                        continue      # dotted sibling bucket's upload
+                    key = info.get("key", "")
+                    if any(key.startswith(r.get("prefix", ""))
+                           and info.get("started", 0)
+                           + r["abort_days"] * 86400.0 <= now
+                           for r in abort_rules):
+                        s, _, _ = await self._abort_multipart(
+                            bucket, key, upload_id)
+                        if s == 204:
+                            aborted += 1
+        return {"expired": expired, "aborted": aborted}
+
     async def _list_buckets(self):
         try:
             omap = await self.io.omap_get(BUCKETS_OID)
@@ -630,12 +848,13 @@ class S3Gateway:
                f"<Buckets>{entries}</Buckets></ListAllMyBucketsResult>")
         return 200, {"Content-Type": "application/xml"}, xml.encode()
 
-    async def _put_bucket(self, bucket: str):
+    async def _put_bucket(self, bucket: str, owner: str = ""):
         if await self._bucket_exists(bucket):
             return 409, {}, _xml_error("BucketAlreadyExists")
         await self.io.omap_set(BUCKETS_OID, {
             bucket.encode(): json.dumps(
-                {"created": time.time()}).encode()})
+                {"created": time.time(), "owner": owner,
+                 "usage": {"size": 0, "count": 0}}).encode()})
         await self.io.write_full(_index_oid(bucket), b"")
         await self._log_change("mkb", bucket)
         return 200, {}, b""
@@ -678,20 +897,41 @@ class S3Gateway:
         return 200, {"Content-Type": "application/xml"}, xml.encode()
 
     # -------------------------------------------------------------- objects
+    @staticmethod
+    def _chain_of(meta: Optional[dict], bucket: str,
+                  key: str) -> List[str]:
+        """The striped objects holding an index entry's bytes: manifest
+        parts, a generation soid, or the legacy fixed soid."""
+        if meta is None:
+            return []
+        if meta.get("manifest"):
+            return [p["soid"] for p in meta["manifest"]]
+        return [meta.get("soid", _data_soid(bucket, key))]
+
     async def _put_object(self, bucket: str, key: str, body: bytes,
                           headers: Dict[str, str]):
         if not await self._bucket_exists(bucket):
             return 404, {}, _xml_error("NoSuchBucket")
+        old = await self._obj_meta(bucket, key)
+        dsize = len(body) - (old["size"] if old else 0)
+        if not await self._check_quota(bucket, max(0, dsize),
+                                       0 if old else 1):
+            return 403, {}, _xml_error("QuotaExceeded")
         st = RadosStriper(self.io)
-        soid = _data_soid(bucket, key)
-        await self._drop_object_data(bucket, key)   # overwrite: old
-        #                              striped data OR manifest parts
+        # each incarnation gets a fresh generation soid (the
+        # reference's tag-prefixed tail objects, rgw_rados.cc): the new
+        # write never collides with bytes a deferred GC chain still
+        # references, and a crash between write and publish leaks only
+        # unreferenced data
+        soid = f"{_data_soid(bucket, key)}.{time.time_ns():x}"
         await st.write(soid, body)
         etag = hashlib.md5(body).hexdigest()
         await self.io.omap_set(_index_oid(bucket), {
             key.encode(): json.dumps({
-                "size": len(body), "etag": etag,
+                "size": len(body), "etag": etag, "soid": soid,
                 "mtime": time.time()}).encode()})
+        await self.gc.defer(self._chain_of(old, bucket, key))
+        await self._usage_apply(bucket, dsize, 0 if old else 1)
         await self._log_change("put", bucket, key)
         return 200, {"ETag": f'"{etag}"'}, b""
 
@@ -719,8 +959,9 @@ class S3Gateway:
                 data = await self._read_manifest(manifest, lo,
                                                  hi - lo + 1)
             else:
-                data = await st.read(_data_soid(bucket, key),
-                                     length=hi - lo + 1, offset=lo)
+                data = await st.read(
+                    meta.get("soid", _data_soid(bucket, key)),
+                    length=hi - lo + 1, offset=lo)
             return 206, {
                 "Content-Range":
                     f"bytes {lo}-{hi}/{meta['size']}",
@@ -728,7 +969,8 @@ class S3Gateway:
         if manifest:
             data = await self._read_manifest(manifest, 0, meta["size"])
         else:
-            data = await st.read(_data_soid(bucket, key))
+            data = await st.read(meta.get("soid",
+                                          _data_soid(bucket, key)))
         return 200, {"ETag": f'"{meta["etag"]}"'}, data
 
     async def _head_object(self, bucket: str, key: str):
@@ -742,8 +984,11 @@ class S3Gateway:
         meta = await self._obj_meta(bucket, key)
         if meta is None:
             return 404, {}, _xml_error("NoSuchKey")
-        await self._drop_object_data(bucket, key)
+        # unlink the index entry now; the bytes die later via the gc
+        # queue (rgw_gc.cc send_chain on delete_obj)
         await self.io.omap_rm_keys(_index_oid(bucket), [key.encode()])
+        await self.gc.defer(self._chain_of(meta, bucket, key))
+        await self._usage_apply(bucket, -meta["size"], -1)
         await self._log_change("del", bucket, key)
         return 204, {}, b""
 
@@ -765,7 +1010,7 @@ class S3Gateway:
         upload_id = hashlib.md5(
             f"{bucket}/{key}/{time.time_ns()}".encode()).hexdigest()[:16]
         await self.io.omap_set(_upload_oid(bucket, upload_id), {
-            b"_meta": json.dumps({"key": key,
+            b"_meta": json.dumps({"key": key, "bucket": bucket,
                                   "started": time.time()}).encode()})
         xml = (f'<?xml version="1.0"?><InitiateMultipartUploadResult>'
                f"<Bucket>{bucket}</Bucket><Key>{quote(key)}</Key>"
@@ -780,7 +1025,12 @@ class S3Gateway:
         except ObjectOperationError:
             return None
         meta = st.get(b"_meta")
-        if meta is None or json.loads(meta.decode())["key"] != key:
+        if meta is None:
+            return None
+        info = json.loads(meta.decode())
+        # `.upload.<bucket>.<id>` is ambiguous when bucket names
+        # contain dots — the recorded bucket is authoritative
+        if info["key"] != key or info.get("bucket", bucket) != bucket:
             return None
         return st
 
@@ -793,6 +1043,16 @@ class S3Gateway:
             return 404, {}, _xml_error("NoSuchUpload")
         if n < 1 or n > 10000:
             return 400, {}, _xml_error("InvalidPartNumber")
+        # prospective quota: committed usage + this upload's other
+        # parts + this part (rgw_op.cc RGWPutObj::verify_permission
+        # quota check covers multipart parts too)
+        pending = sum(json.loads(v.decode())["size"]
+                      for k2, v in state.items()
+                      if k2 not in (b"_meta", f"{n:05d}".encode()))
+        old = await self._obj_meta(bucket, key)
+        if not await self._check_quota(bucket, pending + len(body),
+                                       0 if old else 1):
+            return 403, {}, _xml_error("QuotaExceeded")
         soid = _part_soid(bucket, key, upload_id, n)
         st = RadosStriper(self.io)
         try:
@@ -865,23 +1125,25 @@ class S3Gateway:
             total += meta["size"]
             md5s += bytes.fromhex(meta["etag"])
         final_etag = f"{hashlib.md5(md5s).hexdigest()}-{len(want)}"
-        # drop any previous incarnation's data before republishing
-        await self._drop_object_data(bucket, key)
+        old = await self._obj_meta(bucket, key)
+        if not await self._check_quota(
+                bucket, max(0, total - (old["size"] if old else 0)),
+                0 if old else 1):
+            return 403, {}, _xml_error("QuotaExceeded")
         await self.io.omap_set(_index_oid(bucket), {
             key.encode(): json.dumps({
                 "size": total, "etag": final_etag,
                 "mtime": time.time(), "manifest": manifest}).encode()})
-        # unreferenced parts (uploaded but not listed in Complete) die now
+        # previous incarnation + unreferenced parts (uploaded but not
+        # listed in Complete) go to the gc queue
         listed = {m["soid"] for m in manifest}
-        for k2 in state:
-            if k2 == b"_meta":
-                continue
-            soid = _part_soid(bucket, key, upload_id, int(k2))
-            if soid not in listed:
-                try:
-                    await RadosStriper(self.io).remove(soid)
-                except StripedObjectNotFound:
-                    pass
+        stray = [_part_soid(bucket, key, upload_id, int(k2))
+                 for k2 in state if k2 != b"_meta"]
+        await self.gc.defer(self._chain_of(old, bucket, key)
+                            + [s for s in stray if s not in listed])
+        await self._usage_apply(
+            bucket, total - (old["size"] if old else 0),
+            0 if old else 1)
         await self.io.remove(_upload_oid(bucket, upload_id))
         await self._log_change("put", bucket, key)
         xml = (f'<?xml version="1.0"?><CompleteMultipartUploadResult>'
@@ -895,33 +1157,11 @@ class S3Gateway:
         state = await self._upload_state(bucket, upload_id, key)
         if state is None:
             return 404, {}, _xml_error("NoSuchUpload")
-        for k in state:
-            if k == b"_meta":
-                continue
-            try:
-                await RadosStriper(self.io).remove(
-                    _part_soid(bucket, key, upload_id, int(k)))
-            except StripedObjectNotFound:
-                pass
+        await self.gc.defer([
+            _part_soid(bucket, key, upload_id, int(k))
+            for k in state if k != b"_meta"])
         await self.io.remove(_upload_oid(bucket, upload_id))
         return 204, {}, b""
-
-    async def _drop_object_data(self, bucket: str, key: str) -> None:
-        """Remove the stored bytes behind an index entry (plain striped
-        object or manifest parts)."""
-        meta = await self._obj_meta(bucket, key)
-        st = RadosStriper(self.io)
-        if meta and meta.get("manifest"):
-            for part in meta["manifest"]:
-                try:
-                    await st.remove(part["soid"])
-                except StripedObjectNotFound:
-                    pass
-        else:
-            try:
-                await st.remove(_data_soid(bucket, key))
-            except StripedObjectNotFound:
-                pass
 
     async def _read_manifest(self, manifest: List[dict], offset: int,
                              length: int) -> bytes:

@@ -122,6 +122,10 @@ def daemonize(pidfile: str, logfile: str) -> None:
     """Classic double-fork daemonization (global/global_init.cc
     global_init_daemonize role): detach from the controlling terminal,
     write a pidfile, point stdio at the log."""
+    # resolve BEFORE the chdir below — relative --dir/--pid-file would
+    # silently resolve against / in the detached child
+    pidfile = os.path.abspath(pidfile)
+    logfile = os.path.abspath(logfile)
     if os.fork() > 0:
         os._exit(0)                      # parent returns to the shell
     os.setsid()

@@ -48,6 +48,32 @@ async def run(args) -> int:
             elif args.op == "ls":
                 print(json.dumps(await db.list()))
             return 0
+        if args.cmd == "gc":
+            from ceph_tpu.services.rgw_gc import GarbageCollector
+            gc = GarbageCollector(io)
+            if args.op == "list":
+                print(json.dumps([
+                    {"tag": t, "ready": ready, "objs": soids}
+                    for t, ready, soids in await gc.entries()]))
+            else:                                  # process
+                print(json.dumps({"removed": await gc.process()}))
+            return 0
+        if args.cmd == "lc":
+            gw = S3Gateway(r, pool=args.pool, require_auth=False)
+            print(json.dumps(await gw.lc_process()))
+            return 0
+        if args.cmd == "quota":
+            if args.bucket:
+                gw = S3Gateway(r, pool=args.pool, require_auth=False)
+                ok = await gw.set_bucket_quota(args.bucket,
+                                               args.max_size,
+                                               args.max_objects)
+            else:
+                ok = await UserDB(io).set_quota(args.access,
+                                                args.max_size,
+                                                args.max_objects)
+            print(json.dumps({"set": ok}))
+            return 0 if ok else 1
         if args.cmd == "serve":
             gw = S3Gateway(r, pool=args.pool,
                            require_auth=not args.no_auth)
@@ -75,6 +101,14 @@ def main(argv=None) -> int:
     u.add_argument("--access", default="")
     u.add_argument("--secret", default="")
     u.add_argument("--display", default="")
+    g = sub.add_parser("gc")
+    g.add_argument("op", choices=("list", "process"))
+    sub.add_parser("lc")
+    q = sub.add_parser("quota")
+    q.add_argument("--access", default="")
+    q.add_argument("--bucket", default="")
+    q.add_argument("--max-size", type=int, default=-1)
+    q.add_argument("--max-objects", type=int, default=-1)
     s = sub.add_parser("serve")
     s.add_argument("--port", type=int, default=7480)
     s.add_argument("--no-auth", action="store_true")

@@ -641,3 +641,165 @@ def test_multisite_zone_sync():
         await gw_b.stop()
         await cl.stop()
     asyncio.run(run())
+
+
+def test_gc_deferred_chain_collection():
+    """Deletes/overwrites queue their data chains in .rgw.gc; the bytes
+    survive until gc.process() collects ready chains (rgw_gc.cc)."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create(".rgw", pg_num=8)
+        gw = S3Gateway(admin, require_auth=False)
+        port = await gw.start()
+        c = S3Client(port)
+
+        await c.request("PUT", "/b", sign=False)
+        await c.request("PUT", "/b/one.bin", b"A" * 9000, sign=False)
+        await c.request("PUT", "/b/one.bin", b"B" * 9000, sign=False)
+        await c.request("PUT", "/b/dead.bin", b"C" * 9000, sign=False)
+        await c.request("DELETE", "/b/dead.bin", sign=False)
+
+        ents = await gw.gc.entries()
+        assert len(ents) == 2          # overwritten chain + deleted chain
+        before = len(await gw.io.list_objects())
+        removed = await gw.gc.process()
+        assert removed >= 2
+        assert len(await gw.io.list_objects()) < before
+        assert not await gw.gc.entries()
+        # live object unaffected by collection
+        st, _, got = await c.request("GET", "/b/one.bin", sign=False)
+        assert st == 200 and got == b"B" * 9000
+
+        # min_wait holds chains back until their time comes
+        gw.gc.min_wait = 3600.0
+        await c.request("DELETE", "/b/one.bin", sign=False)
+        assert await gw.gc.process() == 0
+        assert len(await gw.gc.entries()) == 1
+        assert await gw.gc.process(now=__import__("time").time()
+                                   + 7200) >= 1
+        await gw.stop()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_lifecycle_config_and_expiration():
+    """?lifecycle config round-trip + the lc worker expiring objects by
+    prefix/age and aborting stale multipart uploads (rgw_lc.cc)."""
+    import time as _time
+
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create(".rgw", pg_num=8)
+        gw = S3Gateway(admin, require_auth=False)
+        port = await gw.start()
+        c = S3Client(port)
+        await c.request("PUT", "/lc", sign=False)
+
+        # no config yet
+        st, _, body = await c.request("GET", "/lc?lifecycle", sign=False)
+        assert st == 404 and b"NoSuchLifecycleConfiguration" in body
+
+        cfg = (b'<LifecycleConfiguration><Rule><ID>exp</ID>'
+               b'<Prefix>logs/</Prefix><Status>Enabled</Status>'
+               b'<Expiration><Days>7</Days></Expiration></Rule>'
+               b'<Rule><Prefix></Prefix><Status>Enabled</Status>'
+               b'<AbortIncompleteMultipartUpload>'
+               b'<DaysAfterInitiation>2</DaysAfterInitiation>'
+               b'</AbortIncompleteMultipartUpload></Rule>'
+               b'</LifecycleConfiguration>')
+        st, _, _ = await c.request("PUT", "/lc?lifecycle", cfg,
+                                   sign=False)
+        assert st == 200
+        st, _, body = await c.request("GET", "/lc?lifecycle", sign=False)
+        assert st == 200 and b"<Days>7</Days>" in body \
+            and b"<DaysAfterInitiation>2</DaysAfterInitiation>" in body
+        # malformed config refused
+        st, _, _ = await c.request("PUT", "/lc?lifecycle",
+                                   b"<LifecycleConfiguration/>",
+                                   sign=False)
+        assert st == 400
+
+        await c.request("PUT", "/lc/logs/a.log", b"x" * 4000,
+                        sign=False)
+        await c.request("PUT", "/lc/keep.dat", b"y" * 4000, sign=False)
+        st, _, _ = await c.request("POST", "/lc/stale?uploads", b"",
+                                   sign=False)
+        # nothing expires at now
+        res = await gw.lc_process()
+        assert res == {"expired": 0, "aborted": 0}
+        # 8 days later: logs/ expired, keep.dat alive, upload aborted
+        res = await gw.lc_process(now=_time.time() + 8 * 86400)
+        assert res["expired"] == 1 and res["aborted"] == 1
+        st, _, _ = await c.request("GET", "/lc/logs/a.log", sign=False)
+        assert st == 404
+        st, _, _ = await c.request("GET", "/lc/keep.dat", sign=False)
+        assert st == 200
+
+        # DELETE ?lifecycle removes the config
+        st, _, _ = await c.request("DELETE", "/lc?lifecycle", sign=False)
+        assert st == 204
+        st, _, _ = await c.request("GET", "/lc?lifecycle", sign=False)
+        assert st == 404
+        await gw.stop()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_quota_enforcement_and_usage_accounting():
+    """Bucket + user quota (max_size/max_objects) refuse writes that
+    would exceed the caps; usage counters track put/delete/multipart
+    (rgw_quota.cc check_quota)."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create(".rgw", pg_num=8)
+        gw = S3Gateway(admin)
+        io = admin.open_ioctx(".rgw")
+        db = UserDB(io)
+        await db.create("AKID", "sekrit")
+        port = await gw.start()
+        c = S3Client(port, "AKID", "sekrit")
+
+        await c.request("PUT", "/q")               # owner = AKID
+        assert await gw.set_bucket_quota("q", max_size=10000,
+                                         max_objects=3)
+        st, _, _ = await c.request("PUT", "/q/a", b"x" * 6000)
+        assert st == 200
+        st, _, body = await c.request("PUT", "/q/b", b"x" * 6000)
+        assert st == 403 and b"QuotaExceeded" in body
+        # overwrite that shrinks is fine; growth past cap is not
+        st, _, _ = await c.request("PUT", "/q/a", b"x" * 2000)
+        assert st == 200
+        st, _, _ = await c.request("PUT", "/q/b", b"x" * 6000)
+        assert st == 200
+        rec = await gw._bucket_rec("q")
+        assert rec["usage"] == {"size": 8000, "count": 2}
+        # object-count cap
+        await c.request("PUT", "/q/c", b"z")
+        st, _, body = await c.request("PUT", "/q/d", b"z")
+        assert st == 403 and b"QuotaExceeded" in body
+        # delete releases quota
+        await c.request("DELETE", "/q/c")
+        st, _, _ = await c.request("PUT", "/q/d", b"z")
+        assert st == 200
+        # multipart parts are checked too
+        import re as _re
+        st, _, body = await c.request("POST", "/q/mp?uploads", b"")
+        uid = _re.search(rb"<UploadId>([^<]+)</UploadId>",
+                         body).group(1).decode()
+        st, _, body = await c.request(
+            "PUT", f"/q/mp?partNumber=1&uploadId={uid}", b"x" * 9000)
+        assert st == 403 and b"QuotaExceeded" in body
+
+        # user quota caps the SUM across the owner's buckets
+        assert await db.set_quota("AKID", max_size=12000)
+        await c.request("PUT", "/q2")
+        st, _, body = await c.request("PUT", "/q2/big", b"x" * 6000)
+        assert st == 403 and b"QuotaExceeded" in body
+        st, _, _ = await c.request("PUT", "/q2/ok", b"x" * 3000)
+        assert st == 200
+        await gw.stop()
+        await cl.stop()
+    asyncio.run(run())
