@@ -223,6 +223,9 @@ class ObjectStore:
         if kind == "blockstore":
             from ceph_tpu.store.blockstore import BlockStore
             return BlockStore(path)
+        if kind == "kstore":
+            from ceph_tpu.store.kstore import KStore
+            return KStore(path)
         raise ValueError(f"unknown objectstore kind {kind!r}")
 
     # lifecycle
