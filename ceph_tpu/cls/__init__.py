@@ -186,3 +186,4 @@ from ceph_tpu.cls import lock as _lock    # noqa: E402,F401
 from ceph_tpu.cls import rbd as _rbd      # noqa: E402,F401
 from ceph_tpu.cls import journal as _journal    # noqa: E402,F401
 from ceph_tpu.cls import refcount as _refcount  # noqa: E402,F401
+from ceph_tpu.cls import inotable as _inotable  # noqa: E402,F401

@@ -1,0 +1,28 @@
+"""cls_inotable: atomic inode-number block allocation on the OSD.
+
+Reference parity: src/mds/InoTable.cc — each MDS rank claims disjoint
+inode-number intervals from a shared table so concurrent ranks never
+hand out the same ino.  The reference projects+journals interval sets
+per rank; here the claim itself runs server-side next to the table
+object (cls atomicity), which is the property that matters: two ranks
+racing alloc_block get disjoint [base, base+count) windows.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ceph_tpu.cls import ClsContext, cls_method
+
+
+@cls_method("inotable.alloc_block", writes=True)
+def alloc_block(hctx: ClsContext, inbl: bytes):
+    """in: {count} -> {base}: claim [base, base+count)."""
+    req = json.loads(inbl.decode()) if inbl else {}
+    count = int(req.get("count", 1))
+    if count < 1:
+        return -22, b""                    # EINVAL
+    omap = hctx.omap_get()
+    nxt = int(omap.get(b"next", b"2"))
+    hctx.omap_set({b"next": str(nxt + count).encode()})
+    return 0, json.dumps({"base": nxt}).encode()

@@ -77,7 +77,10 @@ class FSMonitor:
         prefix = m.cmd.get("prefix", "")
         if prefix == "mds boot":
             self.pending[m.cmd["name"]] = {
-                "addr": m.cmd["addr"], "stamp": time.time()}
+                "addr": m.cmd["addr"], "stamp": time.time(),
+                # multi-rank: daemons boot with an explicit rank and
+                # clients/peers look ranks up from the committed map
+                "rank": int(m.cmd.get("rank", 0))}
             if not (self.mon.is_leader()
                     and self.mon.paxos.is_writeable()):
                 # queued: refresh() proposes once paxos is writeable;

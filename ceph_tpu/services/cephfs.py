@@ -7,13 +7,19 @@ directly into the data pool using the file layout (<ino>.<block>
 objects, here via RadosStriper on soid `<ino hex>`), sizes propagate
 back to the MDS on close/flush (cap flush role).
 
-Redesign notes: dentry LEASES (the client-caps fast path,
-client/Client.cc lease handling + mds/Locker.cc): lookups return a TTL
-lease and cache locally, so repeated stats are RPC-free; the MDS
-revokes leases (MClientLease) when another client mutates the dentry,
-and local mutations invalidate the local cache (prefix-wide, so a
-renamed directory drops its cached subtree).  Single active MDS
-addressed directly instead of an mdsmap.
+Redesign notes:
+  * Paths resolve by a component-wise WALK (Client::path_walk): each
+    step asks the owning MDS rank for the dentry (dir ino, name) and
+    caches the answer under a TTL lease — the client-caps fast path
+    (client/Client.cc lease handling + mds/Locker.cc).  Repeated stats
+    are RPC-free; the MDS revokes leases (MClientLease) when another
+    client mutates a dentry.
+  * Multi-rank: the target rank for any op is COMPUTED from the parent
+    dir ino (services/mds.py owner_rank) — no mdsmap round-trip, the
+    same placement-is-computed design as the data path's CRUSH.
+  * Lease cache keys are (dir ino, name) dentry identities, not paths:
+    renaming an ancestor directory does NOT invalidate cached child
+    dentries, because the dentries themselves never changed.
 """
 
 from __future__ import annotations
@@ -26,7 +32,8 @@ from ceph_tpu.client.rados_striper import (RadosStriper,
                                            StripedObjectNotFound)
 from ceph_tpu.msg.messenger import Dispatcher
 from ceph_tpu.services.mds import (MClientLease, MClientReply,
-                                   MClientRequest, norm_path)
+                                   MClientRequest, ROOT_INO, lease_key,
+                                   norm_path, owner_rank)
 
 
 class CephFSError(OSError):
@@ -37,19 +44,25 @@ def _file_soid(ino: int) -> str:
     return f"{ino:x}"
 
 
+ROOT_ENT = {"ino": ROOT_INO, "type": "dir", "size": 0, "mtime": 0}
+
+
 class CephFS(Dispatcher):
-    def __init__(self, rados, mds_addr, data_pool: str):
+    def __init__(self, rados, mds_addrs, data_pool: str):
         self.rados = rados
         self.messenger = rados.messenger
         self.messenger.add_dispatcher(self)
-        self.mds_addr = mds_addr
+        # one addr (single rank) or a rank-ordered list
+        self.mds_addrs = (list(mds_addrs)
+                          if isinstance(mds_addrs, (list, tuple))
+                          else [mds_addrs])
         self.data_io = rados.open_ioctx(data_pool)
         # random tid base: several mounts can share one messenger and
         # must never collide on reply matching
         import random
         self._tid = random.getrandbits(32) << 20
         self._pending: Dict[int, asyncio.Future] = {}
-        # dentry lease cache: norm path -> (ent, expiry)
+        # dentry lease cache: lease_key(dir, name) -> (ent, expiry)
         self._leases: Dict[str, tuple] = {}
         self._revoke_epoch = 0       # bumps on every MClientLease
         self.lease_hits = 0          # observability for tests/perf
@@ -64,8 +77,8 @@ class CephFS(Dispatcher):
                 fut.set_result(m)
             return True
         if isinstance(m, MClientLease):
-            for p in m.paths:
-                self._leases.pop(p, None)
+            for key in m.paths:
+                self._leases.pop(key, None)
             # a lookup reply may already be resolved but its coroutine
             # not yet resumed: bump the epoch so its late cache insert
             # is discarded (revoke means drop NOW, not drop-then-recache)
@@ -74,30 +87,28 @@ class CephFS(Dispatcher):
         return False
 
     # --------------------------------------------------------------- leases
-    def _lease_get(self, path: str) -> Optional[dict]:
+    def _lease_get(self, dir_ino: int, name: str) -> Optional[dict]:
         import time
-        ent = self._leases.get(norm_path(path))
+        ent = self._leases.get(lease_key(dir_ino, name))
         if ent is not None and ent[1] > time.time():
             self.lease_hits += 1
             return ent[0]
         return None
 
-    def _lease_drop(self, *paths: str) -> None:
-        """Local mutation: drop the paths AND anything cached under
-        them (a renamed dir invalidates its subtree)."""
-        keys = [norm_path(p) for p in paths]
-        for lp in list(self._leases):
-            if any(lp == k or lp.startswith(k + "/") for k in keys):
-                del self._leases[lp]
+    def _lease_drop(self, dir_ino: int, name: str) -> None:
+        self._leases.pop(lease_key(dir_ino, name), None)
 
-    async def _request(self, op: str, timeout: float = 30.0,
-                       **args) -> dict:
+    async def _request(self, dir_ino: int, op: str,
+                       timeout: float = 30.0, **args) -> dict:
+        """Send `op` to the rank owning `dir_ino`."""
+        rank = owner_rank(dir_ino, len(self.mds_addrs))
         self._tid += 1
         tid = self._tid
         fut = asyncio.get_running_loop().create_future()
         self._pending[tid] = fut
         self.messenger.send_message(MClientRequest(op, args, tid),
-                                    self.mds_addr, peer_type="mds")
+                                    self.mds_addrs[rank],
+                                    peer_type="mds")
         try:
             reply: MClientReply = await asyncio.wait_for(fut, timeout)
         finally:
@@ -107,9 +118,50 @@ class CephFS(Dispatcher):
                               f"{op} {args}: {reply.data}")
         return reply.data
 
+    # ------------------------------------------------------------ walking
+    async def _lookup(self, dir_ino: int, name: str) -> dict:
+        """One walk step: lease cache, else RPC to the owner rank
+        (granting a fresh lease)."""
+        cached = self._lease_get(dir_ino, name)
+        if cached is not None:
+            return cached
+        epoch = self._revoke_epoch
+        data = await self._request(dir_ino, "lookup", dir=dir_ino,
+                                   name=name)
+        if data.get("lease_ttl") and epoch == self._revoke_epoch:
+            # no revoke raced the lookup: safe to cache
+            import time
+            self._leases[lease_key(dir_ino, name)] = (
+                data["ent"], time.time() + data["lease_ttl"])
+        return data["ent"]
+
+    async def _walk(self, path: str) -> dict:
+        """Resolve a full path -> entry (Client::path_walk)."""
+        ent = ROOT_ENT
+        for name in [p for p in path.split("/") if p]:
+            if ent["type"] != "dir":
+                raise CephFSError(errno.ENOTDIR, path)
+            ent = await self._lookup(ent["ino"], name)
+        return ent
+
+    async def _walk_parent(self, path: str) -> tuple:
+        """-> (parent dir ino, final component name)."""
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            raise CephFSError(errno.EINVAL, "root has no name")
+        ent = ROOT_ENT
+        for name in parts[:-1]:
+            if ent["type"] != "dir":
+                raise CephFSError(errno.ENOTDIR, path)
+            ent = await self._lookup(ent["ino"], name)
+        if ent["type"] != "dir":
+            raise CephFSError(errno.ENOTDIR, path)
+        return ent["ino"], parts[-1]
+
     # ------------------------------------------------------------ metadata
     async def mkdir(self, path: str) -> None:
-        await self._request("mkdir", path=path)
+        d, name = await self._walk_parent(path)
+        await self._request(d, "mkdir", dir=d, name=name)
 
     async def makedirs(self, path: str) -> None:
         parts = [p for p in path.split("/") if p]
@@ -117,35 +169,36 @@ class CephFS(Dispatcher):
         for p in parts:
             cur += "/" + p
             try:
-                await self._request("mkdir", path=cur)
+                await self.mkdir(cur)
             except CephFSError as e:
                 if e.errno != errno.EEXIST:
                     raise
 
     async def listdir(self, path: str) -> List[str]:
-        data = await self._request("readdir", path=path)
+        ent = await self._walk(path)
+        if ent["type"] != "dir":
+            raise CephFSError(errno.ENOTDIR, path)
+        data = await self._request(ent["ino"], "readdir",
+                                   dir=ent["ino"])
         return sorted(data["entries"])
 
     async def stat(self, path: str) -> dict:
-        cached = self._lease_get(path)
-        if cached is not None:
-            return cached
-        epoch = self._revoke_epoch
-        data = await self._request("lookup", path=path)
-        if data.get("lease_ttl") and epoch == self._revoke_epoch:
-            # no revoke raced the lookup: safe to cache
-            import time
-            self._leases[norm_path(path)] = (
-                data["ent"], time.time() + data["lease_ttl"])
-        return data["ent"]
+        return await self._walk(path)
 
     async def rename(self, src: str, dst: str) -> None:
-        await self._request("rename", src=src, dst=dst)
-        self._lease_drop(src, dst)
+        sd, sn = await self._walk_parent(src)
+        dd, dn = await self._walk_parent(dst)
+        # served by the DESTINATION dir's owner (which peers to the
+        # source owner when they differ)
+        await self._request(dd, "rename", srcdir=sd, srcname=sn,
+                            dstdir=dd, dstname=dn)
+        self._lease_drop(sd, sn)
+        self._lease_drop(dd, dn)
 
     async def unlink(self, path: str) -> None:
-        data = await self._request("unlink", path=path)
-        self._lease_drop(path)
+        d, name = await self._walk_parent(path)
+        data = await self._request(d, "unlink", dir=d, name=name)
+        self._lease_drop(d, name)
         # the MDS dropped the dentry; the data objects are ours to reap
         # (client-driven purge, the reference queues this on the MDS
         # PurgeQueue — acceptable divergence, documented)
@@ -156,20 +209,23 @@ class CephFS(Dispatcher):
             pass
 
     async def rmdir(self, path: str) -> None:
-        await self._request("rmdir", path=path)
-        self._lease_drop(path)
+        d, name = await self._walk_parent(path)
+        await self._request(d, "rmdir", dir=d, name=name)
+        self._lease_drop(d, name)
 
     # ------------------------------------------------------------ file io
     async def open(self, path: str, mode: str = "r") -> "File":
         if mode not in ("r", "w", "a", "r+", "w+"):
             raise ValueError(f"mode {mode!r}")
+        d, name = await self._walk_parent(path)
         if "w" in mode or "a" in mode or "+" in mode:
-            data = await self._request("create", path=path)
+            data = await self._request(d, "create", dir=d, name=name)
         else:
-            data = await self._request("lookup", path=path)
-            if data["ent"]["type"] != "file":
+            ent = await self._lookup(d, name)
+            if ent["type"] != "file":
                 raise CephFSError(errno.EISDIR, path)
-        f = File(self, path, data["ent"], mode)
+            data = {"ent": ent}
+        f = File(self, d, name, data["ent"], mode)
         if mode.startswith("w"):
             await f.truncate(0)
         if mode == "a":
@@ -193,9 +249,11 @@ class CephFS(Dispatcher):
 class File:
     """An open file handle (Client::Fh)."""
 
-    def __init__(self, fs: CephFS, path: str, ent: dict, mode: str):
+    def __init__(self, fs: CephFS, dir_ino: int, name: str, ent: dict,
+                 mode: str):
         self.fs = fs
-        self.path = path
+        self.dir_ino = dir_ino
+        self.name = name
         self.ino = ent["ino"]
         self.size = ent["size"]
         self.mode = mode
@@ -241,8 +299,9 @@ class File:
 
     async def flush(self) -> None:
         if self._dirty_size:
-            self.fs._lease_drop(self.path)
-            await self.fs._request("setattr", path=self.path,
+            self.fs._lease_drop(self.dir_ino, self.name)
+            await self.fs._request(self.dir_ino, "setattr",
+                                   dir=self.dir_ino, name=self.name,
                                    size=self.size)
             self._dirty_size = False
 

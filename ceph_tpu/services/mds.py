@@ -8,8 +8,18 @@ numbers, and src/client/Client.cc's request/reply protocol distilled to
 MClientRequest/MClientReply.
 
 Redesign notes:
-  * ONE active MDS (subtree partitioning/migration are out of scope),
-    but with the reference's MDLog write-back design (mds/MDLog.cc +
+  * MULTI-RANK: directory authority is COMPUTED — owner_rank(ino) hashes
+    the dir ino over the active ranks (vs the reference's stateful
+    subtree bounds + Migrator exports + MDBalancer, mds/MDCache.cc /
+    mds/MDBalancer.cc).  Every op names (parent dir ino, name) and is
+    served by the parent's owner; clients walk paths component-wise
+    against their dentry-lease cache (client/Client.cc path_walk).
+    Cross-rank compound ops (rmdir/rename spanning two owners) run as
+    peer requests — the MMDSSlaveRequest role — issued with the local
+    mutex released so mutually-peering ranks cannot deadlock.  Each
+    rank claims disjoint ino blocks via an atomic cls call
+    (InoTable.cc interval claim) and keeps its own MDLog.
+  * Each rank runs the reference's MDLog write-back design (mds/MDLog.cc +
     journal/EMetaBlob): every mutation journals its dentry-level
     EFFECTS (EMetaBlob role) to a RADOS journal (journal/journaler.py
     — the same machinery rbd-mirror and rgw multisite ride), applies
@@ -42,10 +52,33 @@ from ceph_tpu.common.encoding import Decoder, Encoder
 ROOT_INO = 1
 INOTABLE_OID = "mds_inotable"
 LEASE_TTL = 5.0         # dentry lease seconds (mds_lease default role)
+INO_BLOCK = 256         # inos claimed per cls alloc_block (InoTable)
 
 
 def norm_path(path: str) -> str:
     return "/" + "/".join(p for p in path.split("/") if p)
+
+
+def owner_rank(ino: int, nranks: int) -> int:
+    """Which MDS rank is authoritative for a directory inode.
+
+    COMPUTED subtree partitioning: the reference delegates dirfrag
+    authority via explicit subtree bounds + Migrator exports
+    (mds/MDCache.cc subtree map, mds/MDBalancer.cc); here authority is
+    a pure function of the ino — the same placement-is-computed design
+    CRUSH gives the data path, so clients and every rank agree with
+    zero coordination state."""
+    if nranks <= 1:
+        return 0
+    from ceph_tpu.crush.hashfn import hash32_2
+    return hash32_2(ino & 0xFFFFFFFF, (ino >> 32) & 0xFFFFFFFF) % nranks
+
+
+def lease_key(dir_ino: int, name: str) -> str:
+    """Dentry identity for the lease tables: (parent dirfrag, name) —
+    the reference's dentry lease granularity (mds/Locker.cc), NOT a
+    path: renames of an ancestor don't invalidate it."""
+    return f"{dir_ino}:{name}"
 
 
 @register_message
@@ -118,9 +151,18 @@ class MDS(Dispatcher):
     def __init__(self, ctx, messenger, rados, metadata_pool: str,
                  mds_log: bool = True,
                  log_flush_interval: float = 1.0,
-                 log_flush_events: int = 64):
+                 log_flush_events: int = 64,
+                 rank: int = 0, nranks: int = 1):
         self.ctx = ctx
         self.log = ctx.logger("mds")
+        self.rank = rank
+        self.nranks = max(1, nranks)
+        # rank -> messenger addr of the peer MDS (multi-rank only;
+        # wired by vstart/tests after every rank has bound)
+        self.peers: Dict[int, object] = {}
+        self._peer_tid = 0
+        self._peer_base = None          # lazy random tid base
+        self._peer_pending: Dict[int, object] = {}
         self.messenger = messenger
         messenger.add_dispatcher(self)
         self.rados = rados
@@ -140,6 +182,7 @@ class MDS(Dispatcher):
         self._gone_dirs: set = set()        # rmdir'd dir inos
         self._new_dirs: set = set()         # mkdir'd, not yet flushed
         self._next_ino: Optional[int] = None
+        self._ino_end = 0               # exclusive end of claimed block
         self._ino_dirty = False
         self._unflushed = 0                 # events since last flush
         self._last_seq = 0
@@ -167,7 +210,10 @@ class MDS(Dispatcher):
             return
         import asyncio
         from ceph_tpu.journal import Journaler
-        self._mdlog = Journaler(self.io, "mdlog")
+        # one MDLog per rank (MDLog journal inos 0x200+rank); rank 0
+        # keeps the bare name so single-rank deployments are unchanged
+        log_name = "mdlog" if self.rank == 0 else f"mdlog.{self.rank}"
+        self._mdlog = Journaler(self.io, log_name)
         if not await self._mdlog.exists():
             await self._mdlog.create()
         await self._mdlog.register_client("mds")
@@ -311,9 +357,8 @@ class MDS(Dispatcher):
                 await self.io.remove(dir_oid(ino))
             except ObjectOperationError:
                 pass
-        if self._ino_dirty and self._next_ino:
-            await self.io.omap_set(INOTABLE_OID, {
-                b"next": str(self._next_ino).encode()})
+        # (the inotable needs no write-back: block claims are made
+        # durable atomically by the cls alloc itself)
         # everything durable: clear bookkeeping, commit + trim the log
         self._dirty.clear()
         self._removed.clear()
@@ -335,18 +380,19 @@ class MDS(Dispatcher):
                 self.log.exception("mdlog flush failed")
 
     async def _alloc_ino(self) -> int:
-        if self._mdlog is not None:
-            if self._next_ino is None:
-                omap = await self.io.omap_get(INOTABLE_OID)
-                self._next_ino = int(omap.get(b"next", b"2"))
-            ino = self._next_ino
-            self._next_ino = ino + 1
-            return ino
-        omap = await self.io.omap_get(INOTABLE_OID)
-        nxt = int(omap.get(b"next", b"2"))
-        await self.io.omap_set(INOTABLE_OID,
-                               {b"next": str(nxt + 1).encode()})
-        return nxt
+        """Claim from this rank's ino block; refill via the atomic
+        cls alloc (InoTable.cc interval claim) — concurrent ranks get
+        disjoint windows, so no rank can mint a duplicate ino."""
+        if self._next_ino is None or self._next_ino >= self._ino_end:
+            resp = await self.io.exec(
+                INOTABLE_OID, "inotable", "alloc_block",
+                json.dumps({"count": INO_BLOCK}).encode())
+            base = json.loads(resp.decode())["base"]
+            self._next_ino = base
+            self._ino_end = base + INO_BLOCK
+        ino = self._next_ino
+        self._next_ino = ino + 1
+        return ino
 
     # -------------------------------------------------------------- helpers
     async def _dir_entries(self, ino: int) -> Dict[str, dict]:
@@ -378,84 +424,108 @@ class MDS(Dispatcher):
             return None
         return ents.get(name)
 
-    async def _resolve(self, path: str) -> Tuple[int, dict]:
-        """-> (parent dir ino of final component, dentry dict) for the
-        full path; root resolves to (0, root-dir pseudo entry)."""
-        parts = [p for p in path.split("/") if p]
-        ino = ROOT_INO
-        ent = {"ino": ROOT_INO, "type": "dir", "size": 0, "mtime": 0}
-        parent = 0
-        for i, name in enumerate(parts):
-            d = await self._dentry(ino, name)
-            if d is None:
-                raise FileNotFoundError(path)
-            parent = ino
-            ent = d
-            if i < len(parts) - 1:
-                if d["type"] != "dir":
-                    raise NotADirectoryError(path)
-                ino = d["ino"]
-        return parent, ent
-
-    @staticmethod
-    def _split(path: str) -> Tuple[str, str]:
-        parts = [p for p in path.split("/") if p]
-        if not parts:
-            raise ValueError("root has no name")
-        return "/" + "/".join(parts[:-1]), parts[-1]
-
     # ------------------------------------------------------------- dispatch
     def ms_dispatch(self, m: Message) -> bool:
         if isinstance(m, MClientRequest):
             import asyncio
             asyncio.get_running_loop().create_task(self._handle(m))
             return True
+        if isinstance(m, MClientReply):
+            fut = self._peer_pending.pop(m.tid, None)
+            if fut is None:
+                return False
+            if not fut.done():
+                fut.set_result(m)
+            return True
         return False
+
+    # --------------------------------------------------------- peer calls
+    # Cross-rank requests (the MMDSSlaveRequest role) ride the SAME
+    # MClientRequest protocol: a rank is just another client of its
+    # peer.  Calls are made with the local MDS mutex RELEASED (see
+    # _handle) — two ranks peering at each other simultaneously must
+    # not deadlock on each other's mutex.
+
+    def _owner(self, ino: int) -> int:
+        return owner_rank(ino, self.nranks)
+
+    async def _peer_request(self, rank: int, op: str,
+                            timeout: float = 30.0, **args) -> dict:
+        import asyncio
+        import random
+        if self._peer_base is None:
+            self._peer_base = random.getrandbits(32) << 20
+            self._peer_tid = self._peer_base
+        self._peer_tid += 1
+        tid = self._peer_tid
+        fut = asyncio.get_running_loop().create_future()
+        self._peer_pending[tid] = fut
+        self.messenger.send_message(MClientRequest(op, args, tid),
+                                    self.peers[rank], peer_type="mds")
+        try:
+            reply: MClientReply = await asyncio.wait_for(fut, timeout)
+        finally:
+            self._peer_pending.pop(tid, None)
+        if reply.result < 0:
+            raise OSError(-reply.result, f"peer {op} {args}")
+        return reply.data
 
     # ------------------------------------------------------------- leases
     MUTATORS = ("mkdir", "create", "setattr", "unlink", "rmdir",
-                "rename")
+                "rename", "peer_rm")
 
-    def _grant_lease(self, path: str, m: MClientRequest,
+    def _grant_lease(self, key: str, m: MClientRequest,
                      data: dict) -> None:
-        key = norm_path(path)
         holders = self._leases.setdefault(key, {})
         holders[str(m.src_name)] = (m.src_addr,
                                     time.time() + LEASE_TTL)
         data["lease_ttl"] = LEASE_TTL
 
-    def _revoke_leases(self, m: MClientRequest, paths: List[str]) -> None:
-        """Mutation: every OTHER holder of a lease on (or under) an
-        affected path gets a revoke (Locker::revoke_client_leases)."""
-        keys = [norm_path(p) for p in paths]
+    def _revoke_leases(self, m: MClientRequest,
+                       keys: List[str]) -> None:
+        """Mutation: every OTHER holder of a lease on an affected
+        dentry gets a revoke (Locker::revoke_client_leases).  Keys are
+        (dir ino, name) dentry identities: because every lookup of a
+        dentry is served by its owner rank, the owner's lease table is
+        complete — no cross-rank lease state exists."""
         victims: Dict[str, tuple] = {}
         # revoke REGARDLESS of MDS-side expiry: the client's
         # clock stamps its lease AFTER the reply round-trip, so its
         # expiry is always later than ours — skipping "expired" holders
         # would leave a stale-read window at the TTL boundary
-        for lp in list(self._leases):
-            if any(lp == k or lp.startswith(k + "/") for k in keys):
-                for who, (addr, exp) in self._leases.pop(lp).items():
-                    if who != str(m.src_name):
-                        ent = victims.setdefault(who, (addr, []))
-                        if lp not in ent[1]:
-                            ent[1].append(lp)
-        for who, (addr, paths_) in victims.items():
-            self.messenger.send_message(MClientLease(paths_), addr,
+        for key in keys:
+            for who, (addr, exp) in self._leases.pop(key, {}).items():
+                if who != str(m.src_name):
+                    ent = victims.setdefault(who, (addr, []))
+                    if key not in ent[1]:
+                        ent[1].append(key)
+        for who, (addr, keys_) in victims.items():
+            self.messenger.send_message(MClientLease(keys_), addr,
                                         peer_type="client")
+
+    def _revoke_all(self, keys: List[str]) -> None:
+        """Revoke EVERY holder (rollback paths have no requester to
+        exempt)."""
+        for key in keys:
+            for who, (addr, _) in self._leases.pop(key, {}).items():
+                self.messenger.send_message(MClientLease([key]), addr,
+                                            peer_type="client")
 
     async def _handle(self, m: MClientRequest) -> None:
         try:
-            async with self._mutex:
-                data = await self._execute(m.op, m.args)
-                if m.op == "lookup":
-                    self._grant_lease(m.args["path"], m, data)
-                elif m.op in self.MUTATORS:
-                    if m.op == "rename":
-                        self._revoke_leases(m, [m.args["src"],
-                                                m.args["dst"]])
-                    else:
-                        self._revoke_leases(m, [m.args["path"]])
+            data = await self._execute(m.op, m.args)
+            a = m.args
+            if m.op == "lookup":
+                self._grant_lease(lease_key(a["dir"], a["name"]), m,
+                                  data)
+            elif m.op in self.MUTATORS:
+                if m.op == "rename":
+                    self._revoke_leases(m, [
+                        lease_key(a["srcdir"], a["srcname"]),
+                        lease_key(a["dstdir"], a["dstname"])])
+                else:
+                    self._revoke_leases(
+                        m, [lease_key(a["dir"], a["name"])])
             reply = MClientReply(m.tid, 0, data)
         except FileNotFoundError:
             reply = MClientReply(m.tid, -errno.ENOENT)
@@ -475,99 +545,206 @@ class MDS(Dispatcher):
                                     peer_type="client")
 
     # ------------------------------------------------------------ operations
+    # Every op names its target dentry as (parent dir ino, name) — the
+    # reference's dirfrag-addressed protocol (MClientRequest carries an
+    # inodeno+dname, not a path; Server::handle_client_request) — and
+    # is served by the parent dir's owner rank.  Clients walk paths
+    # component-by-component against their dentry-lease cache
+    # (client/Client.cc path_walk).
+
+    def _check_owner(self, ino: int) -> None:
+        if self._owner(ino) != self.rank:
+            # client and MDS disagree on the partition function only
+            # on misconfiguration — never silently serve a dir this
+            # rank must not cache
+            raise OSError(errno.ESTALE,
+                          f"dir {ino} owned by rank {self._owner(ino)}")
+
     async def _execute(self, op: str, a: dict) -> dict:
-        if op == "lookup":
-            _, ent = await self._resolve(a["path"])
+        if op == "lookup" or op == "peer_lookup":
+            self._check_owner(a["dir"])
+            async with self._mutex:
+                ent = await self._dentry(a["dir"], a["name"])
+            if ent is None:
+                raise FileNotFoundError(a["name"])
             return {"ent": ent}
         if op == "readdir":
-            _, ent = await self._resolve(a["path"])
-            if ent["type"] != "dir":
-                raise NotADirectoryError(a["path"])
-            ents = await self._dir_entries(ent["ino"])
+            self._check_owner(a["dir"])
+            async with self._mutex:
+                ents = await self._dir_entries(a["dir"])
             return {"entries": ents}
         if op == "mkdir":
-            parent_path, name = self._split(a["path"])
-            _, pent = await self._resolve(parent_path)
-            if pent["type"] != "dir":
-                raise NotADirectoryError(parent_path)
-            if await self._dentry(pent["ino"], name) is not None:
-                raise FileExistsError(a["path"])
-            ino = await self._alloc_ino()
+            self._check_owner(a["dir"])
+            async with self._mutex:
+                if await self._dentry(a["dir"], a["name"]) is not None:
+                    raise FileExistsError(a["name"])
+                ino = await self._alloc_ino()
+            if self._owner(ino) != self.rank:
+                # the new dir's CACHE home is its owner rank: it
+                # journals the mkdir so ITS overlay knows the dir —
+                # BEFORE the dentry publishes.  A failure here leaves
+                # only an invisible unreferenced ino; the reverse order
+                # would leave a visible directory that ENOENTs forever.
+                await self._peer_request(self._owner(ino),
+                                         "peer_mkdir", ino=ino)
             ent = {"ino": ino, "type": "dir", "size": 0,
                    "mtime": time.time()}
-            await self._commit_effects({
-                "mkdir": [ino], "set": [[pent["ino"], name, ent]],
-                "next_ino": self._next_ino})
+            async with self._mutex:
+                if await self._dentry(a["dir"], a["name"]) is not None:
+                    raise FileExistsError(a["name"])   # raced us
+                eff = {"set": [[a["dir"], a["name"], ent]]}
+                if self._owner(ino) == self.rank:
+                    eff["mkdir"] = [ino]
+                await self._commit_effects(eff)
             return {"ent": ent}
+        if op == "peer_mkdir":
+            self._check_owner(a["ino"])
+            async with self._mutex:
+                await self._commit_effects({"mkdir": [a["ino"]]})
+            return {}
         if op == "create":
-            parent_path, name = self._split(a["path"])
-            _, pent = await self._resolve(parent_path)
-            if pent["type"] != "dir":
-                raise NotADirectoryError(parent_path)
-            existing = await self._dentry(pent["ino"], name)
-            if existing is not None:
-                if existing["type"] != "file":
-                    raise IsADirectoryError(a["path"])
-                if a.get("excl"):
-                    raise FileExistsError(a["path"])
-                return {"ent": existing}
-            ino = await self._alloc_ino()
-            ent = {"ino": ino, "type": "file", "size": 0,
-                   "mtime": time.time()}
-            await self._commit_effects({
-                "set": [[pent["ino"], name, ent]],
-                "next_ino": self._next_ino})
+            self._check_owner(a["dir"])
+            async with self._mutex:
+                existing = await self._dentry(a["dir"], a["name"])
+                if existing is not None:
+                    if existing["type"] != "file":
+                        raise IsADirectoryError(a["name"])
+                    if a.get("excl"):
+                        raise FileExistsError(a["name"])
+                    return {"ent": existing}
+                ino = await self._alloc_ino()
+                ent = {"ino": ino, "type": "file", "size": 0,
+                       "mtime": time.time()}
+                await self._commit_effects({
+                    "set": [[a["dir"], a["name"], ent]]})
             return {"ent": ent}
         if op == "setattr":
-            parent_path, name = self._split(a["path"])
-            _, pent = await self._resolve(parent_path)
-            ent = await self._dentry(pent["ino"], name)
-            if ent is None:
-                raise FileNotFoundError(a["path"])
-            if "size" in a:
-                ent["size"] = a["size"]
-            ent["mtime"] = time.time()
-            await self._commit_effects({
-                "set": [[pent["ino"], name, ent]]})
+            self._check_owner(a["dir"])
+            async with self._mutex:
+                ent = await self._dentry(a["dir"], a["name"])
+                if ent is None:
+                    raise FileNotFoundError(a["name"])
+                if "size" in a:
+                    ent["size"] = a["size"]
+                ent["mtime"] = time.time()
+                await self._commit_effects({
+                    "set": [[a["dir"], a["name"], ent]]})
             return {"ent": ent}
         if op == "unlink":
-            parent_path, name = self._split(a["path"])
-            _, pent = await self._resolve(parent_path)
-            ent = await self._dentry(pent["ino"], name)
-            if ent is None:
-                raise FileNotFoundError(a["path"])
-            if ent["type"] == "dir":
-                raise IsADirectoryError(a["path"])
-            await self._commit_effects({"rm": [[pent["ino"], name]]})
+            self._check_owner(a["dir"])
+            async with self._mutex:
+                ent = await self._dentry(a["dir"], a["name"])
+                if ent is None:
+                    raise FileNotFoundError(a["name"])
+                if ent["type"] == "dir":
+                    raise IsADirectoryError(a["name"])
+                await self._commit_effects(
+                    {"rm": [[a["dir"], a["name"]]]})
             return {"ent": ent}   # client punches the data objects
         if op == "rmdir":
-            parent_path, name = self._split(a["path"])
-            _, pent = await self._resolve(parent_path)
-            ent = await self._dentry(pent["ino"], name)
-            if ent is None:
-                raise FileNotFoundError(a["path"])
-            if ent["type"] != "dir":
-                raise NotADirectoryError(a["path"])
-            if await self._dir_entries(ent["ino"]):
-                raise OSError(errno.ENOTEMPTY, "directory not empty")
-            await self._commit_effects({
-                "rm": [[pent["ino"], name]], "rmdir": [ent["ino"]]})
+            self._check_owner(a["dir"])
+            async with self._mutex:
+                ent = await self._dentry(a["dir"], a["name"])
+                if ent is None:
+                    raise FileNotFoundError(a["name"])
+                if ent["type"] != "dir":
+                    raise NotADirectoryError(a["name"])
+                child = ent["ino"]
+                if self._owner(child) == self.rank:
+                    if await self._dir_entries(child):
+                        raise OSError(errno.ENOTEMPTY,
+                                      "directory not empty")
+                    await self._commit_effects({
+                        "rm": [[a["dir"], a["name"]]],
+                        "rmdir": [child]})
+                    return {}
+            # child dir owned elsewhere: its owner checks emptiness and
+            # marks it gone ATOMICALLY under its own mutex (creates
+            # into it fail ENOENT from that instant), then we unlink
+            # the dentry.  A crash in between leaves an orphaned
+            # dentry that resolves ENOENT — scrub territory, where the
+            # reference's 2-phase slave commit would roll forward.
+            await self._peer_request(self._owner(child), "peer_rmdir",
+                                     ino=child)
+            async with self._mutex:
+                cur = await self._dentry(a["dir"], a["name"])
+                if cur is not None and cur.get("ino") == child:
+                    await self._commit_effects(
+                        {"rm": [[a["dir"], a["name"]]]})
+            return {}
+        if op == "peer_rmdir":
+            self._check_owner(a["ino"])
+            async with self._mutex:
+                if await self._dir_entries(a["ino"]):
+                    raise OSError(errno.ENOTEMPTY,
+                                  "directory not empty")
+                await self._commit_effects({"rmdir": [a["ino"]]})
+            return {}
+        if op == "peer_rm":
+            # conditional dentry removal for cross-rank rename: only
+            # if it still names the expected ino (the rename's source
+            # may have been re-targeted concurrently)
+            self._check_owner(a["dir"])
+            async with self._mutex:
+                ent = await self._dentry(a["dir"], a["name"])
+                if ent is None or ent.get("ino") != a.get("ino"):
+                    raise FileNotFoundError(a["name"])
+                await self._commit_effects(
+                    {"rm": [[a["dir"], a["name"]]]})
             return {}
         if op == "rename":
-            sp, sn = self._split(a["src"])
-            dp, dn = self._split(a["dst"])
-            _, spent = await self._resolve(sp)
-            _, dpent = await self._resolve(dp)
-            ent = await self._dentry(spent["ino"], sn)
-            if ent is None:
-                raise FileNotFoundError(a["src"])
-            dst_ent = await self._dentry(dpent["ino"], dn)
-            if dst_ent is not None and dst_ent["type"] == "dir":
-                raise IsADirectoryError(a["dst"])
-            if spent["ino"] == dpent["ino"] and sn == dn:
-                return {"ent": ent}      # no-op: rm would eat the set
-            await self._commit_effects({
-                "set": [[dpent["ino"], dn, ent]],
-                "rm": [[spent["ino"], sn]]})
+            # served by the DESTINATION dir's owner
+            self._check_owner(a["dstdir"])
+            src_local = self._owner(a["srcdir"]) == self.rank
+            if src_local:
+                async with self._mutex:
+                    ent = await self._dentry(a["srcdir"], a["srcname"])
+                    if ent is None:
+                        raise FileNotFoundError(a["srcname"])
+                    dst = await self._dentry(a["dstdir"], a["dstname"])
+                    if dst is not None and dst["type"] == "dir":
+                        raise IsADirectoryError(a["dstname"])
+                    if a["srcdir"] == a["dstdir"] \
+                            and a["srcname"] == a["dstname"]:
+                        return {"ent": ent}   # no-op: rm would eat set
+                    await self._commit_effects({
+                        "set": [[a["dstdir"], a["dstname"], ent]],
+                        "rm": [[a["srcdir"], a["srcname"]]]})
+                return {"ent": ent}
+            # cross-rank: fetch src, publish dst, then conditionally
+            # unlink src.  Between publish and unlink both names exist
+            # (the reference's slave-commit protocol closes this
+            # window; divergence documented) — but the conditional
+            # peer_rm can never delete a dentry re-pointed elsewhere.
+            src_rank = self._owner(a["srcdir"])
+            got = await self._peer_request(src_rank, "peer_lookup",
+                                           dir=a["srcdir"],
+                                           name=a["srcname"])
+            ent = got["ent"]
+            async with self._mutex:
+                dst = await self._dentry(a["dstdir"], a["dstname"])
+                if dst is not None and dst["type"] == "dir":
+                    raise IsADirectoryError(a["dstname"])
+                await self._commit_effects({
+                    "set": [[a["dstdir"], a["dstname"], ent]]})
+            try:
+                await self._peer_request(src_rank, "peer_rm",
+                                         dir=a["srcdir"],
+                                         name=a["srcname"],
+                                         ino=ent["ino"])
+            except OSError:
+                # src vanished mid-flight (concurrent rename/unlink
+                # won): withdraw our copy unless someone re-targeted it
+                async with self._mutex:
+                    cur = await self._dentry(a["dstdir"], a["dstname"])
+                    if cur is not None and cur.get("ino") == ent["ino"]:
+                        await self._commit_effects({
+                            "rm": [[a["dstdir"], a["dstname"]]]})
+                        # _handle only revokes on success — a client
+                        # that glimpsed the short-lived dst dentry must
+                        # not keep serving it from a lease
+                        self._revoke_all(
+                            [lease_key(a["dstdir"], a["dstname"])])
+                raise FileNotFoundError(a["srcname"])
             return {"ent": ent}
         raise OSError(errno.EOPNOTSUPP, f"mds op {op!r}")

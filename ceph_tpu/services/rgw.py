@@ -489,6 +489,8 @@ class S3Gateway:
                 if k:
                     q[k] = unquote(v)
             if not key:
+                if method == "GET" and "uploads" in q:
+                    return await self._list_uploads(bucket)
                 if "lifecycle" in q:
                     if method == "PUT":
                         return await self._put_lifecycle(bucket, body)
@@ -810,21 +812,7 @@ class S3Gateway:
                            if r.get("abort_days") is not None
                            and r.get("status") == "Enabled"]
             if abort_rules:
-                prefix = f".upload.{bucket}."
-                for oid in await self.io.list_objects():
-                    if not oid.startswith(prefix):
-                        continue
-                    upload_id = oid[len(prefix):]
-                    try:
-                        st = await self.io.omap_get(oid)
-                    except ObjectOperationError:
-                        continue
-                    meta = st.get(b"_meta")
-                    if meta is None:
-                        continue
-                    info = json.loads(meta.decode())
-                    if info.get("bucket", bucket) != bucket:
-                        continue      # dotted sibling bucket's upload
+                for upload_id, info in await self._iter_uploads(bucket):
                     key = info.get("key", "")
                     if any(key.startswith(r.get("prefix", ""))
                            and info.get("started", 0)
@@ -1016,6 +1004,42 @@ class S3Gateway:
                f"<Bucket>{bucket}</Bucket><Key>{quote(key)}</Key>"
                f"<UploadId>{upload_id}</UploadId>"
                f"</InitiateMultipartUploadResult>")
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
+
+    async def _iter_uploads(self, bucket: str) -> List[Tuple[str, dict]]:
+        """-> [(upload_id, _meta info)] of this bucket's in-progress
+        multipart uploads (shared by ListMultipartUploads and the
+        lifecycle abort scan; guards against `.upload.<bucket>.` being
+        a prefix of a dotted sibling bucket's uploads)."""
+        prefix = f".upload.{bucket}."
+        out = []
+        for oid in sorted(await self.io.list_objects()):
+            if not oid.startswith(prefix):
+                continue
+            try:
+                st = await self.io.omap_get(oid)
+            except ObjectOperationError:
+                continue
+            meta = st.get(b"_meta")
+            if meta is None:
+                continue
+            info = json.loads(meta.decode())
+            if info.get("bucket", bucket) != bucket:
+                continue              # dotted sibling bucket's upload
+            out.append((oid[len(prefix):], info))
+        return out
+
+    async def _list_uploads(self, bucket: str):
+        """ListMultipartUploads (rgw_rest_s3.cc RGWListBucketMultiparts):
+        in-progress uploads for a bucket."""
+        if not await self._bucket_exists(bucket):
+            return 404, {}, _xml_error("NoSuchBucket")
+        rows = [f"<Upload><Key>{quote(info['key'])}</Key>"
+                f"<UploadId>{upload_id}</UploadId></Upload>"
+                for upload_id, info in await self._iter_uploads(bucket)]
+        xml = (f'<?xml version="1.0"?><ListMultipartUploadsResult>'
+               f"<Bucket>{bucket}</Bucket>{''.join(rows)}"
+               f"</ListMultipartUploadsResult>")
         return 200, {"Content-Type": "application/xml"}, xml.encode()
 
     async def _upload_state(self, bucket: str, upload_id: str,

@@ -25,18 +25,21 @@ def _parse_addr(s: str):
     return EntityAddr(host, int(port), int(nonce))
 
 
-async def _mds_addr(r, cluster_dir: str, mds_id: str):
-    """Resolve via the mon's fsmap (mds dump); file fallback for dirs
-    whose mds predates registration."""
+async def _mds_addrs(r, cluster_dir: str, mds_id: str):
+    """Resolve the rank-ordered MDS address list via the mon's fsmap
+    (mds dump); file fallback (single mds) for dirs whose mds predates
+    registration."""
     try:
         ack = await r.mon_command({"prefix": "mds dump"})
-        ent = json.loads(ack.outs).get(f"mds.{mds_id}")
-        if ent:
-            return _parse_addr(ent["addr"])
+        fsmap = json.loads(ack.outs)
+        by_rank = {rec.get("rank", 0): _parse_addr(rec["addr"])
+                   for rec in fsmap.values()}
+        if by_rank and sorted(by_rank) == list(range(len(by_rank))):
+            return [by_rank[i] for i in range(len(by_rank))]
     except Exception:
         pass
     path = os.path.join(cluster_dir, f"mds.{mds_id}.addr")
-    return _parse_addr(open(path).read())
+    return [_parse_addr(open(path).read())]
 
 
 async def run(args) -> int:
@@ -48,7 +51,7 @@ async def run(args) -> int:
     r = Rados(ctx, load_monmap(args.dir))
     await r.connect()
     try:
-        fs = CephFS(r, await _mds_addr(r, args.dir, args.mds),
+        fs = CephFS(r, await _mds_addrs(r, args.dir, args.mds),
                     "cephfs_data")
         if args.op == "ls":
             for name in await fs.listdir(args.args[0]):

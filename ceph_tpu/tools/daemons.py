@@ -91,8 +91,11 @@ async def run_mds(args) -> None:
             await r.pool_create(pool, pg_num=8)
     msgr = Messenger(ctx, EntityName("mds", args.id))
     addr = await msgr.bind()
-    mds = MDS(ctx, msgr, r, "cephfs_metadata")
-    await mds.create_fs()
+    rank, nranks = getattr(args, "rank", 0), getattr(args, "nranks", 1)
+    mds = MDS(ctx, msgr, r, "cephfs_metadata",
+              rank=rank, nranks=nranks)
+    if rank == 0:
+        await mds.create_fs()
     await mds.start()          # MDLog recovery + write-back flusher
     # register with the mon (FSMonitor beacon) + a file fallback for
     # offline inspection; a transient registration failure must not
@@ -102,9 +105,38 @@ async def run_mds(args) -> None:
     try:
         await r.mon_command(
             {"prefix": "mds boot", "name": f"mds.{args.id}",
-             "addr": f"{addr.host}:{addr.port}:{addr.nonce}"})
+             "addr": f"{addr.host}:{addr.port}:{addr.nonce}",
+             "rank": rank})
     except Exception as e:
         ctx.logger("mds").warning(f"mds boot registration failed: {e}")
+    if nranks > 1:
+        # resolve peer ranks from the committed fsmap (poll: the other
+        # daemons register on their own schedule)
+        import json as _json
+        from ceph_tpu.msg.types import EntityAddr
+        deadline = asyncio.get_running_loop().time() + 60.0
+        while len(mds.peers) < nranks:
+            try:
+                ack = await r.mon_command({"prefix": "mds dump"})
+                fsmap = _json.loads(ack.outs)
+            except Exception:
+                fsmap = {}
+            peers = {}
+            for rec in fsmap.values():
+                h, p, n = rec["addr"].rsplit(":", 2)
+                peers[rec.get("rank", 0)] = EntityAddr(
+                    h, int(p), int(n))
+            mds.peers = peers          # partial map beats none: local
+            #                            ops keep working meanwhile
+            if len(peers) >= nranks:
+                break
+            if asyncio.get_running_loop().time() > deadline:
+                ctx.logger("mds").warning(
+                    f"only {sorted(peers)} of {nranks} ranks "
+                    "registered after 60s; cross-rank ops to missing "
+                    "ranks will fail until they boot")
+                break
+            await asyncio.sleep(0.5)
     await _run_until_signal()
     await msgr.shutdown()
     await r.shutdown()
@@ -159,6 +191,10 @@ def main(argv=None) -> int:
     ap.add_argument("--pid-file", default="",
                     help="pidfile path (default: "
                          "<dir>/<kind>.<id>.pid)")
+    ap.add_argument("--rank", type=int, default=0,
+                    help="mds only: this daemon's rank")
+    ap.add_argument("--nranks", type=int, default=1,
+                    help="mds only: total active ranks")
     args = ap.parse_args(argv)
     if args.daemonize:
         pidfile = args.pid_file or os.path.join(

@@ -42,14 +42,14 @@ class VCluster:
     def __init__(self, directory: str, n_osds: int = 3, n_mons: int = 1,
                  osds_per_host: int = 1,
                  conf: Optional[Dict[str, str]] = None,
-                 cephx: bool = False, mds: bool = False):
+                 cephx: bool = False, mds: int = 0):
         self.dir = os.path.abspath(directory)
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.osds_per_host = osds_per_host
         self.conf = conf or {}
         self.cephx = cephx
-        self.mds = mds
+        self.mds = int(mds)          # number of MDS ranks (0 = none)
         self.procs: Dict[str, subprocess.Popen] = {}
         self.monmap = MonMap()
 
@@ -81,7 +81,9 @@ class VCluster:
             for i in range(self.n_osds):
                 kr.add(f"osd.{i}", caps={"mon": "allow profile osd",
                                          "osd": "allow *"})
-            kr.add("mds.a", caps={"mon": "allow *", "osd": "allow *"})
+            for i in range(max(1, self.mds)):
+                kr.add(f"mds.{chr(ord('a') + i)}",
+                       caps={"mon": "allow *", "osd": "allow *"})
             kr.save(os.path.join(self.dir, "keyring"))
             conf["auth_supported"] = "cephx"
             conf["keyring"] = os.path.join(self.dir, "keyring")
@@ -89,7 +91,7 @@ class VCluster:
             for k, v in conf.items():
                 f.write(f"{k} = {v}\n")
 
-    def _spawn(self, kind: str, id_: str) -> None:
+    def _spawn(self, kind: str, id_: str, extra=()) -> None:
         # Daemons run jax on the CPU backend (device work rides the
         # primary's batch queue; tests are hermetic).  cpu_child_env
         # strips the TPU plugin's site dir: its sitecustomize imports
@@ -102,7 +104,7 @@ class VCluster:
         with open(os.path.join(self.dir, f"{kind}.{id_}.log"), "ab") as logf:
             p = subprocess.Popen(
                 [sys.executable, "-m", "ceph_tpu.tools.daemons", kind,
-                 "--id", id_, "--dir", self.dir],
+                 "--id", id_, "--dir", self.dir, *extra],
                 stdout=logf, stderr=subprocess.STDOUT,
                 env=cpu_child_env(pythonpath_first=repo_root))
         self.procs[f"{kind}.{id_}"] = p
@@ -114,8 +116,13 @@ class VCluster:
             self._spawn("osd", str(i))
 
     def start_mds(self) -> None:
-        """After bootstrap (the mds needs pools + a served osdmap)."""
-        self._spawn("mds", "a")
+        """After bootstrap (the mds needs pools + a served osdmap).
+        Multi-rank: rank i = mds.<a+i>, each told the rank count so
+        dirfrag ownership (services/mds.py owner_rank) agrees."""
+        n = max(1, self.mds)
+        for i in range(n):
+            self._spawn("mds", chr(ord("a") + i),
+                        extra=["--rank", str(i), "--nranks", str(n)])
 
     def kill_daemon(self, name: str, sig=signal.SIGKILL) -> None:
         """qa/ceph-helpers.sh kill_daemon."""
@@ -194,8 +201,9 @@ def main(argv=None) -> int:
                     help="wipe the cluster dir first (vstart -n)")
     ap.add_argument("--cephx", action="store_true",
                     help="enable cephx auth (generates a keyring)")
-    ap.add_argument("--mds", action="store_true",
-                    help="also start an mds (CephFS) after bootstrap")
+    ap.add_argument("--mds", nargs="?", const=1, default=0, type=int,
+                    help="start N mds ranks (CephFS) after bootstrap "
+                         "(bare --mds = 1)")
     ap.add_argument("--keep-running", action="store_true",
                     help="stay attached until ^C")
     args = ap.parse_args(argv)

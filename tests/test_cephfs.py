@@ -224,3 +224,188 @@ def test_dentry_leases_cache_and_revoke():
         assert await fs1.read_file("/doc.txt") == b"version two, longer"
         await cl.stop()
     asyncio.run(run())
+
+
+async def _start_ranks(cl, admin, n):
+    """Boot an n-rank MDS cluster and wire peer addresses."""
+    for pool in ("cephfs_metadata", "cephfs_data"):
+        if admin.monc.osdmap.lookup_pool(pool) < 0:
+            await admin.pool_create(pool, pg_num=8)
+    ranks = []
+    for rk in range(n):
+        ctx = make_ctx(f"mds.r{rk}")
+        r = await cl.client(name=f"mds.r{rk}")
+        msgr = Messenger(ctx, EntityName("mds", f"r{rk}"))
+        addr = await msgr.bind()
+        mds = MDS(ctx, msgr, r, "cephfs_metadata", rank=rk, nranks=n)
+        if rk == 0:
+            await mds.create_fs()
+        await mds.start()
+        ranks.append((mds, msgr, addr))
+    for mds, _, _ in ranks:
+        mds.peers = {rk: a for rk, (_, _, a) in enumerate(ranks)}
+    return ranks
+
+
+def test_multirank_namespace_spans_ranks():
+    """A 3-rank MDS cluster: dirs land on their computed owner rank,
+    the full namespace works through per-component walks, and inos
+    allocated by different ranks never collide (cls ino blocks)."""
+    from ceph_tpu.services.mds import owner_rank
+
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        ranks = await _start_ranks(cl, admin, 3)
+        addrs = [a for _, _, a in ranks]
+        fs = CephFS(admin, addrs, "cephfs_data")
+
+        # build a tree wide enough to hit every rank
+        inos = {}
+        for i in range(12):
+            await fs.makedirs(f"/d{i}/sub")
+            inos[f"/d{i}"] = (await fs.stat(f"/d{i}"))["ino"]
+        owners = {owner_rank(v, 3) for v in inos.values()}
+        assert owners == {0, 1, 2}         # partition actually spreads
+        assert len(set(inos.values())) == len(inos)   # no dup inos
+
+        # file io across subtrees
+        await fs.write_file("/d3/sub/f.bin", b"across-ranks" * 500)
+        assert await fs.read_file("/d3/sub/f.bin") == b"across-ranks" * 500
+        assert await fs.listdir("/d3/sub") == ["f.bin"]
+
+        # unlink + rmdir chain through different owners
+        await fs.unlink("/d3/sub/f.bin")
+        await fs.rmdir("/d3/sub")
+        with pytest.raises(CephFSError):
+            await fs.listdir("/d3/sub")
+        # parent dentry gone too
+        assert await fs.listdir("/d3") == []
+
+        for mds, msgr, _ in ranks:
+            await mds.stop()
+            await msgr.shutdown()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_multirank_cross_rank_rename_and_rmdir():
+    """Rename between directories owned by DIFFERENT ranks (peer
+    lookup + conditional unlink) and rmdir of a child dir owned
+    elsewhere (peer emptiness check)."""
+    from ceph_tpu.services.mds import owner_rank
+
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        ranks = await _start_ranks(cl, admin, 2)
+        addrs = [a for _, _, a in ranks]
+        fs = CephFS(admin, addrs, "cephfs_data")
+
+        # find two top-level dirs with different owner ranks
+        names, inos = [], {}
+        i = 0
+        while len({owner_rank(v, 2) for v in inos.values()}) < 2:
+            nm = f"/x{i}"
+            await fs.mkdir(nm)
+            inos[nm] = (await fs.stat(nm))["ino"]
+            i += 1
+        a, b = sorted(inos, key=lambda n: owner_rank(inos[n], 2))[0], \
+            sorted(inos, key=lambda n: owner_rank(inos[n], 2))[-1]
+        assert owner_rank(inos[a], 2) != owner_rank(inos[b], 2)
+
+        await fs.write_file(f"{a}/moveme", b"M" * 4096)
+        await fs.rename(f"{a}/moveme", f"{b}/moved")
+        assert await fs.read_file(f"{b}/moved") == b"M" * 4096
+        with pytest.raises(CephFSError):
+            await fs.stat(f"{a}/moveme")
+        # rename onto an existing file replaces it
+        await fs.write_file(f"{a}/other", b"O")
+        await fs.rename(f"{b}/moved", f"{a}/other")
+        assert await fs.read_file(f"{a}/other") == b"M" * 4096
+
+        # rmdir where the child dir's owner differs from the parent's:
+        # mkdir under b until the CHILD ino is owned by the other rank
+        j = 0
+        while True:
+            nm = f"{b}/c{j}"
+            await fs.mkdir(nm)
+            cino = (await fs.stat(nm))["ino"]
+            if owner_rank(cino, 2) != owner_rank(inos[b], 2):
+                break
+            j += 1
+        # non-empty: refused (emptiness checked by the child's owner)
+        await fs.write_file(f"{nm}/keep", b"k")
+        with pytest.raises(CephFSError):
+            await fs.rmdir(nm)
+        await fs.unlink(f"{nm}/keep")
+        await fs.rmdir(nm)
+        with pytest.raises(CephFSError):
+            await fs.stat(nm)
+
+        for mds, msgr, _ in ranks:
+            await mds.stop()
+            await msgr.shutdown()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_multirank_lease_revoke_and_restart_replay():
+    """Dentry leases stay coherent across ranks (each dentry's leases
+    live only at its owner), and a rank crash replays ITS OWN mdlog."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        ranks = await _start_ranks(cl, admin, 2)
+        addrs = [a for _, _, a in ranks]
+        c2r = await cl.client(name="client.c2")
+        fs1 = CephFS(admin, addrs, "cephfs_data")
+        fs2 = CephFS(c2r, addrs, "cephfs_data")
+
+        await fs1.makedirs("/share")
+        await fs1.write_file("/share/doc", b"v1")
+        # both clients cache the dentry
+        assert (await fs2.stat("/share/doc"))["size"] == 2
+        before = fs2.lease_hits
+        await fs2.stat("/share/doc")
+        # per-component walk: both "share" and "doc" served from lease
+        assert fs2.lease_hits == before + 2
+        # fs1 mutates: fs2's lease must be revoked
+        f = await fs1.open("/share/doc", "w")
+        await f.write(b"version-two")
+        await f.close()
+        await asyncio.sleep(0.05)                # revoke delivery
+        assert (await fs2.stat("/share/doc"))["size"] == 11
+
+        # crash a rank WITHOUT flush: restart replays its own journal
+        from ceph_tpu.services.mds import owner_rank
+        ino = (await fs1.stat("/share"))["ino"]
+        rk = owner_rank(ino, 2)
+        mds, msgr, addr = ranks[rk]
+        await fs1.write_file("/share/unflushed", b"U" * 100)
+        if mds._flush_task is not None:          # crash: no flush
+            mds._flush_task.cancel()
+            mds._flush_task = None
+        await msgr.shutdown()
+        ctx = make_ctx(f"mds.r{rk}b")
+        r = await cl.client(name=f"mds.r{rk}b")
+        msgr2 = Messenger(ctx, EntityName("mds", f"r{rk}b"))
+        addr2 = await msgr2.bind()
+        mds2 = MDS(ctx, msgr2, r, "cephfs_metadata", rank=rk, nranks=2)
+        await mds2.start()
+        addrs2 = list(addrs)
+        addrs2[rk] = addr2
+        mds2.peers = {i: a for i, a in enumerate(addrs2)}
+        other = ranks[1 - rk][0]
+        other.peers = dict(mds2.peers)
+        fs3 = CephFS(admin, addrs2, "cephfs_data")
+        assert await fs3.read_file("/share/unflushed") == b"U" * 100
+
+        await mds2.stop()
+        await msgr2.shutdown()
+        for i, (mds_, msgr_, _) in enumerate(ranks):
+            if i != rk:
+                await mds_.stop()
+                await msgr_.shutdown()
+        await cl.stop()
+    asyncio.run(run())

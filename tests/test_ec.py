@@ -395,7 +395,7 @@ def test_pallas_variant_space_bit_exact():
     chunks = rng.integers(0, 256, (4, 1024), dtype=np.uint8)
     want = gf256.host_apply(gen[4:], chunks)
     for layout in ("cb", "bc"):
-        for pack in ("vpu", "mxu"):
+        for pack in ("vpu", "mxu", "or"):
             got = np.asarray(_apply_bitmatrix_pallas(
                 bm, jnp.asarray(chunks), interpret=True, tile=512,
                 layout=layout, pack=pack))

@@ -803,3 +803,37 @@ def test_quota_enforcement_and_usage_accounting():
         await gw.stop()
         await cl.stop()
     asyncio.run(run())
+
+
+def test_list_multipart_uploads():
+    """GET /bucket?uploads lists in-progress uploads; completed/aborted
+    ones disappear (rgw RGWListBucketMultiparts)."""
+    import re as _re
+
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create(".rgw", pg_num=8)
+        gw = S3Gateway(admin, require_auth=False)
+        port = await gw.start()
+        c = S3Client(port)
+        await c.request("PUT", "/mu", sign=False)
+        st, _, body = await c.request("GET", "/mu?uploads", sign=False)
+        assert st == 200 and b"<Upload>" not in body
+        ids = []
+        for key in ("k1", "k2"):
+            _, _, body = await c.request("POST", f"/mu/{key}?uploads",
+                                         b"", sign=False)
+            ids.append(_re.search(rb"<UploadId>([^<]+)</UploadId>",
+                                  body).group(1).decode())
+        st, _, body = await c.request("GET", "/mu?uploads", sign=False)
+        assert body.count(b"<Upload>") == 2
+        assert ids[0].encode() in body and ids[1].encode() in body
+        await c.request("DELETE", f"/mu/k1?uploadId={ids[0]}",
+                        sign=False)
+        st, _, body = await c.request("GET", "/mu?uploads", sign=False)
+        assert body.count(b"<Upload>") == 1 \
+            and ids[0].encode() not in body
+        await gw.stop()
+        await cl.stop()
+    asyncio.run(run())
