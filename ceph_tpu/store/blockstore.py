@@ -34,6 +34,7 @@ import struct
 from typing import Dict, List, Optional, Tuple
 
 from ceph_tpu.common.crc import crc32c
+from ceph_tpu.common.xxhash import xxh32, xxh64
 from ceph_tpu.common.encoding import Decoder, Encodable, Encoder
 from ceph_tpu.store.kv import FileDB, KVTransaction
 from ceph_tpu.store.objectstore import (
@@ -201,8 +202,19 @@ def _omap_key(cid: CollectionId, oid: ObjectId, key: bytes) -> bytes:
 
 
 class BlockStore(ObjectStore):
+    #: selectable per-extent checksum (bluestore csum_type: crc32c is
+    #: the default; xxhash32/xxhash64 as in bluestore_types.h
+    #: Checksummer).  Stored crcs are alg-agnostic 32-bit values, so
+    #: the extent format doesn't change (xxh64 keeps its low 32 bits).
+    CSUM_FNS = {
+        "crc32c": crc32c,
+        "xxhash32": xxh32,
+        "xxhash64": lambda d: xxh64(d) & 0xFFFFFFFF,
+    }
+
     def __init__(self, path: str, compression: str = "",
-                 compression_min_blob: int = 4096):
+                 compression_min_blob: int = 4096,
+                 csum_type: str = "crc32c"):
         super().__init__(path)
         self.db: Optional[FileDB] = None
         self._fd = -1
@@ -210,6 +222,12 @@ class BlockStore(ObjectStore):
         self._onodes: Dict[bytes, Onode] = {}    # write-through cache
         self.mounted = False
         self._comp = None
+        if csum_type not in self.CSUM_FNS:
+            raise StoreError(
+                f"unknown csum_type {csum_type!r} "
+                f"(supported: {sorted(self.CSUM_FNS)})")
+        self._csum_name = csum_type
+        self._csum = self.CSUM_FNS[csum_type]
         self.set_compression(compression, compression_min_blob)
 
     def set_compression(self, algorithm: str,
@@ -237,6 +255,25 @@ class BlockStore(ObjectStore):
         if not os.path.exists(self._block_path()):
             self.mkfs()
         self.db = FileDB(os.path.join(self.path, "db"))
+        # the csum alg is a STORE property (extents carry only the
+        # 32-bit value): the pinned type wins over the constructor
+        # argument, so reopening with a different default can't
+        # misverify old extents.  A store WITH onodes but WITHOUT a
+        # pin predates selectable csums — its extents are crc32c.
+        pinned = self.db.get("meta", b"csum_type")
+        if pinned is None and self.db.keys(_PREFIX_ONODE):
+            pinned = b"crc32c"            # legacy store
+        if pinned is not None:
+            name = pinned.decode()
+            if name not in self.CSUM_FNS:
+                raise StoreError(
+                    f"store pins unknown csum_type {name!r} "
+                    f"(supported: {sorted(self.CSUM_FNS)})")
+            self._csum_name = name
+            self._csum = self.CSUM_FNS[name]
+        txn = self.db.create_transaction()
+        txn.set("meta", b"csum_type", self._csum_name.encode())
+        self.db.submit(txn)
         self._fd = os.open(self._block_path(), os.O_RDWR)
         # allocator rebuild: everything is free except extents referenced
         # by some onode (FreelistManager role, derived not persisted)
@@ -633,16 +670,16 @@ class BlockStore(ObjectStore):
         used = _align_up(len(stored))
         if used < d_len:
             self.alloc.release(d_off + used, d_len - used)
-        return Extent(logical, d_off, len(chunk), crc32c(stored),
+        return Extent(logical, d_off, len(chunk), self._csum(stored),
                       len(stored), alg)
 
     # --------------------------------------------------------------- reads
     def _pread_checked(self, ext: Extent) -> bytes:
         data = os.pread(self._fd, ext.disk_len, ext.disk)
-        if len(data) != ext.disk_len or crc32c(data) != ext.crc:
+        if len(data) != ext.disk_len or self._csum(data) != ext.crc:
             raise StoreError(
                 f"blockstore: csum mismatch at {ext!r} "
-                f"(stored {ext.crc:#x}, got {crc32c(data):#x})")
+                f"(stored {ext.crc:#x}, got {self._csum(data):#x})")
         if ext.alg:
             from ceph_tpu.compressor import CompressorError, cached
             try:
