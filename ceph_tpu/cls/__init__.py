@@ -103,6 +103,29 @@ class ClsContext:
         except (NoSuchObject, NoSuchCollection):
             return {}
 
+    def omap_get_header(self) -> bytes:
+        """cls_cxx_map_read_header role."""
+        try:
+            return self.store.omap_get_header(self.cid, self.soid)
+        except (NoSuchObject, NoSuchCollection):
+            return b""
+
+    def omap_get_with_header(self) -> Tuple[bytes, Dict[bytes, bytes]]:
+        """One store fetch for methods that need both (hot cls_rgw ops
+        would otherwise scan the index omap twice per call)."""
+        try:
+            return self.store.omap_get(self.cid, self.soid)
+        except (NoSuchObject, NoSuchCollection):
+            return b"", {}
+
+    def omap_get_values(self, keys) -> Dict[bytes, bytes]:
+        """Keyed omap read (cls_cxx_map_get_val role): per-object hot
+        methods must not materialize a million-entry index omap."""
+        try:
+            return self.store.omap_get_values(self.cid, self.soid, keys)
+        except (NoSuchObject, NoSuchCollection):
+            return {}
+
     # ---- writes: staged logical ops (cls_cxx_write / setxattr / ...) ----
     def _stage(self, op) -> None:
         if self.staged is None:
@@ -136,6 +159,11 @@ class ClsContext:
     def omap_rm(self, keys) -> None:
         from ceph_tpu.osd.messages import OP_OMAP_RM_KEYS, OSDOp
         self._stage(OSDOp(OP_OMAP_RM_KEYS, keys=list(keys)))
+
+    def omap_set_header(self, header: bytes) -> None:
+        """cls_cxx_map_write_header role."""
+        from ceph_tpu.osd.messages import OP_OMAP_SET_HEADER, OSDOp
+        self._stage(OSDOp(OP_OMAP_SET_HEADER, data=header))
 
 
 def call(name: str, hctx: ClsContext, inbl: bytes) -> Tuple[int, bytes]:
@@ -187,3 +215,9 @@ from ceph_tpu.cls import rbd as _rbd      # noqa: E402,F401
 from ceph_tpu.cls import journal as _journal    # noqa: E402,F401
 from ceph_tpu.cls import refcount as _refcount  # noqa: E402,F401
 from ceph_tpu.cls import inotable as _inotable  # noqa: E402,F401
+from ceph_tpu.cls import version as _version    # noqa: E402,F401
+from ceph_tpu.cls import numops as _numops      # noqa: E402,F401
+from ceph_tpu.cls import timeindex as _timeindex  # noqa: E402,F401
+from ceph_tpu.cls import log as _log            # noqa: E402,F401
+from ceph_tpu.cls import user as _user          # noqa: E402,F401
+from ceph_tpu.cls import rgw as _rgw_cls        # noqa: E402,F401

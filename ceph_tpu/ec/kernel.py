@@ -219,7 +219,7 @@ TUNE_SPACE = [
 
 
 def autotune(mat: np.ndarray, length: int = 1 << 25,
-             trials: int = 3) -> dict:
+             trials: int = 3, budget_s: Optional[float] = None) -> dict:
     """Time every fused variant on the live device and install the
     winner (bench.py tpu_ec runs this before measuring).  Returns
     {config, rate_mb_s} of the winner.
@@ -228,9 +228,18 @@ def autotune(mat: np.ndarray, length: int = 1 << 25,
     operand (marginal bytes/second): the tunneled runtime carries a
     ~40-70ms per-call RTT that dwarfs the kernel at single-call sizes
     and made the single-shot tuner pick on noise (round-5 finding —
-    it chose a variant whose true rate was 2x off the best)."""
+    it chose a variant whose true rate was 2x off the best).
+
+    `budget_s` bounds the sweep: each variant costs 2 remote compiles
+    (30-80s each on a loaded container), so a variant is only STARTED
+    when the worst observed variant cost still fits the remaining
+    budget (a between-variant check alone could overshoot by a whole
+    variant).  Whatever won so far (or the champion default) is
+    installed.  A deadline-killed tuner would take the whole bench
+    stage down with it."""
     import time
     from ceph_tpu.ec.gf256 import expand_to_bitmatrix
+    t_start = time.monotonic()
     bm = jnp.asarray(expand_to_bitmatrix(np.asarray(mat, np.uint8)),
                      jnp.int8)
     k = mat.shape[1]
@@ -240,7 +249,13 @@ def autotune(mat: np.ndarray, length: int = 1 << 25,
         rng.integers(0, 256, (k, n // k), dtype=np.uint8)))
         for n in sizes]
     best = None
+    worst_cost = 0.0
     for tile, lay, pk in TUNE_SPACE:
+        elapsed = time.monotonic() - t_start
+        if (budget_s is not None
+                and elapsed + worst_cost > budget_s):
+            break
+        t_var = time.monotonic()
         try:
             fetch = jax.jit(lambda d, t=tile, l=lay, p=pk:
                             _apply_bitmatrix_pallas(
@@ -255,6 +270,7 @@ def autotune(mat: np.ndarray, length: int = 1 << 25,
                     int(fetch(d))
                     t_best = min(t_best, time.perf_counter() - t0)
                 times.append(t_best)
+            worst_cost = max(worst_cost, time.monotonic() - t_var)
             if times[1] <= times[0]:
                 continue                  # RTT noise swamped the slope
             rate = (sizes[1] - sizes[0]) / (times[1] - times[0]) / 1e6
@@ -262,6 +278,7 @@ def autotune(mat: np.ndarray, length: int = 1 << 25,
                 best = {"tile": tile, "layout": lay, "pack": pk,
                         "rate_mb_s": round(rate, 1)}
         except Exception:
+            worst_cost = max(worst_cost, time.monotonic() - t_var)
             continue                      # variant unsupported: skip
     if best:
         set_fused_config(best["tile"], best["layout"], best["pack"])

@@ -104,13 +104,12 @@ class ECBatchQueue:
         if self.mode == "force":
             self._device_ok = self._probe()
             return self._device_ok
-        if self.mode == "on":
-            self._device_ok = self._probe(require_accelerator=True)
-            return self._device_ok
-        # auto: jax backend discovery can BLOCK for a long time (remote
-        # runtime init / a wedged device tunnel), and it must never stall
-        # the OSD event loop — probe in a daemon thread and serve the
-        # host path until the accelerator proves itself
+        # on/auto: even `import jax` can BLOCK for seconds (plugin
+        # registration / remote runtime init / a wedged device tunnel),
+        # and the FIRST apply() runs on the OSD event loop — every
+        # in-flight op would stall behind it (r5 bench: p99 8x worse
+        # with zero device bytes).  Probe in a daemon thread and serve
+        # the host path until the accelerator proves itself.
         if not self._probe_started:
             self._probe_started = True
             import threading
@@ -125,6 +124,12 @@ class ECBatchQueue:
             self.logger.info("accelerator probe ok: EC batch device on")
 
     def _probe(self, require_accelerator: bool = False) -> bool:
+        import os
+        if (require_accelerator
+                and os.environ.get("JAX_PLATFORMS", "").strip()
+                .lower().startswith("cpu")):
+            return False         # no accelerator configured: skip the
+            #                      (expensive) jax import entirely
         try:
             import jax
             if require_accelerator and jax.default_backend() == "cpu":

@@ -11,7 +11,11 @@ Redesign notes:
   * Buckets are an omap-indexed head object per bucket
     (.bucket.index.<name>: key -> json{size, etag, mtime}) plus a
     global bucket directory object; object DATA rides RadosStriper so
-    multi-GB uploads stripe like rgw manifests do.
+    multi-GB uploads stripe like rgw manifests do.  Index mutations go
+    through cls_rgw (ceph_tpu/cls/rgw.py) two-phase prepare/complete
+    on the OSD — entry + per-bucket stats commit atomically, and a
+    gateway crash mid-op leaves a tagged pending marker that `bucket
+    check`/dir_suggest reconcile (cls/rgw/cls_rgw.cc role).
   * Users live in one omap object (.rgw.users: access_key ->
     json{secret, display}); radosgw-admin's user create/rm surface is
     tools/rgw_admin.py.
@@ -53,6 +57,28 @@ BUCKETS_OID = ".rgw.buckets"
 
 def _index_oid(bucket: str) -> str:
     return f".bucket.index.{bucket}"
+
+
+def _committed(idx: Dict[bytes, bytes]) -> Dict[bytes, bytes]:
+    """Committed index entries only: cls_rgw keeps in-flight op markers
+    under the \\x01 namespace in the same omap."""
+    from ceph_tpu.cls.rgw import _entries
+    return _entries(idx)
+
+
+async def _iter_index(io, bucket: str, prefix: str = ""):
+    """Page the bucket index through the OSD-side cls bucket_list —
+    bounded per call — yielding (key, entry) in key order."""
+    marker = ""
+    while True:
+        out = json.loads(await io.exec(
+            _index_oid(bucket), "rgw", "bucket_list",
+            json.dumps({"marker": marker, "prefix": prefix}).encode()))
+        for e in out["entries"]:
+            yield e["key"], e["entry"]
+        if not out["truncated"]:
+            return
+        marker = out["marker"]
 
 
 def _data_soid(bucket: str, key: str) -> str:
@@ -621,14 +647,9 @@ class S3Gateway:
         if method == "GET":
             if not await self._bucket_exists(cont):
                 return 404, {}, b""
-            idx = await self.io.omap_get(_index_oid(cont))
-            prefix = q.get("prefix", "")
             rows = []
-            for k in sorted(idx):
-                key = k.decode()
-                if not key.startswith(prefix):
-                    continue
-                meta = json.loads(idx[k].decode())
+            async for key, meta in _iter_index(self.io, cont,
+                                               q.get("prefix", "")):
                 rows.append({"name": key, "bytes": meta["size"],
                              "hash": meta["etag"]})
             if q.get("format") == "json":
@@ -684,47 +705,57 @@ class S3Gateway:
         await self.io.omap_set(BUCKETS_OID, {
             bucket.encode(): json.dumps(rec).encode()})
 
-    async def _usage_apply(self, bucket: str, dsize: int,
-                           dcount: int) -> None:
-        """Account a publish/delete into the bucket's usage counters
-        (rgw_quota.cc stats update role)."""
-        rec = await self._bucket_rec(bucket)
-        if rec is None:
-            return
-        u = rec.setdefault("usage", {"size": 0, "count": 0})
-        u["size"] = max(0, u.get("size", 0) + dsize)
-        u["count"] = max(0, u.get("count", 0) + dcount)
-        await self._save_bucket_rec(bucket, rec)
+    async def _bucket_usage(self, bucket: str) -> Tuple[int, int]:
+        """(bytes, objects) from the cls-maintained index header — the
+        single, crash-consistent usage source.  The index updates it
+        atomically with every entry change, and `bucket check --fix`
+        repairs it; a gateway-side counter would drift on every crash
+        between data and accounting with no repair path.
+
+        A MISSING header ("uninit") is a legacy (pre-cls) bucket whose
+        entries predate the header: rebuild it in place once, so quota
+        enforcement never runs against phantom zeros.  An initialized
+        empty bucket never re-triggers the probe."""
+        try:
+            hdr = json.loads(await self.io.exec(
+                _index_oid(bucket), "rgw", "bucket_read_header"))
+            if hdr.get("uninit"):
+                hdr = json.loads(await self.io.exec(
+                    _index_oid(bucket), "rgw", "bucket_rebuild_index"))
+        except ObjectOperationError:
+            return 0, 0
+        return int(hdr.get("bytes", 0)), int(hdr.get("entries", 0))
 
     async def _check_quota(self, bucket: str, add_size: int,
                            add_count: int) -> bool:
         """Prospective bucket + owner quota check before a write
-        (rgw_quota.cc check_quota)."""
+        (rgw_quota.cc check_quota), against the index-header stats."""
         from ceph_tpu.services.rgw_gc import QuotaInfo
         rec = await self._bucket_rec(bucket)
         if rec is None:
             return True
-        u = rec.get("usage", {})
+        size, count = await self._bucket_usage(bucket)
         bq = QuotaInfo.from_dict(rec.get("quota"))
-        if not bq.allows(u.get("size", 0), u.get("count", 0),
-                         add_size, add_count):
+        if not bq.allows(size, count, add_size, add_count):
             return False
         owner = rec.get("owner", "")
         if owner:
             user = await self.users.get(owner)
             if user and user.get("quota"):
                 uq = QuotaInfo.from_dict(user["quota"])
-                tsize = tcount = 0
                 try:
                     omap = await self.io.omap_get(BUCKETS_OID)
                 except ObjectOperationError:
                     omap = {}
-                for v in omap.values():
-                    r2 = json.loads(v.decode())
-                    if r2.get("owner", "") == owner:
-                        u2 = r2.get("usage", {})
-                        tsize += u2.get("size", 0)
-                        tcount += u2.get("count", 0)
+                others = [k.decode() for k, v in omap.items()
+                          if json.loads(v.decode()).get("owner", "")
+                          == owner and k.decode() != bucket]
+                # independent header reads: overlap them, and reuse
+                # the target bucket's already-fetched usage
+                sums = await asyncio.gather(
+                    *[self._bucket_usage(b) for b in others])
+                tsize = size + sum(s for s, _ in sums)
+                tcount = count + sum(c for _, c in sums)
                 if not uq.allows(tsize, tcount, add_size, add_count):
                     return False
         return True
@@ -796,7 +827,8 @@ class S3Gateway:
                          or r.get("date") is not None]
             if exp_rules:
                 try:
-                    idx = await self.io.omap_get(_index_oid(bucket))
+                    idx = _committed(
+                        await self.io.omap_get(_index_oid(bucket)))
                 except ObjectOperationError:
                     idx = {}
                 for kraw in sorted(idx):
@@ -841,17 +873,27 @@ class S3Gateway:
             return 409, {}, _xml_error("BucketAlreadyExists")
         await self.io.omap_set(BUCKETS_OID, {
             bucket.encode(): json.dumps(
-                {"created": time.time(), "owner": owner,
-                 "usage": {"size": 0, "count": 0}}).encode()})
-        await self.io.write_full(_index_oid(bucket), b"")
+                {"created": time.time(), "owner": owner}).encode()})
+        try:
+            await self.io.exec(_index_oid(bucket), "rgw", "bucket_init")
+        except ObjectOperationError as e:
+            import errno as _errno
+            if e.retcode != -_errno.EEXIST:
+                raise               # only re-init of a live index is
+                #                     benign; real failures must surface
         await self._log_change("mkb", bucket)
         return 200, {}, b""
 
     async def _delete_bucket(self, bucket: str):
         if not await self._bucket_exists(bucket):
             return 404, {}, _xml_error("NoSuchBucket")
-        idx = await self.io.omap_get(_index_oid(bucket))
-        if idx:
+        # a bucket with committed entries OR in-flight ops (pending
+        # markers) is not empty: deleting under an in-flight PUT would
+        # let its complete_op resurrect a phantom entry in the orphaned
+        # index (reference: cls_rgw list includes pending dirents)
+        chk = json.loads(await self.io.exec(
+            _index_oid(bucket), "rgw", "bucket_check"))
+        if chk["actual"]["entries"] or chk["pending"]:
             return 409, {}, _xml_error("BucketNotEmpty")
         await self.io.omap_rm_keys(BUCKETS_OID, [bucket.encode()])
         try:
@@ -869,13 +911,8 @@ class S3Gateway:
             k, _, v = kv.partition("=")
             if k == "prefix":
                 prefix = unquote(v)
-        idx = await self.io.omap_get(_index_oid(bucket))
         rows = []
-        for k in sorted(idx):
-            key = k.decode()
-            if not key.startswith(prefix):
-                continue
-            meta = json.loads(idx[k].decode())
+        async for key, meta in _iter_index(self.io, bucket, prefix):
             rows.append(
                 f"<Contents><Key>{quote(key)}</Key>"
                 f"<Size>{meta['size']}</Size>"
@@ -898,6 +935,10 @@ class S3Gateway:
 
     async def _put_object(self, bucket: str, key: str, body: bytes,
                           headers: Dict[str, str]):
+        from ceph_tpu.cls.rgw import _bad_key
+        if _bad_key(key):
+            # the index's special namespace (cls_rgw pending markers)
+            return 400, {}, _xml_error("InvalidArgument")
         if not await self._bucket_exists(bucket):
             return 404, {}, _xml_error("NoSuchBucket")
         old = await self._obj_meta(bucket, key)
@@ -912,14 +953,38 @@ class S3Gateway:
         # references, and a crash between write and publish leaks only
         # unreferenced data
         soid = f"{_data_soid(bucket, key)}.{time.time_ns():x}"
-        await st.write(soid, body)
+        # two-phase index update (cls_rgw): prepare marks the op
+        # in-flight BEFORE data lands; complete publishes entry+stats
+        # atomically.  A crash in between leaves a tagged marker, never
+        # a half-updated index.
+        tag = f"{time.time_ns():x}"
+        await self.io.exec(_index_oid(bucket), "rgw", "bucket_prepare_op",
+                           json.dumps({"tag": tag, "op": "put",
+                                       "key": key,
+                                       "ts": time.time()}).encode())
+        try:
+            await st.write(soid, body)
+        except Exception:
+            # the gateway is ALIVE and its write failed: cancel the
+            # marker instead of leaving a phantom "crash" that blocks
+            # bucket deletion until an admin expires it
+            try:
+                await self.io.exec(
+                    _index_oid(bucket), "rgw", "bucket_complete_op",
+                    json.dumps({"tag": tag, "op": "cancel",
+                                "key": key}).encode())
+            except ObjectOperationError:
+                pass
+            raise
         etag = hashlib.md5(body).hexdigest()
-        await self.io.omap_set(_index_oid(bucket), {
-            key.encode(): json.dumps({
-                "size": len(body), "etag": etag, "soid": soid,
-                "mtime": time.time()}).encode()})
+        await self.io.exec(_index_oid(bucket), "rgw", "bucket_complete_op",
+                           json.dumps({"tag": tag, "op": "put", "key": key,
+                                       "entry": {
+                                           "size": len(body), "etag": etag,
+                                           "soid": soid,
+                                           "mtime": time.time(),
+                                       }}).encode())
         await self.gc.defer(self._chain_of(old, bucket, key))
-        await self._usage_apply(bucket, dsize, 0 if old else 1)
         await self._log_change("put", bucket, key)
         return 200, {"ETag": f'"{etag}"'}, b""
 
@@ -928,6 +993,28 @@ class S3Gateway:
         meta = await self._obj_meta(bucket, key)
         if meta is None:
             return 404, {}, _xml_error("NoSuchKey")
+        try:
+            return await self._get_object_data(bucket, key, meta, headers)
+        except StripedObjectNotFound:
+            # index entry dangles (crash between phases, or delete
+            # raced us): suggest the reconciliation back to the index
+            # (cls_rgw dir_suggest_changes role) and 404.  `observed`
+            # pins the suggestion to the entry WE read — if an
+            # overwrite won the race meanwhile, the index skips it.
+            try:
+                await self.io.exec(
+                    _index_oid(bucket), "rgw", "dir_suggest_changes",
+                    json.dumps({"changes": [
+                        {"op": "remove", "key": key,
+                         "observed": {"etag": meta.get("etag"),
+                                      "mtime": meta.get("mtime")},
+                         }]}).encode())
+            except ObjectOperationError:
+                pass
+            return 404, {}, _xml_error("NoSuchKey")
+
+    async def _get_object_data(self, bucket: str, key: str, meta: dict,
+                               headers: Dict[str, str]):
         st = RadosStriper(self.io)
         manifest = meta.get("manifest")
         rng = headers.get("range", "")
@@ -972,17 +1059,43 @@ class S3Gateway:
         meta = await self._obj_meta(bucket, key)
         if meta is None:
             return 404, {}, _xml_error("NoSuchKey")
-        # unlink the index entry now; the bytes die later via the gc
+        # unlink the index entry now (cls_rgw prepare/complete keeps
+        # the header stats honest); the bytes die later via the gc
         # queue (rgw_gc.cc send_chain on delete_obj)
-        await self.io.omap_rm_keys(_index_oid(bucket), [key.encode()])
+        tag = f"{time.time_ns():x}"
+        await self.io.exec(_index_oid(bucket), "rgw", "bucket_prepare_op",
+                           json.dumps({"tag": tag, "op": "del",
+                                       "key": key,
+                                       "ts": time.time()}).encode())
+        # complete succeeds even if the entry raced away (a concurrent
+        # delete won): the marker is cleared either way, and `removed`
+        # says whether WE unlinked it.  `observed` pins the removal to
+        # the meta WE read — if an overwrite landed since, its fresh
+        # entry (and data) survive and the gc chain stays ours alone.
+        out = json.loads(await self.io.exec(
+            _index_oid(bucket), "rgw", "bucket_complete_op",
+            json.dumps({"tag": tag, "op": "del", "key": key,
+                        "observed": {"etag": meta.get("etag"),
+                                     "mtime": meta.get("mtime")},
+                        }).encode()))
+        if not out.get("removed"):
+            # a racing delete owns the accounting/gc — or a racing
+            # overwrite means the object now EXISTS with new bytes; in
+            # both cases this delete changes nothing
+            return 404, {}, _xml_error("NoSuchKey")
         await self.gc.defer(self._chain_of(meta, bucket, key))
-        await self._usage_apply(bucket, -meta["size"], -1)
         await self._log_change("del", bucket, key)
         return 204, {}, b""
 
     async def _obj_meta(self, bucket: str, key: str) -> Optional[dict]:
+        from ceph_tpu.cls.rgw import _bad_key
+        if _bad_key(key):
+            return None     # marker namespace is never object metadata
         try:
-            idx = await self.io.omap_get(_index_oid(bucket))
+            # single-key fetch: per-object ops must not ship the whole
+            # bucket index over the wire
+            idx = await self.io.omap_get(_index_oid(bucket),
+                                         keys=[key.encode()])
         except ObjectOperationError:
             return None
         raw = idx.get(key.encode())
@@ -993,6 +1106,11 @@ class S3Gateway:
         """InitiateMultipartUpload (rgw_multi.cc init): allocate an
         upload id; part state lives in an omap object so an interrupted
         upload is resumable/abortable."""
+        from ceph_tpu.cls.rgw import _bad_key
+        if _bad_key(key):
+            # keep the index's marker namespace unreachable from every
+            # write entry point, not just single PUT
+            return 400, {}, _xml_error("InvalidArgument")
         if not await self._bucket_exists(bucket):
             return 404, {}, _xml_error("NoSuchBucket")
         upload_id = hashlib.md5(
@@ -1154,10 +1272,14 @@ class S3Gateway:
                 bucket, max(0, total - (old["size"] if old else 0)),
                 0 if old else 1):
             return 403, {}, _xml_error("QuotaExceeded")
-        await self.io.omap_set(_index_oid(bucket), {
-            key.encode(): json.dumps({
-                "size": total, "etag": final_etag,
-                "mtime": time.time(), "manifest": manifest}).encode()})
+        await self.io.exec(_index_oid(bucket), "rgw", "bucket_complete_op",
+                           json.dumps({"op": "put", "key": key,
+                                       "entry": {
+                                           "size": total,
+                                           "etag": final_etag,
+                                           "mtime": time.time(),
+                                           "manifest": manifest,
+                                       }}).encode())
         # previous incarnation + unreferenced parts (uploaded but not
         # listed in Complete) go to the gc queue
         listed = {m["soid"] for m in manifest}
@@ -1165,9 +1287,6 @@ class S3Gateway:
                  for k2 in state if k2 != b"_meta"]
         await self.gc.defer(self._chain_of(old, bucket, key)
                             + [s for s in stray if s not in listed])
-        await self._usage_apply(
-            bucket, total - (old["size"] if old else 0),
-            0 if old else 1)
         await self.io.remove(_upload_oid(bucket, upload_id))
         await self._log_change("put", bucket, key)
         xml = (f'<?xml version="1.0"?><CompleteMultipartUploadResult>'

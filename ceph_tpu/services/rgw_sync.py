@@ -60,7 +60,8 @@ class ZoneSyncAgent:
             await jr.create()
         await jr.register_client(self.client_id)
         start_seq = await jr.tail_seq()
-        from ceph_tpu.services.rgw import BUCKETS_OID, _index_oid
+        from ceph_tpu.services.rgw import (BUCKETS_OID, _committed,
+                                           _index_oid)
         try:
             buckets = sorted(
                 k.decode()
@@ -70,7 +71,7 @@ class ZoneSyncAgent:
         for b in buckets:
             if not await self.dst._bucket_exists(b):
                 await self.dst._put_bucket(b)
-            idx = await self.src.io.omap_get(_index_oid(b))
+            idx = _committed(await self.src.io.omap_get(_index_oid(b)))
             for k in sorted(idx):
                 await self._sync_object(b, k.decode())
         await jr.commit(self.client_id, start_seq)

@@ -733,6 +733,18 @@ class BlockStore(ObjectStore):
             out[k[len(pre):]] = self._kv_get(_PREFIX_OMAP, k)
         return on.omap_header, out
 
+    def omap_get_values(self, cid, oid, keys) -> Dict[bytes, bytes]:
+        self._get_onode(cid, oid)          # existence check
+        out = {}
+        for k in keys:
+            v = self._kv_get(_PREFIX_OMAP, _omap_key(cid, oid, k))
+            if v is not None:
+                out[k] = v
+        return out
+
+    def omap_get_header(self, cid, oid) -> bytes:
+        return self._get_onode(cid, oid).omap_header
+
     def list_collections(self) -> List[CollectionId]:
         return [CollectionId(k.decode())
                 for k in self._kv_keys(_PREFIX_COLL)]

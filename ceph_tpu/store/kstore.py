@@ -421,6 +421,19 @@ class KStore(ObjectStore):
             omap[k[len(okey):]] = v
         return on.omap_header, omap
 
+    def omap_get_values(self, cid, oid, keys) -> Dict[bytes, bytes]:
+        okey = self._okey(cid, oid)
+        self._require(cid, oid)
+        out = {}
+        for k in keys:
+            v = self.db.get(P_OMAP, okey + k)
+            if v is not None:
+                out[k] = v
+        return out
+
+    def omap_get_header(self, cid, oid) -> bytes:
+        return self._require(cid, oid).omap_header
+
     def list_collections(self) -> List[CollectionId]:
         return [CollectionId.from_bytes(ck) for ck in self._objs]
 

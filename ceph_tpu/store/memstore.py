@@ -230,6 +230,13 @@ class MemStore(ObjectStore):
         o = self._obj(cid, oid)
         return o.omap_header, dict(o.omap)
 
+    def omap_get_values(self, cid, oid, keys) -> Dict[bytes, bytes]:
+        o = self._obj(cid, oid)
+        return {k: o.omap[k] for k in keys if k in o.omap}
+
+    def omap_get_header(self, cid, oid) -> bytes:
+        return self._obj(cid, oid).omap_header
+
     def list_collections(self) -> List[CollectionId]:
         return sorted(self.colls)
 

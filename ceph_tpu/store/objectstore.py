@@ -270,6 +270,11 @@ class ObjectStore:
         omap = self.omap_get(cid, oid)[1]
         return {k: omap[k] for k in keys if k in omap}
 
+    def omap_get_header(self, cid, oid) -> bytes:
+        """Header-only read; backends override so hot per-object cls
+        methods don't materialize the whole omap for it."""
+        return self.omap_get(cid, oid)[0]
+
     def list_collections(self) -> List[CollectionId]:
         raise NotImplementedError
 

@@ -74,6 +74,33 @@ async def run(args) -> int:
                                                 args.max_objects)
             print(json.dumps({"set": ok}))
             return 0 if ok else 1
+        if args.cmd == "bucket":
+            from ceph_tpu.services.rgw import _index_oid
+            oid = _index_oid(args.bucket)
+            if args.op == "stats":
+                print((await io.exec(oid, "rgw",
+                                     "bucket_read_header")).decode())
+                return 0
+            # check [--fix]: header-vs-actual + stale pending markers
+            # (rgw_admin.cc bucket check / cls_rgw bucket_check role)
+            rep = json.loads(await io.exec(oid, "rgw", "bucket_check"))
+            if args.fix:
+                import time as _time
+                # only expire markers older than --min-age: a young
+                # marker may belong to an op in flight RIGHT NOW, and
+                # expiring it defeats crash reconciliation
+                cutoff = _time.time() - args.min_age
+                stale = [p["tag"] for p in rep["pending"]
+                         if p.get("ts", 0.0) <= cutoff]
+                if stale:
+                    await io.exec(oid, "rgw", "dir_suggest_changes",
+                                  json.dumps(
+                                      {"expire_tags": stale}).encode())
+                rep["header"] = json.loads(await io.exec(
+                    oid, "rgw", "bucket_rebuild_index"))
+                rep["fixed"] = {"expired_tags": stale}
+            print(json.dumps(rep))
+            return 0
         if args.cmd == "serve":
             gw = S3Gateway(r, pool=args.pool,
                            require_auth=not args.no_auth)
@@ -109,6 +136,12 @@ def main(argv=None) -> int:
     q.add_argument("--bucket", default="")
     q.add_argument("--max-size", type=int, default=-1)
     q.add_argument("--max-objects", type=int, default=-1)
+    b = sub.add_parser("bucket")
+    b.add_argument("op", choices=("stats", "check"))
+    b.add_argument("--bucket", required=True)
+    b.add_argument("--fix", action="store_true")
+    b.add_argument("--min-age", type=float, default=3600.0,
+                   help="only expire pending markers older than this")
     s = sub.add_parser("serve")
     s.add_argument("--port", type=int, default=7480)
     s.add_argument("--no-auth", action="store_true")

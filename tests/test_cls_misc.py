@@ -1,0 +1,196 @@
+"""cls_version / cls_numops / cls_timeindex / cls_log / cls_user.
+
+Mirrors the reference's src/test/cls_version, cls_numops.cc tests,
+test_cls_log.cc, and cls_user semantics (src/cls/{version,numops,
+timeindex,log,user}/*.cc): CAS versioning, atomic arithmetic,
+time-range list/trim, header high-water marks, aggregated user stats.
+"""
+
+import asyncio
+import errno
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from test_osd import Cluster  # noqa: E402
+
+from ceph_tpu.client.objecter import ObjectOperationError  # noqa: E402
+
+
+async def _cluster():
+    cl = Cluster()
+    admin = await cl.start(3)
+    await admin.pool_create("p", pg_num=8)
+    return cl, admin.open_ioctx("p")
+
+
+def _j(d) -> bytes:
+    return json.dumps(d).encode()
+
+
+def test_cls_version_set_inc_conds():
+    async def run():
+        cl, io = await _cluster()
+
+        # unversioned object reads as ver 0 / empty tag
+        v = json.loads(await io.exec("o", "version", "read"))
+        assert v == {"ver": 0, "tag": ""}
+
+        # inc mints a tag and bumps; second inc keeps the tag
+        await io.exec("o", "version", "inc")
+        v1 = json.loads(await io.exec("o", "version", "read"))
+        assert v1["ver"] == 1 and v1["tag"]
+        await io.exec("o", "version", "inc")
+        v2 = json.loads(await io.exec("o", "version", "read"))
+        assert v2["ver"] == 2 and v2["tag"] == v1["tag"]
+
+        # conditional inc: stale EQ loses with ECANCELED (the RMW fence)
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("o", "version", "inc",
+                          _j({"conds": [{"cond": "eq", "ver": 1}]}))
+        assert ei.value.retcode == -errno.ECANCELED
+        await io.exec("o", "version", "inc",
+                      _j({"conds": [{"cond": "eq", "ver": 2}]}))
+
+        # explicit set + tag conditions
+        await io.exec("o", "version", "set", _j({"ver": 10, "tag": "t0"}))
+        await io.exec("o", "version", "check_conds",
+                      _j({"conds": [{"cond": "tag_eq", "tag": "t0"},
+                                    {"cond": "ge", "ver": 10}]}))
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("o", "version", "check_conds",
+                          _j({"conds": [{"cond": "tag_ne", "tag": "t0"}]}))
+        assert ei.value.retcode == -errno.ECANCELED
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_cls_numops_add_mul_errors():
+    async def run():
+        cl, io = await _cluster()
+        await io.exec("n", "numops", "add", _j({"key": "x", "value": "5"}))
+        await io.exec("n", "numops", "add", _j({"key": "x", "value": -2}))
+        omap = await io.omap_get("n")
+        assert omap[b"x"] == b"3"
+        await io.exec("n", "numops", "mul", _j({"key": "x", "value": 2.5}))
+        omap = await io.omap_get("n")
+        assert float(omap[b"x"]) == 7.5
+
+        # non-numeric stored value -> EBADMSG
+        await io.omap_set("n", {b"bad": b"not-a-number"})
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("n", "numops", "add",
+                          _j({"key": "bad", "value": 1}))
+        assert ei.value.retcode == -errno.EBADMSG
+
+        # overflow -> EOVERFLOW
+        await io.omap_set("n", {b"big": b"1e308"})
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("n", "numops", "mul",
+                          _j({"key": "big", "value": "1e308"}))
+        assert ei.value.retcode == -errno.EOVERFLOW
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_cls_timeindex_add_list_trim():
+    async def run():
+        cl, io = await _cluster()
+        entries = [{"ts": 100.0 + i, "key_ext": f"e{i}", "value": i}
+                   for i in range(10)]
+        await io.exec("t", "timeindex", "add", _j({"entries": entries}))
+
+        # ranged list [102, 107) in time order
+        out = json.loads(await io.exec(
+            "t", "timeindex", "list",
+            _j({"from_ts": 102.0, "to_ts": 107.0})))
+        assert [e["value"] for e in out["entries"]] == [2, 3, 4, 5, 6]
+        assert not out["truncated"]
+
+        # pagination by marker
+        out1 = json.loads(await io.exec(
+            "t", "timeindex", "list", _j({"max_entries": 4})))
+        assert out1["truncated"] and len(out1["entries"]) == 4
+        out2 = json.loads(await io.exec(
+            "t", "timeindex", "list", _j({"marker": out1["marker"]})))
+        got = [e["value"] for e in out1["entries"] + out2["entries"]]
+        assert got == list(range(10))
+
+        # trim [0, 105) then re-list; second trim of same range ENODATA
+        await io.exec("t", "timeindex", "trim", _j({"to_ts": 105.0}))
+        out = json.loads(await io.exec("t", "timeindex", "list"))
+        assert [e["value"] for e in out["entries"]] == [5, 6, 7, 8, 9]
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("t", "timeindex", "trim", _j({"to_ts": 105.0}))
+        assert ei.value.retcode == -errno.ENODATA
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_cls_log_header_high_water():
+    async def run():
+        cl, io = await _cluster()
+        await io.exec("lg", "log", "add", _j({"entries": [
+            {"ts": 50.0, "section": "meta", "name": "a", "data": "d0"},
+            {"ts": 60.0, "section": "meta", "name": "b", "data": "d1"},
+        ]}))
+        info = json.loads(await io.exec("lg", "log", "info"))
+        assert info["max_time"] == 60.0 and info["max_marker"]
+
+        out = json.loads(await io.exec("lg", "log", "list"))
+        assert [e["name"] for e in out["entries"]] == ["a", "b"]
+
+        # same-timestamp entries stay distinct (persistent uniquifier)
+        await io.exec("lg", "log", "add", _j({"entries": [
+            {"ts": 60.0, "section": "meta", "name": "c"},
+            {"ts": 60.0, "section": "meta", "name": "d"},
+        ]}))
+        out = json.loads(await io.exec("lg", "log", "list"))
+        assert len(out["entries"]) == 4
+
+        # trim everything before 60s: only ts<60 goes; header keeps
+        # its high-water mark
+        await io.exec("lg", "log", "trim", _j({"to_ts": 60.0}))
+        out = json.loads(await io.exec("lg", "log", "list"))
+        assert sorted(e["name"] for e in out["entries"]) == ["b", "c", "d"]
+        info2 = json.loads(await io.exec("lg", "log", "info"))
+        assert info2["max_time"] == 60.0
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_cls_user_stats_and_listing():
+    async def run():
+        cl, io = await _cluster()
+        await io.exec("u", "user", "set_buckets", _j({
+            "entries": [
+                {"bucket": "b1", "size": 100, "count": 3,
+                 "creation_ts": 1.0},
+                {"bucket": "b2", "size": 50, "count": 1,
+                 "creation_ts": 2.0},
+            ], "add": True, "ts": 99.0}))
+        hdr = json.loads(await io.exec("u", "user", "get_header"))
+        assert hdr["total_entries"] == 2 and hdr["total_bytes"] == 150
+
+        # update b1's stats; creation time survives re-registration
+        await io.exec("u", "user", "set_buckets", _j({
+            "entries": [{"bucket": "b1", "size": 200, "count": 5,
+                         "creation_ts": 7.0}], "add": True, "ts": 100.0}))
+        out = json.loads(await io.exec("u", "user", "list_buckets"))
+        b1 = [e for e in out["entries"] if e["bucket"] == "b1"][0]
+        assert b1["size"] == 200 and b1["creation_ts"] == 1.0
+        hdr = json.loads(await io.exec("u", "user", "get_header"))
+        assert hdr["total_bytes"] == 250
+
+        # remove a bucket: header shrinks; removing again ENOENT
+        await io.exec("u", "user", "remove_bucket", _j({"bucket": "b2"}))
+        hdr = json.loads(await io.exec("u", "user", "get_header"))
+        assert hdr["total_entries"] == 1 and hdr["total_bytes"] == 200
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("u", "user", "remove_bucket",
+                          _j({"bucket": "b2"}))
+        assert ei.value.retcode == -errno.ENOENT
+        await cl.stop()
+    asyncio.run(run())

@@ -1,0 +1,232 @@
+"""cls_rgw bucket index: two-phase prepare/complete, header stats,
+pending-marker reconciliation, and the gateway riding it.
+
+Mirrors the reference's src/test/cls_rgw/test_cls_rgw.cc (prepare/
+complete/list/check_index/suggest) plus the rgw_rados.cc contract that
+the index never exposes half-applied ops to listings.
+"""
+
+import asyncio
+import errno
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from test_osd import Cluster  # noqa: E402
+
+from ceph_tpu.client.objecter import ObjectOperationError  # noqa: E402
+
+
+def _j(d) -> bytes:
+    return json.dumps(d).encode()
+
+
+async def _cluster():
+    cl = Cluster()
+    admin = await cl.start(3)
+    await admin.pool_create("p", pg_num=8)
+    return cl, admin.open_ioctx("p")
+
+
+def test_prepare_complete_and_header_stats():
+    async def run():
+        cl, io = await _cluster()
+        await io.exec("idx", "rgw", "bucket_init")
+        # re-init of a live index is refused
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("idx", "rgw", "bucket_init")
+        assert ei.value.retcode == -errno.EEXIST
+
+        # put: prepare -> (data elsewhere) -> complete
+        await io.exec("idx", "rgw", "bucket_prepare_op",
+                      _j({"tag": "t1", "op": "put", "key": "a", "ts": 1.0}))
+        # in-flight op is invisible to list but visible to check
+        out = json.loads(await io.exec("idx", "rgw", "bucket_list"))
+        assert out["entries"] == []
+        chk = json.loads(await io.exec("idx", "rgw", "bucket_check"))
+        assert [p["tag"] for p in chk["pending"]] == ["t1"]
+
+        await io.exec("idx", "rgw", "bucket_complete_op",
+                      _j({"tag": "t1", "op": "put", "key": "a",
+                          "entry": {"size": 100, "etag": "e1",
+                                    "mtime": 1.0}}))
+        hdr = json.loads(await io.exec("idx", "rgw", "bucket_read_header"))
+        assert hdr == {"entries": 1, "bytes": 100}
+        chk = json.loads(await io.exec("idx", "rgw", "bucket_check"))
+        assert chk["pending"] == [] and chk["actual"] == hdr
+
+        # overwrite adjusts bytes, not entries; delete removes both
+        await io.exec("idx", "rgw", "bucket_complete_op",
+                      _j({"op": "put", "key": "a",
+                          "entry": {"size": 40, "etag": "e2",
+                                    "mtime": 2.0}}))
+        hdr = json.loads(await io.exec("idx", "rgw", "bucket_read_header"))
+        assert hdr == {"entries": 1, "bytes": 40}
+        out = json.loads(await io.exec("idx", "rgw", "bucket_complete_op",
+                                       _j({"op": "del", "key": "a"})))
+        assert out["removed"]
+        hdr = json.loads(await io.exec("idx", "rgw", "bucket_read_header"))
+        assert hdr == {"entries": 0, "bytes": 0}
+        # del of a ghost still SUCCEEDS (it must clear the pending
+        # marker even when a concurrent delete won) but reports it
+        await io.exec("idx", "rgw", "bucket_prepare_op",
+                      _j({"tag": "t9", "op": "del", "key": "ghost",
+                          "ts": 2.0}))
+        out = json.loads(await io.exec("idx", "rgw", "bucket_complete_op",
+                                       _j({"tag": "t9", "op": "del",
+                                           "key": "ghost"})))
+        assert not out["removed"]
+        chk = json.loads(await io.exec("idx", "rgw", "bucket_check"))
+        assert chk["pending"] == []      # marker gone despite the miss
+
+        # object keys can't enter the \x01 marker namespace
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("idx", "rgw", "bucket_complete_op",
+                          _j({"op": "put", "key": "\x01pfake",
+                              "entry": {"size": 1}}))
+        assert ei.value.retcode == -errno.EINVAL
+
+        # cancel: a live gateway whose data write failed clears its
+        # own marker and touches nothing else
+        await io.exec("idx", "rgw", "bucket_prepare_op",
+                      _j({"tag": "tc", "op": "put", "key": "c",
+                          "ts": 3.0}))
+        await io.exec("idx", "rgw", "bucket_complete_op",
+                      _j({"tag": "tc", "op": "cancel", "key": "c"}))
+        chk = json.loads(await io.exec("idx", "rgw", "bucket_check"))
+        assert chk["pending"] == [] and chk["actual"]["entries"] == 0
+
+        # observed-pinned del: an overwrite that raced in since the
+        # deleter's read keeps its entry (removed=false)
+        await io.exec("idx", "rgw", "bucket_complete_op",
+                      _j({"op": "put", "key": "r",
+                          "entry": {"size": 5, "etag": "new",
+                                    "mtime": 9.0}}))
+        out = json.loads(await io.exec(
+            "idx", "rgw", "bucket_complete_op",
+            _j({"op": "del", "key": "r",
+                "observed": {"etag": "old", "mtime": 1.0}})))
+        assert not out["removed"]
+        hdr = json.loads(await io.exec("idx", "rgw",
+                                       "bucket_read_header"))
+        assert hdr == {"entries": 1, "bytes": 5}
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_list_pagination_and_prefix():
+    async def run():
+        cl, io = await _cluster()
+        await io.exec("idx", "rgw", "bucket_init")
+        for i in range(6):
+            await io.exec("idx", "rgw", "bucket_complete_op",
+                          _j({"op": "put", "key": f"d/{i}",
+                              "entry": {"size": i, "etag": "", "mtime": 0}}))
+        await io.exec("idx", "rgw", "bucket_complete_op",
+                      _j({"op": "put", "key": "other",
+                          "entry": {"size": 9, "etag": "", "mtime": 0}}))
+        p1 = json.loads(await io.exec(
+            "idx", "rgw", "bucket_list",
+            _j({"prefix": "d/", "max_keys": 4})))
+        assert p1["truncated"] and len(p1["entries"]) == 4
+        p2 = json.loads(await io.exec(
+            "idx", "rgw", "bucket_list",
+            _j({"prefix": "d/", "marker": p1["marker"]})))
+        keys = [e["key"] for e in p1["entries"] + p2["entries"]]
+        assert keys == [f"d/{i}" for i in range(6)]
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_crash_repair_suggest_and_rebuild():
+    """A 'gateway crash' between prepare and complete leaves a marker;
+    check --fix semantics (expire tags + rebuild header) and
+    dir_suggest removal of a dangling entry reconcile the index."""
+    async def run():
+        cl, io = await _cluster()
+        await io.exec("idx", "rgw", "bucket_init")
+        await io.exec("idx", "rgw", "bucket_prepare_op",
+                      _j({"tag": "dead", "op": "put", "key": "x",
+                          "ts": 1.0}))
+        # entry whose data object vanished
+        await io.exec("idx", "rgw", "bucket_complete_op",
+                      _j({"op": "put", "key": "dangling",
+                          "entry": {"size": 7, "etag": "", "mtime": 0}}))
+
+        chk = json.loads(await io.exec("idx", "rgw", "bucket_check"))
+        assert [p["tag"] for p in chk["pending"]] == ["dead"]
+
+        # a STALE suggestion (observed meta no longer matches) is
+        # skipped — a concurrent overwrite must not lose its entry
+        await io.exec("idx", "rgw", "dir_suggest_changes",
+                      _j({"changes": [{"op": "remove", "key": "dangling",
+                                       "observed": {"etag": "other"}}]}))
+        chk = json.loads(await io.exec("idx", "rgw", "bucket_check"))
+        assert chk["actual"]["entries"] == 1
+
+        await io.exec("idx", "rgw", "dir_suggest_changes",
+                      _j({"changes": [{"op": "remove", "key": "dangling",
+                                       "observed": {"etag": ""}}],
+                          "expire_tags": ["dead"]}))
+        chk = json.loads(await io.exec("idx", "rgw", "bucket_check"))
+        assert chk["pending"] == []
+        assert chk["actual"] == {"entries": 0, "bytes": 0}
+
+        # rebuild resets a (deliberately corrupted) header to truth
+        await io.exec("idx", "rgw", "bucket_complete_op",
+                      _j({"op": "put", "key": "y",
+                          "entry": {"size": 3, "etag": "", "mtime": 0}}))
+        hdr = json.loads(await io.exec(
+            "idx", "rgw", "bucket_rebuild_index"))
+        assert hdr == {"entries": 1, "bytes": 3}
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_gateway_rides_cls_index():
+    """End-to-end: S3 puts/deletes through the gateway maintain the
+    cls-held header stats, and a dangling entry self-heals on GET."""
+    async def run():
+        from ceph_tpu.services.rgw import S3Gateway, _index_oid
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create(".rgw", pg_num=8)
+        r = cl.clients[-1] if hasattr(cl, "clients") else admin
+        gw = S3Gateway(admin, pool=".rgw", require_auth=False)
+        io = gw.io
+
+        st, _, _ = await gw._put_bucket("b")
+        assert st == 200
+        st, _, _ = await gw._put_object("b", "k1", b"x" * 100, {})
+        assert st == 200
+        st, _, _ = await gw._put_object("b", "k2", b"y" * 50, {})
+        assert st == 200
+        hdr = json.loads(await io.exec(_index_oid("b"), "rgw",
+                                       "bucket_read_header"))
+        assert hdr == {"entries": 2, "bytes": 150}
+
+        st, _, _ = await gw._delete_object("b", "k1")
+        assert st == 204
+        hdr = json.loads(await io.exec(_index_oid("b"), "rgw",
+                                       "bucket_read_header"))
+        assert hdr == {"entries": 1, "bytes": 50}
+        # no pending markers left behind by the happy path
+        chk = json.loads(await io.exec(_index_oid("b"), "rgw",
+                                       "bucket_check"))
+        assert chk["pending"] == []
+
+        # dangling index entry (data object lost): GET 404s AND heals
+        # the index via dir_suggest
+        await io.exec(_index_oid("b"), "rgw", "bucket_complete_op",
+                      _j({"op": "put", "key": "ghost",
+                          "entry": {"size": 5, "etag": "", "mtime": 0,
+                                    "soid": "b//ghost.nope"}}))
+        st, _, _ = await gw._get_object("b", "ghost", {})
+        assert st == 404
+        out = json.loads(await io.exec(_index_oid("b"), "rgw",
+                                       "bucket_list"))
+        assert [e["key"] for e in out["entries"]] == ["k2"]
+        await cl.stop()
+    asyncio.run(run())

@@ -774,8 +774,14 @@ def test_quota_enforcement_and_usage_accounting():
         assert st == 200
         st, _, _ = await c.request("PUT", "/q/b", b"x" * 6000)
         assert st == 200
-        rec = await gw._bucket_rec("q")
-        assert rec["usage"] == {"size": 8000, "count": 2}
+        # usage lives in the cls-maintained index header (atomic with
+        # every entry change), not a gateway-side counter
+        import json as _json
+        from ceph_tpu.services.rgw import _index_oid
+        hdr = _json.loads(await io.exec(_index_oid("q"), "rgw",
+                                        "bucket_read_header"))
+        assert hdr == {"entries": 2, "bytes": 8000}
+        assert await gw._bucket_usage("q") == (8000, 2)
         # object-count cap
         await c.request("PUT", "/q/c", b"z")
         st, _, body = await c.request("PUT", "/q/d", b"z")
