@@ -21,6 +21,7 @@ from typing import Optional
 from ceph_tpu.cls import ClsContext, cls_method
 
 MAX_LIST_ENTRIES = 1000
+MAX_TRIM_ENTRIES = 4096
 PREFIX = "1_"
 
 
@@ -108,9 +109,13 @@ def log_trim(hctx: ClsContext, inbl: bytes):
         end = _key(float(req["to_ts"]), 0)
     omap = hctx.omap_get()
     lo, hi = start.encode(), end.encode() if end else None
-    doomed = [k for k in sorted(omap)
-              if k.startswith(PREFIX.encode()) and k >= lo
-              and (hi is None or k < hi)]
+    doomed = []
+    for k in sorted(omap):
+        if len(doomed) >= MAX_TRIM_ENTRIES:
+            break              # bounded per call; caller loops on rc 0
+        if (k.startswith(PREFIX.encode()) and k >= lo
+                and (hi is None or k < hi)):
+            doomed.append(k)
     if not doomed:
         return -errno.ENODATA, b""
     hctx.omap_rm(doomed)

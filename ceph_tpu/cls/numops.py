@@ -27,7 +27,7 @@ def _apply(hctx: ClsContext, inbl: bytes, op) -> tuple:
         operand = float(req["value"])
     except (TypeError, ValueError):
         return -errno.EINVAL, b""
-    stored = hctx.omap_get().get(key)
+    stored = hctx.omap_get_values([key]).get(key)
     if stored is None:
         current = 0.0
     else:

@@ -71,10 +71,10 @@ def user_remove_bucket(hctx: ClsContext, inbl: bytes):
     """in: {bucket} — drop the entry and subtract it from the header."""
     req = json.loads(inbl.decode())
     key = req["bucket"].encode()
-    omap = hctx.omap_get()
-    if key not in omap:
+    got = hctx.omap_get_values([key])
+    if key not in got:
         return -errno.ENOENT, b""
-    e = json.loads(omap.pop(key).decode())
+    e = json.loads(got[key].decode())
     hdr = _header(hctx)
     hdr["total_entries"] = max(0, hdr["total_entries"] - 1)
     hdr["total_bytes"] = max(0, hdr["total_bytes"] - int(e.get("size", 0)))

@@ -29,7 +29,7 @@ class Encoder:
         self.buf = bytearray()
 
     # primitives
-    def u8(self, v: int):  self.buf += _U8.pack(v & 0xFF); return self
+    def u8(self, v: int):  self.buf.append(v & 0xFF); return self
     def u16(self, v: int): self.buf += _U16.pack(v & 0xFFFF); return self
     def u32(self, v: int): self.buf += _U32.pack(v & 0xFFFFFFFF); return self
     def u64(self, v: int): self.buf += _U64.pack(v & (2**64 - 1)); return self

@@ -261,16 +261,20 @@ def execute_read_op(store, cid, soid, op: OSDOp) -> int:
             op.outdata = enc.getvalue()
             op.rval = 0
         elif op.op == OP_OMAP_GET_VALS:
-            _, omap = store.omap_get(cid, soid)
-            want = op.keys or sorted(omap)
+            if op.keys:
+                # keyed read stays O(keys) down through the store — a
+                # single-entry lookup must not scan the whole omap
+                vals = store.omap_get_values(cid, soid, op.keys)
+            else:
+                vals = store.omap_get(cid, soid)[1]
             from ceph_tpu.common.encoding import Encoder
             enc = Encoder()
-            enc.map_({k: omap[k] for k in want if k in omap},
-                     lambda e, k: e.bytes_(k), lambda e, v: e.bytes_(v))
+            enc.map_(vals, lambda e, k: e.bytes_(k),
+                     lambda e, v: e.bytes_(v))
             op.outdata = enc.getvalue()
             op.rval = 0
         elif op.op == OP_OMAP_GET_HEADER:
-            op.outdata = store.omap_get(cid, soid)[0]
+            op.outdata = store.omap_get_header(cid, soid)
             op.rval = 0
         elif op.op == OP_CALL:
             from ceph_tpu import cls as cls_mod

@@ -21,9 +21,14 @@ LOG_DELETE = 2
 
 class LogEntry(Encodable):
     """Includes the client reqid (osd_reqid_t role) so a re-sent write is
-    recognized as already-applied instead of executed twice."""
+    recognized as already-applied instead of executed twice.
 
-    __slots__ = ("op", "oid", "version", "prior_version", "reqid")
+    Entries are immutable once constructed, so their framed encoding is
+    cached (_enc): the pg log is re-persisted on EVERY write and
+    re-encoding the whole window per op dominated the OSD profile."""
+
+    __slots__ = ("op", "oid", "version", "prior_version", "reqid",
+                 "_enc")
 
     def __init__(self, op: int = LOG_MODIFY, oid: str = "",
                  version: Optional[EVersion] = None,
@@ -34,6 +39,13 @@ class LogEntry(Encodable):
         self.version = version or EVersion()
         self.prior_version = prior_version or EVersion()
         self.reqid = reqid
+        self._enc: Optional[bytes] = None
+
+    def framed_bytes(self) -> bytes:
+        """Full ENCODE_START-framed bytes, cached (safe: immutable)."""
+        if self._enc is None:
+            self._enc = self.to_bytes()
+        return self._enc
 
     def is_delete(self) -> bool:
         return self.op == LOG_DELETE
@@ -243,7 +255,10 @@ class PGLog(Encodable):
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.tail)
-        enc.list_(self.entries, lambda e, x: e.struct(x))
+        enc.u32(len(self.entries))
+        buf = enc.buf
+        for x in self.entries:
+            buf += x.framed_bytes()
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "PGLog":
