@@ -491,7 +491,11 @@ class S3Gateway:
             # form the router uses
             who, body = await self._authenticate(
                 method, parts.path, parts.query, headers, body)
-            if who is None:
+            if who is None and headers.get("authorization"):
+                # a PRESENTED credential that fails verification is
+                # always rejected; only credential-less requests fall
+                # through as anonymous for the ACL check (rgw_rest_s3
+                # anonymous + verify_permission split)
                 return 403, {}, _xml_error("AccessDenied")
         elif headers.get("x-amz-content-sha256") \
                 == "STREAMING-AWS4-HMAC-SHA256-PAYLOAD":
@@ -505,7 +509,11 @@ class S3Gateway:
         try:
             if not segs:
                 if method == "GET":
-                    return await self._list_buckets()
+                    if self.require_auth and who is None:
+                        # the service root lists the CALLER's buckets;
+                        # there is no anonymous account
+                        return 403, {}, _xml_error("AccessDenied")
+                    return await self._list_buckets(who)
                 return 405, {}, b""
             bucket = segs[0]
             key = "/".join(segs[1:])
@@ -514,10 +522,32 @@ class S3Gateway:
                 k, _, v = kv.partition("=")
                 if k:
                     q[k] = unquote(v)
+            # canned-ACL gate (rgw_acl.cc RGWAccessControlPolicy::
+            # verify_permission distilled to canned grants): owner
+            # passes everything; others by bucket/object acl
+            if "acl" in q:
+                # ACL subresource itself is owner-only (READ_ACP/
+                # WRITE_ACP stay with the owner for canned policies)
+                if not await self._is_owner(who, bucket):
+                    return 403, {}, _xml_error("AccessDenied")
+                if method == "PUT":
+                    return await self._put_acl(bucket, key, headers)
+                if method == "GET":
+                    return await self._get_acl(bucket, key)
+                return 405, {}, b""
+            if not await self._allowed(
+                    who, bucket, key or None,
+                    write=method in ("PUT", "POST", "DELETE")):
+                return 403, {}, _xml_error("AccessDenied")
             if not key:
                 if method == "GET" and "uploads" in q:
                     return await self._list_uploads(bucket)
                 if "lifecycle" in q:
+                    if method != "GET" and not await self._is_owner(
+                            who, bucket):
+                        # bucket config is owner-only even on a
+                        # public-read-write bucket
+                        return 403, {}, _xml_error("AccessDenied")
                     if method == "PUT":
                         return await self._put_lifecycle(bucket, body)
                     if method == "GET":
@@ -526,9 +556,14 @@ class S3Gateway:
                         return await self._delete_lifecycle(bucket)
                     return 405, {}, b""
                 if method == "PUT":
-                    return await self._put_bucket(bucket,
-                                                  owner=who or "")
+                    return await self._put_bucket(
+                        bucket, owner=who or "",
+                        acl=self._canned_from_headers(headers))
                 if method == "DELETE":
+                    if not await self._is_owner(who, bucket):
+                        # DeleteBucket is owner-only even on a
+                        # public-read-write bucket (S3 semantics)
+                        return 403, {}, _xml_error("AccessDenied")
                     return await self._delete_bucket(bucket)
                 if method == "GET":
                     return await self._list_objects(bucket, parts.query)
@@ -554,6 +589,10 @@ class S3Gateway:
                 return await self._abort_multipart(bucket, key,
                                                    q["uploadId"])
             if method == "PUT":
+                src = headers.get("x-amz-copy-source", "")
+                if src:
+                    return await self._copy_object(who, bucket, key,
+                                                   src, headers)
                 return await self._put_object(bucket, key, body, headers)
             if method == "GET":
                 return await self._get_object(bucket, key, headers)
@@ -685,20 +724,19 @@ class S3Gateway:
 
     # -------------------------------------------------------------- buckets
     async def _bucket_exists(self, bucket: str) -> bool:
-        try:
-            omap = await self.io.omap_get(BUCKETS_OID)
-        except ObjectOperationError:
-            return False
-        return bucket.encode() in omap
+        return await self._bucket_rec(bucket) is not None
 
     async def _bucket_rec(self, bucket: str) -> Optional[dict]:
-        """The bucket's metadata row: created/owner/quota/usage/
-        lifecycle (rgw_bucket.cc RGWBucketInfo role)."""
+        """The bucket's metadata row: created/owner/quota/
+        lifecycle (rgw_bucket.cc RGWBucketInfo role).  Keyed read:
+        the per-request ACL gate rides this, and it must not ship the
+        whole bucket table for one row."""
         try:
-            omap = await self.io.omap_get(BUCKETS_OID)
+            got = await self.io.omap_get(BUCKETS_OID,
+                                         keys=[bucket.encode()])
         except ObjectOperationError:
             return None
-        raw = omap.get(bucket.encode())
+        raw = got.get(bucket.encode())
         return json.loads(raw.decode()) if raw else None
 
     async def _save_bucket_rec(self, bucket: str, rec: dict) -> None:
@@ -759,6 +797,115 @@ class S3Gateway:
                 if not uq.allows(tsize, tcount, add_size, add_count):
                     return False
         return True
+
+    # ----------------------------------------------------------------- acls
+    # Canned ACLs (rgw_acl.cc / rgw_acl_s3.cc distilled): "private",
+    # "public-read", "public-read-write", "authenticated-read" on
+    # buckets and objects; object acl overrides bucket acl; full
+    # grant-list policies are out of scope (canned covers the s3tests
+    # anonymous-access matrix).
+
+    CANNED_ACLS = ("private", "public-read", "public-read-write",
+                   "authenticated-read")
+
+    async def _is_owner(self, who: Optional[str], bucket: str) -> bool:
+        if not self.require_auth:
+            return True
+        if who is None:
+            return False
+        rec = await self._bucket_rec(bucket)
+        if rec is None:
+            return True          # bucket 404 surfaces downstream
+        owner = rec.get("owner", "")
+        return not owner or who == owner
+
+    async def _allowed(self, who: Optional[str], bucket: str,
+                       key: Optional[str], write: bool) -> bool:
+        """Does `who` (None = anonymous) get read/write here?"""
+        if not self.require_auth:
+            return True
+        rec = await self._bucket_rec(bucket)
+        if rec is None:
+            # touching a bucket that doesn't exist yet (e.g. create):
+            # any authenticated identity may try; anonymous may not
+            return who is not None
+        owner = rec.get("owner", "")
+        if who is not None and (not owner or who == owner):
+            return True
+        if write:
+            # writes (create/overwrite/delete) answer to the BUCKET's
+            # WRITE grant (rgw_acl verify_permission): an object-level
+            # acl must not let an uploader lock a key inside a shared
+            # public-read-write bucket
+            return rec.get("acl", "private") == "public-read-write"
+        acl = None
+        if key:
+            meta = await self._obj_meta(bucket, key)
+            if meta is not None:
+                acl = meta.get("acl")
+        if acl is None:
+            acl = rec.get("acl", "private")
+        if acl in ("public-read", "public-read-write"):
+            return True
+        return acl == "authenticated-read" and who is not None
+
+    def _canned_from_headers(self, headers: Dict[str, str]
+                             ) -> Optional[str]:
+        acl = headers.get("x-amz-acl", "")
+        return acl if acl in self.CANNED_ACLS else None
+
+    @staticmethod
+    def _acl_xml(owner: str, acl: str) -> bytes:
+        grants = ['<Grant><Grantee>CanonicalUser</Grantee>'
+                  '<Permission>FULL_CONTROL</Permission></Grant>']
+        if acl in ("public-read", "public-read-write"):
+            grants.append("<Grant><Grantee>AllUsers</Grantee>"
+                          "<Permission>READ</Permission></Grant>")
+        if acl == "public-read-write":
+            grants.append("<Grant><Grantee>AllUsers</Grantee>"
+                          "<Permission>WRITE</Permission></Grant>")
+        if acl == "authenticated-read":
+            grants.append("<Grant><Grantee>AuthenticatedUsers"
+                          "</Grantee><Permission>READ</Permission>"
+                          "</Grant>")
+        return (f'<?xml version="1.0"?><AccessControlPolicy>'
+                f"<Owner><ID>{owner}</ID></Owner>"
+                f"<AccessControlList>{''.join(grants)}"
+                f"</AccessControlList></AccessControlPolicy>").encode()
+
+    async def _put_acl(self, bucket: str, key: str,
+                       headers: Dict[str, str]):
+        canned = self._canned_from_headers(headers) or "private"
+        if key:
+            meta = await self._obj_meta(bucket, key)
+            if meta is None:
+                return 404, {}, _xml_error("NoSuchKey")
+            meta["acl"] = canned
+            # same-size entry rewrite: header stats are unchanged
+            await self.io.exec(
+                _index_oid(bucket), "rgw", "bucket_complete_op",
+                json.dumps({"op": "put", "key": key,
+                            "entry": meta}).encode())
+            return 200, {}, b""
+        rec = await self._bucket_rec(bucket)
+        if rec is None:
+            return 404, {}, _xml_error("NoSuchBucket")
+        rec["acl"] = canned
+        await self._save_bucket_rec(bucket, rec)
+        return 200, {}, b""
+
+    async def _get_acl(self, bucket: str, key: str):
+        rec = await self._bucket_rec(bucket)
+        if rec is None:
+            return 404, {}, _xml_error("NoSuchBucket")
+        acl = rec.get("acl", "private")
+        if key:
+            meta = await self._obj_meta(bucket, key)
+            if meta is None:
+                return 404, {}, _xml_error("NoSuchKey")
+            acl = meta.get("acl") or acl
+        return 200, {"Content-Type": "application/xml"}, \
+            self._acl_xml(rec.get("owner", ""), acl)
 
     async def set_bucket_quota(self, bucket: str, max_size: int = -1,
                                max_objects: int = -1) -> bool:
@@ -856,24 +1003,34 @@ class S3Gateway:
                             aborted += 1
         return {"expired": expired, "aborted": aborted}
 
-    async def _list_buckets(self):
+    async def _list_buckets(self, who: Optional[str] = None):
+        """ListAllMyBuckets — scoped to the CALLER's buckets (S3
+        semantics); with auth off (or legacy ownerless buckets) every
+        record is the caller's."""
         try:
             omap = await self.io.omap_get(BUCKETS_OID)
         except ObjectOperationError:
             omap = {}
+        names = []
+        for k in sorted(omap):
+            owner = json.loads(omap[k].decode()).get("owner", "")
+            if not self.require_auth or not owner or owner == who:
+                names.append(k.decode())
         entries = "".join(
-            f"<Bucket><Name>{k.decode()}</Name></Bucket>"
-            for k in sorted(omap))
+            f"<Bucket><Name>{n}</Name></Bucket>" for n in names)
         xml = (f'<?xml version="1.0"?><ListAllMyBucketsResult>'
                f"<Buckets>{entries}</Buckets></ListAllMyBucketsResult>")
         return 200, {"Content-Type": "application/xml"}, xml.encode()
 
-    async def _put_bucket(self, bucket: str, owner: str = ""):
+    async def _put_bucket(self, bucket: str, owner: str = "",
+                          acl: Optional[str] = None):
         if await self._bucket_exists(bucket):
             return 409, {}, _xml_error("BucketAlreadyExists")
+        rec = {"created": time.time(), "owner": owner}
+        if acl:
+            rec["acl"] = acl
         await self.io.omap_set(BUCKETS_OID, {
-            bucket.encode(): json.dumps(
-                {"created": time.time(), "owner": owner}).encode()})
+            bucket.encode(): json.dumps(rec).encode()})
         try:
             await self.io.exec(_index_oid(bucket), "rgw", "bucket_init")
         except ObjectOperationError as e:
@@ -977,16 +1134,48 @@ class S3Gateway:
                 pass
             raise
         etag = hashlib.md5(body).hexdigest()
+        entry = {"size": len(body), "etag": etag, "soid": soid,
+                 "mtime": time.time()}
+        canned = self._canned_from_headers(headers)
+        if canned:
+            entry["acl"] = canned
         await self.io.exec(_index_oid(bucket), "rgw", "bucket_complete_op",
                            json.dumps({"tag": tag, "op": "put", "key": key,
-                                       "entry": {
-                                           "size": len(body), "etag": etag,
-                                           "soid": soid,
-                                           "mtime": time.time(),
-                                       }}).encode())
+                                       "entry": entry}).encode())
         await self.gc.defer(self._chain_of(old, bucket, key))
         await self._log_change("put", bucket, key)
         return 200, {"ETag": f'"{etag}"'}, b""
+
+    async def _copy_object(self, who: Optional[str], bucket: str,
+                           key: str, src: str,
+                           headers: Dict[str, str]):
+        """Server-side copy (rgw_op.cc RGWCopyObj): x-amz-copy-source
+        names /srcbucket/srckey; the gateway moves the bytes without
+        the client round-trip.  Divergence: bytes are re-written
+        rather than manifest-shared via cls_refcount — simpler, and
+        GC/overwrite semantics stay uniform."""
+        parts = [s for s in unquote(src).split("/") if s]
+        if len(parts) < 2:
+            return 400, {}, _xml_error("InvalidArgument")
+        sbucket, skey = parts[0], "/".join(parts[1:])
+        # reading the source is itself ACL-gated
+        if not await self._allowed(who, sbucket, skey, write=False):
+            return 403, {}, _xml_error("AccessDenied")
+        st, _, data = await self._get_object(sbucket, skey, {})
+        if st != 200:
+            return 404, {}, _xml_error("NoSuchKey")
+        st, h, payload = await self._put_object(bucket, key, data,
+                                                headers)
+        if st != 200:
+            return st, h, payload
+        meta = await self._obj_meta(bucket, key)
+        mtime = time.strftime(
+            "%Y-%m-%dT%H:%M:%S.000Z",
+            time.gmtime(meta["mtime"] if meta else time.time()))
+        xml = (f'<?xml version="1.0"?><CopyObjectResult>'
+               f"<LastModified>{mtime}</LastModified>"
+               f"<ETag>{h.get('ETag', '')}</ETag></CopyObjectResult>")
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
 
     async def _get_object(self, bucket: str, key: str,
                           headers: Dict[str, str]):

@@ -843,3 +843,138 @@ def test_list_multipart_uploads():
         await gw.stop()
         await cl.stop()
     asyncio.run(run())
+
+
+def test_canned_acls_and_anonymous_access():
+    """Canned ACL matrix (rgw_acl.cc / s3tests anonymous access):
+    private refuses anonymous; public-read opens GET but not PUT;
+    public-read-write opens both; object acl overrides bucket acl;
+    the ?acl subresource stays owner-only."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create(".rgw", pg_num=8)
+        gw = S3Gateway(admin)
+        db = UserDB(admin.open_ioctx(".rgw"))
+        await db.create("OWNER", "sk1")
+        await db.create("OTHER", "sk2")
+        port = await gw.start()
+        owner = S3Client(port, "OWNER", "sk1")
+        other = S3Client(port, "OTHER", "sk2")
+        anon = S3Client(port)
+
+        await owner.request("PUT", "/b")
+        await owner.request("PUT", "/b/secret", b"s3cret")
+
+        # private (default): anonymous and other users are refused
+        st, _, _ = await anon.request("GET", "/b/secret", sign=False)
+        assert st == 403
+        st, _, _ = await other.request("GET", "/b/secret")
+        assert st == 403
+        st, _, _ = await anon.request("GET", "/b", sign=False)
+        assert st == 403
+
+        # object-level public-read via ?acl (owner-only subresource)
+        st, _, _ = await other.request("PUT", "/b/secret?acl",
+                                       headers={"x-amz-acl":
+                                                "public-read"})
+        assert st == 403
+        st, _, _ = await owner.request("PUT", "/b/secret?acl",
+                                       headers={"x-amz-acl":
+                                                "public-read"})
+        assert st == 200
+        st, _, got = await anon.request("GET", "/b/secret", sign=False)
+        assert st == 200 and got == b"s3cret"
+        # read is open; write is not
+        st, _, _ = await anon.request("PUT", "/b/secret", b"x",
+                                      sign=False)
+        assert st == 403
+        st, _, body = await owner.request("GET", "/b/secret?acl")
+        assert st == 200 and b"AllUsers" in body \
+            and b"FULL_CONTROL" in body
+
+        # bucket-level public-read-write: anonymous can PUT new keys
+        # and list
+        st, _, _ = await owner.request("PUT", "/b?acl",
+                                       headers={"x-amz-acl":
+                                                "public-read-write"})
+        assert st == 200
+        st, _, _ = await anon.request("PUT", "/b/dropbox", b"hi",
+                                      sign=False)
+        assert st == 200
+        st, _, body = await anon.request("GET", "/b", sign=False)
+        assert st == 200 and b"dropbox" in body
+
+        # authenticated-read: other signed users read, anonymous not
+        st, _, _ = await owner.request("PUT", "/b?acl",
+                                       headers={"x-amz-acl":
+                                                "authenticated-read"})
+        assert st == 200
+        st, _, _ = await other.request("GET", "/b/dropbox")
+        assert st == 200
+        st, _, _ = await anon.request("GET", "/b/dropbox", sign=False)
+        assert st == 403
+
+        # x-amz-acl at PUT time
+        st, _, _ = await owner.request("PUT", "/b/open", b"o",
+                                       headers={"x-amz-acl":
+                                                "public-read"})
+        assert st == 200
+        st, _, got = await anon.request("GET", "/b/open", sign=False)
+        assert st == 200 and got == b"o"
+        await gw.stop()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_server_side_copy():
+    """x-amz-copy-source (rgw_op.cc RGWCopyObj): same- and cross-
+    bucket copies move bytes without the client round-trip; source
+    ACLs gate the read; ETag survives."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create(".rgw", pg_num=8)
+        gw = S3Gateway(admin)
+        db = UserDB(admin.open_ioctx(".rgw"))
+        await db.create("OWNER", "sk1")
+        await db.create("OTHER", "sk2")
+        port = await gw.start()
+        owner = S3Client(port, "OWNER", "sk1")
+        other = S3Client(port, "OTHER", "sk2")
+
+        payload = b"copy me " * 9000              # striped size
+        await owner.request("PUT", "/src")
+        await owner.request("PUT", "/dst")
+        await owner.request("PUT", "/src/orig", payload)
+
+        st, _, body = await owner.request(
+            "PUT", "/dst/copied", b"",
+            headers={"x-amz-copy-source": "/src/orig"})
+        assert st == 200 and b"CopyObjectResult" in body
+        assert hashlib.md5(payload).hexdigest().encode() in body
+        st, _, got = await owner.request("GET", "/dst/copied")
+        assert st == 200 and got == payload
+
+        # same-bucket copy
+        st, _, _ = await owner.request(
+            "PUT", "/src/orig2", b"",
+            headers={"x-amz-copy-source": "/src/orig"})
+        assert st == 200
+
+        # a different user can't copy from a private source even into
+        # their own bucket
+        await other.request("PUT", "/theirs")
+        st, _, _ = await other.request(
+            "PUT", "/theirs/stolen", b"",
+            headers={"x-amz-copy-source": "/src/orig"})
+        assert st == 403
+
+        # missing source
+        st, _, _ = await owner.request(
+            "PUT", "/dst/nope", b"",
+            headers={"x-amz-copy-source": "/src/missing"})
+        assert st == 404
+        await gw.stop()
+        await cl.stop()
+    asyncio.run(run())
