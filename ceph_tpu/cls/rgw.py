@@ -146,6 +146,15 @@ def bucket_complete_op(hctx: ClsContext, inbl: bytes):
     key = req["key"].encode()
     removed = True
     if op == "put":
+        obs = req.get("observed")
+        if obs is not None and key in omap:
+            live = json.loads(omap[key].decode())
+            if any(live.get(f) != obs.get(f) for f in obs):
+                # guarded entry rewrite (PutObjectAcl-style RMW): the
+                # entry moved since the caller read it — applying the
+                # stale copy would resurrect a gc'd chain.  ECANCELED
+                # so the caller re-reads and retries.
+                return -errno.ECANCELED, b""
         _apply_put(hctx, omap, hdr, key, req.get("entry") or {})
     else:
         obs = req.get("observed")

@@ -384,6 +384,9 @@ class Monitor(Dispatcher):
             elif prefix == "pg stat":
                 self.reply(m, MMonCommandAck(
                     m.tid, 0, json.dumps(self.pgmon.pg_summary())))
+            elif prefix == "df":
+                self.reply(m, MMonCommandAck(
+                    m.tid, 0, json.dumps(self.pgmon.df())))
             elif prefix == "pg dump":
                 self.reply(m, MMonCommandAck(
                     m.tid, 0, json.dumps(self.pgmon.dump())))
@@ -438,7 +441,8 @@ class Monitor(Dispatcher):
             self.reply(m, MMonCommandAck(m.tid, -errno.EIO, repr(e)))
 
     _READONLY_COMMANDS = frozenset({
-        "health", "status", "pg stat", "pg dump", "log last", "mon dump",
+        "health", "status", "df", "pg stat", "pg dump", "log last",
+        "mon dump",
         "quorum_status", "osd dump", "osd tree", "osd stat", "osd ls",
         "osd pool ls", "osd getmap", "osd getcrushmap",
         "osd erasure-code-profile ls", "osd erasure-code-profile get",

@@ -86,6 +86,50 @@ class PGMonitor:
     def expected_pg_count(self) -> int:
         return sum(p.pg_num for p in self.mon.osdmon.osdmap.pools.values())
 
+    def df(self) -> Dict:
+        """`ceph df` role (PGMonitor::dump_pool_stats /
+        dump_fs_stats): per-pool logical usage aggregated from pg
+        stats, plus the raw multiplier implied by the pool's
+        redundancy (size for replicated, (k+m)/k for EC)."""
+        self._prune()
+        osdmap = self.mon.osdmon.osdmap
+        per_pool: Dict[int, dict] = {}
+        for pgid, st in self.pg_stats.items():
+            try:
+                pool_id = int(pgid.partition(".")[0])
+            except ValueError:
+                continue
+            agg = per_pool.setdefault(pool_id,
+                                      {"objects": 0, "bytes": 0})
+            agg["objects"] += st.get("num_objects", 0)
+            agg["bytes"] += st.get("num_bytes", 0)
+        pools = []
+        total = 0
+        total_raw = 0.0
+        for pool_id, pool in sorted(osdmap.pools.items()):
+            agg = per_pool.get(pool_id, {"objects": 0, "bytes": 0})
+            if pool.is_erasure():
+                prof = osdmap.ec_profiles.get(pool.ec_profile, {})
+                k = max(1, int(prof.get("k", pool.min_size)))
+                raw_mult = pool.size / k
+            else:
+                raw_mult = float(pool.size)
+            raw = agg["bytes"] * raw_mult
+            pools.append({"name": osdmap.pool_names.get(pool_id,
+                                                        str(pool_id)),
+                          "id": pool_id,
+                          "objects": agg["objects"],
+                          "bytes_used": agg["bytes"],
+                          "raw_bytes_used": int(raw)})
+            total += agg["bytes"]
+            total_raw += raw
+        return {"pools": pools,
+                "stats": {"total_objects":
+                          sum(p["objects"] for p in pools),
+                          "total_bytes_used": total,
+                          "total_raw_used": int(total_raw),
+                          "num_osds": osdmap.count_up()}}
+
     def health(self) -> Dict:
         """HEALTH_OK/WARN/ERR roll-up (PGMap::get_health role)."""
         checks: List[str] = []

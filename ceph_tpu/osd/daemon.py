@@ -597,12 +597,29 @@ class OSD(Dispatcher):
                     _, n_objs, nbytes = cached
                 else:
                     try:
+                        from ceph_tpu.osd.backend import SIZE_XATTR
                         objs = [o for o in
                                 self.store.collection_list(pg.cid)
                                 if o.name != pg.meta_oid.name
                                 and o.is_head()]
-                        nbytes = sum(self.store.stat(pg.cid, o)["size"]
-                                     for o in objs)
+
+                        def _obj_bytes(o):
+                            # EC shards store chunk bytes; the LOGICAL
+                            # object length rides SIZE_XATTR (hinfo
+                            # role) so pool stats report what the
+                            # client stored, not the shard residue.
+                            # Replicated pools never carry the xattr —
+                            # plain stat, no probe.
+                            if not pg.pool.is_erasure():
+                                return self.store.stat(pg.cid,
+                                                       o)["size"]
+                            try:
+                                return int(self.store.getattr(
+                                    pg.cid, o, SIZE_XATTR))
+                            except Exception:
+                                return self.store.stat(pg.cid,
+                                                       o)["size"]
+                        nbytes = sum(_obj_bytes(o) for o in objs)
                         n_objs = len(objs)
                         # only cache a SUCCESSFUL walk: recovery pushes
                         # don't bump last_update, so caching a failed or

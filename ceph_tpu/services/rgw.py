@@ -497,10 +497,11 @@ class S3Gateway:
                 # through as anonymous for the ACL check (rgw_rest_s3
                 # anonymous + verify_permission split)
                 return 403, {}, _xml_error("AccessDenied")
-        elif headers.get("x-amz-content-sha256") \
+        if who is None and headers.get("x-amz-content-sha256") \
                 == "STREAMING-AWS4-HMAC-SHA256-PAYLOAD":
-            # auth off: still strip the aws-chunked framing, or the
-            # framing bytes would be stored as object data
+            # anonymous (or auth off): still strip the aws-chunked
+            # framing — unverifiable without a secret, but the framing
+            # bytes must never be stored as object data
             decoded = decode_aws_chunked(body)
             if decoded is None:
                 return 400, {}, _xml_error("IncompleteBody")
@@ -524,11 +525,14 @@ class S3Gateway:
                     q[k] = unquote(v)
             # canned-ACL gate (rgw_acl.cc RGWAccessControlPolicy::
             # verify_permission distilled to canned grants): owner
-            # passes everything; others by bucket/object acl
+            # passes everything; others by bucket/object acl.  The
+            # bucket rec is fetched ONCE here and passed down.
+            rec = await self._bucket_rec(bucket) if self.require_auth \
+                else None
             if "acl" in q:
                 # ACL subresource itself is owner-only (READ_ACP/
                 # WRITE_ACP stay with the owner for canned policies)
-                if not await self._is_owner(who, bucket):
+                if not await self._is_owner(who, bucket, rec=rec):
                     return 403, {}, _xml_error("AccessDenied")
                 if method == "PUT":
                     return await self._put_acl(bucket, key, headers)
@@ -537,14 +541,15 @@ class S3Gateway:
                 return 405, {}, b""
             if not await self._allowed(
                     who, bucket, key or None,
-                    write=method in ("PUT", "POST", "DELETE")):
+                    write=method in ("PUT", "POST", "DELETE"),
+                    rec=rec):
                 return 403, {}, _xml_error("AccessDenied")
             if not key:
                 if method == "GET" and "uploads" in q:
                     return await self._list_uploads(bucket)
                 if "lifecycle" in q:
                     if method != "GET" and not await self._is_owner(
-                            who, bucket):
+                            who, bucket, rec=rec):
                         # bucket config is owner-only even on a
                         # public-read-write bucket
                         return 403, {}, _xml_error("AccessDenied")
@@ -560,7 +565,7 @@ class S3Gateway:
                         bucket, owner=who or "",
                         acl=self._canned_from_headers(headers))
                 if method == "DELETE":
-                    if not await self._is_owner(who, bucket):
+                    if not await self._is_owner(who, bucket, rec=rec):
                         # DeleteBucket is owner-only even on a
                         # public-read-write bucket (S3 semantics)
                         return 403, {}, _xml_error("AccessDenied")
@@ -634,12 +639,15 @@ class S3Gateway:
             return 204, {"X-Storage-Url":
                          f"http://127.0.0.1:{self.port}/swift/v1",
                          "X-Auth-Token": token}, b""
+        who: Optional[str] = None
         if self.require_auth:
             tok = headers.get("x-auth-token", "")
             ent = self._swift_tokens.get(tok)
             if ent is None or ent[1] < time.time():
                 self._swift_tokens.pop(tok, None)
                 return 401, {}, b""
+            who = ent[0]    # the token's user: ACL/ownership checks
+            #                 apply across BOTH REST personalities
         segs = [s for s in path[len("/swift/v1"):].split("/") if s]
         q = {}
         for kv in query.split("&"):
@@ -654,7 +662,12 @@ class S3Gateway:
                     omap = await self.io.omap_get(BUCKETS_OID)
                 except ObjectOperationError:
                     omap = {}
-                names = sorted(k.decode() for k in omap)
+                names = []
+                for k in sorted(omap):        # the CALLER's containers
+                    owner = json.loads(omap[k].decode()).get("owner", "")
+                    if not self.require_auth or not owner \
+                            or owner == who:
+                        names.append(k.decode())
                 if q.get("format") == "json":
                     out = json.dumps([{"name": n} for n in names])
                     return 200, {"Content-Type": "application/json"}, \
@@ -664,8 +677,14 @@ class S3Gateway:
                 return 200, {"Content-Type": "text/plain"}, text
             cont = segs[0]
             obj = "/".join(segs[1:])
+            # same _allowed/_is_owner gates as the S3 personality: one
+            # store, one ACL model, two REST dialects
+            if not await self._allowed(
+                    who, cont, obj or None,
+                    write=method in ("PUT", "POST", "DELETE")):
+                return 403, {}, b""
             if not obj:
-                return await self._swift_container(method, cont, q)
+                return await self._swift_container(method, cont, q, who)
             return await self._swift_object(method, cont, obj, body,
                                             headers)
         except ObjectOperationError:
@@ -673,11 +692,14 @@ class S3Gateway:
         except StripedObjectNotFound:
             return 404, {}, b""
 
-    async def _swift_container(self, method: str, cont: str, q: dict):
+    async def _swift_container(self, method: str, cont: str, q: dict,
+                               who: Optional[str] = None):
         if method == "PUT":
-            st, _, _ = await self._put_bucket(cont)
+            st, _, _ = await self._put_bucket(cont, owner=who or "")
             return (201 if st == 200 else 202), {}, b""  # 202 = existed
         if method == "DELETE":
+            if not await self._is_owner(who, cont):
+                return 403, {}, b""
             st, _, _ = await self._delete_bucket(cont)
             return (204 if st == 204 else st), {}, b""
         if method == "HEAD":
@@ -808,23 +830,30 @@ class S3Gateway:
     CANNED_ACLS = ("private", "public-read", "public-read-write",
                    "authenticated-read")
 
-    async def _is_owner(self, who: Optional[str], bucket: str) -> bool:
+    _UNSET = object()            # "rec not prefetched" sentinel
+
+    async def _is_owner(self, who: Optional[str], bucket: str,
+                        rec=_UNSET) -> bool:
         if not self.require_auth:
             return True
         if who is None:
             return False
-        rec = await self._bucket_rec(bucket)
+        if rec is self._UNSET:
+            rec = await self._bucket_rec(bucket)
         if rec is None:
             return True          # bucket 404 surfaces downstream
         owner = rec.get("owner", "")
         return not owner or who == owner
 
     async def _allowed(self, who: Optional[str], bucket: str,
-                       key: Optional[str], write: bool) -> bool:
-        """Does `who` (None = anonymous) get read/write here?"""
+                       key: Optional[str], write: bool,
+                       rec=_UNSET) -> bool:
+        """Does `who` (None = anonymous) get read/write here?  Pass a
+        prefetched bucket rec to avoid re-reading it per gate."""
         if not self.require_auth:
             return True
-        rec = await self._bucket_rec(bucket)
+        if rec is self._UNSET:
+            rec = await self._bucket_rec(bucket)
         if rec is None:
             # touching a bucket that doesn't exist yet (e.g. create):
             # any authenticated identity may try; anonymous may not
@@ -877,16 +906,28 @@ class S3Gateway:
                        headers: Dict[str, str]):
         canned = self._canned_from_headers(headers) or "private"
         if key:
-            meta = await self._obj_meta(bucket, key)
-            if meta is None:
-                return 404, {}, _xml_error("NoSuchKey")
-            meta["acl"] = canned
-            # same-size entry rewrite: header stats are unchanged
-            await self.io.exec(
-                _index_oid(bucket), "rgw", "bucket_complete_op",
-                json.dumps({"op": "put", "key": key,
-                            "entry": meta}).encode())
-            return 200, {}, b""
+            import errno as _errno
+            for _ in range(5):
+                meta = await self._obj_meta(bucket, key)
+                if meta is None:
+                    return 404, {}, _xml_error("NoSuchKey")
+                observed = {"etag": meta.get("etag"),
+                            "mtime": meta.get("mtime")}
+                meta["acl"] = canned
+                try:
+                    # observed-guarded RMW: a racing overwrite between
+                    # our read and this write would otherwise be
+                    # reverted to a stale (already gc-deferred) entry
+                    await self.io.exec(
+                        _index_oid(bucket), "rgw", "bucket_complete_op",
+                        json.dumps({"op": "put", "key": key,
+                                    "entry": meta,
+                                    "observed": observed}).encode())
+                    return 200, {}, b""
+                except ObjectOperationError as e:
+                    if e.retcode != -_errno.ECANCELED:
+                        raise
+            return 409, {}, _xml_error("OperationAborted")
         rec = await self._bucket_rec(bucket)
         if rec is None:
             return 404, {}, _xml_error("NoSuchBucket")

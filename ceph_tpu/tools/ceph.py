@@ -52,7 +52,7 @@ def build_command(args, extra) -> dict:
     extra = [w for w in args.command
              if "=" in w and not w.startswith("-")] + list(extra)
     cmd = {"prefix": " ".join(words)}
-    if words[0] in ("status", "health", "quorum_status", "mon"):
+    if words[0] in ("status", "health", "df", "quorum_status", "mon"):
         return cmd
     if words[0] == "pg" and len(words) > 2 \
             and words[1] in ("scrub", "deep-scrub"):

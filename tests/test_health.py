@@ -177,3 +177,32 @@ def test_osd_op_tracking_via_client_io():
                    for osd in cl.osds.values())
         await cl.stop()
     asyncio.run(run())
+
+
+def test_ceph_df_reports_pool_usage():
+    """`ceph df` (PGMonitor dump_pool_stats role): per-pool logical
+    bytes/objects from pg stats + raw usage implied by redundancy
+    (size x for replicated, (k+m)/k x for EC)."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(4)
+        await admin.pool_create("rep", pg_num=4, size=3)
+        await admin.pool_create("ec", pg_num=4, pool_type="erasure",
+                                k=2, m=2)
+        rio = admin.open_ioctx("rep")
+        eio = admin.open_ioctx("ec")
+        await rio.write_full("a", b"x" * 1000)
+        await eio.write_full("b", b"y" * 4000)
+        await wait_health(admin, "HEALTH_OK")
+        ack = await admin.mon_command({"prefix": "df"})
+        df = json.loads(ack.outs)
+        rows = {p["name"]: p for p in df["pools"]}
+        assert rows["rep"]["objects"] == 1
+        assert rows["rep"]["bytes_used"] == 1000
+        assert rows["rep"]["raw_bytes_used"] == 3000      # size 3
+        assert rows["ec"]["bytes_used"] == 4000
+        assert rows["ec"]["raw_bytes_used"] == 8000       # (2+2)/2
+        assert df["stats"]["total_objects"] == 2
+        assert df["stats"]["total_bytes_used"] == 5000
+        await cl.stop()
+    asyncio.run(run())

@@ -565,11 +565,16 @@ def test_swift_dialect_end_to_end():
         rows = _json.loads(body)
         assert rows and rows[0]["name"] == "a/b.bin" \
             and rows[0]["bytes"] == len(payload)
-        # the S3 personality sees the same object
-        await UserDB(gw.io).create("AKS", "SKS")
-        s3 = S3Client(port, "AKS", "SKS")
+        # the S3 personality sees the same object (same user, same
+        # credentials — ownership spans both dialects)
+        s3 = S3Client(port, "swiftop", "swsecret")
         st, _, got = await s3.request("GET", "/media/a/b.bin")
         assert st == 200 and got == payload
+        # ...and a DIFFERENT s3 user is refused by the same ACLs
+        await UserDB(gw.io).create("AKS", "SKS")
+        st, _, _ = await S3Client(port, "AKS", "SKS").request(
+            "GET", "/media/a/b.bin")
+        assert st == 403
         # delete object then container
         st, _, _ = await c.request("DELETE", "/swift/v1/media/a/b.bin",
                                    sign=False, headers=tok)
