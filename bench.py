@@ -602,7 +602,10 @@ def main():
     # plugin on PYTHONPATH wedges even under JAX_PLATFORMS=cpu).
     crush = None
     if not skip_crush:
-        crush, n = run_stage("crush", remaining() - 120, crush_env)
+        # leave the e2e stage a real budget: it boots a 5-osd cluster
+        # and needs ~3-5 min on a loaded container (r5: a 110s
+        # leftover starved it to a timeout)
+        crush, n = run_stage("crush", remaining() - 300, crush_env)
         if n:
             notes.append(n)
 

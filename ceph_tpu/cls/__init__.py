@@ -221,3 +221,8 @@ from ceph_tpu.cls import timeindex as _timeindex  # noqa: E402,F401
 from ceph_tpu.cls import log as _log            # noqa: E402,F401
 from ceph_tpu.cls import user as _user          # noqa: E402,F401
 from ceph_tpu.cls import rgw as _rgw_cls        # noqa: E402,F401
+from ceph_tpu.cls import statelog as _statelog  # noqa: E402,F401
+from ceph_tpu.cls import replica_log as _replica_log  # noqa: E402,F401
+# deliberately absent vs src/cls/: hello (demo), lua (needs a lua vm),
+# cephfs (dirfrag size/mtime hints for offline recovery tooling the
+# MDS redesign doesn't use), log/timeindex/... are present above

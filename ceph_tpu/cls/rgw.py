@@ -147,13 +147,16 @@ def bucket_complete_op(hctx: ClsContext, inbl: bytes):
     removed = True
     if op == "put":
         obs = req.get("observed")
-        if obs is not None and key in omap:
+        if obs is not None:
+            # guarded entry rewrite (PutObjectAcl-style RMW): the
+            # entry must still be EXACTLY what the caller read — a
+            # racing overwrite (field mismatch) or a racing delete
+            # (key gone) both mean applying the stale copy would
+            # resurrect a gc'd chain.  ECANCELED: caller re-reads.
+            if key not in omap:
+                return -errno.ECANCELED, b""
             live = json.loads(omap[key].decode())
             if any(live.get(f) != obs.get(f) for f in obs):
-                # guarded entry rewrite (PutObjectAcl-style RMW): the
-                # entry moved since the caller read it — applying the
-                # stale copy would resurrect a gc'd chain.  ECANCELED
-                # so the caller re-reads and retries.
                 return -errno.ECANCELED, b""
         _apply_put(hctx, omap, hdr, key, req.get("entry") or {})
     else:

@@ -678,13 +678,18 @@ class S3Gateway:
             cont = segs[0]
             obj = "/".join(segs[1:])
             # same _allowed/_is_owner gates as the S3 personality: one
-            # store, one ACL model, two REST dialects
+            # store, one ACL model, two REST dialects (bucket rec
+            # fetched once, passed down)
+            rec = await self._bucket_rec(cont) if self.require_auth \
+                else None
             if not await self._allowed(
                     who, cont, obj or None,
-                    write=method in ("PUT", "POST", "DELETE")):
+                    write=method in ("PUT", "POST", "DELETE"),
+                    rec=rec):
                 return 403, {}, b""
             if not obj:
-                return await self._swift_container(method, cont, q, who)
+                return await self._swift_container(method, cont, q,
+                                                   who, rec=rec)
             return await self._swift_object(method, cont, obj, body,
                                             headers)
         except ObjectOperationError:
@@ -693,12 +698,13 @@ class S3Gateway:
             return 404, {}, b""
 
     async def _swift_container(self, method: str, cont: str, q: dict,
-                               who: Optional[str] = None):
+                               who: Optional[str] = None,
+                               rec=None):
         if method == "PUT":
             st, _, _ = await self._put_bucket(cont, owner=who or "")
             return (201 if st == 200 else 202), {}, b""  # 202 = existed
         if method == "DELETE":
-            if not await self._is_owner(who, cont):
+            if not await self._is_owner(who, cont, rec=rec):
                 return 403, {}, b""
             st, _, _ = await self._delete_bucket(cont)
             return (204 if st == 204 else st), {}, b""

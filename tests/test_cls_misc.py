@@ -194,3 +194,109 @@ def test_cls_user_stats_and_listing():
         assert ei.value.retcode == -errno.ENOENT
         await cl.stop()
     asyncio.run(run())
+
+
+def test_cls_statelog_indexes_and_guard():
+    """cls_statelog (src/cls/statelog/cls_statelog.cc): triple-indexed
+    op-state entries; filtered listings; check_state fences stale
+    agents with ECANCELED."""
+    async def run():
+        cl, io = await _cluster()
+        await io.exec("sl", "statelog", "add", _j({"entries": [
+            {"client_id": "c1", "op_id": "op1", "object": "a",
+             "state": "in_progress", "ts": 1.0},
+            {"client_id": "c1", "op_id": "op2", "object": "b",
+             "state": "done", "ts": 2.0},
+            {"client_id": "c2", "op_id": "op3", "object": "a",
+             "state": "in_progress", "ts": 3.0},
+        ]}))
+        by_client = json.loads(await io.exec(
+            "sl", "statelog", "list", _j({"client_id": "c1"})))
+        assert sorted(e["op_id"] for e in by_client["entries"]) \
+            == ["op1", "op2"]
+        by_obj = json.loads(await io.exec(
+            "sl", "statelog", "list", _j({"object": "a"})))
+        assert sorted(e["client_id"] for e in by_obj["entries"]) \
+            == ["c1", "c2"]
+
+        # separator collision: object "a" filter must NOT leak
+        # object "a_1" entries (values are %-escaped in index keys)
+        await io.exec("sl", "statelog", "add", _j({"entries": [
+            {"client_id": "c9", "op_id": "op9", "object": "a_1",
+             "state": "done", "ts": 9.0}]}))
+        by_obj = json.loads(await io.exec(
+            "sl", "statelog", "list", _j({"object": "a"})))
+        assert sorted(e["client_id"] for e in by_obj["entries"]) \
+            == ["c1", "c2"]
+        by_obj = json.loads(await io.exec(
+            "sl", "statelog", "list", _j({"object": "a_1"})))
+        assert [e["client_id"] for e in by_obj["entries"]] == ["c9"]
+        await io.exec("sl", "statelog", "remove",
+                      _j({"client_id": "c9", "op_id": "op9",
+                          "object": "a_1"}))
+
+        # state guard
+        ok = json.loads(await io.exec(
+            "sl", "statelog", "check_state",
+            _j({"client_id": "c1", "op_id": "op2", "object": "b",
+                "state": "done"})))
+        assert ok["ts"] == 2.0
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("sl", "statelog", "check_state",
+                          _j({"client_id": "c1", "op_id": "op2",
+                              "object": "b", "state": "in_progress"}))
+        assert ei.value.retcode == -errno.ECANCELED
+
+        # remove drops every index row
+        await io.exec("sl", "statelog", "remove",
+                      _j({"client_id": "c1", "op_id": "op1",
+                          "object": "a"}))
+        allrows = json.loads(await io.exec("sl", "statelog", "list"))
+        assert len(allrows["entries"]) == 2
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("sl", "statelog", "remove",
+                          _j({"client_id": "c1", "op_id": "op1",
+                              "object": "a"}))
+        assert ei.value.retcode == -errno.ENOENT
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_cls_replica_log_bounds():
+    """cls_replica_log (src/cls/replica_log): per-entity progress
+    markers; get_bounds returns the OLDEST position (the trim fence);
+    a bound can't move backward over in-progress items."""
+    async def run():
+        cl, io = await _cluster()
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("rl", "replica_log", "get_bounds")
+        assert ei.value.retcode == -errno.ENOENT
+
+        await io.exec("rl", "replica_log", "set_bound",
+                      _j({"entity_id": "zoneB", "position_marker": "50",
+                          "position_time": 5.0}))
+        await io.exec("rl", "replica_log", "set_bound",
+                      _j({"entity_id": "zoneC", "position_marker": "30",
+                          "position_time": 3.0,
+                          "items": [{"name": "x", "ts": 2.5}]}))
+        b = json.loads(await io.exec("rl", "replica_log", "get_bounds"))
+        assert b["position_marker"] == "30"
+        assert b["oldest_time"] == 3.0 and len(b["markers"]) == 2
+
+        # backward move with in-progress items refused
+        with pytest.raises(ObjectOperationError) as ei:
+            await io.exec("rl", "replica_log", "set_bound",
+                          _j({"entity_id": "zoneC",
+                              "position_marker": "10"}))
+        assert ei.value.retcode == -errno.EINVAL
+        # forward move fine; then delete releases the fence
+        await io.exec("rl", "replica_log", "set_bound",
+                      _j({"entity_id": "zoneC",
+                          "position_marker": "60",
+                          "position_time": 6.0}))
+        await io.exec("rl", "replica_log", "delete_bound",
+                      _j({"entity_id": "zoneB"}))
+        b = json.loads(await io.exec("rl", "replica_log", "get_bounds"))
+        assert b["position_marker"] == "60"
+        await cl.stop()
+    asyncio.run(run())
