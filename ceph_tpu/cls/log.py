@@ -69,8 +69,8 @@ def log_list(hctx: ClsContext, inbl: bytes):
     """in: {from_ts?, to_ts?, marker?, max_entries?}; out: {entries,
     marker, truncated} — entries carry their key for trim-to-marker."""
     req = json.loads(inbl.decode()) if inbl else {}
-    limit = min(int(req.get("max_entries", MAX_LIST_ENTRIES)),
-                MAX_LIST_ENTRIES)
+    limit = max(1, min(int(req.get("max_entries", MAX_LIST_ENTRIES)),
+                MAX_LIST_ENTRIES))
     start: Optional[str] = req.get("marker")
     if start is None and "from_ts" in req:
         start = _key(float(req["from_ts"]), 0)

@@ -178,8 +178,8 @@ def bucket_list(hctx: ClsContext, inbl: bytes):
     (rgw_bucket_list role)."""
     import bisect
     req = json.loads(inbl.decode()) if inbl else {}
-    limit = min(int(req.get("max_keys", MAX_LIST_ENTRIES)),
-                MAX_LIST_ENTRIES)
+    limit = max(1, min(int(req.get("max_keys", MAX_LIST_ENTRIES)),
+                MAX_LIST_ENTRIES))
     prefix = req.get("prefix", "")
     omap = hctx.omap_get()
     # sort keys only and json-decode only the returned page — a paged

@@ -61,8 +61,8 @@ def statelog_list(hctx: ClsContext, inbl: bytes):
     filtered listing via the matching index; out {entries, marker,
     truncated}."""
     req = json.loads(inbl.decode()) if inbl else {}
-    limit = min(int(req.get("max_entries", MAX_LIST_ENTRIES)),
-                MAX_LIST_ENTRIES)
+    limit = max(1, min(int(req.get("max_entries", MAX_LIST_ENTRIES)),
+                MAX_LIST_ENTRIES))
     if req.get("object"):
         prefix = f"1_{_esc(req['object'])}_"
     elif req.get("client_id"):

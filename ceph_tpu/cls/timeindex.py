@@ -63,8 +63,8 @@ def timeindex_list(hctx: ClsContext, inbl: bytes):
     order from max(from_ts, marker) up to to_ts; out: {entries:
     [{key, value}], marker, truncated}."""
     req = json.loads(inbl.decode()) if inbl else {}
-    limit = min(int(req.get("max_entries", MAX_LIST_ENTRIES)),
-                MAX_LIST_ENTRIES)
+    limit = max(1, min(int(req.get("max_entries", MAX_LIST_ENTRIES)),
+                MAX_LIST_ENTRIES))
     start = req.get("marker")
     if start is None and "from_ts" in req:
         start = key_of(float(req["from_ts"]))

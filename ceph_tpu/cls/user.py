@@ -87,8 +87,8 @@ def user_remove_bucket(hctx: ClsContext, inbl: bytes):
 def user_list_buckets(hctx: ClsContext, inbl: bytes):
     """in: {marker?, max_entries?}; out: {entries, marker, truncated}."""
     req = json.loads(inbl.decode()) if inbl else {}
-    limit = min(int(req.get("max_entries", MAX_LIST_ENTRIES)),
-                MAX_LIST_ENTRIES)
+    limit = max(1, min(int(req.get("max_entries", MAX_LIST_ENTRIES)),
+                MAX_LIST_ENTRIES))
     lo = req.get("marker", "").encode()
     omap = hctx.omap_get()
     entries, marker, truncated = [], req.get("marker", ""), False

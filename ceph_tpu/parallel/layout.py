@@ -83,6 +83,52 @@ def ec_cluster_step(mesh: Mesh, bitmat: jnp.ndarray):
     return jax.jit(sharded)
 
 
+def ec_recover_step(mesh: Mesh, dec_bitmat: jnp.ndarray,
+                    n_surv: int):
+    """Build the jitted multi-chip EC RECOVERY step — the data-plane
+    analog of ECBackend::continue_recovery_op (osd/ECBackend.cc:484):
+    the primary gathers k survivor shards (MOSDECSubOpRead fan-in) and
+    decodes the lost chunks.
+
+    Mesh layout is the OSD placement itself: each 'shard' position
+    holds ITS OWN chunk of every stripe — input surv [B, n_surv, L]
+    sharded (host, shard, -): the chunk AXIS is distributed, so no
+    device can decode alone.  The step all_gathers the survivor chunks
+    along 'shard' (the ICI ride replacing k point-to-point shard
+    reads) and every device runs the decode matmul locally — the
+    rebuilt chunks are then immediately available at every shard
+    position (replicate-on-recover), and a psum over 'host' rolls up
+    a scrub digest of the reconstruction.
+
+    Requires n_surv % mesh.shape['shard'] == 0 (each device holds an
+    equal slice of the survivor set).
+    """
+    assert n_surv % mesh.shape["shard"] == 0, \
+        (n_surv, dict(mesh.shape))
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    from ceph_tpu.ec.kernel import _apply_bitmatrix
+
+    def step(surv):
+        # surv local block: [B_local, n_surv/n_shard, L] — gather the
+        # full survivor set along the shard axis (MOSDECSubOpRead)
+        full = jax.lax.all_gather(surv, "shard", axis=1, tiled=True)
+        lost = jax.vmap(lambda d: _apply_bitmatrix(dec_bitmat, d))(full)
+        local_sum = jnp.sum(lost.astype(jnp.uint32), axis=(0, 2))
+        scrub = jax.lax.psum(local_sum, "host")
+        return lost, scrub
+
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(P("host", "shard", None),),
+        out_specs=(P("host", None, None), P()),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
 def replicated(mesh: Mesh, x):
     return jax.device_put(x, NamedSharding(mesh, P()))
 
