@@ -251,6 +251,34 @@ def bucket_rebuild_index(hctx: ClsContext, inbl: bytes):
     return 0, json.dumps(hdr).encode()
 
 
+@cls_method("rgw.usage_add", writes=True)
+def usage_add(hctx: ClsContext, inbl: bytes):
+    """in: {rows: [{key, ops, successful_ops, bytes_sent,
+    bytes_received}]} — merge usage deltas into this (per-owner)
+    usage object ATOMICALLY on the OSD (cls_rgw usage_log_add role):
+    a client-side read-modify-write would lose increments under
+    concurrent flushers."""
+    req = json.loads(inbl.decode())
+    rows = req.get("rows", [])
+    keys = [r["key"].encode() for r in rows]
+    old = hctx.omap_get_values(keys)
+    out: Dict[bytes, bytes] = {}
+    for r in rows:
+        k = r["key"].encode()
+        base = json.loads((out.get(k) or old.get(k) or b"{}").decode())
+        out[k] = json.dumps({
+            "ops": base.get("ops", 0) + int(r.get("ops", 0)),
+            "successful_ops": base.get("successful_ops", 0)
+            + int(r.get("successful_ops", 0)),
+            "bytes_sent": base.get("bytes_sent", 0)
+            + int(r.get("bytes_sent", 0)),
+            "bytes_received": base.get("bytes_received", 0)
+            + int(r.get("bytes_received", 0))}).encode()
+    if out:
+        hctx.omap_set(out)
+    return 0, b""
+
+
 @cls_method("rgw.dir_suggest_changes", writes=True)
 def dir_suggest_changes(hctx: ClsContext, inbl: bytes):
     """in: {changes: [{op: remove|update, key, entry?, observed?}],

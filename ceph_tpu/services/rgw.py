@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import contextvars
 import hashlib
 import hmac
 import json
@@ -53,6 +54,15 @@ from ceph_tpu.client.rados_striper import (RadosStriper,
 
 USERS_OID = ".rgw.users"
 BUCKETS_OID = ".rgw.buckets"
+
+#: billing identity for the request being routed (rgw_usage): set by
+#: the route when it learns the bucket owner (its ACL gate already
+#: read the rec) or falls back to the authenticated caller — so usage
+#: for delete_bucket (rec gone by flush time) and bucketless ops
+#: (list_buckets) still bills correctly.  ContextVar: each request
+#: task carries its own value.
+_USAGE_OWNER: contextvars.ContextVar = contextvars.ContextVar(
+    "rgw_usage_owner", default=None)
 
 
 def _index_oid(bucket: str) -> str:
@@ -292,7 +302,8 @@ class S3Gateway:
     def __init__(self, rados, pool: str = ".rgw",
                  require_auth: bool = True, datalog: bool = False,
                  gc_min_wait: float = 0.0, gc_interval: float = 0.0,
-                 lc_interval: float = 0.0):
+                 lc_interval: float = 0.0,
+                 usage_interval: float = 0.0):
         self.rados = rados
         self.io = rados.open_ioctx(pool)
         self.users = UserDB(self.io)
@@ -313,6 +324,12 @@ class S3Gateway:
         if datalog:
             from ceph_tpu.journal import Journaler
             self.datalog = Journaler(self.io, "rgw.datalog")
+        # usage accounting (rgw_usage.cc role): counters bump in
+        # memory per request; a flush merges them into per-owner
+        # usage objects
+        from ceph_tpu.services.rgw_usage import UsageLog
+        self.usage = UsageLog(self.io)
+        self.usage_interval = usage_interval
 
     async def _log_change(self, op: str, bucket: str,
                           key: str = "") -> None:
@@ -337,7 +354,18 @@ class S3Gateway:
         if self.lc_interval > 0:
             self._workers.append(asyncio.ensure_future(
                 self._periodic(self.lc_interval, self.lc_process)))
+        if self.usage_interval > 0:
+            self._workers.append(asyncio.ensure_future(
+                self._periodic(self.usage_interval, self.usage_flush)))
         return self.port
+
+    async def usage_flush(self) -> int:
+        """Merge accumulated usage counters into per-owner objects
+        (billed to the bucket owner, like the reference)."""
+        async def owner_of(bucket: str) -> str:
+            rec = await self._bucket_rec(bucket)
+            return (rec or {}).get("owner", "")
+        return await self.usage.flush(owner_of)
 
     async def _periodic(self, interval: float, fn) -> None:
         while True:
@@ -380,6 +408,8 @@ class S3Gateway:
                     body = await reader.readexactly(n)
                 status, rhdrs, payload = await self._route(
                     method.upper(), target, headers, body)
+                self._record_usage(method.upper(), target, status,
+                                   len(payload), len(body))
                 self._respond(writer, status, rhdrs, payload)
                 await writer.drain()
                 if headers.get("connection", "").lower() == "close":
@@ -388,6 +418,36 @@ class S3Gateway:
             pass
         finally:
             writer.close()
+
+    def _record_usage(self, method: str, target: str, status: int,
+                      bytes_sent: int, bytes_received: int) -> None:
+        """Pure counter bump (no I/O) after every REST request; the
+        swift prefix maps onto the same bucket namespace.  The billing
+        owner was captured by the route (contextvar) while it held the
+        bucket rec; None falls back to flush-time resolution."""
+        from ceph_tpu.services.rgw_usage import categorize
+        parts = urlsplit(target)
+        path = unquote(parts.path)
+        if path.startswith("/auth/"):
+            return
+        # exact-boundary strip, matching _route: an S3 bucket literally
+        # named "swift" must not be mis-billed
+        if path == "/swift/v1":
+            path = ""
+        elif path.startswith("/swift/v1/"):
+            path = path[len("/swift/v1"):]
+        segs = [s for s in path.split("/") if s]
+        bucket = segs[0] if segs else ""
+        key = "/".join(segs[1:])
+        q = {}
+        for kv in parts.query.split("&"):
+            k, _, v = kv.partition("=")
+            if k:
+                q[k] = v
+        self.usage.record(bucket, categorize(method, bucket, key, q),
+                          status < 400, bytes_sent, bytes_received,
+                          owner=_USAGE_OWNER.get())
+        _USAGE_OWNER.set(None)        # one request, one billing scope
 
     def _respond(self, writer, status: int, headers: Dict[str, str],
                  payload: bytes) -> None:
@@ -514,6 +574,7 @@ class S3Gateway:
                         # the service root lists the CALLER's buckets;
                         # there is no anonymous account
                         return 403, {}, _xml_error("AccessDenied")
+                    _USAGE_OWNER.set(who)
                     return await self._list_buckets(who)
                 return 405, {}, b""
             bucket = segs[0]
@@ -529,6 +590,7 @@ class S3Gateway:
             # bucket rec is fetched ONCE here and passed down.
             rec = await self._bucket_rec(bucket) if self.require_auth \
                 else None
+            _USAGE_OWNER.set((rec or {}).get("owner") or who)
             if "acl" in q:
                 # ACL subresource itself is owner-only (READ_ACP/
                 # WRITE_ACP stay with the owner for canned policies)
@@ -682,6 +744,7 @@ class S3Gateway:
             # fetched once, passed down)
             rec = await self._bucket_rec(cont) if self.require_auth \
                 else None
+            _USAGE_OWNER.set((rec or {}).get("owner") or who)
             if not await self._allowed(
                     who, cont, obj or None,
                     write=method in ("PUT", "POST", "DELETE"),

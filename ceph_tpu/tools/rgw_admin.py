@@ -74,6 +74,17 @@ async def run(args) -> int:
                                                 args.max_objects)
             print(json.dumps({"set": ok}))
             return 0 if ok else 1
+        if args.cmd == "usage":
+            from ceph_tpu.services.rgw_usage import UsageLog
+            ul = UsageLog(io)
+            if args.op == "show":
+                print(json.dumps(await ul.show(
+                    args.uid, args.start_epoch,
+                    args.end_epoch if args.end_epoch >= 0 else None)))
+            else:                                  # trim
+                n = await ul.trim(args.uid, args.before_epoch)
+                print(json.dumps({"trimmed": n}))
+            return 0
         if args.cmd == "bucket":
             from ceph_tpu.services.rgw import _index_oid
             oid = _index_oid(args.bucket)
@@ -136,6 +147,12 @@ def main(argv=None) -> int:
     q.add_argument("--bucket", default="")
     q.add_argument("--max-size", type=int, default=-1)
     q.add_argument("--max-objects", type=int, default=-1)
+    us = sub.add_parser("usage")
+    us.add_argument("op", choices=("show", "trim"))
+    us.add_argument("--uid", required=True)
+    us.add_argument("--start-epoch", type=int, default=0)
+    us.add_argument("--end-epoch", type=int, default=-1)
+    us.add_argument("--before-epoch", type=int, default=0)
     b = sub.add_parser("bucket")
     b.add_argument("op", choices=("stats", "check"))
     b.add_argument("--bucket", required=True)

@@ -983,3 +983,58 @@ def test_server_side_copy():
         await gw.stop()
         await cl.stop()
     asyncio.run(run())
+
+
+def test_usage_log_accounting():
+    """rgw_usage.cc role: REST ops are billed to the bucket owner per
+    (bucket, category, hour); flush merges idempotently into the
+    owner's usage object; show filters by epoch; trim reclaims."""
+    async def run():
+        from ceph_tpu.services.rgw_usage import UsageLog
+
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create(".rgw", pg_num=8)
+        gw = S3Gateway(admin)
+        db = UserDB(admin.open_ioctx(".rgw"))
+        await db.create("OWNER", "sk1")
+        port = await gw.start()
+        c = S3Client(port, "OWNER", "sk1")
+
+        await c.request("PUT", "/b")
+        await c.request("PUT", "/b/k1", b"x" * 1000)
+        await c.request("PUT", "/b/k2", b"y" * 500)
+        st, _, _ = await c.request("GET", "/b/k1")
+        assert st == 200
+        st, _, _ = await c.request("GET", "/b/missing")
+        assert st == 404                       # counted, unsuccessful
+
+        assert await gw.usage_flush() > 0
+        rows = await UsageLog(gw.io).show("OWNER")
+        by_cat = {r["category"]: r for r in rows if r["bucket"] == "b"}
+        assert by_cat["put_obj"]["ops"] == 2
+        assert by_cat["put_obj"]["successful_ops"] == 2
+        assert by_cat["put_obj"]["bytes_received"] == 1500
+        assert by_cat["get_obj"]["ops"] == 2
+        assert by_cat["get_obj"]["successful_ops"] == 1
+        assert by_cat["get_obj"]["bytes_sent"] >= 1000
+        assert by_cat["create_bucket"]["ops"] == 1
+
+        # second flush merges (not overwrites)
+        await c.request("PUT", "/b/k3", b"z" * 100)
+        await gw.usage_flush()
+        rows = await UsageLog(gw.io).show("OWNER")
+        by_cat = {r["category"]: r for r in rows if r["bucket"] == "b"}
+        assert by_cat["put_obj"]["ops"] == 3
+        assert by_cat["put_obj"]["bytes_received"] == 1600
+
+        # epoch filters + trim
+        cur = rows[0]["epoch"]
+        assert await UsageLog(gw.io).show("OWNER",
+                                          start_epoch=cur + 1) == []
+        n = await UsageLog(gw.io).trim("OWNER", before_epoch=cur + 1)
+        assert n == len(rows)
+        assert await UsageLog(gw.io).show("OWNER") == []
+        await gw.stop()
+        await cl.stop()
+    asyncio.run(run())
