@@ -33,7 +33,7 @@ def _rotl64(x: int, r: int) -> int:
     return ((x << r) | (x >> (64 - r))) & _M64
 
 
-def xxh32(data: bytes, seed: int = 0) -> int:
+def _py_xxh32(data: bytes, seed: int = 0) -> int:
     n = len(data)
     i = 0
     if n >= 16:
@@ -86,7 +86,7 @@ def _merge64(acc: int, val: int) -> int:
     return (acc * _P64_1 + _P64_4) & _M64
 
 
-def xxh64(data: bytes, seed: int = 0) -> int:
+def _py_xxh64(data: bytes, seed: int = 0) -> int:
     n = len(data)
     i = 0
     if n >= 32:
@@ -129,3 +129,19 @@ def xxh64(data: bytes, seed: int = 0) -> int:
     acc = (acc * _P64_3) & _M64
     acc ^= acc >> 32
     return acc
+
+
+def xxh32(data: bytes, seed: int = 0) -> int:
+    """Native C when built (~GB/s), pure-python ground truth
+    otherwise (~5 MB/s — fine for tests, not for a data-path csum)."""
+    from ceph_tpu import native
+    if native.available():
+        return native.xxh32(data, seed)
+    return _py_xxh32(data, seed)
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    from ceph_tpu import native
+    if native.available():
+        return native.xxh64(data, seed)
+    return _py_xxh64(data, seed)

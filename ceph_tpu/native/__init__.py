@@ -83,6 +83,12 @@ def _bind(lib: ctypes.CDLL) -> Optional[ctypes.CDLL]:
         lib.ceph_straw2_winner_rows_indexed.argtypes = [
             i32p, i64p, i64p, ctypes.c_int64, ctypes.c_int32, u32p,
             u32p, i64p, i32p]
+        lib.ceph_xxh32.restype = ctypes.c_uint32
+        lib.ceph_xxh32.argtypes = [u8p, ctypes.c_uint64,
+                                   ctypes.c_uint32]
+        lib.ceph_xxh64.restype = ctypes.c_uint64
+        lib.ceph_xxh64.argtypes = [u8p, ctypes.c_uint64,
+                                   ctypes.c_uint64]
     except AttributeError:
         # stale prebuilt .so missing newer symbols (no compiler to
         # rebuild): degrade to unavailable, never raise out of _load —
@@ -107,6 +113,23 @@ def crc32c(data: bytes, crc: int = 0) -> int:
         raise RuntimeError("native crc32c unavailable (check available())")
     buf = np.frombuffer(data, np.uint8)
     return int(lib.ceph_crc32c(crc, _u8p(buf), buf.size))
+
+
+def xxh32(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native xxh32 unavailable (check available())")
+    buf = np.frombuffer(data, np.uint8)
+    return int(lib.ceph_xxh32(_u8p(buf), buf.size, seed & 0xFFFFFFFF))
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native xxh64 unavailable (check available())")
+    buf = np.frombuffer(data, np.uint8)
+    return int(lib.ceph_xxh64(_u8p(buf), buf.size,
+                              seed & 0xFFFFFFFFFFFFFFFF))
 
 
 def rjenkins3(a: int, b: int, c: int) -> int:

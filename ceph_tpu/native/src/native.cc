@@ -386,4 +386,101 @@ void ceph_straw2_winner_shared(const int32_t* items,   // [I]
   }
 }
 
+// ---------------------------------------------------------------- xxhash --
+// XXH32/XXH64 one-shot, implemented from the public algorithm spec
+// (the reference vendors the xxHash submodule; BlockStore offers it as
+// a selectable checksum type and the pure-python fallback runs at
+// ~5 MB/s — useless for a data-path csum).
+
+static inline uint32_t xx_rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+static inline uint64_t xx_rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+static inline uint32_t xx_read32(const uint8_t* p) {
+  uint32_t v; __builtin_memcpy(&v, p, 4); return v;
+}
+static inline uint64_t xx_read64(const uint8_t* p) {
+  uint64_t v; __builtin_memcpy(&v, p, 8); return v;
+}
+
+uint32_t ceph_xxh32(const uint8_t* p, uint64_t len, uint32_t seed) {
+  const uint32_t P1 = 2654435761u, P2 = 2246822519u, P3 = 3266489917u,
+                 P4 = 668265263u, P5 = 374761393u;
+  const uint8_t* end = p + len;
+  uint32_t h;
+  if (len >= 16) {
+    uint32_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed,
+             v4 = seed - P1;
+    const uint8_t* limit = end - 16;
+    do {
+      v1 = xx_rotl32(v1 + xx_read32(p) * P2, 13) * P1; p += 4;
+      v2 = xx_rotl32(v2 + xx_read32(p) * P2, 13) * P1; p += 4;
+      v3 = xx_rotl32(v3 + xx_read32(p) * P2, 13) * P1; p += 4;
+      v4 = xx_rotl32(v4 + xx_read32(p) * P2, 13) * P1; p += 4;
+    } while (p <= limit);
+    h = xx_rotl32(v1, 1) + xx_rotl32(v2, 7) + xx_rotl32(v3, 12) +
+        xx_rotl32(v4, 18);
+  } else {
+    h = seed + P5;
+  }
+  h += (uint32_t)len;
+  while (p + 4 <= end) {
+    h = xx_rotl32(h + xx_read32(p) * P3, 17) * P4;
+    p += 4;
+  }
+  while (p < end) {
+    h = xx_rotl32(h + (*p) * P5, 11) * P1;
+    p++;
+  }
+  h ^= h >> 15; h *= P2; h ^= h >> 13; h *= P3; h ^= h >> 16;
+  return h;
+}
+
+uint64_t ceph_xxh64(const uint8_t* p, uint64_t len, uint64_t seed) {
+  const uint64_t P1 = 11400714785074694791ULL,
+                 P2 = 14029467366897019727ULL,
+                 P3 = 1609587929392839161ULL,
+                 P4 = 9650029242287828579ULL,
+                 P5 = 2870177450012600261ULL;
+  const uint8_t* end = p + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed,
+             v4 = seed - P1;
+    const uint8_t* limit = end - 32;
+    do {
+      v1 = xx_rotl64(v1 + xx_read64(p) * P2, 31) * P1; p += 8;
+      v2 = xx_rotl64(v2 + xx_read64(p) * P2, 31) * P1; p += 8;
+      v3 = xx_rotl64(v3 + xx_read64(p) * P2, 31) * P1; p += 8;
+      v4 = xx_rotl64(v4 + xx_read64(p) * P2, 31) * P1; p += 8;
+    } while (p <= limit);
+    h = xx_rotl64(v1, 1) + xx_rotl64(v2, 7) + xx_rotl64(v3, 12) +
+        xx_rotl64(v4, 18);
+    v1 = xx_rotl64(v1 * P2, 31) * P1; h ^= v1; h = h * P1 + P4;
+    v2 = xx_rotl64(v2 * P2, 31) * P1; h ^= v2; h = h * P1 + P4;
+    v3 = xx_rotl64(v3 * P2, 31) * P1; h ^= v3; h = h * P1 + P4;
+    v4 = xx_rotl64(v4 * P2, 31) * P1; h ^= v4; h = h * P1 + P4;
+  } else {
+    h = seed + P5;
+  }
+  h += len;
+  while (p + 8 <= end) {
+    uint64_t k = xx_rotl64(xx_read64(p) * P2, 31) * P1;
+    h = xx_rotl64(h ^ k, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h = xx_rotl64(h ^ ((uint64_t)xx_read32(p) * P1), 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h = xx_rotl64(h ^ ((*p) * P5), 11) * P1;
+    p++;
+  }
+  h ^= h >> 33; h *= P2; h ^= h >> 29; h *= P3; h ^= h >> 32;
+  return h;
+}
+
 }  // extern C

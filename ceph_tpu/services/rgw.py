@@ -382,6 +382,13 @@ class S3Gateway:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        try:
+            # billing accumulated since the last periodic flush must
+            # not die with the process (flush AFTER the listener closes
+            # so late requests still get captured)
+            await self.usage_flush()
+        except Exception:
+            pass
 
     # ----------------------------------------------------------------- http
     async def _client(self, reader: asyncio.StreamReader,
@@ -720,6 +727,7 @@ class S3Gateway:
             if not segs:                      # account: list containers
                 if method != "GET":
                     return 405, {}, b""
+                _USAGE_OWNER.set(who)         # billed like S3 GET /
                 try:
                     omap = await self.io.omap_get(BUCKETS_OID)
                 except ObjectOperationError:

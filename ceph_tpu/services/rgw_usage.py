@@ -37,6 +37,10 @@ def _ukey(epoch: int, bucket: str, category: str) -> bytes:
 
 
 class UsageLog:
+    #: distinct pending rows before record() starts dropping — bounds
+    #: memory when nothing ever flushes
+    MAX_ROWS = 100_000
+
     def __init__(self, io, now: Callable[[], float] = time.time):
         self.io = io
         self.now = now
@@ -44,6 +48,7 @@ class UsageLog:
         # recv]; owner None = resolve from the bucket rec at flush
         self.pending: Dict[Tuple[Optional[str], str, str, int],
                            list] = {}
+        self.dropped = 0
 
     # ------------------------------------------------------------ record
     def record(self, bucket: str, category: str, ok: bool,
@@ -54,8 +59,14 @@ class UsageLog:
         critical for ops that destroy the rec (delete_bucket) or have
         no bucket (list_buckets)."""
         epoch = int(self.now() // EPOCH_SECONDS)
-        row = self.pending.setdefault((owner, bucket, category, epoch),
-                                      [0, 0, 0, 0])
+        key = (owner, bucket, category, epoch)
+        if key not in self.pending and len(self.pending) >= self.MAX_ROWS:
+            # no flusher draining us (usage_interval=0 and nobody
+            # calls flush): cap memory rather than grow forever;
+            # `dropped` records the loss for an operator to see
+            self.dropped += 1
+            return
+        row = self.pending.setdefault(key, [0, 0, 0, 0])
         row[0] += 1
         row[1] += 1 if ok else 0
         row[2] += bytes_sent
