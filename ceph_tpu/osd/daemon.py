@@ -557,8 +557,53 @@ class OSD(Dispatcher):
                 "pgs": {str(pg.pgid): pg.state
                         for pg in self.pgs.values()},
             }, "daemon status")
+        def _bench_cmd(cmd):
+            # accept both k=v fields and the text protocol's
+            # positional args ("bench <count> <size>")
+            args = cmd.get("args") or []
+            count = int(cmd.get("count") or (args[0] if args else 16))
+            size = int(cmd.get("size")
+                       or (args[1] if len(args) > 1 else 1 << 20))
+            return self._store_bench(count, size)
+        sock.register(
+            "bench", _bench_cmd,
+            "store write throughput (`ceph tell osd.N bench` role, "
+            "osd/OSD.cc:5583); args: [count [size]]")
         await sock.start()
         self.admin_socket = sock
+
+    async def _store_bench(self, count: int, size: int) -> dict:
+        """Timed object writes straight at the ObjectStore — measures
+        the local persistence path with no client/network in the way
+        (OSD::bench).  Async with a yield per object so heartbeats and
+        client IO on the shared event loop keep breathing; random
+        payload so a compression-enabled BlockStore measures the write
+        path, not the compressor.  The bench collection is destroyed
+        afterwards (OP_RMCOLL drops contained objects)."""
+        import os as _os
+        import time as _time
+        from ceph_tpu.store.types import CollectionId, ObjectId
+        count = max(1, min(count, 1024))
+        size = max(1, min(size, 16 << 20))
+        cid = CollectionId(f"bench.{self.whoami}")
+        payload = _os.urandom(size)
+        t = Transaction()
+        if not self.store.collection_exists(cid):
+            t.create_collection(cid)
+        self.store.apply_transaction(t)
+        t0 = _time.perf_counter()
+        for i in range(count):
+            t = Transaction()
+            t.write(cid, ObjectId(f"bench.{i}"), 0, payload)
+            self.store.apply_transaction(t)
+            await asyncio.sleep(0)
+        dt = _time.perf_counter() - t0
+        t = Transaction()
+        t.remove_collection(cid)
+        self.store.apply_transaction(t)
+        return {"bytes_written": count * size, "seconds": round(dt, 4),
+                "bytes_per_sec": round(count * size / dt, 1)
+                if dt else 0.0}
 
     def _send_cluster_log(self, entry: dict) -> None:
         try:

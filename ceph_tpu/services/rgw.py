@@ -328,8 +328,11 @@ class S3Gateway:
         # memory per request; a flush merges them into per-owner
         # usage objects
         from ceph_tpu.services.rgw_usage import UsageLog
-        self.usage = UsageLog(self.io)
+        self.usage = UsageLog(self.io,
+                              logger=rados.ctx.logger("rgw")
+                              if hasattr(rados, "ctx") else None)
         self.usage_interval = usage_interval
+        self._conns: set = set()
 
     async def _log_change(self, op: str, bucket: str,
                           key: str = "") -> None:
@@ -382,10 +385,13 @@ class S3Gateway:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        # keep-alive connections outlive the listener: wait for their
+        # handlers (bounded) so their usage records make the flush
+        if self._conns:
+            await asyncio.wait(self._conns, timeout=5.0)
         try:
             # billing accumulated since the last periodic flush must
-            # not die with the process (flush AFTER the listener closes
-            # so late requests still get captured)
+            # not die with the process
             await self.usage_flush()
         except Exception:
             pass
@@ -393,6 +399,10 @@ class S3Gateway:
     # ----------------------------------------------------------------- http
     async def _client(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conns.add(task)
+            task.add_done_callback(self._conns.discard)
         try:
             while True:
                 line = await reader.readline()

@@ -41,9 +41,11 @@ class UsageLog:
     #: memory when nothing ever flushes
     MAX_ROWS = 100_000
 
-    def __init__(self, io, now: Callable[[], float] = time.time):
+    def __init__(self, io, now: Callable[[], float] = time.time,
+                 logger=None):
         self.io = io
         self.now = now
+        self.logger = logger
         # (owner|None, bucket, category, epoch) -> [ops, ok, sent,
         # recv]; owner None = resolve from the bucket rec at flush
         self.pending: Dict[Tuple[Optional[str], str, str, int],
@@ -80,6 +82,13 @@ class UsageLog:
         -> str` resolves rows recorded without an owner.  On failure
         the batch is merged BACK into pending (billing survives a
         transient outage).  Returns rows flushed."""
+        if self.dropped and self.logger is not None:
+            # the cap is an invisible revenue leak unless someone says
+            # so out loud
+            self.logger.warning(
+                f"usage log dropped {self.dropped} rows at the "
+                f"{self.MAX_ROWS}-row memory cap")
+            self.dropped = 0
         if not self.pending:
             return 0
         batch, self.pending = self.pending, {}

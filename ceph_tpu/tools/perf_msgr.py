@@ -1,0 +1,128 @@
+"""Messenger throughput harness.
+
+Reference parity: src/test/msgr/perf_msgr_server.cc /
+perf_msgr_client.cc — a server messenger echoes typed payload
+messages while clients blast N in-flight requests and report msg/s +
+MB/s + latency percentiles.  One process, two messengers over real
+TCP, because the number that matters is the full encode -> frame ->
+socket -> decode -> dispatch path.
+
+    python -m ceph_tpu.tools.perf_msgr [--count 2000] [--size 4096]
+        [--inflight 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+import time
+from typing import Dict
+
+from ceph_tpu.common.context import Context
+from ceph_tpu.common.encoding import Decoder, Encoder
+from ceph_tpu.msg import (Dispatcher, EntityName, Message, Messenger,
+                          Policy)
+from ceph_tpu.msg.message import register_message
+
+
+@register_message
+class MPerf(Message):
+    """Echo payload (perf_msgr's MOSDOp stand-in)."""
+
+    TYPE = 4090
+
+    def __init__(self, tid: int = 0, data: bytes = b""):
+        super().__init__()
+        self.tid = tid
+        self.data = data
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid).bytes_(self.data)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPerf":
+        return cls(dec.u64(), dec.bytes_())
+
+
+class _Echo(Dispatcher):
+    def __init__(self, msgr: Messenger):
+        self.msgr = msgr
+
+    def ms_dispatch(self, msg: Message) -> bool:
+        if msg.TYPE != MPerf.TYPE:
+            return False
+        self.msgr.send_message(MPerf(msg.tid, b""), msg.src_addr)
+        return True
+
+
+class _Client(Dispatcher):
+    def __init__(self):
+        self.waiters: Dict[int, asyncio.Future] = {}
+
+    def ms_dispatch(self, msg: Message) -> bool:
+        if msg.TYPE != MPerf.TYPE:
+            return False
+        fut = self.waiters.pop(msg.tid, None)
+        if fut is not None and not fut.done():
+            fut.set_result(None)
+        return True
+
+
+async def run(count: int, size: int, inflight: int) -> dict:
+    ctx_s = Context("osd.0")
+    ctx_c = Context("client.perf")
+    server = Messenger(ctx_s, EntityName.parse("osd.0"))
+    server.set_policy("client", Policy(lossy=True))
+    server.add_dispatcher(_Echo(server))
+    addr = await server.bind()
+
+    client = Messenger(ctx_c, EntityName.parse("client.perf"))
+    client.set_policy("osd", Policy(lossy=True))
+    disp = _Client()
+    client.add_dispatcher(disp)
+    await client.bind()          # replies dial back to this addr
+
+    payload = b"\x5a" * size
+    loop = asyncio.get_running_loop()
+    lats = []
+    sem = asyncio.Semaphore(inflight)
+
+    async def one(tid: int) -> None:
+        async with sem:
+            fut = loop.create_future()
+            disp.waiters[tid] = fut
+            t0 = time.perf_counter()
+            client.send_message(MPerf(tid, payload), addr)
+            await fut
+            lats.append(time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*[one(i) for i in range(count)])
+    wall = time.perf_counter() - t0
+    await client.shutdown()
+    await server.shutdown()
+    lats.sort()
+    return {
+        "count": count, "size": size, "inflight": inflight,
+        "msgs_per_sec": round(count / wall, 1),
+        "mb_per_sec": round(count * size / wall / 1e6, 2),
+        "p50_us": round(lats[len(lats) // 2] * 1e6, 1),
+        "p99_us": round(lats[int(len(lats) * 0.99) - 1] * 1e6, 1),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="perf_msgr")
+    ap.add_argument("--count", type=int, default=2000)
+    ap.add_argument("--size", type=int, default=4096)
+    ap.add_argument("--inflight", type=int, default=32)
+    args = ap.parse_args(argv)
+    import json
+    out = asyncio.run(run(args.count, args.size, args.inflight))
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

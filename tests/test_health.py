@@ -206,3 +206,24 @@ def test_ceph_df_reports_pool_usage():
         assert df["stats"]["total_bytes_used"] == 5000
         await cl.stop()
     asyncio.run(run())
+
+
+def test_osd_bench_admin_command():
+    """`ceph tell osd.N bench` role (osd/OSD.cc:5583): timed writes
+    straight at the ObjectStore via the admin socket; the bench
+    collection is cleaned up."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(1)
+        osd = list(cl.osds.values())[0]
+        out = await osd._store_bench(count=8, size=64 * 1024)
+        assert out["bytes_written"] == 8 * 64 * 1024
+        assert out["bytes_per_sec"] > 0
+        from ceph_tpu.store.types import CollectionId
+        assert not osd.store.collection_exists(
+            CollectionId(f"bench.{osd.whoami}"))
+        # count/size clamp
+        out2 = await osd._store_bench(count=0, size=0)
+        assert out2["bytes_written"] == 1
+        await cl.stop()
+    asyncio.run(run())

@@ -249,3 +249,15 @@ def test_dispatcher_chain():
         await a.shutdown()
         await b.shutdown()
     asyncio.run(run())
+
+
+def test_perf_msgr_harness():
+    """perf_msgr_client/server role (src/test/msgr/): the throughput
+    harness round-trips real typed messages over TCP and reports
+    sane numbers."""
+    from ceph_tpu.tools.perf_msgr import run as perf_run
+
+    out = asyncio.run(perf_run(count=100, size=1024, inflight=16))
+    assert out["count"] == 100
+    assert out["msgs_per_sec"] > 0
+    assert out["p99_us"] >= out["p50_us"] > 0
