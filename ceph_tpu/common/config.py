@@ -307,7 +307,9 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("objectstore_path", "str", "", "data dir for filestore"),
     Option("filestore_journal_size", "size", "64m", "WAL size"),
     Option("filestore_kill_at", "int", 0,
-           "crash injection at Nth txn (config_opts.h:1171)"),
+           "crash injection countdown in queue_transactions batches: "
+           "N>0 dies after the Nth batch journals, N<0 before "
+           "(config_opts.h:1171)"),
     Option("objecter_inflight_ops", "int", 1024, "client op throttle"),
     Option("objecter_inflight_op_bytes", "size", "100m", ""),
     Option("ec_batch_window_us", "int", 200,

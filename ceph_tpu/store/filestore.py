@@ -24,6 +24,12 @@ from ceph_tpu.store.wal import WriteAheadLog, atomic_snapshot
 _MAGIC = b"CTFS\x01"
 
 
+class KilledAt(StoreError):
+    """Injected crash (filestore_kill_at role, config_opts.h:1171):
+    the store dies mid-write-path; the test re-mounts and checks the
+    recovered state is an exact transaction-boundary prefix."""
+
+
 class FileStore(MemStore):
     COMPACT_BYTES = 64 << 20
 
@@ -33,6 +39,11 @@ class FileStore(MemStore):
         super().__init__(path)
         self.committed_seq = 0
         self._wal = None
+        #: crash injection countdown (0 = off).  N > 0: die AFTER the
+        #: Nth batch's WAL records are durable but BEFORE the in-memory
+        #: apply (journal replay must recover it).  N < 0: die BEFORE
+        #: the |N|th batch touches the WAL (the txn must vanish).
+        self.kill_at = 0
 
     # --- paths ---
     def _ckpt_path(self):
@@ -71,10 +82,18 @@ class FileStore(MemStore):
                            on_applied=None, on_commit=None):
         if not self.mounted:
             raise StoreError("not mounted")
+        if self.kill_at < 0:
+            self.kill_at += 1
+            if self.kill_at == 0:
+                self._die("before journal")
         # journal-ahead: encode + fsync all records, then apply in memory
         recs = [(self.committed_seq + 1 + i, t.to_bytes())
                 for i, t in enumerate(txns)]
         self._wal.append_many(recs)
+        if self.kill_at > 0:
+            self.kill_at -= 1
+            if self.kill_at == 0:
+                self._die("after journal, before apply")
         self.committed_seq += len(txns)   # only after records are durable
         for t in txns:
             self._apply(t)
@@ -85,6 +104,16 @@ class FileStore(MemStore):
             on_commit()
         if self._wal.size() > self.COMPACT_BYTES:
             self.checkpoint()
+
+    def _die(self, where: str) -> None:
+        """Injected crash: the store must look DEAD — in particular the
+        WAL handle closes WITHOUT checkpoint/rotate, or a well-meaning
+        try/finally umount() would snapshot the stale pre-apply state
+        and truncate the very record the injection proved durable."""
+        self.mounted = False
+        if self._wal is not None and not self._wal.closed:
+            self._wal.close()
+        raise KilledAt(where)
 
     # --- checkpoint / replay ---
     def checkpoint(self) -> None:

@@ -65,6 +65,9 @@ async def run_osd(args) -> None:
         store.set_compression(
             ctx.config["blockstore_compression"],
             ctx.config["blockstore_compression_min_blob"])
+    if kind == "filestore" and ctx.config["filestore_kill_at"]:
+        # crash injection countdown (config_opts.h filestore_kill_at)
+        store.kill_at = int(ctx.config["filestore_kill_at"])
     fresh_marker = os.path.join(
         path, "fsid" if kind == "filestore" else "block")
     if not os.path.exists(fresh_marker):
