@@ -62,6 +62,7 @@ class CephFS(Dispatcher):
         import random
         self._tid = random.getrandbits(32) << 20
         self._pending: Dict[int, asyncio.Future] = {}
+        self._snapc_ver = 0          # newest snap-table state applied
         # dentry lease cache: lease_key(dir, name) -> (ent, expiry)
         self._leases: Dict[str, tuple] = {}
         self._revoke_epoch = 0       # bumps on every MClientLease
@@ -116,6 +117,19 @@ class CephFS(Dispatcher):
         if reply.result < 0:
             raise CephFSError(-reply.result,
                               f"{op} {args}: {reply.data}")
+        snapc = reply.data.pop("_snapc", None)
+        if snapc is not None:
+            # piggybacked fs snap context (cap-message role): our
+            # data-pool writes COW every live snapshot from now on.
+            # Ordering rides the table VERSION, not snap_seq: two
+            # concurrent mksnaps yield same-seq states with different
+            # id sets, and a rank's TTL-stale table must never roll
+            # back a newer state this client already holds.
+            ver = int(snapc[2]) if len(snapc) > 2 else 0
+            if ver > self._snapc_ver:
+                self._snapc_ver = ver
+                self.data_io.set_write_snapc(
+                    int(snapc[0]), [int(s) for s in snapc[1]])
         return reply.data
 
     # ------------------------------------------------------------ walking
@@ -158,12 +172,92 @@ class CephFS(Dispatcher):
             raise CephFSError(errno.ENOTDIR, path)
         return ent["ino"], parts[-1]
 
+    # ------------------------------------------------------------ snapshots
+    # The '.snap' virtual directory (client/Client.cc snapdir):
+    # `/a/b/.snap` lists b's snapshots; `/a/b/.snap/s1/c` resolves c
+    # inside snapshot s1's frozen manifest, and reads target the
+    # data-pool clone at the snapshot's snapid.
+
+    @staticmethod
+    def _split_snap(path: str):
+        """-> None, or (dir_path, snap_name|None, rel_path)."""
+        parts = [p for p in norm_path(path).split("/") if p]
+        if ".snap" not in parts:
+            return None
+        i = parts.index(".snap")
+        dir_path = "/" + "/".join(parts[:i])
+        rest = parts[i + 1:]
+        if not rest:
+            return dir_path, None, ""
+        return dir_path, rest[0], "/".join(rest[1:])
+
+    async def mksnap(self, path: str, name: str) -> int:
+        """Snapshot the dir at `path` (mkdir /path/.snap/name role):
+        allocate a data-pool self-managed snapid, then ask the MDS to
+        freeze the subtree manifest under that id."""
+        ent = await self._walk(path)
+        if ent["type"] != "dir":
+            raise CephFSError(errno.ENOTDIR, path)
+        snapid = await self.data_io.selfmanaged_snap_create()
+        try:
+            data = await self._request(ent["ino"], "mksnap",
+                                       ino=ent["ino"], name=name,
+                                       snapid=snapid)
+        except Exception:
+            # the MDS refused (EEXIST/EINVAL/EFBIG/...): retire the
+            # snapid we allocated or it leaks in the pool forever
+            try:
+                await self.data_io.selfmanaged_snap_remove(snapid)
+            except Exception:
+                pass
+            raise
+        return data["snapid"]
+
+    async def rmsnap(self, path: str, name: str) -> None:
+        """rmdir /path/.snap/name: drop the manifest, then retire the
+        data snap (OSDs trim its clones)."""
+        ent = await self._walk(path)
+        data = await self._request(ent["ino"], "rmsnap",
+                                   ino=ent["ino"], name=name)
+        await self.data_io.selfmanaged_snap_remove(data["snapid"])
+
+    async def listsnaps(self, path: str) -> Dict[str, dict]:
+        ent = await self._walk(path)
+        data = await self._request(ent["ino"], "lssnap",
+                                   ino=ent["ino"])
+        return data["snaps"]
+
+    async def _snap_node(self, dir_path: str, snap: str, rel: str,
+                         list_: bool = False) -> dict:
+        ent = await self._walk(dir_path)
+        return await self._request(ent["ino"], "snaplookup",
+                                   ino=ent["ino"], snap=snap,
+                                   path=rel, list=list_)
+
+    def _snap_read_io(self, snapid: int):
+        """A dedicated ioctx pinned to the snap — the shared data_io's
+        snap_read must stay at head for concurrent live reads."""
+        io = self.data_io.dup()
+        io.set_snap_read(snapid)
+        return io
+
     # ------------------------------------------------------------ metadata
     async def mkdir(self, path: str) -> None:
+        sp = self._split_snap(path)
+        if sp is not None:
+            if sp[1] is None or sp[2]:
+                raise CephFSError(errno.EROFS, path)
+            await self.mksnap(sp[0], sp[1])   # mkdir /d/.snap/s1
+            return
         d, name = await self._walk_parent(path)
         await self._request(d, "mkdir", dir=d, name=name)
 
     async def makedirs(self, path: str) -> None:
+        if self._split_snap(path) is not None:
+            # '.snap/<name>' is virtual: a single mkdir IS the whole
+            # creation (walking into '.snap' itself would EROFS)
+            await self.mkdir(path)
+            return
         parts = [p for p in path.split("/") if p]
         cur = ""
         for p in parts:
@@ -175,6 +269,13 @@ class CephFS(Dispatcher):
                     raise
 
     async def listdir(self, path: str) -> List[str]:
+        sp = self._split_snap(path)
+        if sp is not None:
+            if sp[1] is None:                 # ls /d/.snap
+                return sorted(await self.listsnaps(sp[0]))
+            data = await self._snap_node(sp[0], sp[1], sp[2],
+                                         list_=True)
+            return sorted(data["entries"])
         ent = await self._walk(path)
         if ent["type"] != "dir":
             raise CephFSError(errno.ENOTDIR, path)
@@ -183,9 +284,17 @@ class CephFS(Dispatcher):
         return sorted(data["entries"])
 
     async def stat(self, path: str) -> dict:
+        sp = self._split_snap(path)
+        if sp is not None:
+            if sp[1] is None:
+                ent = await self._walk(sp[0])
+                return dict(ent, type="dir")
+            return (await self._snap_node(sp[0], sp[1], sp[2]))["ent"]
         return await self._walk(path)
 
     async def rename(self, src: str, dst: str) -> None:
+        if self._split_snap(src) or self._split_snap(dst):
+            raise CephFSError(errno.EROFS, "snapshots are read-only")
         sd, sn = await self._walk_parent(src)
         dd, dn = await self._walk_parent(dst)
         # served by the DESTINATION dir's owner (which peers to the
@@ -196,6 +305,8 @@ class CephFS(Dispatcher):
         self._lease_drop(dd, dn)
 
     async def unlink(self, path: str) -> None:
+        if self._split_snap(path):
+            raise CephFSError(errno.EROFS, "snapshots are read-only")
         d, name = await self._walk_parent(path)
         data = await self._request(d, "unlink", dir=d, name=name)
         self._lease_drop(d, name)
@@ -209,6 +320,12 @@ class CephFS(Dispatcher):
             pass
 
     async def rmdir(self, path: str) -> None:
+        sp = self._split_snap(path)
+        if sp is not None:
+            if sp[1] is None or sp[2]:
+                raise CephFSError(errno.EROFS, path)
+            await self.rmsnap(sp[0], sp[1])   # rmdir /d/.snap/s1
+            return
         d, name = await self._walk_parent(path)
         await self._request(d, "rmdir", dir=d, name=name)
         self._lease_drop(d, name)
@@ -217,6 +334,21 @@ class CephFS(Dispatcher):
     async def open(self, path: str, mode: str = "r") -> "File":
         if mode not in ("r", "w", "a", "r+", "w+"):
             raise ValueError(f"mode {mode!r}")
+        sp = self._split_snap(path)
+        if sp is not None:
+            if mode != "r":
+                raise CephFSError(errno.EROFS, path)
+            if sp[1] is None or not sp[2]:
+                raise CephFSError(errno.EISDIR, path)
+            data = await self._snap_node(sp[0], sp[1], sp[2])
+            ent = data["ent"]
+            if ent["type"] != "file":
+                raise CephFSError(errno.EISDIR, path)
+            f = File(self, 0, sp[2], ent, "r")
+            # reads resolve the data-pool CLONE at the snapshot's id
+            f._striper = RadosStriper(
+                self._snap_read_io(data["snapid"]))
+            return f
         d, name = await self._walk_parent(path)
         if "w" in mode or "a" in mode or "+" in mode:
             data = await self._request(d, "create", dir=d, name=name)

@@ -53,6 +53,11 @@ ROOT_INO = 1
 INOTABLE_OID = "mds_inotable"
 LEASE_TTL = 5.0         # dentry lease seconds (mds_lease default role)
 INO_BLOCK = 256         # inos claimed per cls alloc_block (InoTable)
+#: reserved dir-omap namespace for snapshot manifests ('\x01' cannot
+#: appear in a dentry name — guarded at mkdir/create)
+SNAP_KEY_PREFIX = "\x01snap."
+SNAPTABLE_TTL = 2.0     # seconds a rank trusts its cached snap table
+SNAP_MANIFEST_CAP = 100_000   # entries per snapshot manifest
 
 
 def norm_path(path: str) -> str:
@@ -185,6 +190,10 @@ class MDS(Dispatcher):
         self._ino_end = 0               # exclusive end of claimed block
         self._ino_dirty = False
         self._unflushed = 0                 # events since last flush
+        # ---- snapshot table cache (SnapServer role) ----
+        # (table_ver, snap_seq, [snapids]) — ver linearizes states
+        self._snapc_cache: Optional[Tuple[int, int, List[int]]] = None
+        self._snapc_stamp = 0.0
         self._last_seq = 0
         self._flush_interval = log_flush_interval
         self._flush_events = log_flush_events
@@ -406,7 +415,8 @@ class MDS(Dispatcher):
                 return dict(self._dirs[ino])   # created, not yet flushed
             raise FileNotFoundError(ino)
         ents = {k.decode(): json.loads(v.decode())
-                for k, v in omap.items()}
+                for k, v in omap.items()
+                if not k.startswith(b"\x01")}   # snap manifests etc.
         if self._mdlog is not None:
             # overlay unflushed cache state
             for n in self._removed.get(ino, ()):  # removed, not flushed
@@ -423,6 +433,103 @@ class MDS(Dispatcher):
         except FileNotFoundError:
             return None
         return ents.get(name)
+
+    # ------------------------------------------------------------ snapshots
+    # CephFS snapshots (mds/SnapServer.cc + snaprealm machinery,
+    # distilled): `mksnap` freezes a dir subtree as a MANIFEST stored
+    # in the dir object's reserved '\x01snap.' omap namespace, backed
+    # by a DATA-pool self-managed snapid the CLIENT allocates (the MDS
+    # stays data-pool-agnostic).  One snap realm = the whole fs: every
+    # registered snapid rides the snapc piggybacked on every reply, so
+    # all clients' subsequent writes COW whatever snapshot exists
+    # (conservative vs the reference's per-realm scoping; extra clones
+    # die with the snap).  Snapshots are fuzzy for writers that
+    # haven't spoken to an MDS since mksnap — the reference closes
+    # this with cap revocation; divergence documented.
+
+    async def _snap_table(self, force: bool = False
+                          ) -> Tuple[int, int, List[int]]:
+        """(table_ver, snap_seq, [snapids]) — TTL-cached from the
+        shared snaptable omap so reply piggybacking costs no I/O."""
+        now = time.time()
+        if (not force and self._snapc_cache is not None
+                and now - self._snapc_stamp < SNAPTABLE_TTL):
+            return self._snapc_cache
+        try:
+            omap = await self.io.omap_get(INOTABLE_OID)
+        except ObjectOperationError:
+            omap = {}
+        ver = int(omap.get(b"snap_ver", b"0"))
+        seq = int(omap.get(b"snap_seq", b"0"))
+        ids = json.loads(omap.get(b"snaps", b"[]").decode())
+        self._snapc_cache = (ver, seq, ids)
+        self._snapc_stamp = now
+        return self._snapc_cache
+
+    async def _snap_table_update(self, add: Optional[int] = None,
+                                 rm: Optional[int] = None) -> None:
+        """ATOMIC table mutation via cls (inotable.snap_update): two
+        ranks snapshotting concurrently must never lose each other's
+        snapid to a client-side read-modify-write."""
+        out = json.loads(await self.io.exec(
+            INOTABLE_OID, "inotable", "snap_update",
+            json.dumps({"add": add, "rm": rm}).encode()))
+        self._snapc_cache = (out["ver"], out["snap_seq"],
+                             out["snaps"])
+        self._snapc_stamp = time.time()
+
+    async def _build_manifest(self, ino: int) -> Dict[str, dict]:
+        """Flatten the subtree under `ino`: relpath -> dentry copy.
+        Dirs owned by peer ranks are listed through THEIR cache
+        (peer_readdir), so unflushed dentries are captured."""
+        out: Dict[str, dict] = {}
+        queue: List[Tuple[int, str]] = [(ino, "")]
+        while queue:
+            dino, prefix = queue.pop()
+            if self._owner(dino) == self.rank:
+                async with self._mutex:
+                    ents = await self._dir_entries(dino)
+            else:
+                got = await self._peer_request(
+                    self._owner(dino), "peer_readdir", dir=dino)
+                ents = got["entries"]
+            for name, ent in ents.items():
+                rel = f"{prefix}{name}"
+                out[rel] = dict(ent)
+                if len(out) > SNAP_MANIFEST_CAP:
+                    raise OSError(errno.EFBIG,
+                                  "snapshot subtree too large")
+                if ent.get("type") == "dir":
+                    queue.append((ent["ino"], rel + "/"))
+        return out
+
+    @staticmethod
+    def _snap_omap_key(name: str) -> bytes:
+        return (SNAP_KEY_PREFIX + name).encode()
+
+    @staticmethod
+    def _manifest_oid(ino: int, name: str) -> str:
+        return f"dirsnap.{ino:x}.{name}"
+
+    async def _dir_snaps(self, ino: int) -> Dict[str, dict]:
+        """name -> {snapid, created} for a dir.  The dir omap carries
+        only these SMALL records — manifests live in their own
+        objects, off the metadata hot path."""
+        try:
+            omap = await self.io.omap_get(dir_oid(ino))
+        except ObjectOperationError:
+            if self._mdlog is not None and ino in self._dirs \
+                    and ino not in self._gone_dirs:
+                return {}     # created, not yet flushed: no snaps yet
+            raise FileNotFoundError(ino)
+        pre = SNAP_KEY_PREFIX.encode()
+        out = {}
+        for k, v in omap.items():
+            if k.startswith(pre):
+                rec = json.loads(v.decode())
+                out[k[len(pre):].decode()] = {
+                    "snapid": rec["snapid"], "created": rec["created"]}
+        return out
 
     # ------------------------------------------------------------- dispatch
     def ms_dispatch(self, m: Message) -> bool:
@@ -526,6 +633,14 @@ class MDS(Dispatcher):
                 else:
                     self._revoke_leases(
                         m, [lease_key(a["dir"], a["name"])])
+            # piggyback the fs snap context on every successful reply
+            # (cap-message role): clients keep their data-pool write
+            # snapc current without extra round trips
+            ver, seq, ids = await self._snap_table()
+            if seq:
+                data = dict(data)
+                data["_snapc"] = [seq, sorted(ids, reverse=True),
+                                  ver]
             reply = MClientReply(m.tid, 0, data)
         except FileNotFoundError:
             reply = MClientReply(m.tid, -errno.ENOENT)
@@ -552,6 +667,17 @@ class MDS(Dispatcher):
     # component-by-component against their dentry-lease cache
     # (client/Client.cc path_walk).
 
+    MUTATOR_NAME_ARGS = {"mkdir": "name", "create": "name",
+                         "rename": "dstname"}
+
+    @staticmethod
+    def _check_name(name: str) -> None:
+        """'.snap' is the virtual snapshot dir; '\\x01' is the dir
+        omap's reserved metadata namespace (client/Client.cc refuses
+        .snap the same way)."""
+        if name == ".snap" or name.startswith("\x01"):
+            raise OSError(errno.EINVAL, f"reserved name {name!r}")
+
     def _check_owner(self, ino: int) -> None:
         if self._owner(ino) != self.rank:
             # client and MDS disagree on the partition function only
@@ -561,6 +687,9 @@ class MDS(Dispatcher):
                           f"dir {ino} owned by rank {self._owner(ino)}")
 
     async def _execute(self, op: str, a: dict) -> dict:
+        narg = self.MUTATOR_NAME_ARGS.get(op)
+        if narg is not None:
+            self._check_name(a[narg])
         if op == "lookup" or op == "peer_lookup":
             self._check_owner(a["dir"])
             async with self._mutex:
@@ -747,4 +876,92 @@ class MDS(Dispatcher):
                             [lease_key(a["dstdir"], a["dstname"])])
                 raise FileNotFoundError(a["srcname"])
             return {"ent": ent}
+        if op == "peer_readdir":
+            self._check_owner(a["dir"])
+            async with self._mutex:
+                ents = await self._dir_entries(a["dir"])
+            return {"entries": ents}
+        if op == "mksnap":
+            self._check_owner(a["ino"])
+            name, snapid = a["name"], int(a["snapid"])
+            if not name or name.startswith("\x01") or "/" in name:
+                raise OSError(errno.EINVAL, "bad snapshot name")
+            async with self._mutex:
+                if self._mdlog is not None:
+                    # materialize the dir + dentries before the omap
+                    # reads below (manifest + snap-key write need the
+                    # dir object on disk)
+                    await self._flush_locked()
+            if name in await self._dir_snaps(a["ino"]):
+                raise FileExistsError(name)
+            # subtree walk OUTSIDE the mutex: peer ranks may be
+            # mksnap-ing into us concurrently (same release discipline
+            # as cross-rank rename)
+            manifest = await self._build_manifest(a["ino"])
+            # manifest first (own object), then the small dir record —
+            # a crash in between leaves an orphan manifest, never a
+            # snap record pointing nowhere
+            await self.io.write_full(
+                self._manifest_oid(a["ino"], name),
+                json.dumps(manifest).encode())
+            await self.io.omap_set(dir_oid(a["ino"]), {
+                self._snap_omap_key(name): json.dumps({
+                    "snapid": snapid,
+                    "created": time.time()}).encode()})
+            await self._snap_table_update(add=snapid)
+            return {"snapid": snapid, "entries": len(manifest)}
+        if op == "rmsnap":
+            self._check_owner(a["ino"])
+            snaps = await self._dir_snaps(a["ino"])
+            if a["name"] not in snaps:
+                raise FileNotFoundError(a["name"])
+            snapid = snaps[a["name"]]["snapid"]
+            await self.io.omap_rm_keys(
+                dir_oid(a["ino"]), [self._snap_omap_key(a["name"])])
+            try:
+                await self.io.remove(
+                    self._manifest_oid(a["ino"], a["name"]))
+            except ObjectOperationError:
+                pass
+            await self._snap_table_update(rm=snapid)
+            return {"snapid": snapid}   # client retires the data snap
+        if op == "lssnap":
+            self._check_owner(a["ino"])
+            return {"snaps": await self._dir_snaps(a["ino"])}
+        if op == "snaplookup":
+            # resolve `path` (relative, "" = the snapped dir itself)
+            # inside the frozen manifest
+            self._check_owner(a["ino"])
+            try:
+                omap = await self.io.omap_get(
+                    dir_oid(a["ino"]),
+                    keys=[self._snap_omap_key(a["snap"])])
+            except ObjectOperationError:
+                raise FileNotFoundError(a["ino"])
+            raw = omap.get(self._snap_omap_key(a["snap"]))
+            if raw is None:
+                raise FileNotFoundError(a["snap"])
+            rec = json.loads(raw.decode())
+            manifest = json.loads(
+                await self.io.read(
+                    self._manifest_oid(a["ino"], a["snap"])))
+            rel = a.get("path", "")
+            if rel:
+                ent = manifest.get(rel)
+                if ent is None:
+                    raise FileNotFoundError(rel)
+            else:
+                ent = {"type": "dir", "ino": a["ino"], "size": 0,
+                       "mtime": rec["created"]}
+            if a.get("list"):
+                if ent["type"] != "dir":
+                    raise NotADirectoryError(rel)
+                pre = rel + "/" if rel else ""
+                entries = {p[len(pre):]: e
+                           for p, e in manifest.items()
+                           if p.startswith(pre)
+                           and "/" not in p[len(pre):]}
+                return {"entries": entries,
+                        "snapid": rec["snapid"]}
+            return {"ent": ent, "snapid": rec["snapid"]}
         raise OSError(errno.EOPNOTSUPP, f"mds op {op!r}")

@@ -409,3 +409,126 @@ def test_multirank_lease_revoke_and_restart_replay():
                 await msgr_.shutdown()
         await cl.stop()
     asyncio.run(run())
+
+
+def test_cephfs_snapshots_end_to_end():
+    """CephFS snapshots (mds/SnapServer + snaprealm distilled): mkdir
+    /d/.snap/<name> freezes the subtree; post-snap writes COW the
+    data-pool clones; .snap reads serve the frozen bytes; unlink of
+    the live file leaves the snapshot readable; rmsnap retires it."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        mds, msgr, addr = await _start_mds(cl, admin)
+        fs = CephFS(admin, addr, "cephfs_data")
+
+        await fs.makedirs("/proj/sub")
+        v1 = b"version-one " * 5000          # striped size
+        await fs.write_file("/proj/data.bin", v1)
+        await fs.write_file("/proj/sub/notes.txt", b"alpha")
+
+        # snapshot via the .snap virtual dir
+        await fs.mkdir("/proj/.snap/s1")
+        assert await fs.listdir("/proj/.snap") == ["s1"]
+
+        # overwrite + new file AFTER the snapshot
+        v2 = b"version-two!" * 6000
+        await fs.write_file("/proj/data.bin", v2)
+        await fs.write_file("/proj/later.txt", b"not in snap")
+
+        # live tree serves v2; the snapshot serves v1
+        assert await fs.read_file("/proj/data.bin") == v2
+        assert await fs.read_file("/proj/.snap/s1/data.bin") == v1
+        assert await fs.read_file("/proj/.snap/s1/sub/notes.txt") \
+            == b"alpha"
+        # snapshot listing is the frozen namespace
+        assert await fs.listdir("/proj/.snap/s1") \
+            == ["data.bin", "sub"]
+        assert await fs.listdir("/proj/.snap/s1/sub") == ["notes.txt"]
+        st = await fs.stat("/proj/.snap/s1/data.bin")
+        assert st["size"] == len(v1)
+        with pytest.raises(CephFSError):
+            await fs.read_file("/proj/.snap/s1/later.txt")  # post-snap
+
+        # snapshots are read-only
+        with pytest.raises(CephFSError):
+            await fs.write_file("/proj/.snap/s1/data.bin", b"x")
+        with pytest.raises(CephFSError):
+            await fs.unlink("/proj/.snap/s1/data.bin")
+        # '.snap' itself is an unusable file name
+        with pytest.raises(CephFSError):
+            await fs.mkdir("/proj/sub/.snap/nested/deep")
+
+        # deleting the LIVE file keeps the snapshot readable
+        await fs.unlink("/proj/data.bin")
+        with pytest.raises(CephFSError):
+            await fs.read_file("/proj/data.bin")
+        assert await fs.read_file("/proj/.snap/s1/data.bin") == v1
+
+        # second snapshot sees the current (post-delete) tree
+        await fs.mksnap("/proj", "s2")
+        assert sorted(await fs.listdir("/proj/.snap")) == ["s1", "s2"]
+        assert await fs.listdir("/proj/.snap/s2") \
+            == ["later.txt", "sub"]
+
+        # rmsnap via rmdir of the virtual path
+        await fs.rmdir("/proj/.snap/s1")
+        assert await fs.listdir("/proj/.snap") == ["s2"]
+        with pytest.raises(CephFSError):
+            await fs.read_file("/proj/.snap/s1/data.bin")
+
+        await mds.stop()
+        await msgr.shutdown()
+        await cl.stop()
+    asyncio.run(run())
+
+
+def test_multirank_snapshot_spans_ranks():
+    """mksnap on a subtree whose child dirs are owned by OTHER ranks:
+    the manifest walk rides peer_readdir (capturing peers' unflushed
+    caches) and concurrent mksnaps on different ranks never lose each
+    other's snapid (atomic cls snap table)."""
+    from ceph_tpu.services.mds import owner_rank
+
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        ranks = await _start_ranks(cl, admin, 3)
+        addrs = [a for _, _, a in ranks]
+        fs = CephFS(admin, addrs, "cephfs_data")
+
+        # find two sibling dirs owned by DIFFERENT ranks
+        made, owners = [], {}
+        for i in range(8):
+            await fs.mkdir(f"/m{i}")
+            ino = (await fs.stat(f"/m{i}"))["ino"]
+            owners[f"/m{i}"] = owner_rank(ino, 3)
+            made.append(f"/m{i}")
+        root_owner = owner_rank(1, 3)
+        cross = next(p for p in made if owners[p] != root_owner)
+        await fs.write_file(f"{cross}/f.txt", b"cross-rank bytes")
+
+        # snapshot the ROOT: the walk must traverse dirs on all ranks
+        await fs.mksnap("/", "all")
+        assert await fs.read_file(f"/.snap/all{cross}/f.txt") \
+            == b"cross-rank bytes"
+
+        # concurrent snapshots on dirs owned by different ranks: both
+        # snapids must survive in the table (every client write COWs
+        # both) — the atomic cls update is what makes this hold
+        a_dir = next(p for p in made if owners[p] == root_owner)
+        await asyncio.gather(fs.mksnap(cross, "c1"),
+                             fs.mksnap(a_dir, "c2"))
+        _, seq, ids = await ranks[0][0]._snap_table(force=True)
+        assert len(ids) >= 3           # "all" + "c1" + "c2"
+
+        # post-snap write; per-dir snapshot still serves the old bytes
+        await fs.write_file(f"{cross}/f.txt", b"NEW")
+        assert await fs.read_file(f"{cross}/.snap/c1/f.txt") \
+            == b"cross-rank bytes"
+
+        for mds, msgr, _ in ranks:
+            await mds.stop()
+            await msgr.shutdown()
+        await cl.stop()
+    asyncio.run(run())
