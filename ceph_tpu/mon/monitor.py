@@ -387,6 +387,9 @@ class Monitor(Dispatcher):
             elif prefix == "df":
                 self.reply(m, MMonCommandAck(
                     m.tid, 0, json.dumps(self.pgmon.df())))
+            elif prefix == "osd df":
+                self.reply(m, MMonCommandAck(
+                    m.tid, 0, json.dumps(self.pgmon.osd_df())))
             elif prefix == "pg dump":
                 self.reply(m, MMonCommandAck(
                     m.tid, 0, json.dumps(self.pgmon.dump())))
@@ -441,8 +444,8 @@ class Monitor(Dispatcher):
             self.reply(m, MMonCommandAck(m.tid, -errno.EIO, repr(e)))
 
     _READONLY_COMMANDS = frozenset({
-        "health", "status", "df", "pg stat", "pg dump", "log last",
-        "mon dump",
+        "health", "status", "df", "osd df", "pg stat", "pg dump",
+        "log last", "mon dump",
         "quorum_status", "osd dump", "osd tree", "osd stat", "osd ls",
         "osd pool ls", "osd getmap", "osd getcrushmap",
         "osd erasure-code-profile ls", "osd erasure-code-profile get",

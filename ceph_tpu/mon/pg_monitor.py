@@ -86,6 +86,32 @@ class PGMonitor:
     def expected_pg_count(self) -> int:
         return sum(p.pg_num for p in self.mon.osdmon.osdmap.pools.values())
 
+    def osd_df(self) -> Dict:
+        """`ceph osd df` role (OSDMonitor/PGMap osd_df): per-osd
+        capacity + pg count from the reported osd_stat statfs."""
+        osdmap = self.mon.osdmon.osdmap
+        rows = []
+        for osd in range(osdmap.max_osd):
+            if not osdmap.exists(osd):
+                continue
+            st = self.osd_stats.get(osd, {})
+            fs = st.get("statfs", {})
+            total, used = fs.get("total", 0), fs.get("used", 0)
+            rows.append({
+                "id": osd,
+                "up": osdmap.is_up(osd),
+                "in": osdmap.is_in(osd),
+                "weight": osdmap.osd_weight[osd] / 0x10000
+                if osd < len(osdmap.osd_weight) else 0.0,
+                "num_pgs": st.get("num_pgs", 0),
+                "total": total, "used": used,
+                "free": fs.get("free", 0),
+                "utilization": round(used / total, 4) if total else None,
+            })
+        return {"nodes": rows,
+                "summary": {"total": sum(r["total"] for r in rows),
+                            "used": sum(r["used"] for r in rows)}}
+
     def df(self) -> Dict:
         """`ceph df` role (PGMonitor::dump_pool_stats /
         dump_fs_stats): per-pool logical usage aggregated from pg

@@ -697,10 +697,17 @@ class OSD(Dispatcher):
                     "up": list(pg.up),
                     "acting": list(pg.acting),
                 })
+            osd_stat = {"num_pgs": len(self.pgs)}
+            try:
+                # store capacity for `ceph osd df` (osd_stat_t kb/
+                # kb_used role); MemStore-family reports used only
+                osd_stat["statfs"] = self.store.statfs()
+            except AttributeError:
+                pass          # store backend without statfs
             try:
                 self.monc.messenger.send_message(
                     MPGStats(self.whoami, self.osdmap.epoch, rows,
-                             {"num_pgs": len(self.pgs)}),
+                             osd_stat),
                     self.monc.monmap.addr_of_rank(self.monc.cur_mon),
                     peer_type="mon")
             except Exception:

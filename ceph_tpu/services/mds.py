@@ -783,6 +783,12 @@ class MDS(Dispatcher):
                     if await self._dir_entries(child):
                         raise OSError(errno.ENOTEMPTY,
                                       "directory not empty")
+                    if await self._dir_snaps(child):
+                        # live snapshots anchor to the dir record:
+                        # removing it would orphan their manifests and
+                        # leak the snapids in the table forever
+                        raise OSError(errno.ENOTEMPTY,
+                                      "directory has snapshots")
                     await self._commit_effects({
                         "rm": [[a["dir"], a["name"]]],
                         "rmdir": [child]})
@@ -807,6 +813,9 @@ class MDS(Dispatcher):
                 if await self._dir_entries(a["ino"]):
                     raise OSError(errno.ENOTEMPTY,
                                   "directory not empty")
+                if await self._dir_snaps(a["ino"]):
+                    raise OSError(errno.ENOTEMPTY,
+                                  "directory has snapshots")
                 await self._commit_effects({"rmdir": [a["ino"]]})
             return {}
         if op == "peer_rm":
@@ -893,22 +902,37 @@ class MDS(Dispatcher):
                     # dir object on disk)
                     await self._flush_locked()
             if name in await self._dir_snaps(a["ino"]):
-                raise FileExistsError(name)
+                raise FileExistsError(name)      # cheap early out
             # subtree walk OUTSIDE the mutex: peer ranks may be
             # mksnap-ing into us concurrently (same release discipline
             # as cross-rank rename)
             manifest = await self._build_manifest(a["ino"])
-            # manifest first (own object), then the small dir record —
-            # a crash in between leaves an orphan manifest, never a
-            # snap record pointing nowhere
-            await self.io.write_full(
-                self._manifest_oid(a["ino"], name),
-                json.dumps(manifest).encode())
-            await self.io.omap_set(dir_oid(a["ino"]), {
-                self._snap_omap_key(name): json.dumps({
-                    "snapid": snapid,
-                    "created": time.time()}).encode()})
-            await self._snap_table_update(add=snapid)
+            async with self._mutex:
+                # re-check under the mutex: a same-name mksnap may
+                # have raced the walk (mkdir/create 'raced us' rule) —
+                # without this, the loser's snapid would orphan in the
+                # table, COWing every future write forever
+                if name in await self._dir_snaps(a["ino"]):
+                    raise FileExistsError(name)
+                # manifest entries as OMAP KEYS on their own object so
+                # a single .snap stat fetches ONE key, not the whole
+                # subtree; manifest first, then the small dir record —
+                # a crash in between leaves an orphan manifest, never
+                # a record pointing nowhere
+                moid = self._manifest_oid(a["ino"], name)
+                items = [(rel.encode(), json.dumps(e).encode())
+                         for rel, e in manifest.items()]
+                if items:
+                    for i in range(0, len(items), 8192):
+                        await self.io.omap_set(
+                            moid, dict(items[i:i + 8192]))
+                else:
+                    await self.io.write_full(moid, b"")  # empty snap
+                await self.io.omap_set(dir_oid(a["ino"]), {
+                    self._snap_omap_key(name): json.dumps({
+                        "snapid": snapid,
+                        "created": time.time()}).encode()})
+                await self._snap_table_update(add=snapid)
             return {"snapid": snapid, "entries": len(manifest)}
         if op == "rmsnap":
             self._check_owner(a["ino"])
@@ -942,25 +966,35 @@ class MDS(Dispatcher):
             if raw is None:
                 raise FileNotFoundError(a["snap"])
             rec = json.loads(raw.decode())
-            manifest = json.loads(
-                await self.io.read(
-                    self._manifest_oid(a["ino"], a["snap"])))
+            moid = self._manifest_oid(a["ino"], a["snap"])
             rel = a.get("path", "")
             if rel:
-                ent = manifest.get(rel)
-                if ent is None:
+                # single-entry resolution: ONE keyed omap read, never
+                # the whole manifest
+                try:
+                    got = await self.io.omap_get(moid,
+                                                 keys=[rel.encode()])
+                except ObjectOperationError:
+                    got = {}                  # empty-snapshot object
+                raw_e = got.get(rel.encode())
+                if raw_e is None:
                     raise FileNotFoundError(rel)
+                ent = json.loads(raw_e.decode())
             else:
                 ent = {"type": "dir", "ino": a["ino"], "size": 0,
                        "mtime": rec["created"]}
             if a.get("list"):
                 if ent["type"] != "dir":
                     raise NotADirectoryError(rel)
-                pre = rel + "/" if rel else ""
-                entries = {p[len(pre):]: e
+                try:
+                    manifest = await self.io.omap_get(moid)
+                except ObjectOperationError:
+                    manifest = {}
+                pre = (rel + "/" if rel else "").encode()
+                entries = {p[len(pre):].decode(): json.loads(e.decode())
                            for p, e in manifest.items()
                            if p.startswith(pre)
-                           and "/" not in p[len(pre):]}
+                           and b"/" not in p[len(pre):]}
                 return {"entries": entries,
                         "snapid": rec["snapid"]}
             return {"ent": ent, "snapid": rec["snapid"]}

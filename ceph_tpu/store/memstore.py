@@ -226,6 +226,14 @@ class MemStore(ObjectStore):
     def getattrs(self, cid, oid) -> Dict[str, bytes]:
         return dict(self._obj(cid, oid).xattrs)
 
+    def statfs(self) -> Dict[str, int]:
+        """df-style usage (ObjectStore::statfs): RAM-backed stores
+        have no fixed device — total/free report 0 = unknown."""
+        used = sum(len(o.data)
+                   for objs in self.colls.values()
+                   for o in objs.values())
+        return {"total": 0, "free": 0, "used": used}
+
     def omap_get(self, cid, oid) -> Tuple[bytes, Dict[bytes, bytes]]:
         o = self._obj(cid, oid)
         return o.omap_header, dict(o.omap)

@@ -471,6 +471,15 @@ def test_cephfs_snapshots_end_to_end():
         assert await fs.listdir("/proj/.snap/s2") \
             == ["later.txt", "sub"]
 
+        # a dir with live snapshots refuses rmdir (the snap records
+        # anchor there; removing them would leak snapids forever)
+        await fs.mkdir("/victim")
+        await fs.mksnap("/victim", "sv")
+        with pytest.raises(CephFSError):
+            await fs.rmdir("/victim")
+        await fs.rmsnap("/victim", "sv")
+        await fs.rmdir("/victim")
+
         # rmsnap via rmdir of the virtual path
         await fs.rmdir("/proj/.snap/s1")
         assert await fs.listdir("/proj/.snap") == ["s2"]

@@ -227,3 +227,24 @@ def test_osd_bench_admin_command():
         assert out2["bytes_written"] == 1
         await cl.stop()
     asyncio.run(run())
+
+
+def test_osd_df_reports_capacity():
+    """`ceph osd df` (PGMap osd_df role): per-osd store usage + pg
+    counts from the reported statfs."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("data", pg_num=4)
+        io = admin.open_ioctx("data")
+        await io.write_full("x", b"y" * 5000)
+        await wait_health(admin, "HEALTH_OK")
+        ack = await admin.mon_command({"prefix": "osd df"})
+        out = json.loads(ack.outs)
+        assert len(out["nodes"]) == 3
+        assert all(n["up"] and n["in"] for n in out["nodes"])
+        assert sum(n["num_pgs"] for n in out["nodes"]) >= 4
+        # memstore: total unknown (0) but used counts stored bytes
+        assert out["summary"]["used"] >= 5000 * 3   # replicated x3
+        await cl.stop()
+    asyncio.run(run())
