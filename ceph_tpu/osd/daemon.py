@@ -698,12 +698,12 @@ class OSD(Dispatcher):
                     "acting": list(pg.acting),
                 })
             osd_stat = {"num_pgs": len(self.pgs)}
-            try:
+            if hasattr(self.store, "statfs"):
                 # store capacity for `ceph osd df` (osd_stat_t kb/
-                # kb_used role); MemStore-family reports used only
+                # kb_used role); MemStore-family reports used only.
+                # hasattr (not except AttributeError): a bug INSIDE a
+                # real statfs must surface, not silently zero the df
                 osd_stat["statfs"] = self.store.statfs()
-            except AttributeError:
-                pass          # store backend without statfs
             try:
                 self.monc.messenger.send_message(
                     MPGStats(self.whoami, self.osdmap.epoch, rows,

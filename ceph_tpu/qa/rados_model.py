@@ -154,14 +154,83 @@ async def run_model(seed: int, rounds: int = 80, n_osds: int = 5,
     thrasher = Thrasher(cl, admin, rng, events)
     thrasher.start()
     stats = {"writes": 0, "deletes": 0, "reads": 0, "ambiguous": 0,
-             "read_checks": 0}
+             "read_checks": 0, "snaps": 0, "snap_reads": 0}
     failures: List[str] = []
+    # ---- snapshot model (ceph_test_rados SnapCreateOp/SnapRemoveOp
+    # role): snapid -> frozen acceptable-value SETS per oid.  Taken
+    # between ops, so the frozen sets are exactly the model's current
+    # sets; an ambiguous pre-snap write that lands late carries the
+    # OLD snapc (no clone) but its value is IN the frozen set — sound.
+    snaps: Dict[int, Dict[str, set]] = {}
+    snap_order: List[int] = []
+
+    def _apply_snapc():
+        if snap_order:
+            io.set_write_snapc(max(snap_order),
+                               sorted(snap_order, reverse=True))
+        else:
+            io.set_write_snapc(0, [])
     try:
         for r in range(rounds):
             await asyncio.sleep(rng.uniform(0.0, 0.06))
             oid = rng.choice(oids)
             op = rng.choice(["write", "write", "write", "read", "read",
-                             "delete"])
+                             "delete", "snap_read"]
+                            + (["snap_create"] if len(snaps) < 3
+                               and r % 3 == 0 else [])
+                            + (["snap_remove"] if len(snaps) > 1
+                               else []))
+            if op == "snap_create":
+                try:
+                    sid = await io.selfmanaged_snap_create()
+                except Exception as e:
+                    # created-or-not unknown: nobody will read it, and
+                    # not adding it to our snapc only skips COW for a
+                    # snapid no check ever targets
+                    events.append(f"round {r}: snap_create "
+                                  f"ambiguous ({e!r})")
+                    continue
+                snaps[sid] = {o: set(model.value(o)) for o in oids}
+                snap_order.append(sid)
+                _apply_snapc()
+                stats["snaps"] += 1
+                continue
+            if op == "snap_remove":
+                sid = rng.choice(snap_order)
+                # drop from the model FIRST: even an ambiguous remove
+                # must end reads-at-snap (the clones may be trimming)
+                snap_order.remove(sid)
+                snaps.pop(sid, None)
+                _apply_snapc()
+                try:
+                    await io.selfmanaged_snap_remove(sid)
+                except Exception as e:
+                    events.append(f"round {r}: snap_remove {sid} "
+                                  f"ambiguous ({e!r})")
+                continue
+            if op == "snap_read":
+                if not snap_order:
+                    op = "read"
+                else:
+                    sid = rng.choice(snap_order)
+                    sio = io.dup()
+                    sio.set_snap_read(sid)
+                    try:
+                        sgot = await sio.read(oid, timeout=10.0)
+                    except ObjectOperationError:
+                        sgot = None
+                    except asyncio.TimeoutError:
+                        continue       # unavailable: no verdict
+                    stats["snap_reads"] += 1
+                    stats["read_checks"] += 1
+                    if sgot not in snaps[sid][oid]:
+                        failures.append(
+                            f"round {r}: snap {sid} read {oid} = "
+                            f"{sgot if sgot is None else sgot[:16]!r} "
+                            f"not in frozen set")
+                        events.extend(_forensics(cl, admin, "model",
+                                                 oid))
+                    continue
             if op in ("write", "delete") and oid in model.dirty:
                 op = "read"   # never overwrite an ambiguous object
             try:

@@ -920,6 +920,13 @@ class MDS(Dispatcher):
                 # a crash in between leaves an orphan manifest, never
                 # a record pointing nowhere
                 moid = self._manifest_oid(a["ino"], name)
+                try:
+                    # a crashed prior attempt may have left an orphan
+                    # manifest here; merging onto it would resurrect
+                    # entries that weren't in the subtree at snap time
+                    await self.io.remove(moid)
+                except ObjectOperationError:
+                    pass
                 items = [(rel.encode(), json.dumps(e).encode())
                          for rel, e in manifest.items()]
                 if items:

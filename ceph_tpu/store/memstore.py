@@ -226,13 +226,24 @@ class MemStore(ObjectStore):
     def getattrs(self, cid, oid) -> Dict[str, bytes]:
         return dict(self._obj(cid, oid).xattrs)
 
+    _STATFS_TTL = 5.0
+
     def statfs(self) -> Dict[str, int]:
         """df-style usage (ObjectStore::statfs): RAM-backed stores
-        have no fixed device — total/free report 0 = unknown."""
+        have no fixed device — total/free report 0 = unknown.  The
+        object walk is TTL-cached: the stats reporter calls this every
+        tick and deliberately avoids per-tick store walks."""
+        import time
+        cached = getattr(self, "_statfs_cache", None)
+        now = time.monotonic()
+        if cached is not None and now - cached[0] < self._STATFS_TTL:
+            return cached[1]
         used = sum(len(o.data)
                    for objs in self.colls.values()
                    for o in objs.values())
-        return {"total": 0, "free": 0, "used": used}
+        out = {"total": 0, "free": 0, "used": used}
+        self._statfs_cache = (now, out)
+        return out
 
     def omap_get(self, cid, oid) -> Tuple[bytes, Dict[bytes, bytes]]:
         o = self._obj(cid, oid)
