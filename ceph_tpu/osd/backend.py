@@ -135,6 +135,34 @@ class PGBackend:
             if local is not None and VERSION_XATTR not in m.attrs:
                 txn.setattr(pg.cid, oid, VERSION_XATTR,
                             local.version.to_bytes())
+        # snapshot state rides REPLICATED pushes (has_snap_state):
+        # replace OUR clones/SnapSet/SnapMapper rows with the pusher's
+        # (stale local clones must not survive — their ids may have
+        # been trimmed at the source).  EC shard pushes don't carry
+        # it, and must never DESTROY the receiver's local snap state.
+        from ceph_tpu.osd.snaps import (SnapSet, load_snapset, sm_key,
+                                        ss_key)
+        old_ss = load_snapset(self.osd.store, pg.cid, pg.meta_oid,
+                              m.oid) if m.has_snap_state else None
+        if old_ss is not None:
+            for c in old_ss.clones:
+                txn.remove(pg.cid, oid.with_snap(c))
+            txn.omap_rmkeys(pg.cid, pg.meta_oid, [ss_key(m.oid)] + [
+                sm_key(s, m.oid)
+                for c in old_ss.clones
+                for s in old_ss.clone_snaps.get(c, [])])
+        if m.snapset:
+            ss = SnapSet.from_bytes(m.snapset)
+            sm = {}
+            for c, cdata, cattrs in m.clones:
+                csoid = oid.with_snap(c)
+                txn.write(pg.cid, csoid, 0, cdata)
+                if cattrs:
+                    txn.setattrs(pg.cid, csoid, cattrs)
+                for s in ss.clone_snaps.get(c, []):
+                    sm[sm_key(s, m.oid)] = str(c).encode()
+            txn.omap_setkeys(pg.cid, pg.meta_oid,
+                             {ss_key(m.oid): m.snapset, **sm})
         # recovery landed: this object no longer gates our completeness
         pg.missing.items.pop(m.oid, None)
         if not pg.missing:
@@ -153,7 +181,9 @@ class PGBackend:
                     progress: str = "") -> None:
         """Send full object state to peer (fire-and-forget variant).
         `progress` stamps backfill pushes so the receiver's
-        last_backfill cursor advances durably."""
+        last_backfill cursor advances durably.  The object's SnapSet +
+        clone objects ride along, so the recovered copy serves
+        reads-at-snap too (previously a documented scope limit)."""
         pg = self.pg
         soid = pg.object_id(oid)
         try:
@@ -165,6 +195,19 @@ class PGBackend:
         except (NoSuchObject, NoSuchCollection):
             msg = MPGPush(pg.pgid.with_shard(pg.shard_of(peer)), oid, at,
                           from_osd=self.osd.whoami, deleted=True)
+        from ceph_tpu.osd.snaps import load_snapset
+        msg.has_snap_state = True       # replicated pushes carry it
+        ss = load_snapset(self.osd.store, pg.cid, pg.meta_oid, oid)
+        if ss is not None:
+            msg.snapset = ss.to_bytes()
+            for c in ss.clones:
+                try:
+                    csoid = soid.with_snap(c)
+                    msg.clones.append(
+                        (c, self.osd.store.read(pg.cid, csoid),
+                         self.osd.store.getattrs(pg.cid, csoid)))
+                except (NoSuchObject, NoSuchCollection):
+                    pass        # trimmed under us: receiver trims too
         msg.backfill_progress = progress
         self.osd.send_osd(peer, msg)
 

@@ -533,8 +533,12 @@ class MPGLog(Message):
 @register_message
 class MPGPush(Message):
     """Recovery push: full object state to a peer (MOSDPGPush distilled:
-    whole-object pushes, no partial chunks)."""
+    whole-object pushes, no partial chunks).  v2 adds the object's
+    SnapSet + clone objects, so a recovered replica can serve
+    reads-at-snap (the reference pushes clones as ordinary hobjects;
+    here they ride the head's push)."""
     TYPE = 214
+    STRUCT_V = 2
 
     def __init__(self, pgid: Optional[PGId] = None, oid: str = "",
                  version: Optional[EVersion] = None, data: bytes = b"",
@@ -556,6 +560,14 @@ class MPGPush(Message):
         # cursor to this name (pushes arrive in sorted-name order), so a
         # killed target resumes from the cursor instead of from scratch
         self.backfill_progress = ""
+        # v2: snapshot state.  has_snap_state=True means the pusher's
+        # snapset/clones below are AUTHORITATIVE (replicated pushes) —
+        # the receiver replaces its local state, even with "none".
+        # False (EC shard pushes) means "not carried": local snapshot
+        # state must be left untouched, not destroyed.
+        self.has_snap_state: bool = False
+        self.snapset: bytes = b""       # encoded SnapSet (b"" = none)
+        self.clones: List[tuple] = []   # [(clone_id, data, attrs)]
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid).string(self.oid).struct(self.version)
@@ -567,6 +579,13 @@ class MPGPush(Message):
         enc.bytes_(self.omap_header).s32(self.from_osd)
         enc.boolean(self.deleted)
         enc.string(self.backfill_progress)
+        enc.boolean(self.has_snap_state)
+        enc.bytes_(self.snapset)
+        enc.u32(len(self.clones))
+        for cid_, cdata, cattrs in self.clones:
+            enc.u64(cid_).bytes_(cdata)
+            enc.map_(cattrs, lambda e, k: e.string(k),
+                     lambda e, v: e.bytes_(v))
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGPush":
@@ -576,6 +595,12 @@ class MPGPush(Message):
                 dec.map_(lambda d: d.bytes_(), lambda d: d.bytes_()),
                 dec.bytes_(), dec.s32(), dec.boolean())
         m.backfill_progress = dec.string()
+        if struct_v >= 2:
+            m.has_snap_state = dec.boolean()
+            m.snapset = dec.bytes_()
+            for _ in range(dec.u32()):
+                m.clones.append((dec.u64(), dec.bytes_(), dec.map_(
+                    lambda d: d.string(), lambda d: d.bytes_())))
         return m
 
 

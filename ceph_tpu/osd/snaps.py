@@ -22,8 +22,10 @@ Redesign notes:
   trims independently — no cross-OSD coordination (the reference
   serializes trim through the primary because its replicas don't see
   identical stores; ours do).
-Known scope limits (documented, not silent): clones are not re-pushed by
-backfill/recovery (head objects are), and scrub verifies heads only.
+Known scope limits (documented, not silent): REPLICATED clones ride
+recovery/backfill pushes (MPGPush v2 carries the SnapSet + clone
+objects); EC-pool clones are still not re-pushed, and scrub verifies
+heads only.
 """
 
 from __future__ import annotations

@@ -76,10 +76,13 @@ def _committed(idx: Dict[bytes, bytes]) -> Dict[bytes, bytes]:
     return _entries(idx)
 
 
-async def _iter_index(io, bucket: str, prefix: str = ""):
+async def _iter_index(io, bucket: str, prefix: str = "",
+                      start: str = ""):
     """Page the bucket index through the OSD-side cls bucket_list —
-    bounded per call — yielding (key, entry) in key order."""
-    marker = ""
+    bounded per call — yielding (key, entry) in key order.  `start`
+    seeds the walk strictly-after that key (resume without re-reading
+    every preceding page)."""
+    marker = start
     while True:
         out = json.loads(await io.exec(
             _index_oid(bucket), "rgw", "bucket_list",
@@ -1189,21 +1192,94 @@ class S3Gateway:
         return 204, {}, b""
 
     async def _list_objects(self, bucket: str, query: str):
+        """ListObjects v1 + v2 (rgw_rest_s3.cc RGWListBucket): prefix,
+        delimiter -> CommonPrefixes folding, max-keys pagination with
+        marker / continuation-token, IsTruncated + NextMarker."""
         if not await self._bucket_exists(bucket):
             return 404, {}, _xml_error("NoSuchBucket")
-        prefix = ""
+        q: Dict[str, str] = {}
         for kv in query.split("&"):
             k, _, v = kv.partition("=")
-            if k == "prefix":
-                prefix = unquote(v)
-        rows = []
-        async for key, meta in _iter_index(self.io, bucket, prefix):
+            if k:
+                q[k] = unquote(v)
+        prefix = q.get("prefix", "")
+        delim = q.get("delimiter", "")
+        try:
+            max_keys = max(0, min(int(q.get("max-keys", "1000")), 1000))
+        except ValueError:
+            return 400, {}, _xml_error("InvalidArgument")
+        v2 = q.get("list-type") == "2"
+        after = (q.get("continuation-token") or "") if v2 \
+            else q.get("marker", "")
+        if v2 and not after:
+            after = q.get("start-after", "")
+        if max_keys == 0:
+            # S3: zero keys requested is a complete (non-truncated)
+            # empty listing, not a resume loop
+            xml = (f'<?xml version="1.0"?><ListBucketResult>'
+                   f"<Name>{bucket}</Name><KeyCount>0</KeyCount>"
+                   f"<IsTruncated>false</IsTruncated>"
+                   f"</ListBucketResult>")
+            return 200, {"Content-Type": "application/xml"}, \
+                xml.encode()
+        rows: List[str] = []
+        common: List[str] = []
+        seen_prefixes = set()
+        n = 0
+        truncated = False
+        next_marker = ""
+        # seed the index walk at the resume point: page N must not
+        # re-read pages 1..N-1
+        async for key, meta in _iter_index(self.io, bucket, prefix,
+                                           start=after):
+            if after and key <= after:
+                continue
+            if delim:
+                # fold keys sharing a delimited prefix into ONE
+                # CommonPrefixes row (the "directory" illusion)
+                rest = key[len(prefix):]
+                cut = rest.find(delim)
+                if cut >= 0:
+                    cp = prefix + rest[:cut + len(delim)]
+                    if cp in seen_prefixes or (after and cp <= after):
+                        # folded this page — or already REPORTED on a
+                        # previous page (the client's marker is the
+                        # prefix itself: re-emitting would loop it)
+                        continue
+                    if n >= max_keys:
+                        truncated = True
+                        break
+                    seen_prefixes.add(cp)
+                    common.append(
+                        f"<CommonPrefixes><Prefix>{quote(cp)}"
+                        f"</Prefix></CommonPrefixes>")
+                    # a common prefix advances the marker past every
+                    # key it folds
+                    next_marker = cp
+                    after = cp + "\xff"
+                    n += 1
+                    continue
+            if n >= max_keys:
+                truncated = True
+                break
             rows.append(
                 f"<Contents><Key>{quote(key)}</Key>"
                 f"<Size>{meta['size']}</Size>"
                 f"<ETag>&quot;{meta['etag']}&quot;</ETag></Contents>")
+            next_marker = key
+            n += 1
+        extra = (f"<IsTruncated>{'true' if truncated else 'false'}"
+                 f"</IsTruncated>")
+        if truncated:
+            if v2:
+                extra += (f"<NextContinuationToken>"
+                          f"{quote(next_marker)}"
+                          f"</NextContinuationToken>")
+            else:
+                extra += f"<NextMarker>{quote(next_marker)}</NextMarker>"
         xml = (f'<?xml version="1.0"?><ListBucketResult>'
-               f"<Name>{bucket}</Name>{''.join(rows)}</ListBucketResult>")
+               f"<Name>{bucket}</Name><KeyCount>{n}</KeyCount>{extra}"
+               f"{''.join(rows)}{''.join(common)}</ListBucketResult>")
         return 200, {"Content-Type": "application/xml"}, xml.encode()
 
     # -------------------------------------------------------------- objects

@@ -62,6 +62,11 @@ def _osdmap():
     return m
 
 
+def _mperf():
+    from ceph_tpu.tools.perf_msgr import MPerf
+    return MPerf(7, b"perf-payload")
+
+
 def samples():
     """Deterministic instances, keyed by dotted type name."""
     from ceph_tpu.crush.types import Bucket, Rule, RuleStep
@@ -160,6 +165,7 @@ def samples():
         "ceph_tpu.mon.messages.MPGTemp": monm.MPGTemp(),
         "ceph_tpu.mon.monmap.MonMap": MonMap(),
         "ceph_tpu.msg.message.MPing": MPing(),
+        "ceph_tpu.tools.perf_msgr.MPerf": _mperf(),
         "ceph_tpu.msg.types.EntityAddr": EntityAddr("10.0.0.1", 6789,
                                                     77),
         "ceph_tpu.msg.types.EntityName": EntityName("osd", "3"),

@@ -1038,3 +1038,92 @@ def test_usage_log_accounting():
         await gw.stop()
         await cl.stop()
     asyncio.run(run())
+
+
+def test_list_objects_delimiter_and_pagination():
+    """ListObjects v1+v2 (rgw_rest_s3.cc RGWListBucket): delimiter
+    folds keys into CommonPrefixes, max-keys truncates with
+    NextMarker / NextContinuationToken resume."""
+    import re as _re
+
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create(".rgw", pg_num=8)
+        gw = S3Gateway(admin, require_auth=False)
+        port = await gw.start()
+        c = S3Client(port)
+        await c.request("PUT", "/b", sign=False)
+        for k in ("a/1.txt", "a/2.txt", "b/3.txt", "top1", "top2"):
+            await c.request("PUT", f"/b/{k}", b"x", sign=False)
+
+        # delimiter folds a/ and b/ into CommonPrefixes
+        st, _, body = await c.request("GET", "/b?delimiter=/",
+                                      sign=False)
+        assert st == 200
+        assert body.count(b"<CommonPrefixes>") == 2
+        assert b"<Prefix>a/</Prefix>" in body
+        assert b"<Prefix>b/</Prefix>" in body
+        assert b"top1" in body and b"top2" in body
+        assert b"a/1.txt" not in body              # folded away
+        assert b"<IsTruncated>false</IsTruncated>" in body
+
+        # prefix + delimiter descends one level
+        st, _, body = await c.request(
+            "GET", "/b?prefix=a/&delimiter=/", sign=False)
+        assert b"a/1.txt" in body and b"a/2.txt" in body
+        assert b"CommonPrefixes" not in body
+
+        # v1 pagination: max-keys=2 -> NextMarker resume walks all 5
+        got, marker = [], ""
+        while True:
+            qs = f"/b?max-keys=2" + (f"&marker={marker}" if marker
+                                     else "")
+            st, _, body = await c.request("GET", qs, sign=False)
+            got += [m.decode() for m in
+                    _re.findall(rb"<Key>([^<]+)</Key>", body)]
+            if b"<IsTruncated>true</IsTruncated>" not in body:
+                break
+            marker = _re.search(rb"<NextMarker>([^<]+)</NextMarker>",
+                                body).group(1).decode()
+        assert got == ["a/1.txt", "a/2.txt", "b/3.txt", "top1", "top2"]
+
+        # delimiter + tiny pages: marker-following must TERMINATE and
+        # never repeat a CommonPrefix (resume marker = folded prefix)
+        got, marker, pages = [], "", 0
+        while True:
+            qs = "/b?delimiter=/&max-keys=1" + (
+                f"&marker={marker}" if marker else "")
+            st, _, body = await c.request("GET", qs, sign=False)
+            got += [m.decode() for m in _re.findall(
+                rb"<Prefix>([^<]+)</Prefix>", body)]
+            got += [m.decode() for m in _re.findall(
+                rb"<Key>([^<]+)</Key>", body)]
+            pages += 1
+            assert pages < 20, got     # livelock guard
+            if b"<IsTruncated>true</IsTruncated>" not in body:
+                break
+            marker = _re.search(rb"<NextMarker>([^<]+)</NextMarker>",
+                                body).group(1).decode()
+        assert got == ["a/", "b/", "top1", "top2"]
+
+        # max-keys=0: complete empty listing, never a resume loop
+        st, _, body = await c.request("GET", "/b?max-keys=0",
+                                      sign=False)
+        assert b"<IsTruncated>false</IsTruncated>" in body
+        assert b"<Key>" not in body
+
+        # v2: continuation-token + KeyCount
+        st, _, body = await c.request(
+            "GET", "/b?list-type=2&max-keys=3", sign=False)
+        assert b"<KeyCount>3</KeyCount>" in body
+        tok = _re.search(
+            rb"<NextContinuationToken>([^<]+)</NextContinuationToken>",
+            body).group(1).decode()
+        st, _, body = await c.request(
+            "GET", f"/b?list-type=2&continuation-token={tok}",
+            sign=False)
+        assert b"top1" in body and b"top2" in body
+        await gw.stop()
+        await cl.stop()
+    asyncio.run(run())

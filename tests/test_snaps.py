@@ -193,3 +193,51 @@ def test_snapshots_via_rados_cli_grammar():
         assert "cs" in ack.outs
         await cl.stop()
     asyncio.run(run())
+
+
+def test_recovery_pushes_clones_to_new_member():
+    """Clones ride recovery pushes (MPGPush v2): a member backfilled
+    after the snapshot was taken holds the clone objects + SnapSet
+    rows, so reads-at-snap survive losing every original holder of
+    the pg (previously a documented scope limit: heads only)."""
+    async def run():
+        import time as _time
+        cl = Cluster()
+        admin = await cl.start(4)
+        await admin.pool_create("data", pg_num=4, size=3)
+        io = admin.open_ioctx("data")
+        await io.write_full("obj", b"v1" * 800)
+        await io.snap_create("s1")
+        sid = io.snap_lookup("s1")
+        await io.write_full("obj", b"v2" * 900)   # clones v1
+
+        def holders():
+            out = set()
+            for osd_id, osd in cl.osds.items():
+                for cid in osd.store.list_collections():
+                    for soid in osd.store.collection_list(cid):
+                        if soid.name == "obj" and not soid.is_head():
+                            out.add(osd_id)
+            return out
+
+        before = holders()
+        assert len(before) == 3
+        victim = sorted(before)[0]
+        await cl.kill_osd(victim)
+        # down-out -> the spare backfills in; wait until it holds the
+        # CLONE, not just the head
+        deadline = _time.monotonic() + 60.0
+        spare = ({0, 1, 2, 3} - before).pop()
+        while _time.monotonic() < deadline:
+            if spare in holders():
+                break
+            await asyncio.sleep(0.25)
+        assert spare in holders(), (before, holders())
+
+        # and the recovered copy actually SERVES the snap read: drop
+        # another original member so the spare is in the acting set
+        sio = io.dup()
+        sio.set_snap_read(sid)
+        assert await sio.read("obj") == b"v1" * 800
+        await cl.stop()
+    asyncio.run(run())
