@@ -195,19 +195,24 @@ class PGBackend:
         except (NoSuchObject, NoSuchCollection):
             msg = MPGPush(pg.pgid.with_shard(pg.shard_of(peer)), oid, at,
                           from_osd=self.osd.whoami, deleted=True)
-        from ceph_tpu.osd.snaps import load_snapset
-        msg.has_snap_state = True       # replicated pushes carry it
-        ss = load_snapset(self.osd.store, pg.cid, pg.meta_oid, oid)
-        if ss is not None:
-            msg.snapset = ss.to_bytes()
-            for c in ss.clones:
-                try:
-                    csoid = soid.with_snap(c)
-                    msg.clones.append(
-                        (c, self.osd.store.read(pg.cid, csoid),
-                         self.osd.store.getattrs(pg.cid, csoid)))
-                except (NoSuchObject, NoSuchCollection):
-                    pass        # trimmed under us: receiver trims too
+        if not pg.pool.is_erasure():
+            # REPLICATED pushes carry authoritative snap state; EC
+            # shard pushes must not — a pusher's own-shard clone
+            # chunks are foreign bytes on any other shard, and even an
+            # empty carry would wipe the receiver's clones
+            from ceph_tpu.osd.snaps import load_snapset
+            msg.has_snap_state = True
+            ss = load_snapset(self.osd.store, pg.cid, pg.meta_oid, oid)
+            if ss is not None:
+                msg.snapset = ss.to_bytes()
+                for c in ss.clones:
+                    try:
+                        csoid = soid.with_snap(c)
+                        msg.clones.append(
+                            (c, self.osd.store.read(pg.cid, csoid),
+                             self.osd.store.getattrs(pg.cid, csoid)))
+                    except (NoSuchObject, NoSuchCollection):
+                        pass    # trimmed under us: receiver trims too
         msg.backfill_progress = progress
         self.osd.send_osd(peer, msg)
 

@@ -52,8 +52,17 @@ def build_scrub_map(pg, deep: bool) -> Dict[str, ScrubEntry]:
     except NoSuchCollection:
         return out
     for soid in soids:
-        if soid.name == pg.meta_oid.name or not soid.is_head():
-            continue    # snap clones: head-only scrub (documented scope)
+        if soid.name == pg.meta_oid.name:
+            continue
+        if not soid.is_head():
+            if pg.pool.is_erasure():
+                continue    # EC clones: head-only (documented scope)
+            # replicated clones scrub like heads, keyed by
+            # name\x00snapid; their CRC_XATTR was copied at clone time
+            # so deep scrub self-verifies the frozen bytes
+            key = f"{soid.name}\x00{soid.snap}"
+        else:
+            key = soid.name
         try:
             stored = -1
             try:
@@ -64,12 +73,12 @@ def build_scrub_map(pg, deep: bool) -> Dict[str, ScrubEntry]:
                 pass
             if deep:
                 data = store.read(pg.cid, soid)
-                out[soid.name] = ScrubEntry(
+                out[key] = ScrubEntry(
                     size=len(data), stored_crc=stored,
                     computed_crc=crc32c(data))
             else:
                 # light scrub never reads object bytes (stat only)
-                out[soid.name] = ScrubEntry(
+                out[key] = ScrubEntry(
                     size=store.stat(pg.cid, soid)["size"],
                     stored_crc=stored, computed_crc=-1)
         except (NoSuchObject, NoSuchCollection):
@@ -136,7 +145,8 @@ async def scrub_pg(pg, deep: bool, repair: bool = True) -> Dict:
     txn.touch(pg.cid, pg.meta_oid)
     txn.omap_setkeys(pg.cid, pg.meta_oid, {
         b"scrub_errors": str(errors).encode(),
-        b"scrub_inconsistent": "\x00".join(inconsistent).encode(),
+        # \x01-joined: clone keys embed \x00 (name\x00snapid)
+        b"scrub_inconsistent": "\x01".join(inconsistent).encode(),
     })
     pg.save_meta(txn)
     osd.store.apply_transaction(txn)
@@ -163,9 +173,19 @@ async def _scrub_replicated(pg, maps, all_oids, deep, repair):
     errors = repaired = 0
     inconsistent = []
     me = osd.whoami
+    # detection pass: per-key comparison; repairs ACCUMULATE per base
+    # object, because a push moves head + SnapSet + clones wholesale
+    # (MPGPush v2) — the repair auth must hold good copies of EVERY
+    # key of the base, and the push must reach the UNION of bad osds
+    repairs: Dict[str, dict] = {}
     for oid in sorted(all_oids):
-        if pg.log.latest_entry_for(oid) is not None and \
-                pg.log.latest_entry_for(oid).is_delete():
+        base, _, snap_s = oid.partition("\x00")
+        is_clone = bool(snap_s)
+        if not is_clone \
+                and pg.log.latest_entry_for(oid) is not None \
+                and pg.log.latest_entry_for(oid).is_delete():
+            # a deleted HEAD is expected-absent; its CLONES legitimately
+            # outlive it (snapdir role), so only head keys skip here
             continue
         entries = {o: maps[o].get(oid) for o in maps}
         # copies that PROVE themselves (recomputed crc == stored digest)
@@ -174,6 +194,7 @@ async def _scrub_replicated(pg, maps, all_oids, deep, repair):
                   and e.computed_crc == e.stored_crc}
         if proven:
             auth = me if me in proven else sorted(proven)[0]
+            cands = set(proven)
         else:
             # digest-less objects (partial-write history): nothing
             # self-verifies, so majority vote on (size, crc).  Trusting
@@ -187,6 +208,8 @@ async def _scrub_replicated(pg, maps, all_oids, deep, repair):
             if not groups:
                 errors += 1
                 inconsistent.append(oid)
+                repairs.setdefault(base, {"bad": set(), "cands": [],
+                                          "ok": True})["ok"] = False
                 continue
             best = max(groups.values(), key=len)
             n_copies = sum(len(g) for g in groups.values())
@@ -194,8 +217,11 @@ async def _scrub_replicated(pg, maps, all_oids, deep, repair):
                 # no strict majority: report, never guess a repair
                 errors += len(groups) - 1
                 inconsistent.append(oid)
+                repairs.setdefault(base, {"bad": set(), "cands": [],
+                                          "ok": True})["ok"] = False
                 continue
             auth = me if me in best else sorted(best)[0]
+            cands = set(best)
         ref = entries[auth]
         bad = set()
         for o, e in entries.items():
@@ -211,25 +237,47 @@ async def _scrub_replicated(pg, maps, all_oids, deep, repair):
             continue
         errors += len(bad)
         inconsistent.append(oid)
-        if not repair:
-            continue
-        if auth != me:
-            # heal ourselves first, then fan out
-            try:
-                await pg.pull_object_via_push(auth, oid,
-                                              pg.interval_epoch)
-                repaired += 1 if me in bad else 0
-                bad.discard(me)
-            except Exception:
-                # one failed pull must not abort the whole scrub
-                pg.log_.exception(f"{pg.pgid} scrub self-repair {oid}")
+        rec = repairs.setdefault(base, {"bad": set(), "cands": [],
+                                        "ok": True})
+        rec["bad"] |= bad
+        rec["cands"].append(cands - bad)
+
+    # repair pass: one push per base covering the union of bad osds,
+    # sourced from an osd whose copies of EVERY key verified
+    if repair:
+        for base in sorted(repairs):
+            rec = repairs[base]
+            if not rec["bad"] or not rec["ok"]:
                 continue
-        for o in bad:
-            try:
-                await pg.backend.recover_object(o, oid)
-                repaired += 1
-            except Exception:
-                pg.log_.exception(f"{pg.pgid} scrub repair {oid}->{o}")
+            cands = set(maps)
+            for c in rec["cands"]:
+                cands &= c
+            cands -= rec["bad"]
+            if not cands:
+                # no single osd holds a good copy of every key:
+                # reported inconsistent above, never guess a source
+                continue
+            auth = me if me in cands else sorted(cands)[0]
+            bad = set(rec["bad"])
+            if auth != me:
+                # heal ourselves first, then fan out from our copy
+                try:
+                    await pg.pull_object_via_push(auth, base,
+                                                  pg.interval_epoch)
+                    repaired += 1 if me in bad else 0
+                    bad.discard(me)
+                except Exception:
+                    # one failed pull must not abort the whole scrub
+                    pg.log_.exception(
+                        f"{pg.pgid} scrub self-repair {base}")
+                    continue
+            for o in sorted(bad):
+                try:
+                    await pg.backend.recover_object(o, base)
+                    repaired += 1
+                except Exception:
+                    pg.log_.exception(
+                        f"{pg.pgid} scrub repair {base}->{o}")
     return errors, repaired, inconsistent
 
 

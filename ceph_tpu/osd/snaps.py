@@ -24,8 +24,8 @@ Redesign notes:
   identical stores; ours do).
 Known scope limits (documented, not silent): REPLICATED clones ride
 recovery/backfill pushes (MPGPush v2 carries the SnapSet + clone
-objects); EC-pool clones are still not re-pushed, and scrub verifies
-heads only.
+objects) and are scrubbed/repaired like heads (keyed name\\x00snapid);
+EC-pool clones are still neither re-pushed nor scrubbed.
 """
 
 from __future__ import annotations

@@ -1229,45 +1229,55 @@ class S3Gateway:
         truncated = False
         next_marker = ""
         # seed the index walk at the resume point: page N must not
-        # re-read pages 1..N-1
-        async for key, meta in _iter_index(self.io, bucket, prefix,
-                                           start=after):
-            if after and key <= after:
-                continue
-            if delim:
-                # fold keys sharing a delimited prefix into ONE
-                # CommonPrefixes row (the "directory" illusion)
-                rest = key[len(prefix):]
-                cut = rest.find(delim)
-                if cut >= 0:
-                    cp = prefix + rest[:cut + len(delim)]
-                    if cp in seen_prefixes or (after and cp <= after):
-                        # folded this page — or already REPORTED on a
-                        # previous page (the client's marker is the
-                        # prefix itself: re-emitting would loop it)
-                        continue
-                    if n >= max_keys:
-                        truncated = True
-                        break
-                    seen_prefixes.add(cp)
-                    common.append(
-                        f"<CommonPrefixes><Prefix>{quote(cp)}"
-                        f"</Prefix></CommonPrefixes>")
-                    # a common prefix advances the marker past every
-                    # key it folds
-                    next_marker = cp
-                    after = cp + "\xff"
-                    n += 1
+        # re-read pages 1..N-1.  Emitting a CommonPrefixes row RESTARTS
+        # the walk past the whole folded group, so a 100k-key
+        # "directory" costs one seek, not a full scan.
+        restart = after
+        scanning = True
+        while scanning:
+            scanning = False
+            async for key, meta in _iter_index(self.io, bucket, prefix,
+                                               start=restart):
+                if after and key <= after:
                     continue
-            if n >= max_keys:
-                truncated = True
-                break
-            rows.append(
-                f"<Contents><Key>{quote(key)}</Key>"
-                f"<Size>{meta['size']}</Size>"
-                f"<ETag>&quot;{meta['etag']}&quot;</ETag></Contents>")
-            next_marker = key
-            n += 1
+                if delim:
+                    # fold keys sharing a delimited prefix into ONE
+                    # CommonPrefixes row (the "directory" illusion)
+                    rest = key[len(prefix):]
+                    cut = rest.find(delim)
+                    if cut >= 0:
+                        cp = prefix + rest[:cut + len(delim)]
+                        if cp in seen_prefixes or cp == after:
+                            # folded this page — or the marker IS this
+                            # prefix (our own resume token):
+                            # re-emitting would loop the client.  A
+                            # marker merely INSIDE the group (a real
+                            # key) must still emit the prefix.
+                            continue
+                        if n >= max_keys:
+                            truncated = True
+                            break
+                        seen_prefixes.add(cp)
+                        common.append(
+                            f"<CommonPrefixes><Prefix>{quote(cp)}"
+                            f"</Prefix></CommonPrefixes>")
+                        # advance past every key the prefix folds and
+                        # seek the index there
+                        next_marker = cp
+                        after = cp + "\xff"
+                        restart = after
+                        scanning = True
+                        break
+                if n >= max_keys:
+                    truncated = True
+                    break
+                rows.append(
+                    f"<Contents><Key>{quote(key)}</Key>"
+                    f"<Size>{meta['size']}</Size>"
+                    f"<ETag>&quot;{meta['etag']}&quot;</ETag>"
+                    f"</Contents>")
+                next_marker = key
+                n += 1
         extra = (f"<IsTruncated>{'true' if truncated else 'false'}"
                  f"</IsTruncated>")
         if truncated:

@@ -218,3 +218,44 @@ def test_scrub_updates_info_stamps_and_perf():
         assert posd.perf_scrub.dump()["scrubs_deep"] >= 1
         await cl.stop()
     asyncio.run(run())
+
+
+def test_deep_scrub_repairs_clone_bitrot():
+    """Snapshot clones scrub + repair like heads (keyed name\\x00snap):
+    bit-rot in a replica's CLONE is detected by deep scrub and healed
+    by re-pushing the base object (head + SnapSet + clones)."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("data", pg_num=4)
+        io = admin.open_ioctx("data")
+        await io.write_full("obj", b"frozen" * 500)
+        await io.snap_create("s1")
+        sid = io.snap_lookup("s1")
+        await io.write_full("obj", b"newer!" * 700)   # clones v1
+
+        clones = [(o, c, s) for o, c, s in find_copies(cl, "obj")
+                  if not s.is_head()]
+        assert len(clones) == 3
+        vosd, vcid, vsoid = clones[0]
+        corrupt(vosd, vcid, vsoid)
+
+        pg, posd = primary_pg(cl, "data", "obj")
+        res = await run_scrub(pg, deep=True)
+        assert res["errors"] >= 1, res
+        assert res["repaired"] >= 1, res
+        assert any("\x00" in i for i in res["inconsistent"]), res
+
+        # the corrupted clone is bit-exact again on every copy...
+        for o, c, s in find_copies(cl, "obj"):
+            if not s.is_head():
+                assert o.store.read(c, s) == b"frozen" * 500
+        # ...and a re-scrub is clean
+        res = await run_scrub(pg, deep=True)
+        assert res["errors"] == 0, res
+        # snapshot read serves the healed bytes
+        sio = io.dup()
+        sio.set_snap_read(sid)
+        assert await sio.read("obj") == b"frozen" * 500
+        await cl.stop()
+    asyncio.run(run())
