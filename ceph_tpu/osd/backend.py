@@ -1037,6 +1037,37 @@ class ECBackend(PGBackend):
         return data[:size]
 
     # ----------------------------------------------------------- recovery
+    async def _rebuild_clones(self, oid: str, target: int, exclude):
+        """Reconstruct `target`'s clone chunks by decoding over the
+        peers' clone chunks (the erasure relation holds per clone —
+        every shard cloned its own chunk at COW).  Returns (snapset
+        bytes, [(clone_id, bytes, attrs)]) — or (None, []) when the
+        object has no snap state OR any clone gather failed: a partial
+        claim would make the receiver's apply_push wipe clones we
+        cannot replace."""
+        pg = self.pg
+        from ceph_tpu.common.crc import crc32c
+        from ceph_tpu.osd.scrub import CRC_XATTR
+        from ceph_tpu.osd.snaps import load_snapset
+        ss = load_snapset(self.osd.store, pg.cid, pg.meta_oid, oid)
+        if ss is None:
+            return None, []
+        out = []
+        for c in ss.clones:
+            cgot = await self._gather_shards(
+                oid, exclude={target} | set(exclude), snap=c)
+            if cgot is None:
+                return None, []    # incomplete: claim nothing
+            cstreams, cattrs = cgot
+            crebuilt = self.codec.decode(
+                {target}, cstreams)[target].tobytes()
+            # keep the clone's xattrs (SIZE_XATTR drives snap reads);
+            # only the per-shard digest is its own
+            cattrs = dict(cattrs)
+            cattrs[CRC_XATTR] = str(crc32c(crebuilt)).encode()
+            out.append((c, crebuilt, cattrs))
+        return ss.to_bytes(), out
+
     async def recover_object(self, peer: int, oid: str,
                              exclude=frozenset(),
                              progress: str = "") -> None:
@@ -1046,12 +1077,28 @@ class ECBackend(PGBackend):
         pg = self.pg
         target = pg.shard_of(peer)
         soid = pg.object_id(oid)
-        # object deleted? push tombstone
+        # object deleted? push tombstone — but a deleted HEAD's clones
+        # legitimately survive (snapdir role) and must still rebuild
         try:
             attrs = self.osd.store.getattrs(pg.cid, soid)
         except (NoSuchObject, NoSuchCollection):
-            await self._push_and_wait(peer, oid,
-                                      progress)   # pushes deleted=True
+            ssb, clones = await self._rebuild_clones(oid, target,
+                                                     exclude)
+            fut = asyncio.get_running_loop().create_future()
+            pg._push_acks[(peer, oid)] = fut
+            try:
+                msg = MPGPush(pg.pgid.with_shard(target), oid,
+                              pg.info.last_update,
+                              from_osd=self.osd.whoami, deleted=True)
+                msg.backfill_progress = progress
+                if ssb is not None:
+                    msg.has_snap_state = True
+                    msg.snapset = ssb
+                    msg.clones = clones
+                self.osd.send_osd(peer, msg)
+                await asyncio.wait_for(fut, 20.0)
+            finally:
+                pg._push_acks.pop((peer, oid), None)
             return
         got = await self._gather_shards(
             oid, exclude={target} | set(exclude),
@@ -1074,6 +1121,12 @@ class ECBackend(PGBackend):
                 pg.pgid.with_shard(target), oid, pg.info.last_update,
                 rebuilt.tobytes(), attrs, {}, b"", self.osd.whoami)
             msg.backfill_progress = progress
+            ssb, clones = await self._rebuild_clones(oid, target,
+                                                     exclude)
+            if ssb is not None:
+                msg.has_snap_state = True
+                msg.snapset = ssb
+                msg.clones = clones
             self.osd.send_osd(peer, msg)
             await asyncio.wait_for(fut, 20.0)
         finally:
@@ -1096,9 +1149,19 @@ class ECBackend(PGBackend):
                 # genuinely deleted per our log: drop the local shard.
                 # `latest is None` proves NOTHING — old objects fall out
                 # of the log window, and during full resync the adopted
-                # log is exactly one whose window has closed
-                self.osd.store.apply_transaction(
-                    Transaction().remove(pg.cid, soid))
+                # log is exactly one whose window has closed.  A deleted
+                # head's clones survive (snapdir role): rebuild ours too
+                txn = Transaction()
+                txn.remove(pg.cid, soid)
+                ssb, clones = await self._rebuild_clones(
+                    oid, self.my_shard, exclude)
+                if ssb is not None:
+                    for c, cdata, cattrs in clones:
+                        csoid = soid.with_snap(c)
+                        txn.remove(pg.cid, csoid)
+                        txn.write(pg.cid, csoid, 0, cdata)
+                        txn.setattrs(pg.cid, csoid, cattrs)
+                self.osd.store.apply_transaction(txn)
                 return
             # the log says this object EXISTS: an insufficient gather is
             # a transient failure (peers down/backfilling), never a
@@ -1119,6 +1182,16 @@ class ECBackend(PGBackend):
         txn.write(pg.cid, soid, 0, rebuilt.tobytes())
         if attrs:
             txn.setattrs(pg.cid, soid, attrs)
+        # rebuild OUR clone chunks the same way (decode over the peers'
+        # clone chunks); all-or-nothing — a partial rebuild must not
+        # replace clones it couldn't reconstruct
+        ssb, clones = await self._rebuild_clones(oid, my, exclude)
+        if ssb is not None:
+            for c, cdata, cattrs in clones:
+                csoid = soid.with_snap(c)
+                txn.remove(pg.cid, csoid)
+                txn.write(pg.cid, csoid, 0, cdata)
+                txn.setattrs(pg.cid, csoid, cattrs)
         pg.save_meta(txn)
         self.osd.store.apply_transaction(txn)
 

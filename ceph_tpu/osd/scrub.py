@@ -55,11 +55,10 @@ def build_scrub_map(pg, deep: bool) -> Dict[str, ScrubEntry]:
         if soid.name == pg.meta_oid.name:
             continue
         if not soid.is_head():
-            if pg.pool.is_erasure():
-                continue    # EC clones: head-only (documented scope)
-            # replicated clones scrub like heads, keyed by
-            # name\x00snapid; their CRC_XATTR was copied at clone time
-            # so deep scrub self-verifies the frozen bytes
+            # clones scrub like heads, keyed by name\x00snapid; their
+            # CRC_XATTR (per-object for replicated, per-shard for EC)
+            # was copied at clone time, so deep scrub self-verifies
+            # the frozen bytes
             key = f"{soid.name}\x00{soid.snap}"
         else:
             key = soid.name
@@ -181,12 +180,13 @@ async def _scrub_replicated(pg, maps, all_oids, deep, repair):
     for oid in sorted(all_oids):
         base, _, snap_s = oid.partition("\x00")
         is_clone = bool(snap_s)
-        if not is_clone \
-                and pg.log.latest_entry_for(oid) is not None \
-                and pg.log.latest_entry_for(oid).is_delete():
-            # a deleted HEAD is expected-absent; its CLONES legitimately
-            # outlive it (snapdir role), so only head keys skip here
-            continue
+        if not is_clone:
+            latest = pg.log.latest_entry_for(oid)
+            if latest is not None and latest.is_delete():
+                # a deleted HEAD is expected-absent; its CLONES
+                # legitimately outlive it (snapdir role), so only
+                # head keys skip here
+                continue
         entries = {o: maps[o].get(oid) for o in maps}
         # copies that PROVE themselves (recomputed crc == stored digest)
         proven = {o for o, e in entries.items() if e is not None
@@ -290,10 +290,17 @@ async def _scrub_ec(pg, maps, all_oids, deep, repair):
     me = osd.whoami
     shard_of = {o: pg.shard_of(o) for o in pg.acting
                 if o != CRUSH_ITEM_NONE}
+    # repairs rebuild the BASE per osd (recover/pull reconstruct the
+    # head chunk AND every clone chunk), so dedupe per (osd, base)
+    rebuilt_pairs = set()
     for oid in sorted(all_oids):
-        latest = pg.log.latest_entry_for(oid)
-        if latest is not None and latest.is_delete():
-            continue
+        base, _, snap_s = oid.partition("\x00")
+        if not snap_s:
+            latest = pg.log.latest_entry_for(oid)
+            if latest is not None and latest.is_delete():
+                # deleted HEAD is expected-absent; clone keys
+                # legitimately outlive it (snapdir role)
+                continue
         bad_osds = set()
         for o, m in maps.items():
             e = m.get(oid)
@@ -308,21 +315,22 @@ async def _scrub_ec(pg, maps, all_oids, deep, repair):
         bad_shards = {shard_of[o] for o in bad_osds if o in shard_of}
         good_osds = sorted(set(maps) - bad_osds)
         for o in sorted(bad_osds):
-            if o not in shard_of:
+            if o not in shard_of or (o, base) in rebuilt_pairs:
                 continue
+            rebuilt_pairs.add((o, base))
             try:
                 if o == me:
                     if not good_osds:
                         continue   # nothing trustworthy to rebuild from
                     await pg.backend.pull_object(
-                        good_osds[0], oid, pg.interval_epoch,
+                        good_osds[0], base, pg.interval_epoch,
                         exclude=bad_shards - {shard_of[o]})
                 else:
                     await pg.backend.recover_object(
-                        o, oid, exclude=bad_shards - {shard_of[o]})
+                        o, base, exclude=bad_shards - {shard_of[o]})
                 repaired += 1
             except Exception:
-                pg.log_.exception(f"{pg.pgid} scrub repair {oid} "
+                pg.log_.exception(f"{pg.pgid} scrub repair {base} "
                                   f"shard {shard_of[o]}")
     return errors, repaired, inconsistent
 

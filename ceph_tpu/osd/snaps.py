@@ -22,10 +22,12 @@ Redesign notes:
   trims independently — no cross-OSD coordination (the reference
   serializes trim through the primary because its replicas don't see
   identical stores; ours do).
-Known scope limits (documented, not silent): REPLICATED clones ride
-recovery/backfill pushes (MPGPush v2 carries the SnapSet + clone
-objects) and are scrubbed/repaired like heads (keyed name\\x00snapid);
-EC-pool clones are still neither re-pushed nor scrubbed.
+Clones are fully covered by recovery and scrub: replicated pushes
+carry the SnapSet + clone objects (MPGPush v2); EC recovery REBUILDS a
+lost shard's clone chunks by decoding over the peers' clone chunks
+(the erasure relation holds per clone, since every shard cloned its
+own chunk at COW); scrub keys clones as name\\x00snapid and repairs
+them through the same paths.
 """
 
 from __future__ import annotations

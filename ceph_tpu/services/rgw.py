@@ -1232,7 +1232,10 @@ class S3Gateway:
         # re-read pages 1..N-1.  Emitting a CommonPrefixes row RESTARTS
         # the walk past the whole folded group, so a 100k-key
         # "directory" costs one seek, not a full scan.
-        restart = after
+        # a marker that IS a folded prefix (our resume token, ends with
+        # the delimiter) seeks straight past the whole group
+        restart = after + "\xff" if delim and after \
+            and after.endswith(delim) else after
         scanning = True
         while scanning:
             scanning = False
@@ -1261,8 +1264,10 @@ class S3Gateway:
                         common.append(
                             f"<CommonPrefixes><Prefix>{quote(cp)}"
                             f"</Prefix></CommonPrefixes>")
-                        # advance past every key the prefix folds and
-                        # seek the index there
+                        # a CommonPrefixes row counts toward max-keys
+                        # (S3 contract); advance past every key the
+                        # prefix folds and seek the index there
+                        n += 1
                         next_marker = cp
                         after = cp + "\xff"
                         restart = after

@@ -259,3 +259,42 @@ def test_deep_scrub_repairs_clone_bitrot():
         assert await sio.read("obj") == b"frozen" * 500
         await cl.stop()
     asyncio.run(run())
+
+
+def test_deep_scrub_rebuilds_ec_clone_chunk():
+    """EC clone chunks scrub + rebuild: bit-rot in one shard's CLONE
+    chunk is detected and reconstructed by decoding over the peers'
+    clone chunks (the erasure relation holds per clone)."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(4)
+        await admin.pool_create("ec", pg_num=4, pool_type="erasure",
+                                k=2, m=2)
+        io = admin.open_ioctx("ec")
+        await io.write_full("obj", b"frozen" * 600)
+        await io.snap_create("s1")
+        sid = io.snap_lookup("s1")
+        await io.write_full("obj", b"newer!" * 400)   # clones chunks
+
+        clones = [(o, c, s) for o, c, s in find_copies(cl, "obj")
+                  if not s.is_head()]
+        assert len(clones) == 4            # one clone chunk per shard
+        vosd, vcid, vsoid = clones[0]
+        want = vosd.store.read(vcid, vsoid)
+        corrupt(vosd, vcid, vsoid)
+
+        pg, posd = primary_pg(cl, "ec", "obj")
+        res = await run_scrub(pg, deep=True)
+        assert res["errors"] >= 1, res
+        assert res["repaired"] >= 1, res
+
+        # the corrupted clone chunk is bit-exact again
+        assert vosd.store.read(vcid, vsoid) == want
+        res = await run_scrub(pg, deep=True)
+        assert res["errors"] == 0, res
+        # and the snapshot read decodes the healed stripe
+        sio = io.dup()
+        sio.set_snap_read(sid)
+        assert await sio.read("obj") == b"frozen" * 600
+        await cl.stop()
+    asyncio.run(run())
