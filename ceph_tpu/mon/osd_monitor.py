@@ -613,7 +613,27 @@ class OSDMonitor(PaxosService):
         "cache_target_dirty_ratio": float,
         "cache_target_full_ratio": float, "size": int,
         "min_size": int,
+        # pool quotas (`osd pool set-quota` role): the mon's quota
+        # check flips FLAG_FULL_QUOTA off PGMap usage
+        "quota_max_bytes": int, "quota_max_objects": int,
     }
+
+    def set_pool_full_quota(self, pid: int, full: bool) -> None:
+        """Flip FLAG_FULL_QUOTA on a pool and propose (called by the
+        PGMonitor's quota check — OSDMonitor handle_full role)."""
+        import copy
+        from ceph_tpu.osd.types import FLAG_FULL_QUOTA
+        pool = copy.deepcopy(self.pending_inc.new_pools.get(
+            pid, self.osdmap.pools[pid]))
+        if bool(pool.flags & FLAG_FULL_QUOTA) == full:
+            return
+        pool.flags = (pool.flags | FLAG_FULL_QUOTA) if full \
+            else (pool.flags & ~FLAG_FULL_QUOTA)
+        self.pending_inc.new_pools[pid] = pool
+        name = self.osdmap.pool_names.get(pid, pid)
+        self.mon.log.warning(
+            f"pool {name!r} {'is FULL (quota exceeded)' if full else 'quota cleared'}")
+        self.propose_pending()
 
     def _cmd_pool_set(self, m: MMonCommand) -> None:
         """osd pool set <pool> <var> <val> — the tiering/agent knobs +

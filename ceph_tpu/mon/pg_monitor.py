@@ -46,6 +46,36 @@ class PGMonitor:
             self.pg_stats[pgid] = dict(row, reported_by=m.from_osd,
                                        stamp=now, epoch=m.epoch)
         self._prune()
+        self._check_pool_quotas()
+
+    def _check_pool_quotas(self) -> None:
+        """Flip FLAG_FULL_QUOTA when PGMap usage crosses a pool's
+        quota (OSDMonitor/PGMap check_full role): writes to a full
+        pool fail EDQUOT on the OSDs until usage drops or the quota
+        is raised."""
+        if not self.mon.is_leader():
+            return
+        usage: Dict[int, list] = {}
+        for pgid, st in self.pg_stats.items():
+            try:
+                pool_id = int(pgid.partition(".")[0])
+            except ValueError:
+                continue
+            agg = usage.setdefault(pool_id, [0, 0])
+            agg[0] += st.get("num_objects", 0)
+            agg[1] += st.get("num_bytes", 0)
+        from ceph_tpu.osd.types import FLAG_FULL_QUOTA
+        for pid, pool in self.mon.osdmon.osdmap.pools.items():
+            if not (pool.quota_max_bytes or pool.quota_max_objects):
+                if pool.flags & FLAG_FULL_QUOTA:
+                    self.mon.osdmon.set_pool_full_quota(pid, False)
+                continue
+            objs, nbytes = usage.get(pid, [0, 0])
+            full = (pool.quota_max_objects
+                    and objs >= pool.quota_max_objects) or \
+                   (pool.quota_max_bytes
+                    and nbytes >= pool.quota_max_bytes)
+            self.mon.osdmon.set_pool_full_quota(pid, bool(full))
 
     def _prune(self) -> None:
         """Drop rows for pgs that no longer exist (pool deleted/shrunk),

@@ -1037,6 +1037,27 @@ class ECBackend(PGBackend):
         return data[:size]
 
     # ----------------------------------------------------------- recovery
+    async def _send_push_and_wait(self, peer: int, oid: str,
+                                  msg: MPGPush) -> None:
+        """Send a prebuilt push and await its ack (one copy of the
+        future-register/timeout/cleanup plumbing)."""
+        pg = self.pg
+        fut = asyncio.get_running_loop().create_future()
+        pg._push_acks[(peer, oid)] = fut
+        try:
+            self.osd.send_osd(peer, msg)
+            await asyncio.wait_for(fut, 20.0)
+        finally:
+            pg._push_acks.pop((peer, oid), None)
+
+    def _txn_install_clones(self, txn, soid, clones) -> None:
+        pg = self.pg
+        for c, cdata, cattrs in clones:
+            csoid = soid.with_snap(c)
+            txn.remove(pg.cid, csoid)
+            txn.write(pg.cid, csoid, 0, cdata)
+            txn.setattrs(pg.cid, csoid, cattrs)
+
     async def _rebuild_clones(self, oid: str, target: int, exclude):
         """Reconstruct `target`'s clone chunks by decoding over the
         peers' clone chunks (the erasure relation holds per clone —
@@ -1084,21 +1105,15 @@ class ECBackend(PGBackend):
         except (NoSuchObject, NoSuchCollection):
             ssb, clones = await self._rebuild_clones(oid, target,
                                                      exclude)
-            fut = asyncio.get_running_loop().create_future()
-            pg._push_acks[(peer, oid)] = fut
-            try:
-                msg = MPGPush(pg.pgid.with_shard(target), oid,
-                              pg.info.last_update,
-                              from_osd=self.osd.whoami, deleted=True)
-                msg.backfill_progress = progress
-                if ssb is not None:
-                    msg.has_snap_state = True
-                    msg.snapset = ssb
-                    msg.clones = clones
-                self.osd.send_osd(peer, msg)
-                await asyncio.wait_for(fut, 20.0)
-            finally:
-                pg._push_acks.pop((peer, oid), None)
+            msg = MPGPush(pg.pgid.with_shard(target), oid,
+                          pg.info.last_update,
+                          from_osd=self.osd.whoami, deleted=True)
+            msg.backfill_progress = progress
+            if ssb is not None:
+                msg.has_snap_state = True
+                msg.snapset = ssb
+                msg.clones = clones
+            await self._send_push_and_wait(peer, oid, msg)
             return
         got = await self._gather_shards(
             oid, exclude={target} | set(exclude),
@@ -1114,23 +1129,16 @@ class ECBackend(PGBackend):
         from ceph_tpu.osd.scrub import CRC_XATTR
         attrs = dict(attrs)
         attrs[CRC_XATTR] = str(crc32c(rebuilt.tobytes())).encode()
-        fut = asyncio.get_running_loop().create_future()
-        pg._push_acks[(peer, oid)] = fut
-        try:
-            msg = MPGPush(
-                pg.pgid.with_shard(target), oid, pg.info.last_update,
-                rebuilt.tobytes(), attrs, {}, b"", self.osd.whoami)
-            msg.backfill_progress = progress
-            ssb, clones = await self._rebuild_clones(oid, target,
-                                                     exclude)
-            if ssb is not None:
-                msg.has_snap_state = True
-                msg.snapset = ssb
-                msg.clones = clones
-            self.osd.send_osd(peer, msg)
-            await asyncio.wait_for(fut, 20.0)
-        finally:
-            pg._push_acks.pop((peer, oid), None)
+        msg = MPGPush(
+            pg.pgid.with_shard(target), oid, pg.info.last_update,
+            rebuilt.tobytes(), attrs, {}, b"", self.osd.whoami)
+        msg.backfill_progress = progress
+        ssb, clones = await self._rebuild_clones(oid, target, exclude)
+        if ssb is not None:
+            msg.has_snap_state = True
+            msg.snapset = ssb
+            msg.clones = clones
+        await self._send_push_and_wait(peer, oid, msg)
 
     async def pull_object(self, peer: int, oid: str, epoch: int,
                           exclude=frozenset()) -> None:
@@ -1156,11 +1164,7 @@ class ECBackend(PGBackend):
                 ssb, clones = await self._rebuild_clones(
                     oid, self.my_shard, exclude)
                 if ssb is not None:
-                    for c, cdata, cattrs in clones:
-                        csoid = soid.with_snap(c)
-                        txn.remove(pg.cid, csoid)
-                        txn.write(pg.cid, csoid, 0, cdata)
-                        txn.setattrs(pg.cid, csoid, cattrs)
+                    self._txn_install_clones(txn, soid, clones)
                 self.osd.store.apply_transaction(txn)
                 return
             # the log says this object EXISTS: an insufficient gather is
@@ -1187,11 +1191,7 @@ class ECBackend(PGBackend):
         # replace clones it couldn't reconstruct
         ssb, clones = await self._rebuild_clones(oid, my, exclude)
         if ssb is not None:
-            for c, cdata, cattrs in clones:
-                csoid = soid.with_snap(c)
-                txn.remove(pg.cid, csoid)
-                txn.write(pg.cid, csoid, 0, cdata)
-                txn.setattrs(pg.cid, csoid, cattrs)
+            self._txn_install_clones(txn, soid, clones)
         pg.save_meta(txn)
         self.osd.store.apply_transaction(txn)
 

@@ -1289,6 +1289,16 @@ class PG:
                 m.tid, -errno.EINVAL, map_epoch=self.osd.osdmap.epoch))
             return
         has_write = any(o.is_write() for o in m.ops)
+        from ceph_tpu.osd.messages import OP_DELETE
+        from ceph_tpu.osd.types import FLAG_FULL_QUOTA
+        if has_write and (self.pool.flags & FLAG_FULL_QUOTA) \
+                and not any(o.op == OP_DELETE for o in m.ops):
+            # pool over quota (mon-flagged): writes fail EDQUOT;
+            # deletes still pass so the operator can dig out
+            # (ReplicatedPG::do_op pool-full EDQUOT path)
+            self.osd.reply_to(m, MOSDOpReply(
+                m.tid, -errno.EDQUOT, map_epoch=self.osd.osdmap.epoch))
+            return
         if has_write and len(
                 [o for o in self.acting if o != CRUSH_ITEM_NONE]) \
                 < self.pool.min_size:

@@ -290,9 +290,13 @@ async def _scrub_ec(pg, maps, all_oids, deep, repair):
     me = osd.whoami
     shard_of = {o: pg.shard_of(o) for o in pg.acting
                 if o != CRUSH_ITEM_NONE}
-    # repairs rebuild the BASE per osd (recover/pull reconstruct the
-    # head chunk AND every clone chunk), so dedupe per (osd, base)
-    rebuilt_pairs = set()
+    # detection pass: repairs rebuild the BASE per osd (recover/pull
+    # reconstruct the head chunk AND every clone chunk), so the
+    # exclude set must be the UNION of bad shards across all keys of
+    # the base — a shard bad on only one clone key must never feed
+    # ANY rebuild of that base (its garbage would be re-encoded with
+    # a fresh self-consistent digest and scrub clean forever after)
+    base_bad: Dict[str, set] = {}
     for oid in sorted(all_oids):
         base, _, snap_s = oid.partition("\x00")
         if not snap_s:
@@ -310,28 +314,30 @@ async def _scrub_ec(pg, maps, all_oids, deep, repair):
             continue
         errors += len(bad_osds)
         inconsistent.append(oid)
-        if not repair:
-            continue
-        bad_shards = {shard_of[o] for o in bad_osds if o in shard_of}
-        good_osds = sorted(set(maps) - bad_osds)
-        for o in sorted(bad_osds):
-            if o not in shard_of or (o, base) in rebuilt_pairs:
-                continue
-            rebuilt_pairs.add((o, base))
-            try:
-                if o == me:
-                    if not good_osds:
-                        continue   # nothing trustworthy to rebuild from
-                    await pg.backend.pull_object(
-                        good_osds[0], base, pg.interval_epoch,
-                        exclude=bad_shards - {shard_of[o]})
-                else:
-                    await pg.backend.recover_object(
-                        o, base, exclude=bad_shards - {shard_of[o]})
-                repaired += 1
-            except Exception:
-                pg.log_.exception(f"{pg.pgid} scrub repair {base} "
-                                  f"shard {shard_of[o]}")
+        base_bad.setdefault(base, set()).update(bad_osds)
+    if repair:
+        for base in sorted(base_bad):
+            bad_osds = base_bad[base]
+            bad_shards = {shard_of[o] for o in bad_osds
+                          if o in shard_of}
+            good_osds = sorted(set(maps) - bad_osds)
+            for o in sorted(bad_osds):
+                if o not in shard_of:
+                    continue
+                try:
+                    if o == me:
+                        if not good_osds:
+                            continue   # nothing trustworthy left
+                        await pg.backend.pull_object(
+                            good_osds[0], base, pg.interval_epoch,
+                            exclude=bad_shards - {shard_of[o]})
+                    else:
+                        await pg.backend.recover_object(
+                            o, base, exclude=bad_shards - {shard_of[o]})
+                    repaired += 1
+                except Exception:
+                    pg.log_.exception(f"{pg.pgid} scrub repair {base} "
+                                      f"shard {shard_of[o]}")
     return errors, repaired, inconsistent
 
 

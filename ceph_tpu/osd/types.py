@@ -22,6 +22,9 @@ POOL_TYPE_ERASURE = 3
 
 # pool flags
 FLAG_HASHPSPOOL = 1
+#: mon-managed: pool usage exceeds its quota — writes fail EDQUOT
+#: (osd_types.h FLAG_FULL_QUOTA role)
+FLAG_FULL_QUOTA = 2
 
 # osd state bits (include/rados.h CEPH_OSD_*)
 OSD_EXISTS = 1
@@ -120,9 +123,10 @@ class PGPool(Encodable):
     snapshots (snap_seq/snaps/removed_snaps — osd_types.h pg_pool_t
     snap state; v2) + cache tiering linkage (tier_of/read_tier/
     write_tier/cache_mode/hit-set + agent targets — osd_types.h
-    pg_pool_t:1230-1234; v3)."""
+    pg_pool_t:1230-1234; v3) + pool quotas (quota_max_bytes/objects —
+    osd_types.h pg_pool_t quota fields; v4)."""
 
-    STRUCT_V = 3
+    STRUCT_V = 4
 
     def __init__(self, type_: int = POOL_TYPE_REPLICATED, size: int = 3,
                  min_size: int = 0, crush_ruleset: int = 0,
@@ -154,6 +158,10 @@ class PGPool(Encodable):
         self.target_max_objects = 0      # agent: object budget (0=off)
         self.cache_target_dirty_ratio = 0.4
         self.cache_target_full_ratio = 0.8
+        # pool quotas (0 = unlimited); the mon flips FLAG_FULL_QUOTA
+        # when PGMap usage crosses them
+        self.quota_max_bytes = 0
+        self.quota_max_objects = 0
 
     def is_tier(self) -> bool:
         return self.tier_of >= 0
@@ -219,6 +227,7 @@ class PGPool(Encodable):
         enc.u64(self.target_max_objects)
         enc.f64(self.cache_target_dirty_ratio)
         enc.f64(self.cache_target_full_ratio)
+        enc.u64(self.quota_max_bytes).u64(self.quota_max_objects)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "PGPool":
@@ -241,6 +250,9 @@ class PGPool(Encodable):
             p.target_max_objects = dec.u64()
             p.cache_target_dirty_ratio = dec.f64()
             p.cache_target_full_ratio = dec.f64()
+        if struct_v >= 4:
+            p.quota_max_bytes = dec.u64()
+            p.quota_max_objects = dec.u64()
         return p
 
 

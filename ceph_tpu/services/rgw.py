@@ -1232,10 +1232,15 @@ class S3Gateway:
         # re-read pages 1..N-1.  Emitting a CommonPrefixes row RESTARTS
         # the walk past the whole folded group, so a 100k-key
         # "directory" costs one seek, not a full scan.
-        # a marker that IS a folded prefix (our resume token, ends with
-        # the delimiter) seeks straight past the whole group
-        restart = after + "\xff" if delim and after \
-            and after.endswith(delim) else after
+        # a marker that IS a fold-level prefix (our resume token: the
+        # delimiter appears ONLY as its suffix past the query prefix)
+        # seeks straight past the whole group.  A client start-after
+        # at a DEEPER level (e.g. "logs/2024/" under delimiter=/) must
+        # not skip the group — its CommonPrefixes row is still due.
+        rest_a = after[len(prefix):] if after.startswith(prefix) else ""
+        restart = after + "\xff" if (
+            delim and rest_a.endswith(delim)
+            and delim not in rest_a[:-len(delim)]) else after
         scanning = True
         while scanning:
             scanning = False

@@ -60,7 +60,14 @@ def build_command(args, extra) -> dict:
     if words[0] == "osd" and len(words) > 1:
         if words[1] == "pool" and len(words) > 3:
             cmd = {"prefix": f"osd pool {words[2]}", "pool": words[3]}
-            if len(words) > 4 and words[4].isdigit():
+            if words[2] == "set-quota" and len(words) > 5:
+                # `osd pool set-quota data max_objects 100` sugar over
+                # pool set quota_max_*
+                cmd = {"prefix": "osd pool set", "pool": words[3],
+                       "var": f"quota_{words[4]}", "val": words[5]}
+            elif words[2] == "set" and len(words) > 5:
+                cmd["var"], cmd["val"] = words[4], words[5]
+            elif len(words) > 4 and words[4].isdigit():
                 cmd["pg_num"] = int(words[4])
             if args.type:
                 cmd["pool_type"] = args.type

@@ -297,3 +297,62 @@ def test_full_resync_removes_peer_only_objects():
         finally:
             PGLog.MAX_ENTRIES = old_max
     asyncio.run(run())
+
+
+def test_pool_quota_full_flag_blocks_writes():
+    """Pool quotas (OSDMonitor set-quota + PGMap check_full role): the
+    mon flips FLAG_FULL_QUOTA when usage crosses the quota; writes
+    fail EDQUOT, deletes still pass (dig-out), and clearing the quota
+    or deleting objects unblocks."""
+    import errno as _errno
+
+    async def run():
+        import time as _time
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("q", pg_num=4)
+        io = admin.open_ioctx("q")
+        await admin.mon_command({"prefix": "osd pool set", "pool": "q",
+                                 "var": "quota_max_objects", "val": "2"})
+        await io.write_full("a", b"x" * 100)
+        await io.write_full("b", b"y" * 100)
+
+        # stats propagate -> mon flags the pool full -> writes EDQUOT
+        deadline = _time.monotonic() + 30.0
+        while _time.monotonic() < deadline:
+            try:
+                await io.write_full("c", b"z")
+                await io.remove("c")          # not yet flagged: undo
+                await asyncio.sleep(0.3)
+            except ObjectOperationError as e:
+                assert e.retcode == -_errno.EDQUOT, e
+                break
+        else:
+            raise AssertionError("pool never went quota-full")
+
+        # deletes pass while full (dig-out), then usage drops below
+        # the quota and the mon clears the flag
+        await io.remove("b")
+        deadline = _time.monotonic() + 30.0
+        while _time.monotonic() < deadline:
+            try:
+                await io.write_full("d", b"w")
+                break
+            except ObjectOperationError:
+                await asyncio.sleep(0.3)
+        else:
+            raise AssertionError("pool never un-flagged after delete")
+        # raise the quota entirely: a third object fits now
+        await admin.mon_command({"prefix": "osd pool set", "pool": "q",
+                                 "var": "quota_max_objects", "val": "0"})
+        deadline = _time.monotonic() + 30.0
+        while _time.monotonic() < deadline:
+            try:
+                await io.write_full("e", b"v")
+                break
+            except ObjectOperationError:
+                await asyncio.sleep(0.3)
+        else:
+            raise AssertionError("quota=0 never unblocked")
+        await cl.stop()
+    asyncio.run(run())
