@@ -39,6 +39,11 @@ class OSDMonitor(PaxosService):
         # failure tracking: target osd -> {reporter osd: monotonic stamp}
         self.failure_reports: Dict[int, Dict[int, float]] = {}
         self.down_stamp: Dict[int, float] = {}
+        # absolute flag word most recently PROPOSED but possibly not
+        # yet committed — the read-modify-write base for a second `osd
+        # set` arriving in that window (pending_inc resets on propose,
+        # so neither it nor osdmap.flags carries the in-flight value)
+        self._flags_target: Optional[int] = None
 
     # ----------------------------------------------------------- state io
     def refresh(self) -> None:
@@ -48,6 +53,9 @@ class OSDMonitor(PaxosService):
             full = self.mon.store_get("osdmap", f"full_{last}")
             self.osdmap = OSDMap.from_bytes(full)
             self.log.info(f"osdmap {self.osdmap.summary()}")
+            if self._flags_target is not None \
+                    and self.osdmap.flags == self._flags_target:
+                self._flags_target = None     # landed
         if self.pending_inc.epoch <= self.osdmap.epoch:
             self.pending_inc = Incremental(self.osdmap.epoch + 1)
         elif self.pending_inc.epoch > self.osdmap.epoch + 1:
@@ -67,9 +75,12 @@ class OSDMonitor(PaxosService):
                     or inc.new_primary_affinity or inc.new_up_thru
                     or inc.new_pg_temp or inc.new_primary_temp
                     or inc.new_crush is not None or inc.new_max_osd >= 0
-                    or inc.fsid or inc.new_lost)
+                    or inc.fsid or inc.new_lost or inc.new_flags >= 0)
 
     def on_active(self) -> None:
+        # a flag target proposed by the previous leadership is void:
+        # its command was never acked (acks follow commit)
+        self._flags_target = None
         if self.osdmap.epoch == 0:
             self.create_initial()
 
@@ -208,6 +219,9 @@ class OSDMonitor(PaxosService):
 
     def tick(self) -> None:
         """Leader periodic work: age down osds to out."""
+        from ceph_tpu.osd.osdmap import FLAG_NOOUT
+        if self.osdmap.flags & FLAG_NOOUT:
+            return        # maintenance: `osd set noout` holds them in
         now = time.monotonic()
         grace = self.mon.cfg["mon_osd_down_out_interval"]
         dirty = False
@@ -267,6 +281,28 @@ class OSDMonitor(PaxosService):
                 self.pending_inc.new_state[osd] = \
                     self.pending_inc.new_state.get(osd, 0) | OSD_UP
             self._propose_and_ack(m)
+        elif prefix in ("osd set", "osd unset"):
+            # cluster flags: `osd set noout|noscrub|nodeep-scrub`
+            from ceph_tpu.osd.osdmap import CLUSTER_FLAGS, flag_names
+            bit = CLUSTER_FLAGS.get(cmd.get("key", ""))
+            if bit is None:
+                ack(-errno.EINVAL,
+                    f"unknown flag {cmd.get('key')!r} "
+                    f"(know: {sorted(CLUSTER_FLAGS)})")
+                return
+            cur = self.pending_inc.new_flags
+            if cur < 0:
+                cur = self._flags_target \
+                    if self._flags_target is not None \
+                    else self.osdmap.flags
+            new = (cur | bit) if prefix == "osd set" else (cur & ~bit)
+            if new == cur == self.osdmap.flags:
+                ack(0, f"flags {','.join(flag_names(new)) or '(none)'}")
+                return
+            self.pending_inc.new_flags = new
+            self._flags_target = new
+            self._propose_and_ack(
+                m, outs=f"flags {','.join(flag_names(new)) or '(none)'}")
         elif prefix == "osd reweight":
             osd = int(cmd["id"])
             if not self.osdmap.exists(osd):

@@ -48,13 +48,9 @@ class PGMonitor:
         self._prune()
         self._check_pool_quotas()
 
-    def _check_pool_quotas(self) -> None:
-        """Flip FLAG_FULL_QUOTA when PGMap usage crosses a pool's
-        quota (OSDMonitor/PGMap check_full role): writes to a full
-        pool fail EDQUOT on the OSDs until usage drops or the quota
-        is raised."""
-        if not self.mon.is_leader():
-            return
+    def _pool_usage(self) -> Dict[int, list]:
+        """pool_id -> [objects, bytes] aggregated from pg stats (one
+        copy, shared by df() and the quota check)."""
         usage: Dict[int, list] = {}
         for pgid, st in self.pg_stats.items():
             try:
@@ -64,6 +60,16 @@ class PGMonitor:
             agg = usage.setdefault(pool_id, [0, 0])
             agg[0] += st.get("num_objects", 0)
             agg[1] += st.get("num_bytes", 0)
+        return usage
+
+    def _check_pool_quotas(self) -> None:
+        """Flip FLAG_FULL_QUOTA when PGMap usage crosses a pool's
+        quota (OSDMonitor/PGMap check_full role): writes to a full
+        pool fail EDQUOT on the OSDs until usage drops or the quota
+        is raised."""
+        if not self.mon.is_leader():
+            return
+        usage = self._pool_usage()
         from ceph_tpu.osd.types import FLAG_FULL_QUOTA
         for pid, pool in self.mon.osdmon.osdmap.pools.items():
             if not (pool.quota_max_bytes or pool.quota_max_objects):
@@ -149,16 +155,8 @@ class PGMonitor:
         redundancy (size for replicated, (k+m)/k for EC)."""
         self._prune()
         osdmap = self.mon.osdmon.osdmap
-        per_pool: Dict[int, dict] = {}
-        for pgid, st in self.pg_stats.items():
-            try:
-                pool_id = int(pgid.partition(".")[0])
-            except ValueError:
-                continue
-            agg = per_pool.setdefault(pool_id,
-                                      {"objects": 0, "bytes": 0})
-            agg["objects"] += st.get("num_objects", 0)
-            agg["bytes"] += st.get("num_bytes", 0)
+        per_pool = {pid: {"objects": u[0], "bytes": u[1]}
+                    for pid, u in self._pool_usage().items()}
         pools = []
         total = 0
         total_raw = 0.0

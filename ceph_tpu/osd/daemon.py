@@ -723,9 +723,15 @@ class OSD(Dispatcher):
         deep = self.cfg["osd_deep_scrub_interval"]
         poll = max(0.5, min(light, deep) / 4)
         from ceph_tpu.osd.pg import STATE_ACTIVE
+        from ceph_tpu.osd.osdmap import FLAG_NODEEP_SCRUB, FLAG_NOSCRUB
         while self.running:
             await asyncio.sleep(poll)
             now = int(_time.time() * 1000)
+            # cluster flags gate SCHEDULED scrubs only; operator `pg
+            # scrub` commands still run (OSD::sched_scrub noscrub)
+            no_light = bool(self.osdmap.flags & FLAG_NOSCRUB)
+            no_deep = no_light or bool(self.osdmap.flags
+                                       & FLAG_NODEEP_SCRUB)
             for pg in list(self.pgs.values()):
                 if not pg.is_primary() or pg.state != STATE_ACTIVE:
                     continue
@@ -738,10 +744,12 @@ class OSD(Dispatcher):
                     continue
                 if pg._scrub_queued:
                     continue       # one in flight; stamp moves on completion
-                if now - info.last_deep_scrub_stamp > deep * 1000:
+                if not no_deep \
+                        and now - info.last_deep_scrub_stamp > deep * 1000:
                     pg._scrub_queued = True
                     pg.queue_op(MPGScrub(pg.pgid, deep=True))
-                elif now - info.last_scrub_stamp > light * 1000:
+                elif not no_light \
+                        and now - info.last_scrub_stamp > light * 1000:
                     pg._scrub_queued = True
                     pg.queue_op(MPGScrub(pg.pgid, deep=False))
 

@@ -25,11 +25,22 @@ from ceph_tpu.osd.types import (
     OSD_IN_WEIGHT, ObjectLocator, OSDInfo, PGId, PGPool,
 )
 
+# cluster flags (OSDMap CEPH_OSDMAP_* — `osd set <flag>`)
+FLAG_NOOUT = 1           # suppress automatic down->out aging
+FLAG_NOSCRUB = 2         # suppress scheduled light scrubs
+FLAG_NODEEP_SCRUB = 4    # suppress scheduled deep scrubs
+CLUSTER_FLAGS = {"noout": FLAG_NOOUT, "noscrub": FLAG_NOSCRUB,
+                 "nodeep-scrub": FLAG_NODEEP_SCRUB}
+
+
+def flag_names(flags: int) -> List[str]:
+    return sorted(n for n, b in CLUSTER_FLAGS.items() if flags & b)
+
 
 class Incremental(Encodable):
     """OSDMap::Incremental — the delta the monitor commits per epoch."""
 
-    STRUCT_V = 3
+    STRUCT_V = 4
 
     def __init__(self, epoch: int = 0):
         self.epoch = epoch
@@ -52,6 +63,9 @@ class Incremental(Encodable):
         self.old_ec_profiles: List[str] = []
         # v3: `osd lost` declarations (osd -> epoch of the declaration)
         self.new_lost: Dict[int, int] = {}
+        # v4: cluster flag replacement (-1 = unchanged) — `osd set
+        # noout` etc. (OSDMap::Incremental new_flags)
+        self.new_flags = -1
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u32(self.epoch).string(self.fsid).s32(self.new_max_osd)
@@ -81,6 +95,7 @@ class Incremental(Encodable):
                                      lambda e2, v2: e2.string(v2)))
         enc.list_(self.old_ec_profiles, lambda e, v: e.string(v))
         enc.map_(self.new_lost, lambda e, k: e.s32(k), lambda e, v: e.u32(v))
+        enc.s32(self.new_flags)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "Incremental":
@@ -114,6 +129,8 @@ class Incremental(Encodable):
             inc.old_ec_profiles = dec.list_(lambda d: d.string())
         if struct_v >= 3:
             inc.new_lost = dec.map_(lambda d: d.s32(), lambda d: d.u32())
+        if struct_v >= 4:
+            inc.new_flags = dec.s32()
         return inc
 
 
@@ -415,6 +432,8 @@ class OSDMap(Encodable):
         self.epoch = inc.epoch
         if inc.fsid:
             self.fsid = inc.fsid
+        if inc.new_flags >= 0:
+            self.flags = inc.new_flags
         if inc.new_max_osd >= 0:
             self.set_max_osd(inc.new_max_osd)
         for pid in inc.old_pools:
@@ -520,7 +539,9 @@ class OSDMap(Encodable):
                 and self.to_bytes() == other.to_bytes())
 
     def summary(self) -> str:
+        fl = f" flags {','.join(flag_names(self.flags))}" \
+            if self.flags else ""
         return (f"e{self.epoch}: {self.max_osd} osds "
                 f"({self.count_up()} up, "
                 f"{sum(1 for o in range(self.max_osd) if self.is_in(o))}"
-                f" in), {len(self.pools)} pools")
+                f" in), {len(self.pools)} pools{fl}")

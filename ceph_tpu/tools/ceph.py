@@ -102,6 +102,10 @@ def build_command(args, extra) -> dict:
             cmd = {"prefix": f"osd {words[1]}", "id": int(words[2])}
             if words[1] == "lost" and confirmed:
                 cmd["yes_i_really_mean_it"] = True
+        elif words[1] in ("set", "unset") and len(words) > 2 \
+                and words[0] == "osd":
+            # cluster flags: ceph osd set noout / unset noout
+            cmd = {"prefix": f"osd {words[1]}", "key": words[2]}
         elif words[1] == "getmap":
             cmd = {"prefix": "osd getmap"}
             if len(words) > 2:

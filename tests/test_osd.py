@@ -356,3 +356,48 @@ def test_pool_quota_full_flag_blocks_writes():
             raise AssertionError("quota=0 never unblocked")
         await cl.stop()
     asyncio.run(run())
+
+
+def test_cluster_flag_noout_holds_down_osd_in():
+    """`osd set noout` (OSDMap cluster flags): a down osd is NOT aged
+    out while the flag is set; unset resumes the down-out clock; the
+    flag shows in the osdmap summary."""
+    async def run():
+        import time as _time
+        cl = Cluster()
+        admin = await cl.start(3)
+        await admin.pool_create("nf", pg_num=4)   # heartbeat peers
+        io = admin.open_ioctx("nf")
+        await io.write_full("x", b"y")
+        ack = await admin.mon_command({"prefix": "osd set",
+                                       "key": "noout"})
+        assert "noout" in ack.outs
+        ack = await admin.mon_command({"prefix": "status"})
+        assert "noout" in ack.outs
+
+        await cl.kill_osd(2)
+        grace = FAST_CFG["mon_osd_down_out_interval"]
+        # wait until it's seen DOWN, then well past the out-grace
+        deadline = _time.monotonic() + 30.0
+        while _time.monotonic() < deadline and \
+                admin.monc.osdmap.is_up(2):
+            await asyncio.sleep(0.2)
+        await asyncio.sleep(grace + 2.0)
+        m = admin.monc.osdmap
+        assert not m.is_up(2) and m.is_in(2), "noout must hold it in"
+
+        await admin.mon_command({"prefix": "osd unset", "key": "noout"})
+        deadline = _time.monotonic() + 30.0
+        while _time.monotonic() < deadline:
+            if not admin.monc.osdmap.is_in(2):
+                break
+            await asyncio.sleep(0.3)
+        assert not admin.monc.osdmap.is_in(2), \
+            "unset noout must resume down-out"
+        # unknown flag is rejected loudly
+        with pytest.raises(Exception) as ei:
+            await admin.mon_command({"prefix": "osd set",
+                                     "key": "nosuchflag"})
+        assert "nosuchflag" in str(ei.value)
+        await cl.stop()
+    asyncio.run(run())
