@@ -111,10 +111,13 @@ class OSDMonitor(PaxosService):
             ok = self.encode_pending(txn)
         except Exception:
             # a poisoned pending_inc (e.g. a mutation for an osd id the
-            # map rejects) must never wedge the service: drop it
+            # map rejects) must never wedge the service: drop it — and
+            # any flag target riding it never committed, so it must
+            # not seed a later read-modify-write
             self.log.exception("encode_pending failed; "
                                "discarding pending incremental")
             self.pending_inc = Incremental(self.osdmap.epoch + 1)
+            self._flags_target = None
             ok = False
         if not ok:
             if done:

@@ -102,8 +102,7 @@ def build_command(args, extra) -> dict:
             cmd = {"prefix": f"osd {words[1]}", "id": int(words[2])}
             if words[1] == "lost" and confirmed:
                 cmd["yes_i_really_mean_it"] = True
-        elif words[1] in ("set", "unset") and len(words) > 2 \
-                and words[0] == "osd":
+        elif words[1] in ("set", "unset") and len(words) > 2:
             # cluster flags: ceph osd set noout / unset noout
             cmd = {"prefix": f"osd {words[1]}", "key": words[2]}
         elif words[1] == "getmap":
