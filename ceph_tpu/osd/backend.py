@@ -572,6 +572,8 @@ class ECBackend(PGBackend):
         self.codec = factory(plugin, profile)
         self.k = self.codec.get_data_chunk_count()
         self.n = self.codec.get_chunk_count()
+        # oid -> (interval_epoch, raw snapset) from _authoritative_ss
+        self._ss_cache: Dict[str, Tuple[int, bytes]] = {}
 
     async def _encode_object(self, data: bytes) -> Dict[int, np.ndarray]:
         """Full-object encode, batched across PGs on the device queue
@@ -657,6 +659,9 @@ class ECBackend(PGBackend):
         snaps_mod.prepare_cow(
             pg, m.oid, m.snap_seq, m.snaps,
             [(shard_txns[i], cids[i], soid) for i in range(self.n)])
+        # the write may have advanced the snapset: the survey cache
+        # must not serve the pre-COW row to a later read-at-snap
+        self._ss_cache.pop(m.oid, None)
         for op in [o for o in writes if o.op == OP_ROLLBACK]:
             try:
                 src = snaps_mod.rollback_targets(pg, m.oid, soid,
@@ -786,7 +791,12 @@ class ECBackend(PGBackend):
         soid = head
         snap = 0
         if snapid:
-            soid = snaps_mod.resolve_read(pg, oid, head, snapid)
+            # resolve against the ACTING SET's snapset, not only our
+            # own meta: a primary that adopted this pg mid-churn can
+            # be missing the row, and head-serves-the-snap from the
+            # missing row would return post-snapshot data
+            ss = await self._authoritative_ss(oid)
+            soid = snaps_mod.resolve_read(pg, oid, head, snapid, ss=ss)
             if soid is None:
                 op.rval = -errno.ENOENT
                 return op.rval
@@ -824,8 +834,19 @@ class ECBackend(PGBackend):
         try:
             size = int(self.osd.store.getattr(pg.cid, soid, SIZE_XATTR))
         except (NoSuchObject, NoSuchCollection):
-            op.rval = -errno.ENOENT
-            return op.rval
+            if snap:
+                # WE may be missing the clone chunk the acting set
+                # holds (adopted mid-churn): the gather below can
+                # still decode it — take the size from peer attrs
+                got = await self._gather_shards(oid, snap=snap)
+                if got is not None and SIZE_XATTR in got[1]:
+                    size = int(got[1][SIZE_XATTR])
+                else:
+                    op.rval = -errno.ENOENT
+                    return op.rval
+            else:
+                op.rval = -errno.ENOENT
+                return op.rval
         whole = await self._read_object(oid, size, snap)
         if whole is None:
             op.rval = -errno.EIO
@@ -884,6 +905,66 @@ class ECBackend(PGBackend):
             got = await self._gather_once(oid, set(exclude), snap,
                                           want_version)
         return got
+
+    async def _authoritative_ss(self, oid: str):
+        """The object's SnapSet as the ACTING SET knows it: highest
+        seq wins across our row and every reachable shard's.  A
+        primary that adopted the pg mid-churn can be missing the row
+        (or hold a stale one) while its peers carry the truth — and a
+        head-serves-the-snap resolution from the stale row would
+        return post-snapshot data (found by qa/rados_model seed 306).
+        Surveyed CONCURRENTLY, cached per (oid, interval) — one survey
+        per object per acting set, not per read — and self-heals our
+        meta when a peer's row beats ours.  (Replicated pools don't
+        need this: their COW metadata rides the replicated write txn
+        itself, and MPGPush v2 carries it on every push.)"""
+        from ceph_tpu.osd import snaps as snaps_mod
+        pg = self.pg
+        epoch = pg.interval_epoch
+        hit = self._ss_cache.get(oid)
+        if hit is not None and hit[0] == epoch:
+            raw = hit[1]
+            return snaps_mod.SnapSet.from_bytes(raw) if raw else None
+        local = snaps_mod.load_snapset(self.osd.store, pg.cid,
+                                       pg.meta_oid, oid)
+        best, best_raw = local, \
+            (local.to_bytes() if local is not None else b"")
+
+        async def ask(i: int, osd_id: int):
+            tid = self.osd.next_tid()
+            fut = asyncio.get_running_loop().create_future()
+            self._inflight[tid] = ({osd_id}, fut)
+            msg = MOSDECSubOpRead(pg.pgid.with_shard(i), tid,
+                                  [(oid, 0, 0)])
+            msg.want_ss = True
+            self.osd.send_osd(osd_id, msg)
+            try:
+                return await asyncio.wait_for(fut, 5.0)
+            except asyncio.TimeoutError:
+                self._inflight.pop(tid, None)
+                return None
+
+        peers = [(i, o) for i, o in enumerate(pg.acting)
+                 if o != CRUSH_ITEM_NONE and i != self.my_shard
+                 and self.osd.osdmap.is_up(o)]
+        replies = await asyncio.gather(
+            *[ask(i, o) for i, o in peers], return_exceptions=True)
+        for reply in replies:
+            if isinstance(reply, PGIntervalChanged):
+                raise reply    # stale acting snapshot: caller retries
+            if isinstance(reply, BaseException) or reply is None \
+                    or not reply.ss:
+                continue
+            cand = snaps_mod.SnapSet.from_bytes(reply.ss)
+            if best is None or cand.seq > best.seq:
+                best, best_raw = cand, reply.ss
+        if best is not None and (local is None or local.seq < best.seq):
+            txn = Transaction()
+            txn.omap_setkeys(pg.cid, pg.meta_oid,
+                             {snaps_mod.ss_key(oid): best_raw})
+            self.osd.store.apply_transaction(txn)
+        self._ss_cache[oid] = (epoch, best_raw)
+        return best
 
     async def _gather_once(self, oid: str, exclude: Set[int],
                            snap: int,
@@ -1227,5 +1308,19 @@ class ECBackend(PGBackend):
                 except (NoSuchObject, NoSuchCollection):
                     result = -errno.ENOENT
                     data.append(b"")
-            self.osd.send_osd(int(m.src_name.id), MOSDECSubOpReadReply(
-                pg.pgid, m.tid, self.my_shard, result, data, attrs))
+            reply = MOSDECSubOpReadReply(
+                pg.pgid, m.tid, self.my_shard, result, data, attrs)
+            if m.want_ss and m.reads:
+                # attach OUR SnapSet row: the primary may have adopted
+                # the pg without it and needs the acting set's truth
+                # to resolve reads-at-snap.  A shard mid-adoption may
+                # lack the meta object entirely — that's "no row", not
+                # a dropped reply (the survey would eat a timeout)
+                from ceph_tpu.osd.snaps import ss_key
+                try:
+                    raw = self.osd.store.omap_get_values(
+                        pg.cid, pg.meta_oid, [ss_key(m.reads[0][0])])
+                    reply.ss = next(iter(raw.values()), b"")
+                except (NoSuchObject, NoSuchCollection):
+                    pass
+            self.osd.send_osd(int(m.src_name.id), reply)

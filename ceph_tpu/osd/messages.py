@@ -311,9 +311,12 @@ class MOSDECSubOpWriteReply(Message):
 @register_message
 class MOSDECSubOpRead(Message):
     """Primary -> shard chunk read: (oid, off, len) list.  v2 adds the
-    snap each read targets (clone chunk reads for snapshot decode)."""
+    snap each read targets (clone chunk reads for snapshot decode);
+    v3 adds want_ss — the reply carries the shard's SnapSet row so a
+    primary whose own meta missed the row (adopted the pg mid-churn)
+    can resolve reads-at-snap authoritatively."""
     TYPE = 206
-    STRUCT_V = 2
+    STRUCT_V = 3
     PRIORITY = PRIO_HIGH
 
     def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
@@ -324,12 +327,14 @@ class MOSDECSubOpRead(Message):
         self.tid = tid
         self.reads = reads or []
         self.snap = snap              # 0 = head
+        self.want_ss = False
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid).u64(self.tid)
         enc.list_(self.reads, lambda e, r: (e.string(r[0]), e.u64(r[1]),
                                             e.s64(r[2])))
         enc.u64(self.snap)
+        enc.boolean(self.want_ss)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int):
@@ -337,12 +342,15 @@ class MOSDECSubOpRead(Message):
                 dec.list_(lambda d: (d.string(), d.u64(), d.s64())))
         if struct_v >= 2:
             m.snap = dec.u64()
+        if struct_v >= 3:
+            m.want_ss = dec.boolean()
         return m
 
 
 @register_message
 class MOSDECSubOpReadReply(Message):
     TYPE = 207
+    STRUCT_V = 2
     PRIORITY = PRIO_HIGH
 
     def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
@@ -356,6 +364,7 @@ class MOSDECSubOpReadReply(Message):
         self.result = result
         self.data = data or []
         self.attrs = attrs or {}
+        self.ss = b""        # v2: shard's SnapSet row (want_ss reads)
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid).u64(self.tid).s32(self.from_shard)
@@ -363,12 +372,16 @@ class MOSDECSubOpReadReply(Message):
         enc.list_(self.data, lambda e, b: e.bytes_(b))
         enc.map_(self.attrs, lambda e, k: e.string(k),
                  lambda e, v: e.bytes_(v))
+        enc.bytes_(self.ss)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int):
-        return cls(dec.struct(PGId), dec.u64(), dec.s32(), dec.s32(),
-                   dec.list_(lambda d: d.bytes_()),
-                   dec.map_(lambda d: d.string(), lambda d: d.bytes_()))
+        m = cls(dec.struct(PGId), dec.u64(), dec.s32(), dec.s32(),
+                dec.list_(lambda d: d.bytes_()),
+                dec.map_(lambda d: d.string(), lambda d: d.bytes_()))
+        if struct_v >= 2:
+            m.ss = dec.bytes_()
+        return m
 
 
 # ------------------------------------------------------------- heartbeats

@@ -148,14 +148,20 @@ def prepare_cow(pg, oid: str, snap_seq: int, snaps: List[int],
     return clone_id
 
 
-def resolve_read(pg, oid: str, head_soid, snapid: int):
+_SS_UNSET = object()
+
+
+def resolve_read(pg, oid: str, head_soid, snapid: int, ss=_SS_UNSET):
     """Which store object serves a read at `snapid`?  Returns the soid
     or None for ENOENT-at-that-snap (ReplicatedPG::find_object_context).
-    """
+    `ss` overrides the local SnapSet lookup (EC primaries resolve
+    against the acting set's authoritative row; None = authoritatively
+    no snap history)."""
     from ceph_tpu.store.types import SNAP_HEAD
     if snapid in (0, SNAP_HEAD):
         return head_soid
-    ss = load_snapset(pg.osd.store, pg.cid, pg.meta_oid, oid)
+    if ss is _SS_UNSET:
+        ss = load_snapset(pg.osd.store, pg.cid, pg.meta_oid, oid)
     if ss is None:
         # no snap history: head serves every snap it predates
         return head_soid if head_exists(pg.osd.store, pg.cid, head_soid) \
