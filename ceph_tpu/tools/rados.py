@@ -84,6 +84,20 @@ async def run(args) -> int:
         if args.op == "lspools":
             print("\n".join(r.pool_list()))
             return 0
+        if args.op == "df":
+            # per-pool usage (rados df role, PGMap dump_pool_stats)
+            import json as _json
+            ack = await r.mon_command({"prefix": "df"})
+            d = _json.loads(ack.outs)
+            for p in d["pools"]:
+                print(f"{p['name']:<20} objects {p['objects']:<8} "
+                      f"used {p['bytes_used']:<12} "
+                      f"raw {p['raw_bytes_used']}")
+            s = d["stats"]
+            print(f"total: objects {s['total_objects']} "
+                  f"used {s['total_bytes_used']} "
+                  f"raw {s['total_raw_used']}")
+            return 0
         io = r.open_ioctx(args.pool)
         if args.snap:
             io.set_snap_read(io.snap_lookup(args.snap))
@@ -138,7 +152,7 @@ def main(argv=None) -> int:
     ap.add_argument("-t", "--concurrent", type=int, default=16)
     ap.add_argument("-s", "--snap", default="",
                     help="read from this pool snapshot")
-    ap.add_argument("op", help="put|get|rm|ls|stat|bench|lspools|"
+    ap.add_argument("op", help="put|get|rm|ls|stat|bench|lspools|df|"
                                "mksnap|rmsnap|lssnap|rollback|listsnaps")
     ap.add_argument("args", nargs="*")
     args = ap.parse_args(argv)
