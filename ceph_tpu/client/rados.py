@@ -238,8 +238,23 @@ class IoCtx:
                                       "snap": name})
         await self._wait_snap(lambda p: name not in p.snaps.values())
 
-    async def _wait_snap(self, pred) -> None:
-        while not pred(self.rados.monc.osdmap.pools[self.pool_id]):
+    async def _wait_snap(self, pred, timeout: float = 30.0) -> None:
+        """Bounded wait for the pool's snap state to propagate through
+        the osdmap subscription — unbounded, a stalled subscription
+        (or a pool deleted mid-wait) would hang the caller forever
+        (found by qa/rados_model seed 409 wedging a whole run)."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            pool = self.rados.monc.osdmap.pools.get(self.pool_id)
+            if pool is None:
+                raise ObjectOperationError(-errno.ENOENT,
+                                           f"pool {self.pool_id}")
+            if pred(pool):
+                return
+            if asyncio.get_running_loop().time() >= deadline:
+                raise asyncio.TimeoutError(
+                    f"snap state never propagated for pool "
+                    f"{self.pool_name}")
             await asyncio.sleep(0.05)
 
     async def rollback(self, oid: str, snap_name: str) -> None:

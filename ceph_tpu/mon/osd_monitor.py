@@ -185,6 +185,15 @@ class OSDMonitor(PaxosService):
             return
         if not self.osdmap.exists(target) or self.osdmap.is_down(target):
             return
+        if target < len(self.osdmap.osd_info) \
+                and m.epoch < self.osdmap.osd_info[target].up_from:
+            # the reporter hadn't seen the target's LATEST boot: its
+            # grace window straddles the re-boot and its report is
+            # about the previous incarnation.  Counting it re-downs a
+            # freshly booted osd and sustains a flap loop (mon marks
+            # down -> osd re-boots -> stale reports mark it down
+            # again; OSDMonitor::prepare_failure failed_since guard).
+            return
         if self.pending_inc.new_state.get(target, 0) & OSD_UP:
             return   # down-mark already queued: a second XOR would undo it
         reps = self.failure_reports.setdefault(target, {})

@@ -86,9 +86,8 @@ async def run(args) -> int:
             return 0
         if args.op == "df":
             # per-pool usage (rados df role, PGMap dump_pool_stats)
-            import json as _json
             ack = await r.mon_command({"prefix": "df"})
-            d = _json.loads(ack.outs)
+            d = json.loads(ack.outs)
             for p in d["pools"]:
                 print(f"{p['name']:<20} objects {p['objects']:<8} "
                       f"used {p['bytes_used']:<12} "
