@@ -836,14 +836,10 @@ class ECBackend(PGBackend):
         except (NoSuchObject, NoSuchCollection):
             if snap:
                 # WE may be missing the clone chunk the acting set
-                # holds (adopted mid-churn): the gather below can
-                # still decode it — take the size from peer attrs
-                got = await self._gather_shards(oid, snap=snap)
-                if got is not None and SIZE_XATTR in got[1]:
-                    size = int(got[1][SIZE_XATTR])
-                else:
-                    op.rval = -errno.ENOENT
-                    return op.rval
+                # holds (adopted mid-churn): the gather inside
+                # _read_object can still decode it and carries the
+                # cohort's SIZE_XATTR — defer the length to it
+                size = None
             else:
                 op.rval = -errno.ENOENT
                 return op.rval
@@ -851,7 +847,9 @@ class ECBackend(PGBackend):
         if whole is None:
             op.rval = -errno.EIO
             return op.rval
-        length = op.length if op.length else size - op.offset
+        # slice against the COHORT length (len(whole)), not the local
+        # size hint — they differ exactly when the local xattr is stale
+        length = op.length if op.length else len(whole) - op.offset
         op.outdata = whole[op.offset:op.offset + length]
         op.rval = len(op.outdata)
         return op.rval
@@ -977,6 +975,7 @@ class ECBackend(PGBackend):
             soid = soid.with_snap(snap)
         streams: Dict[int, np.ndarray] = {}
         attrs: Dict[str, bytes] = {}
+        shard_attrs: Dict[int, Dict[str, bytes]] = {}
         shard_vers: Dict[int, bytes] = {}
         my = self.my_shard
         candidates: List[int] = []
@@ -988,6 +987,7 @@ class ECBackend(PGBackend):
                     streams[i] = np.frombuffer(
                         self.osd.store.read(pg.cid, soid), np.uint8)
                     attrs = self.osd.store.getattrs(pg.cid, soid)
+                    shard_attrs[i] = attrs
                     shard_vers[i] = attrs.get(VERSION_XATTR, b"")
                 except (NoSuchObject, NoSuchCollection):
                     pass
@@ -1018,6 +1018,7 @@ class ECBackend(PGBackend):
                 streams[i] = np.frombuffer(reply.data[0], np.uint8)
                 if reply.attrs:
                     attrs = reply.attrs
+                    shard_attrs[i] = reply.attrs
                     shard_vers[i] = reply.attrs.get(VERSION_XATTR, b"")
                 need -= 1
         if len(streams) < self.k:
@@ -1056,6 +1057,7 @@ class ECBackend(PGBackend):
                 if reply.result == 0 and reply.data:
                     streams[i] = np.frombuffer(reply.data[0], np.uint8)
                     if reply.attrs:
+                        shard_attrs[i] = reply.attrs
                         shard_vers[i] = reply.attrs.get(VERSION_XATTR,
                                                         b"")
             cohorts: Dict[tuple, Dict[int, np.ndarray]] = {}
@@ -1084,9 +1086,14 @@ class ECBackend(PGBackend):
             if len(best) < self.k:
                 return None
             streams = best
+        # attrs must describe the RETURNED cohort, not whichever shard
+        # replied last: a stale generation's SIZE_XATTR would silently
+        # truncate fresh decoded bytes downstream
+        attrs = next((shard_attrs[i] for i in streams
+                      if shard_attrs.get(i)), attrs)
         return streams, attrs
 
-    async def _read_object(self, oid: str, size: int,
+    async def _read_object(self, oid: str, size: Optional[int],
                            snap: int = 0) -> Optional[bytes]:
         # a gather can transiently starve while shards are down or
         # mid-recovery: WAIT like the reference (ReplicatedPG
@@ -1108,13 +1115,25 @@ class ECBackend(PGBackend):
             if asyncio.get_running_loop().time() >= deadline:
                 return None
             await asyncio.sleep(0.2)
-        streams, _ = got
+        streams, gattrs = got
         from ceph_tpu.ec.interface import ErasureCodeError
         try:
             data = self.codec.decode_concat(streams)
         except (ErasureCodeError, ValueError):
             # ValueError: mixed-generation chunk lengths — undecodable
             return None
+        # the LOGICAL length must come from the same version-checked
+        # cohort as the bytes: a primary that adopted the pg mid-churn
+        # can hold a stale local SIZE_XATTR, and slicing fresh bytes
+        # to a stale length returns silently truncated/padded data
+        # (qa/rados_model seed 431)
+        if SIZE_XATTR in gattrs:
+            try:
+                size = int(gattrs[SIZE_XATTR])
+            except ValueError:
+                pass
+        if size is None:
+            return None    # no length from any cohort member: EIO
         return data[:size]
 
     # ----------------------------------------------------------- recovery

@@ -143,7 +143,19 @@ async def run_model(seed: int, rounds: int = 80, n_osds: int = 5,
     """One seeded run: returns a result dict (ok, ops, ambiguities...)."""
     rng = random.Random(seed)
     events: List[str] = []
-    cl = Cluster()
+
+    def _ctx(name):
+        from ceph_tpu.qa.cluster import make_ctx
+        c = make_ctx(name)
+        # the checker's signal is CONSISTENCY under thrasher-driven
+        # kills, not heartbeat tuning: on a loaded box the fast-test
+        # grace (1.5s) false-positives into a mon-flap storm that
+        # wedges runs (seeds 406/422) — relax it; real kills still
+        # stop heartbeats entirely and get detected
+        c.config.set("osd_heartbeat_grace", 5.0)
+        return c
+
+    cl = Cluster(ctx_factory=_ctx)
     admin = await cl.start(n_osds)
     await admin.pool_create("model", pg_num=8,
                             **(pool_kw or {"size": 3}))
