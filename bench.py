@@ -385,6 +385,12 @@ def stage_ec_e2e():
         def f(name):
             c = make_ctx(name)
             c.config.set("osd_ec_batch_device", batch_mode)
+            # co-located daemons skip TCP framing/crc/acks entirely
+            # (messenger local fast path) — the bench cluster is one
+            # process, so per-message socket round trips are pure
+            # overhead the real system wouldn't pay either (it maps
+            # co-located shards onto ICI collectives, SURVEY §2.4)
+            c.config.set("ms_local_delivery", True)
             return c
         return f
 
@@ -408,10 +414,22 @@ def stage_ec_e2e():
         await asyncio.gather(*[one(i) for i in range(N_OBJS)])
         wall = time.perf_counter() - t0
         dev = host = 0
+        # store group-commit counters (read BEFORE stop: umount drops
+        # the commit thread): batches shared across concurrent txns +
+        # fsyncs saved is the write-path pipelining evidence
+        st = {"commit_batches": 0, "txns": 0, "fsyncs": 0,
+              "fsyncs_saved": 0}
+        writes = msgs = local = 0
         for osd in cl.osds.values():
             d = osd.ec_queue.perf.dump()
             dev += int(d.get("device_bytes", 0))
             host += int(d.get("host_bytes", 0))
+            c = osd.store.commit_counters()
+            for k in st:
+                st[k] += int(c.get(k, 0))
+            writes += osd.messenger._sock_writes
+            msgs += osd.messenger._sock_write_msgs
+            local += osd.messenger._local_msgs
         await cl.stop()
         lats.sort()
         return {
@@ -421,6 +439,16 @@ def stage_ec_e2e():
             "device_bytes": dev, "host_bytes": host,
             "device_frac": round(dev / (dev + host), 3)
             if dev + host else 0.0,
+            "store_txns": st["txns"],
+            "store_commit_batches": st["commit_batches"],
+            "store_txns_per_batch": round(
+                st["txns"] / st["commit_batches"], 2)
+            if st["commit_batches"] else 0.0,
+            "store_fsyncs": st["fsyncs"],
+            "store_fsyncs_saved": st["fsyncs_saved"],
+            "msgs_per_sock_write": round(msgs / writes, 2)
+            if writes else 0.0,
+            "local_msgs": local,
         }
 
     on = asyncio.run(run_once("on"))
@@ -701,6 +729,11 @@ def main():
             "p50_ms": on["p50_ms"], "p99_ms": on["p99_ms"],
             "p50_ms_off": off["p50_ms"], "p99_ms_off": off["p99_ms"],
             "device_byte_fraction": on["device_frac"],
+            "store_txns_per_commit_batch": on.get(
+                "store_txns_per_batch", 0.0),
+            "store_fsyncs": on.get("store_fsyncs", 0),
+            "store_txns": on.get("store_txns", 0),
+            "msgs_per_sock_write": on.get("msgs_per_sock_write", 0.0),
         })
 
     line = {

@@ -245,6 +245,11 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("ms_max_backoff", "float", 15.0, "reconnect backoff cap"),
     Option("ms_inject_socket_failures", "int", 0,
            "fault injection: fail 1-in-N socket ops (config_opts.h:197)"),
+    Option("ms_local_delivery", "bool", False,
+           "deliver to co-located (same-process) messengers directly, "
+           "skipping TCP framing/crc/acks (AsyncMessenger "
+           "local_connection fast-dispatch role); auto-disabled under "
+           "socket fault injection or cephx"),
     Option("ms_dispatch_throttle_bytes", "size", "100m",
            "inflight dispatch byte throttle"),
     Option("mon_lease", "float", 5.0, "paxos lease seconds (mon/Paxos.h:912)"),

@@ -205,18 +205,37 @@ class Connection:
                 # silently (half-open TCP), so force the reconnect path —
                 # un-acked frames replay there
                 raise ConnectionError("peer closed ack stream")
-            while self.out_q:
-                msg = self.out_q.popleft()
-                self.out_seq += 1
-                msg.seq = self.out_seq
-                body = msg.to_bytes()
-                payload = _MSG_HDR.pack(msg.seq, msg.TYPE,
-                                        zlib.crc32(body)) + body
-                self.unacked.append((self.out_seq, payload))
-                if self.msgr._inject_failure():
+            if self.out_q:
+                # cork: frame EVERY queued message into one buffer and
+                # hand the transport a single write before the single
+                # drain — per-message write() calls each cost a send
+                # syscall (asyncio flushes an empty transport buffer
+                # eagerly), which dominates small-message bursts like
+                # repop ack storms.  Ordering is untouched: frames are
+                # corked in queue order and unacked tracks each seq.
+                buf = bytearray()
+                inject = False
+                n = 0
+                while self.out_q:
+                    msg = self.out_q.popleft()
+                    self.out_seq += 1
+                    msg.seq = self.out_seq
+                    body = msg.to_bytes()
+                    payload = _MSG_HDR.pack(msg.seq, msg.TYPE,
+                                            zlib.crc32(body)) + body
+                    self.unacked.append((self.out_seq, payload))
+                    if self.msgr._inject_failure():
+                        inject = True   # this frame replays on reconnect
+                        break
+                    buf += self._wrap(payload)
+                    n += 1
+                if buf:
+                    writer.write(bytes(buf))
+                    self.msgr._sock_writes += 1
+                    self.msgr._sock_write_msgs += n
+                if inject:
                     writer.transport.abort()   # hard drop, like a RST
                     raise ConnectionError("injected socket failure")
-                writer.write(self._wrap(payload))
             await writer.drain()
             self._kick.clear()
             if not self.out_q and not self._broken:
@@ -304,6 +323,96 @@ class Connection:
                 pass
 
 
+#: process-local endpoint registry: (host, port) -> bound Messenger.
+#: Registration is unconditional (bind/shutdown); whether a sender USES
+#: it is gated per-send by the ms_local_delivery config on both ends.
+_LOCAL_ENDPOINTS: Dict[Tuple[str, int], "Messenger"] = {}
+
+
+class LocalConnection:
+    """Same-process fast path (AsyncMessenger local_connection /
+    ms_fast_dispatch role, widened from self-delivery to any co-located
+    messenger — the deployment the QA cluster and bench actually run).
+
+    The message body is serialized exactly once (object isolation: the
+    receiver decodes its own copy, same as off the wire) and handed to
+    the peer messenger's intake queue in FIFO order.  Everything that
+    exists to survive an unreliable byte stream — framing, crc, acks,
+    replay, reconnect — is skipped: in-process delivery cannot drop or
+    reorder.  Fault-injection and cephx configs fall back to TCP at
+    routing time (_local_peer), so thrash/model-checker semantics and
+    auth gating are untouched."""
+
+    is_local = True
+
+    def __init__(self, msgr: "Messenger", addr: EntityAddr,
+                 peer: "Messenger"):
+        self.msgr = msgr
+        self.addr = addr
+        self.peer = peer
+        self.conn_id = random.getrandbits(63)
+        self.out_seq = 0
+        self.closed = False
+        self._kick = _NullKick()   # mark_down compatibility
+
+    def send(self, msg: Message) -> None:
+        if self.closed:
+            return
+        peer = _LOCAL_ENDPOINTS.get(self.addr.without_nonce())
+        if peer is not self.peer:
+            # peer endpoint went away (daemon shutdown/restart): behave
+            # like a torn-down TCP session — drop and let the caller's
+            # resend machinery (objecter, peering) recover via whatever
+            # endpoint rebinds
+            self.closed = True
+            self.msgr._drop_connection(self)
+            for d in self.msgr.dispatchers:
+                d.ms_handle_reset(self.addr)
+            return
+        self.out_seq += 1
+        msg.seq = self.out_seq
+        self.msgr._local_msgs += 1
+        peer._local_enqueue(self.msgr.name, self.msgr.addr,
+                            self.conn_id, msg.TYPE, msg.to_bytes())
+
+    async def close(self) -> None:
+        self.closed = True
+
+
+class _NullKick:
+    def set(self) -> None:
+        pass
+
+
+class _AckBatcher:
+    """Coalesces the receive side's cumulative acks: one ACK frame per
+    drained burst of inbound frames (scheduled via call_soon, which runs
+    only once the reader empties its buffer and yields), instead of one
+    eager write syscall + sender wakeup per message.  Acks are
+    cumulative, so acking only the newest seq is lossless."""
+
+    __slots__ = ("writer", "_seq", "_scheduled")
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+        self._seq = 0
+        self._scheduled = False
+
+    def note(self, seq: int) -> None:
+        if seq > self._seq:
+            self._seq = seq
+        if not self._scheduled:
+            self._scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush)
+
+    def _flush(self) -> None:
+        self._scheduled = False
+        if self.writer.is_closing():
+            return
+        ack = struct.pack("<Q", self._seq)
+        self.writer.write(_FRAME_HDR.pack(TAG_ACK, len(ack)) + ack)
+
+
 class Messenger:
     """One per process endpoint (daemons bind; clients stay unbound)."""
 
@@ -332,6 +441,16 @@ class Messenger:
         self._next_transport_id = 1    # per-incoming-socket id counter
         self._msgs_sent = 0
         self._msgs_received = 0
+        # corked-write accounting: messages coalesced per socket write
+        # (msgs/write > 1 == the cork is earning its keep)
+        self._sock_writes = 0
+        self._sock_write_msgs = 0
+        # same-process fast-path accounting + intake: one queue+worker
+        # PER SENDER CONNECTION, mirroring the TCP path's per-peer
+        # reader tasks — a throttled client op must only back-pressure
+        # its own sender, never head-of-line block peer acks
+        self._local_msgs = 0
+        self._local_in: Dict[int, Tuple[asyncio.Queue, asyncio.Task]] = {}
         # cephx hooks (msg/Messenger.h ms_get_authorizer /
         # ms_verify_authorizer dispatcher hooks, collapsed onto the
         # messenger since auth state lives with the owning stack):
@@ -370,6 +489,7 @@ class Messenger:
         sock = self._server.sockets[0]
         bound_host, bound_port = sock.getsockname()[:2]
         self.addr = EntityAddr(bound_host, bound_port, self.nonce)
+        _LOCAL_ENDPOINTS[self.addr.without_nonce()] = self
         self.log.debug(f"{self.name} bound at {self.addr}")
         return self.addr
 
@@ -383,12 +503,33 @@ class Messenger:
         key = addr.without_nonce()
         conn = self.conns.get(key)
         if conn is None or conn.closed:
-            conn = Connection(self, addr, self._policy_for(peer_type),
-                              peer_type)
+            peer = self._local_peer(addr)
+            if peer is not None:
+                conn = LocalConnection(self, addr, peer)
+            else:
+                conn = Connection(self, addr,
+                                  self._policy_for(peer_type), peer_type)
+                conn.start()
             self.conns[key] = conn
-            conn.start()
         self._msgs_sent += 1
         conn.send(msg)
+
+    def _local_peer(self, addr: EntityAddr) -> Optional["Messenger"]:
+        """The co-located messenger at addr, when BOTH ends opted into
+        ms_local_delivery and nothing requires real wire semantics
+        (fault injection, cephx authorizers)."""
+        if not self.cfg["ms_local_delivery"]:
+            return None
+        if self.cfg["ms_inject_socket_failures"] > 0:
+            return None
+        if self.get_authorizer_cb is not None:
+            return None
+        peer = _LOCAL_ENDPOINTS.get(addr.without_nonce())
+        if peer is None or not peer.cfg["ms_local_delivery"] \
+                or peer.cfg["ms_inject_socket_failures"] > 0 \
+                or peer.require_authorizer or peer._server is None:
+            return None
+        return peer
 
     def get_connection(self, addr: EntityAddr) -> Optional[Connection]:
         return self.conns.get(addr.without_nonce())
@@ -408,6 +549,63 @@ class Messenger:
     def _inject_failure(self) -> bool:
         n = self.cfg["ms_inject_socket_failures"]
         return n > 0 and random.randrange(n) == 0
+
+    # --- receive path (same-process fast path) ---
+    def _local_enqueue(self, peer_name: EntityName, peer_addr: EntityAddr,
+                       conn_id: int, mtype: int, body: bytes) -> None:
+        ent = self._local_in.get(conn_id)
+        if ent is None:
+            q: asyncio.Queue = asyncio.Queue()
+            task = asyncio.get_running_loop().create_task(
+                self._local_worker(q, conn_id))
+            ent = self._local_in[conn_id] = (q, task)
+        ent[0].put_nowait((peer_name, peer_addr, mtype, body))
+
+    async def _local_worker(self, q: asyncio.Queue,
+                            conn_id: int) -> None:
+        """Drains ONE co-located sender's bodies in FIFO order — the
+        local twin of a _serve_peer reader, minus everything that only
+        exists to survive a real socket.  Dispatch throttle still
+        applies and, as on TCP, stalls only THIS sender's stream while
+        the intake budget is full.  An idle worker retires itself so
+        sender reset/reconnect cycles (fresh conn_ids) can't accumulate
+        parked tasks; the entry pop and any _local_enqueue interleave
+        only at await points, so no message can slip into a popped
+        queue."""
+        while True:
+            if not q.empty():
+                # burst fast path: drain buffered bodies without the
+                # per-message wait_for Task/timer overhead (the same
+                # no-yield drain a TCP reader gets from buffered frames;
+                # throttle awaits below still yield under pressure)
+                peer_name, peer_addr, mtype, body = q.get_nowait()
+            else:
+                try:
+                    peer_name, peer_addr, mtype, body = \
+                        await asyncio.wait_for(q.get(), 60.0)
+                except asyncio.TimeoutError:
+                    self._local_in.pop(conn_id, None)
+                    return
+            cls = message_class(mtype)
+            if cls is None:
+                self.log.warning(f"unknown local message type {mtype}")
+                continue
+            try:
+                msg = cls.from_bytes(body)
+            except Exception as e:
+                self.log.warning(
+                    f"local decode of {cls.__name__} failed: {e!r}")
+                continue
+            msg.src_name = peer_name
+            msg.src_addr = peer_addr
+            msg.transport_id = -conn_id   # local ids: distinct namespace
+            msg.recv_stamp = time.monotonic()
+            if (self.dispatch_throttle is not None
+                    and msg.THROTTLE_DISPATCH):
+                cost = len(body)
+                await self.dispatch_throttle.get(cost)
+                msg.throttle_cost = cost
+            self._dispatch(msg)
 
     # --- receive path ---
     async def _handle_incoming(self, reader: asyncio.StreamReader,
@@ -475,6 +673,12 @@ class Messenger:
                     d.ms_handle_remote_reset(peer_addr)
             if peer_addr.nonce:
                 self._peer_nonce[pkey] = peer_addr.nonce
+        # coalesced cumulative acks: frames already buffered in the
+        # reader parse back-to-back without yielding, so the flush
+        # scheduled via call_soon runs once per drained burst and acks
+        # only the LATEST seq — one tiny write (and one peer wakeup)
+        # per burst instead of one per message
+        acker = _AckBatcher(writer)
         try:
             while True:
                 hdr = await reader.readexactly(_FRAME_HDR.size)
@@ -503,7 +707,7 @@ class Messenger:
                                 f"{peer_name}")
                             raise ConnectionError("bad message signature")
                     msg = self._parse_frame(payload, peer_name,
-                                            peer_addr, conn_id, writer,
+                                            peer_addr, conn_id, acker,
                                             auth_ticket, transport_id)
                     if msg is not None:
                         # dispatch throttle (Message.cc throttle hooks /
@@ -526,7 +730,7 @@ class Messenger:
 
     def _parse_frame(self, payload: bytes, peer_name: EntityName,
                      peer_addr: EntityAddr, conn_id: int,
-                     writer: asyncio.StreamWriter,
+                     acker: "_AckBatcher",
                      auth_ticket=None,
                      transport_id: Optional[int] = None
                      ) -> Optional[Message]:
@@ -535,10 +739,8 @@ class Messenger:
         if zlib.crc32(body) != crc:
             self.log.warning(f"crc mismatch on {mtype} from {peer_name}")
             raise ConnectionError("bad crc")
-        # ack first (cumulative), then dedupe replays
-        if not writer.is_closing():
-            ack = struct.pack("<Q", seq)
-            writer.write(_FRAME_HDR.pack(TAG_ACK, len(ack)) + ack)
+        # ack first (cumulative, coalesced per burst), then dedupe replays
+        acker.note(seq)
         skey = (peer_addr.nonce, conn_id)
         if seq <= self._in_seq.get(skey, 0):
             return None  # replayed duplicate after sender reconnect
@@ -595,6 +797,16 @@ class Messenger:
 
     # --- teardown ---
     async def shutdown(self) -> None:
+        key = self.addr.without_nonce()
+        if _LOCAL_ENDPOINTS.get(key) is self:
+            del _LOCAL_ENDPOINTS[key]
+        for _, task in list(self._local_in.values()):
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._local_in.clear()
         if self._server is not None:
             self._server.close()
         # cancel live peer handlers instead of wait_closed(): waiting would

@@ -101,7 +101,32 @@ class PGBackend:
         except (asyncio.TimeoutError, PGIntervalChanged):
             return False
 
-    def apply_push(self, m: MPGPush) -> bool:
+    def _queue_txn(self, txn: Transaction,
+                   on_commit=None) -> asyncio.Future:
+        """Queue txn on the local store; the returned future resolves
+        once it is DURABLE.  The caller overlaps the replica round trip
+        with the local group commit (commit pipelining) instead of
+        serializing every write behind a private fsync."""
+        fut = asyncio.get_running_loop().create_future()
+
+        def _committed():
+            if on_commit is not None:
+                on_commit()
+            if not fut.done():
+                fut.set_result(True)
+
+        self.osd.store.queue_transactions([txn], on_commit=_committed)
+        return fut
+
+    async def _await_commit(self, fut: asyncio.Future,
+                            timeout=20.0) -> bool:
+        try:
+            await asyncio.wait_for(fut, timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def apply_push(self, m: MPGPush, on_commit=None) -> bool:
         """Install a pushed object (recovery receive side).  A push
         snapshotted BEFORE a concurrent client write but delivered after
         it must not regress the object: the reference orders this with
@@ -174,7 +199,9 @@ class PGBackend:
                 m.backfill_progress > pg.info.last_backfill:
             pg.info.last_backfill = m.backfill_progress
         pg.save_meta(txn)
-        self.osd.store.apply_transaction(txn)
+        # the push ack (on_commit) rides the commit callback: the
+        # pusher's cursor advance must vouch for DURABLE state
+        self.osd.store.queue_transactions([txn], on_commit=on_commit)
         return True
 
     def push_object(self, peer: int, oid: str, at: EVersion,
@@ -465,8 +492,11 @@ class ReplicatedBackend(PGBackend):
             txn.setattr(pg.cid, soid, VERSION_XATTR, version.to_bytes())
         pg.append_log(txn, entry)
         txn_bytes = txn.to_bytes()
-        # local apply first (the primary is always shard 0 of the data)
-        self.osd.store.apply_transaction(txn)
+        # local apply now (memory is immediately readable); durability
+        # rides the commit thread CONCURRENTLY with the replica round
+        # trip — pglog last_complete advances from the commit callback
+        commit_fut = self._queue_txn(
+            txn, on_commit=lambda: pg.complete_to(version))
         # fan out to acting AND up: an up-but-not-acting member (pg_temp
         # backfill target) must see every write or its copy stales
         peers = {o for o in set(pg.acting) | set(pg.up)
@@ -481,6 +511,8 @@ class ReplicatedBackend(PGBackend):
         if not await self._await_acks(fut):
             self._inflight.pop(tid, None)
             return -errno.EAGAIN   # interval change in flight: client resends
+        if not await self._await_commit(commit_fut):
+            return -errno.EAGAIN   # local store wedged: client resends
         return 0
 
     async def do_reads(self, m: MOSDOp) -> int:
@@ -522,6 +554,7 @@ class ReplicatedBackend(PGBackend):
         if isinstance(m, MOSDRepOp):
             txn = Transaction.from_bytes(m.txn_bytes)
             entry = LogEntry.from_bytes(m.log_bytes)
+            advance = None
             if pg.log.head < entry.version:
                 pg.log.append(entry)
                 pg.note_reqid(entry)
@@ -529,11 +562,24 @@ class ReplicatedBackend(PGBackend):
                 if not pg.missing:
                     # a copy still owed recovery pushes must keep its
                     # honest last_complete cursor, or the gap hides
-                    pg.info.last_complete = entry.version
+                    advance = entry.version
             pg.save_meta(txn)
-            self.osd.store.apply_transaction(txn)
-            self.osd.send_osd(int(m.src_name.id), MOSDRepOpReply(
-                pg.pgid, m.tid, 0, True, self.osd.whoami))
+            src = int(m.src_name.id)
+            reply = MOSDRepOpReply(pg.pgid, m.tid, 0, True,
+                                   self.osd.whoami)
+
+            def _committed():
+                # last_complete and the repop ack advance TOGETHER from
+                # the commit callback — the ack can never outrun the
+                # durability of the pglog entry it vouches for, and the
+                # PG worker is already applying the next sub-op while
+                # this one's group commits (commit pipelining)
+                if advance is not None:
+                    pg.complete_to(advance)
+                self.osd.send_osd(src, reply)
+
+            self.osd.store.queue_transactions([txn],
+                                              on_commit=_committed)
 
 
 # ================================================================= erasure
@@ -718,11 +764,14 @@ class ECBackend(PGBackend):
                 t.setattr(cids[i], soid, VERSION_XATTR,
                           version.to_bytes())
         entry_bytes = entry.to_bytes()
-        # local shard applies directly
+        # local shard applies in memory now; its durability overlaps
+        # the sub-op fan-out (commit pipelining), and pglog
+        # last_complete advances from the commit callback
         my = self.my_shard
         local_txn = shard_txns.get(my, Transaction())
         pg.append_log(local_txn, entry)
-        self.osd.store.apply_transaction(local_txn)
+        commit_fut = self._queue_txn(
+            local_txn, on_commit=lambda: pg.complete_to(version))
         # fan out to the other shards; each position also goes to its
         # UP holder when that differs from acting (pg_temp backfill
         # target keeps current while the complete copy serves)
@@ -756,6 +805,8 @@ class ECBackend(PGBackend):
             self.osd.send_osd(osd_id, msg)
         if not await self._await_acks(fut):
             self._inflight.pop(tid, None)
+            return -errno.EAGAIN
+        if not await self._await_commit(commit_fut):
             return -errno.EAGAIN
         return 0
 
@@ -1301,6 +1352,7 @@ class ECBackend(PGBackend):
         if isinstance(m, MOSDECSubOpWrite):
             txn = Transaction.from_bytes(m.txn_bytes)
             entry = LogEntry.from_bytes(m.log_bytes)
+            advance = None
             if pg.log.head < entry.version:
                 pg.log.append(entry)
                 pg.note_reqid(entry)
@@ -1308,11 +1360,21 @@ class ECBackend(PGBackend):
                 if not pg.missing:
                     # a copy still owed recovery pushes must keep its
                     # honest last_complete cursor, or the gap hides
-                    pg.info.last_complete = entry.version
+                    advance = entry.version
             pg.save_meta(txn)
-            self.osd.store.apply_transaction(txn)
-            self.osd.send_osd(int(m.src_name.id), MOSDECSubOpWriteReply(
-                pg.pgid, m.tid, 0, self.my_shard, self.osd.whoami))
+            src = int(m.src_name.id)
+            reply = MOSDECSubOpWriteReply(pg.pgid, m.tid, 0,
+                                          self.my_shard, self.osd.whoami)
+
+            def _committed():
+                # EC sub-op ack + last_complete ride the commit callback
+                # in submission order (see MOSDRepOp above)
+                if advance is not None:
+                    pg.complete_to(advance)
+                self.osd.send_osd(src, reply)
+
+            self.osd.store.queue_transactions([txn],
+                                              on_commit=_committed)
         elif isinstance(m, MOSDECSubOpRead):
             data, attrs = [], {}
             result = 0

@@ -172,6 +172,15 @@ class OSD(Dispatcher):
             pg.stop()
         self.monc.stop()
         await self.ec_queue.stop()
+        # drain the commit pipeline while the messenger still lives so
+        # pending ack callbacks send (or no-op) instead of erroring;
+        # a dead commit thread raises from sync() — teardown proceeds,
+        # the loss is already surfaced to writers
+        try:
+            self.store.sync()
+        except Exception:
+            self.logger.exception("store sync failed during stop")
+        await asyncio.sleep(0)
         await self.messenger.shutdown()
         self.store.umount()
 
@@ -595,15 +604,19 @@ class OSD(Dispatcher):
         for i in range(count):
             t = Transaction()
             t.write(cid, ObjectId(f"bench.{i}"), 0, payload)
-            self.store.apply_transaction(t)
+            # queue without waiting: the commit thread groups the whole
+            # burst into shared fsyncs (the path client IO rides too)
+            self.store.queue_transactions([t])
             await asyncio.sleep(0)
+        self.store.sync()
         dt = _time.perf_counter() - t0
         t = Transaction()
         t.remove_collection(cid)
         self.store.apply_transaction(t)
         return {"bytes_written": count * size, "seconds": round(dt, 4),
                 "bytes_per_sec": round(count * size / dt, 1)
-                if dt else 0.0}
+                if dt else 0.0,
+                "commit": self.store.commit_counters()}
 
     def _send_cluster_log(self, entry: dict) -> None:
         try:

@@ -1102,12 +1102,19 @@ class PG:
                 fut.set_result((m.info_bytes, m.log_bytes))
 
     def on_push(self, m: MPGPush) -> None:
-        self.backend.apply_push(m)
-        self.osd.send_osd(m.from_osd, MPGPushReply(
-            m.pgid, m.oid, self.osd.whoami))
-        fut = self._pull_waiters.get(m.oid)
-        if fut is not None and not fut.done():
-            fut.set_result(True)
+        def _ack():
+            # the ack (and any local pull waiter) fires from the store
+            # commit callback: a push is only acknowledged once the
+            # installed object — and the backfill cursor riding the
+            # same txn — is durable
+            self.osd.send_osd(m.from_osd, MPGPushReply(
+                m.pgid, m.oid, self.osd.whoami))
+            fut = self._pull_waiters.get(m.oid)
+            if fut is not None and not fut.done():
+                fut.set_result(True)
+
+        if not self.backend.apply_push(m, on_commit=_ack):
+            _ack()   # rejected push: nothing queued, ack immediately
 
     def on_object_list(self, m: MPGObjectList) -> None:
         ent = self._list_waiters.get(m.from_osd)
@@ -1428,14 +1435,24 @@ class PG:
                         self.info.last_update.version + 1)
 
     def append_log(self, txn: Transaction, entry: LogEntry) -> None:
+        """Advance the APPLIED state: log head + last_update move now
+        (read-your-writes, next_version monotonicity); last_complete —
+        the committed cursor — advances via complete_to from the store
+        commit callback, never ahead of durability."""
         self.log.append(entry)
         self.note_reqid(entry)
         self.info.last_update = entry.version
-        if not self.missing:
-            # a copy still owed recovery pulls keeps its honest cursor:
-            # new writes advance the head, not completeness
-            self.info.last_complete = entry.version
         self.save_meta(txn)
+
+    def complete_to(self, version: EVersion) -> None:
+        """Store commit callback: the txn carrying this log entry is
+        durable — advance last_complete.  Guarded against an interval
+        change that rewound the log mid-flight (never past last_update)
+        and against a copy still owed recovery pulls (its honest cursor
+        must keep exposing the gap)."""
+        if not self.missing and self.info.last_complete < version \
+                and version <= self.info.last_update:
+            self.info.last_complete = version
 
     def note_reqid(self, entry: LogEntry) -> None:
         if entry.reqid:

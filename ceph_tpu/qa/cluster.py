@@ -39,12 +39,16 @@ def make_ctx(name):
 
 
 class Cluster:
-    def __init__(self, ctx_factory=None):
+    def __init__(self, ctx_factory=None, store_factory=None):
         self.monmap = MonMap()
         self.mons = []
         self.osds = {}
         self.clients = []
         self.make_ctx = ctx_factory or make_ctx
+        # store_factory(osd_id) -> ObjectStore lets tests run OSDs on a
+        # durable backend (e.g. BlockStore on a tmp dir) instead of the
+        # MemStore default
+        self.store_factory = store_factory
 
     async def start(self, n_osds: int, osds_per_host: int = 1):
         self.monmap.fsid = "e2e-fsid"
@@ -71,7 +75,9 @@ class Cluster:
         # it (mkfs wipes), or restart-with-data scenarios silently test
         # recovery-from-peers instead
         fresh = store is None
-        store = store or MemStore()
+        if store is None:
+            store = (self.store_factory(i) if self.store_factory
+                     else MemStore())
         if fresh:
             store.mkfs()
         osd = OSD(ctx, i, store, msgr, self.monmap)

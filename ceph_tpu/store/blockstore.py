@@ -25,6 +25,14 @@ Redesign notes (vs the C++ original):
     surfaces as a shard error instead of silently returning rot.
   * clone copies extents (no shared-blob refcounting); clone_range and
     zero/truncate trim or copy at extent granularity.
+  * Commit is a group-committed pipeline (BlueStore kv_sync_thread):
+    queue_transactions applies data (pwrite) and metadata (kv memory)
+    inline — immediately readable — and a dedicated commit thread
+    issues ONE data fsync + ONE atomic kv WAL submit for every batch in
+    flight, preserving data-before-metadata and submission order, then
+    fires on_commit callbacks back on the event loop.  Freed COW blocks
+    return to the allocator only after their dereferencing metadata is
+    durable.
 """
 
 from __future__ import annotations
@@ -36,6 +44,7 @@ from typing import Dict, List, Optional, Tuple
 from ceph_tpu.common.crc import crc32c
 from ceph_tpu.common.xxhash import xxh32, xxh64
 from ceph_tpu.common.encoding import Decoder, Encodable, Encoder
+from ceph_tpu.store.commit import KVSyncThread
 from ceph_tpu.store.kv import FileDB, KVTransaction
 from ceph_tpu.store.objectstore import (
     NoSuchCollection, NoSuchObject, ObjectStore, StoreError, Transaction,
@@ -123,58 +132,68 @@ class Onode(Encodable):
 
 class Allocator:
     """Free-extent manager over the block file (Allocator.h bitmap/stupid
-    role, as a sorted free-range list)."""
+    role, as a sorted free-range list).  Thread-safe: freed COW blocks
+    are released from the commit thread once the metadata that stopped
+    referencing them is durable, while the event loop allocates."""
 
     def __init__(self):
+        import threading
+        self._mu = threading.Lock()
         self.free: List[List[int]] = []   # sorted [off, len]
         self.device_size = 0
 
     def init_add_free(self, off: int, length: int) -> None:
-        self.free.append([off, length])
-        self.free.sort()
-        self._coalesce()
+        with self._mu:
+            self.free.append([off, length])
+            self.free.sort()
+            self._coalesce()
 
     def init_rm_free(self, off: int, length: int) -> None:
         """Carve an allocated range out during mount rebuild."""
-        out = []
-        for f_off, f_len in self.free:
-            f_end, end = f_off + f_len, off + length
-            if f_end <= off or f_off >= end:
-                out.append([f_off, f_len])
-                continue
-            if f_off < off:
-                out.append([f_off, off - f_off])
-            if f_end > end:
-                out.append([end, f_end - end])
-        self.free = sorted(out)
+        with self._mu:
+            out = []
+            for f_off, f_len in self.free:
+                f_end, end = f_off + f_len, off + length
+                if f_end <= off or f_off >= end:
+                    out.append([f_off, f_len])
+                    continue
+                if f_off < off:
+                    out.append([f_off, off - f_off])
+                if f_end > end:
+                    out.append([end, f_end - end])
+            self.free = sorted(out)
 
     def allocate(self, length: int) -> List[Tuple[int, int]]:
         """-> [(disk_off, len)] covering length (may fragment); extends
         the device when free space runs out (file-backed device grows)."""
         need = length
         got: List[Tuple[int, int]] = []
-        while need > 0 and self.free:
-            off, ln = self.free[0]
-            take = min(ln, need)
-            got.append((off, take))
-            if take == ln:
-                self.free.pop(0)
-            else:
-                self.free[0] = [off + take, ln - take]
-            need -= take
-        if need > 0:
-            off = self.device_size
-            grow = (need + MIN_ALLOC - 1) // MIN_ALLOC * MIN_ALLOC
-            self.device_size += grow
-            got.append((off, need))
-            if grow > need:
-                self.init_add_free(off + need, grow - need)
+        with self._mu:
+            while need > 0 and self.free:
+                off, ln = self.free[0]
+                take = min(ln, need)
+                got.append((off, take))
+                if take == ln:
+                    self.free.pop(0)
+                else:
+                    self.free[0] = [off + take, ln - take]
+                need -= take
+            if need > 0:
+                off = self.device_size
+                grow = (need + MIN_ALLOC - 1) // MIN_ALLOC * MIN_ALLOC
+                self.device_size += grow
+                got.append((off, need))
+                if grow > need:
+                    self.free.append([off + need, grow - need])
+                    self.free.sort()
+                    self._coalesce()
         return got
 
     def release(self, off: int, length: int) -> None:
         self.init_add_free(off, length)
 
     def _coalesce(self) -> None:
+        # caller holds _mu
         out: List[List[int]] = []
         for off, ln in self.free:
             if out and out[-1][0] + out[-1][1] == off:
@@ -184,7 +203,8 @@ class Allocator:
         self.free = out
 
     def free_bytes(self) -> int:
-        return sum(ln for _, ln in self.free)
+        with self._mu:
+            return sum(ln for _, ln in self.free)
 
 
 def _oid_key(oid: ObjectId) -> bytes:
@@ -199,6 +219,28 @@ def _onode_key(cid: CollectionId, oid: ObjectId) -> bytes:
 
 def _omap_key(cid: CollectionId, oid: ObjectId, key: bytes) -> bytes:
     return _onode_key(cid, oid) + b"\x00" + key
+
+
+class _Batch:
+    """Call-local staging for ONE queue_transactions invocation.
+
+    Previously the overlay / wrote-data flag were instance attributes
+    mutated per call, so two interleaved callers corrupted each other's
+    staged kv — and the async commit path makes interleaving the norm.
+    """
+
+    __slots__ = ("ov", "freed", "dirty", "wrote_data")
+
+    def __init__(self):
+        # staged kv mutations: (prefix, key) -> value | None(delete).
+        # Reads during apply consult this overlay so ops see earlier
+        # ops of the SAME batch, while the db commits in ONE atomic
+        # KVTransaction at the end (anything less would tear the txn
+        # on crash)
+        self.ov: Dict[Tuple[str, bytes], Optional[bytes]] = {}
+        self.freed: List[Tuple[int, int]] = []
+        self.dirty: Dict[bytes, Optional[Onode]] = {}
+        self.wrote_data = False
 
 
 class BlockStore(ObjectStore):
@@ -221,6 +263,7 @@ class BlockStore(ObjectStore):
         self.alloc = Allocator()
         self._onodes: Dict[bytes, Onode] = {}    # write-through cache
         self.mounted = False
+        self._committer: Optional[KVSyncThread] = None
         self._comp = None
         if csum_type not in self.CSUM_FNS:
             raise StoreError(
@@ -289,32 +332,71 @@ class BlockStore(ObjectStore):
                 self.alloc.init_rm_free(ext.disk,
                                         _align_up(ext.disk_len))
         self._onodes = {}
+        # group-commit pipeline (BlueStore kv_sync_thread role): the
+        # event loop applies in memory; this thread batches the data
+        # fsync + kv WAL sync for every transaction in flight
+        self.db.pre_compact_hook = self._data_barrier
+        self._committer = KVSyncThread(
+            "blockstore_commit",
+            data_sync=self._data_barrier,
+            kv_sync=self.db.log_deferred)
+        self._committer.start()
         self.mounted = True
+
+    def _data_barrier(self) -> None:
+        if self._fd >= 0:
+            os.fsync(self._fd)
+
+    def sync(self) -> None:
+        """Block until every queued transaction is durable (flush)."""
+        if self._committer is not None:
+            self._committer.flush()
+
+    def commit_counters(self) -> Dict[str, float]:
+        return self._committer.counters() if self._committer else {}
 
     def umount(self) -> None:
         if not self.mounted:
             return
-        os.close(self._fd)
-        self._fd = -1
+        self._committer.stop()
+        self._committer = None
+        # close the db BEFORE the block fd: close() may still flush
+        # deferred kv records (dead commit thread) and its data barrier
+        # (pre_compact_hook -> _data_barrier) needs the fd open
         self.db.close()
         self.db = None
+        os.close(self._fd)
+        self._fd = -1
         self._onodes = {}
         self.mounted = False
 
     # ------------------------------------------------------------- helpers
-    def _coll_exists(self, cid: CollectionId) -> bool:
-        return self._kv_get(_PREFIX_COLL, cid.name.encode()) is not None
+    def _coll_exists(self, cid: CollectionId,
+                     b: Optional[_Batch] = None) -> bool:
+        return self._kv_get(_PREFIX_COLL, cid.name.encode(),
+                            b) is not None
 
     def _get_onode(self, cid: CollectionId, oid: ObjectId,
-                   create: bool = False) -> Onode:
+                   create: bool = False,
+                   b: Optional[_Batch] = None) -> Onode:
         key = _onode_key(cid, oid)
+        if b is not None and key in b.dirty and b.dirty[key] is None:
+            # removed earlier in THIS batch: the committed row must not
+            # resurrect (remove+write in one txn is apply_push's shape)
+            if not create:
+                raise NoSuchObject(f"{cid}/{oid}")
+            if not self._coll_exists(cid, b):
+                raise NoSuchCollection(str(cid))
+            on = Onode()
+            self._onodes[key] = on
+            return on
         on = self._onodes.get(key)
         if on is None:
-            raw = self._kv_get(_PREFIX_ONODE, key)
+            raw = self._kv_get(_PREFIX_ONODE, key, b)
             if raw is not None:
                 on = Onode.from_bytes(raw)
             elif create:
-                if not self._coll_exists(cid):
+                if not self._coll_exists(cid, b):
                     raise NoSuchCollection(str(cid))
                 on = Onode()
             else:
@@ -325,76 +407,85 @@ class BlockStore(ObjectStore):
     # -------------------------------------------------------------- writes
     def queue_transactions(self, txns, on_applied=None,
                            on_commit=None) -> None:
+        """Apply data + metadata in memory, then hand the staged kv
+        batch to the commit thread: ONE data fsync + ONE atomic kv
+        submit cover every batch in flight (group commit).  on_applied
+        fires inline (state is readable); on_commit fires from the
+        commit thread once the batch is durable, in submission order."""
         assert self.mounted, "blockstore not mounted"
-        # staged kv mutations: (prefix, key) -> value | None(delete).
-        # Reads during apply consult this overlay so ops see earlier ops
-        # of the SAME batch, while the db commits in ONE atomic
-        # KVTransaction at the end (anything less would tear the txn on
-        # crash)
-        self._overlay: Dict[Tuple[str, bytes], Optional[bytes]] = {}
-        freed: List[Tuple[int, int]] = []
-        dirty: Dict[bytes, Optional[Onode]] = {}
-        self._wrote_data = False
+        if self._committer is not None and self._committer.dead:
+            # the commit thread died (fsync error / injected crash):
+            # accepting more writes would apply them in memory with no
+            # path to durability and no acks — fail loudly so the OSD
+            # surfaces the wedge instead of serving phantom writes
+            raise StoreError("blockstore commit thread is dead")
+        b = _Batch()                     # call-local: reentrancy-safe
         try:
             for txn in txns:
                 for op in txn.ops:
-                    self._apply_op(op, freed, dirty)
+                    self._apply_op(op, b)
         except Exception:
             # roll back every trace of the failed batch: staged kv is
             # dropped, the onode cache may hold in-place mutations so it
             # is flushed wholesale (it is only a cache), and blocks
             # allocated for the doomed writes leak until the next mount
             # rebuild reclaims them
-            self._overlay = {}
             self._onodes = {}
             raise
-        if self._wrote_data:
-            os.fsync(self._fd)        # data before metadata, always
-        for key, on in dirty.items():
+        for key, on in b.dirty.items():
             if on is None:
-                self._stage(_PREFIX_ONODE, key, None)
+                self._stage(b, _PREFIX_ONODE, key, None)
                 self._onodes.pop(key, None)
             else:
-                self._stage(_PREFIX_ONODE, key, on.to_bytes())
+                self._stage(b, _PREFIX_ONODE, key, on.to_bytes())
                 self._onodes[key] = on
         batch = KVTransaction()
-        for (prefix, key), val in self._overlay.items():
+        for (prefix, key), val in b.ov.items():
             if val is None:
                 batch.rmkey(prefix, key)
             else:
                 batch.set(prefix, key, val)
-        self._overlay = {}
-        self.db.submit(batch, sync=True)
-        # old blocks become reusable only after metadata no longer
-        # references them (COW ordering)
-        for off, ln in freed:
-            self.alloc.release(off, ln)
+        # memory-apply now (read-your-writes for every later caller);
+        # the WAL record becomes durable on the commit thread
+        seq = self.db.submit_deferred(batch)
         self.applied_seq += 1
         if on_applied:
             on_applied()
-        if on_commit:
-            on_commit()
+        post = None
+        if b.freed:
+            freed = b.freed
+
+            def post():
+                # old blocks become reusable only after the metadata
+                # that dereferenced them is DURABLE (COW ordering): a
+                # reuse before that could overwrite blocks a replayed
+                # old onode still references
+                for off, ln in freed:
+                    self.alloc.release(off, ln)
+        self._committer.submit(seq=seq, wrote_data=b.wrote_data,
+                               on_commit=on_commit, post=post)
 
     # --- staged kv views (overlay over the committed db) ---
-    def _stage(self, prefix: str, key: bytes,
+    @staticmethod
+    def _stage(b: _Batch, prefix: str, key: bytes,
                val: Optional[bytes]) -> None:
-        self._overlay[(prefix, key)] = val
+        b.ov[(prefix, key)] = val
 
-    def _kv_get(self, prefix: str, key: bytes) -> Optional[bytes]:
-        ov = getattr(self, "_overlay", None)
-        if ov is not None and (prefix, key) in ov:
-            return ov[(prefix, key)]
+    def _kv_get(self, prefix: str, key: bytes,
+                b: Optional[_Batch] = None) -> Optional[bytes]:
+        if b is not None and (prefix, key) in b.ov:
+            return b.ov[(prefix, key)]
         return self.db.get(prefix, key)
 
-    def _kv_keys(self, prefix: str, pre: bytes = b"") -> List[bytes]:
+    def _kv_keys(self, prefix: str, pre: bytes = b"",
+                 b: Optional[_Batch] = None) -> List[bytes]:
         """Keys under `prefix` starting with `pre`, overlay-aware; the
         committed side is a bounded range scan, not a full-prefix walk."""
         end = _prefix_end(pre) if pre else None
         keys = {k for k, _ in self.db.iterate(prefix, start=pre,
                                               end=end)}
-        ov = getattr(self, "_overlay", None)
-        if ov:
-            for (p, k), v in ov.items():
+        if b is not None:
+            for (p, k), v in b.ov.items():
                 if p != prefix or not k.startswith(pre):
                     continue
                 if v is None:
@@ -403,61 +494,61 @@ class BlockStore(ObjectStore):
                     keys.add(k)
         return sorted(keys)
 
-    def _apply_op(self, op, freed: List[Tuple[int, int]],
-                  dirty: Dict[bytes, Optional[Onode]]) -> None:
-        """Apply one op; any block-file write sets self._wrote_data."""
+    def _apply_op(self, op, b: _Batch) -> None:
+        """Apply one op; any block-file write sets b.wrote_data."""
         c, o = op.cid, op.oid
+        freed, dirty = b.freed, b.dirty
         if op.op == OP_NOP:
             return
         if op.op == OP_MKCOLL:
-            self._stage(_PREFIX_COLL, c.name.encode(), b"")
+            self._stage(b, _PREFIX_COLL, c.name.encode(), b"")
             return
         if op.op == OP_RMCOLL:
-            if not self._coll_exists(c):
+            if not self._coll_exists(c, b):
                 return       # removal of missing collection: no-op
             for oid in self.collection_list(c):
-                self._remove_object(c, oid, freed, dirty)
-            self._stage(_PREFIX_COLL, c.name.encode(), None)
+                self._remove_object(c, oid, b)
+            self._stage(b, _PREFIX_COLL, c.name.encode(), None)
             return
         if op.op == OP_TOUCH:
             key = _onode_key(c, o)
-            dirty[key] = self._get_onode(c, o, create=True)
+            dirty[key] = self._get_onode(c, o, create=True, b=b)
             return
         if op.op == OP_WRITE:
-            on = self._get_onode(c, o, create=True)
-            self._write_range(on, op.off, op.data, freed)
+            on = self._get_onode(c, o, create=True, b=b)
+            self._write_range(on, op.off, op.data, b)
             dirty[_onode_key(c, o)] = on
             return
         if op.op == OP_ZERO:
-            on = self._get_onode(c, o, create=True)
-            self._punch(on, op.off, op.length, freed)
+            on = self._get_onode(c, o, create=True, b=b)
+            self._punch(on, op.off, op.length, b)
             on.size = max(on.size, op.off + op.length)
             dirty[_onode_key(c, o)] = on
             return
         if op.op == OP_TRUNCATE:
-            on = self._get_onode(c, o, create=True)
+            on = self._get_onode(c, o, create=True, b=b)
             size = op.off
-            self._punch(on, size, max(on.size - size, 0), freed)
+            self._punch(on, size, max(on.size - size, 0), b)
             on.size = size
             dirty[_onode_key(c, o)] = on
             return
         if op.op == OP_REMOVE:
-            self._remove_object(c, o, freed, dirty)
+            self._remove_object(c, o, b)
             return
         if op.op == OP_SETATTR:
-            on = self._get_onode(c, o, create=True)
+            on = self._get_onode(c, o, create=True, b=b)
             on.attrs[op.name] = op.data
             dirty[_onode_key(c, o)] = on
             return
         if op.op == OP_SETATTRS:
-            on = self._get_onode(c, o, create=True)
+            on = self._get_onode(c, o, create=True, b=b)
             for k, v in op.kv.items():
                 on.attrs[k.decode("utf-8")] = v
             dirty[_onode_key(c, o)] = on
             return
         if op.op == OP_RMATTR:
             try:
-                on = self._get_onode(c, o)
+                on = self._get_onode(c, o, b=b)
             except StoreError:
                 return       # destructive op on missing: no-op
             on.attrs.pop(op.name, None)
@@ -465,18 +556,18 @@ class BlockStore(ObjectStore):
             return
         if op.op == OP_CLONE:
             try:
-                src = self._get_onode(c, o)
+                src = self._get_onode(c, o, b=b)
             except StoreError:
                 return       # clone of missing: no-op
             # clone REPLACES the destination (memstore semantics): old
             # extents freed, old omap dropped
             try:
-                old = self._get_onode(c, op.oid2)
+                old = self._get_onode(c, op.oid2, b=b)
                 for ext in old.extents:
                     freed.append((ext.disk, _align_up(ext.disk_len)))
                 pre_old = _omap_key(c, op.oid2, b"")
-                for k in self._kv_keys(_PREFIX_OMAP, pre_old):
-                    self._stage(_PREFIX_OMAP, k, None)
+                for k in self._kv_keys(_PREFIX_OMAP, pre_old, b):
+                    self._stage(b, _PREFIX_OMAP, k, None)
                 self._onodes.pop(_onode_key(c, op.oid2), None)
             except StoreError:
                 pass
@@ -484,50 +575,50 @@ class BlockStore(ObjectStore):
             dst = Onode()
             dst.attrs = dict(src.attrs)
             dst.omap_header = src.omap_header
-            self._write_range(dst, 0, data, freed)
+            self._write_range(dst, 0, data, b)
             dst.size = src.size
             # omap copies too (clone carries omap in the reference)
             if src.has_omap:
                 dst.has_omap = True
                 pre = _omap_key(c, o, b"")
-                for k in self._kv_keys(_PREFIX_OMAP, pre):
-                    self._stage(_PREFIX_OMAP,
+                for k in self._kv_keys(_PREFIX_OMAP, pre, b):
+                    self._stage(b, _PREFIX_OMAP,
                                 _omap_key(c, op.oid2, k[len(pre):]),
-                                self._kv_get(_PREFIX_OMAP, k))
+                                self._kv_get(_PREFIX_OMAP, k, b))
             dirty[_onode_key(c, op.oid2)] = dst
             return
         if op.op == OP_CLONERANGE2:
             try:
-                src = self._get_onode(c, o)
+                src = self._get_onode(c, o, b=b)
             except StoreError:
                 return
 
             data = self._read_onode(src, op.off, op.length)
             try:
-                dst = self._get_onode(c, op.oid2, create=True)
+                dst = self._get_onode(c, op.oid2, create=True, b=b)
             except NoSuchObject:
                 dst = Onode()
-            self._write_range(dst, op.dest_off, data, freed)
+            self._write_range(dst, op.dest_off, data, b)
             dirty[_onode_key(c, op.oid2)] = dst
             return
         if op.op == OP_COLL_MOVE_RENAME or op.op == OP_TRY_RENAME:
             newcid = op.cid2 or c
             try:
-                src = self._get_onode(c, o)
+                src = self._get_onode(c, o, b=b)
             except NoSuchObject:
                 if op.op == OP_TRY_RENAME:
                     return
                 raise
             # rename replaces any existing destination
             try:
-                old = self._get_onode(newcid, op.oid2)
+                old = self._get_onode(newcid, op.oid2, b=b)
                 if old is not src:
                     for ext in old.extents:
                         freed.append((ext.disk, _align_up(ext.disk_len)))
                     for k in self._kv_keys(_PREFIX_OMAP,
                                            _omap_key(newcid, op.oid2,
-                                                     b"")):
-                        self._stage(_PREFIX_OMAP, k, None)
+                                                     b""), b):
+                        self._stage(b, _PREFIX_OMAP, k, None)
                     self._onodes.pop(_onode_key(newcid, op.oid2), None)
             except StoreError:
                 pass
@@ -535,64 +626,64 @@ class BlockStore(ObjectStore):
             self._onodes.pop(_onode_key(c, o), None)
             dirty[_onode_key(newcid, op.oid2)] = src
             pre = _omap_key(c, o, b"")
-            for k in self._kv_keys(_PREFIX_OMAP, pre):
-                self._stage(_PREFIX_OMAP,
+            for k in self._kv_keys(_PREFIX_OMAP, pre, b):
+                self._stage(b, _PREFIX_OMAP,
                             _omap_key(newcid, op.oid2, k[len(pre):]),
-                            self._kv_get(_PREFIX_OMAP, k))
-                self._stage(_PREFIX_OMAP, k, None)
+                            self._kv_get(_PREFIX_OMAP, k, b))
+                self._stage(b, _PREFIX_OMAP, k, None)
             return
         if op.op == OP_OMAP_CLEAR:
             try:
-                self._get_onode(c, o)
+                self._get_onode(c, o, b=b)
             except StoreError:
                 return
 
             pre = _omap_key(c, o, b"")
-            for k in self._kv_keys(_PREFIX_OMAP, pre):
-                self._stage(_PREFIX_OMAP, k, None)
+            for k in self._kv_keys(_PREFIX_OMAP, pre, b):
+                self._stage(b, _PREFIX_OMAP, k, None)
             return
         if op.op == OP_OMAP_SETKEYS:
-            on = self._get_onode(c, o, create=True)
+            on = self._get_onode(c, o, create=True, b=b)
             on.has_omap = True
             dirty[_onode_key(c, o)] = on
             for k, v in op.kv.items():
-                self._stage(_PREFIX_OMAP, _omap_key(c, o, k), v)
+                self._stage(b, _PREFIX_OMAP, _omap_key(c, o, k), v)
             return
         if op.op == OP_OMAP_RMKEYS:
             for k in op.keys:
-                self._stage(_PREFIX_OMAP, _omap_key(c, o, k), None)
+                self._stage(b, _PREFIX_OMAP, _omap_key(c, o, k), None)
             return
         if op.op == OP_OMAP_RMKEYRANGE:
             first, last = op.keys
             pre = _omap_key(c, o, b"")
-            for k in self._kv_keys(_PREFIX_OMAP, pre):
+            for k in self._kv_keys(_PREFIX_OMAP, pre, b):
                 if first <= k[len(pre):] < last:
-                    self._stage(_PREFIX_OMAP, k, None)
+                    self._stage(b, _PREFIX_OMAP, k, None)
             return
         if op.op == OP_OMAP_SETHEADER:
-            on = self._get_onode(c, o, create=True)
+            on = self._get_onode(c, o, create=True, b=b)
             on.omap_header = op.data
             dirty[_onode_key(c, o)] = on
             return
         raise StoreError(f"blockstore: unsupported op {op.op}")
 
-    def _remove_object(self, cid, oid, freed, dirty) -> None:
+    def _remove_object(self, cid, oid, b: _Batch) -> None:
         try:
-            on = self._get_onode(cid, oid)
+            on = self._get_onode(cid, oid, b=b)
         except NoSuchObject:
             return
         for ext in on.extents:
-            freed.append((ext.disk, _align_up(ext.disk_len)))
+            b.freed.append((ext.disk, _align_up(ext.disk_len)))
         pre = _omap_key(cid, oid, b"")
-        for k in self._kv_keys(_PREFIX_OMAP, pre):
-            self._stage(_PREFIX_OMAP, k, None)
-        dirty[_onode_key(cid, oid)] = None
+        for k in self._kv_keys(_PREFIX_OMAP, pre, b):
+            self._stage(b, _PREFIX_OMAP, k, None)
+        b.dirty[_onode_key(cid, oid)] = None
         self._onodes.pop(_onode_key(cid, oid), None)
 
     # COW write: merge-affected old extents are read, the merged span is
     # written to fresh blocks, old blocks freed post-commit
     def _write_range(self, on: Onode, off: int, data: bytes,
-                     freed: List[Tuple[int, int]]) -> None:
+                     b: _Batch) -> None:
         if not data:
             on.size = max(on.size, off)
             return
@@ -613,14 +704,14 @@ class BlockStore(ObjectStore):
         for ext in drop:
             span[ext.logical - lo:ext.logical - lo + ext.length] = \
                 self._pread_checked(ext)
-            freed.append((ext.disk, _align_up(ext.disk_len)))
+            b.freed.append((ext.disk, _align_up(ext.disk_len)))
         span[off - lo:end - lo] = data
-        on.extents = sorted(keep + self._rewrite(lo, bytes(span)),
+        on.extents = sorted(keep + self._rewrite(lo, bytes(span), b),
                             key=lambda e: e.logical)
         on.size = max(on.size, end)
 
     def _punch(self, on: Onode, off: int, length: int,
-               freed: List[Tuple[int, int]]) -> None:
+               b: _Batch) -> None:
         if length <= 0:
             return
         end = off + length
@@ -631,16 +722,17 @@ class BlockStore(ObjectStore):
                 out.append(ext)
                 continue
             data = self._pread_checked(ext)
-            freed.append((ext.disk, _align_up(ext.disk_len)))
+            b.freed.append((ext.disk, _align_up(ext.disk_len)))
             if ext.logical < off:
                 head = data[:off - ext.logical]
-                out.extend(self._rewrite(ext.logical, head))
+                out.extend(self._rewrite(ext.logical, head, b))
             if e_end > end:
                 tail = data[end - ext.logical:]
-                out.extend(self._rewrite(end, tail))
+                out.extend(self._rewrite(end, tail, b))
         on.extents = sorted(out, key=lambda e: e.logical)
 
-    def _rewrite(self, logical: int, data: bytes) -> List[Extent]:
+    def _rewrite(self, logical: int, data: bytes,
+                 b: _Batch) -> List[Extent]:
         exts = []
         pos = 0
         for d_off, d_len in self.alloc.allocate(_align_up(len(data))):
@@ -650,12 +742,12 @@ class BlockStore(ObjectStore):
                 continue
             chunk = data[pos:pos + take]
             exts.append(self._store_piece(logical + pos, chunk, d_off,
-                                          d_len))
+                                          d_len, b))
             pos += take
         return exts
 
     def _store_piece(self, logical: int, chunk: bytes, d_off: int,
-                     d_len: int) -> Extent:
+                     d_len: int, b: _Batch) -> Extent:
         """Write one contiguous piece, compressing when it pays
         (bluestore_compression_required_ratio role: stored bytes must
         save at least one alloc unit)."""
@@ -666,7 +758,7 @@ class BlockStore(ObjectStore):
             if _align_up(len(cand)) < _align_up(len(chunk)):
                 stored, alg = cand, self._comp.name
         os.pwrite(self._fd, stored, d_off)
-        self._wrote_data = True
+        b.wrote_data = True
         used = _align_up(len(stored))
         if used < d_len:
             self.alloc.release(d_off + used, d_len - used)

@@ -1,0 +1,303 @@
+"""Group-commit thread: many in-flight transactions share one fsync.
+
+Reference parity: os/bluestore/BlueStore.cc ``_kv_sync_thread`` — the
+event loop (or op threads) stage transactions cheaply in memory and a
+dedicated thread drains the backlog, issuing ONE data-device barrier and
+ONE atomic kv submit for the whole group, then completes the commit
+callbacks in submission order.  The store's ``queue_transactions``
+becomes "apply + enqueue"; durability (and therefore repop acks, client
+acks, pglog last_complete) rides the callback.
+
+Invariants the thread preserves:
+  * data before metadata — the group's data fsync happens strictly
+    before its kv records are made durable (COW crash rule);
+  * submission order — kv records are logged in seq order and commit
+    callbacks fire in the exact order transactions were submitted;
+  * bounded backlog — the queue is bounded; a producer outrunning the
+    disk blocks on enqueue (Throttle role) instead of ballooning RAM.
+
+Fault injection for crash-ordering tests: ``crash_at`` kills the thread
+at a named point ("before_data_sync" | "before_kv") leaving the store
+exactly as a power cut at that instant would; ``trace`` observes the
+stage sequence without perturbing it.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ceph_tpu.common.perf_counters import PerfCounters
+
+_log = logging.getLogger("ceph-tpu.store.commit")
+
+_STOP = object()
+
+
+class _Item:
+    __slots__ = ("seq", "wrote_data", "on_commit", "post", "loop", "t0")
+
+    def __init__(self, seq, wrote_data, on_commit, post, loop):
+        self.seq = seq
+        self.wrote_data = wrote_data
+        self.on_commit = on_commit
+        self.post = post
+        self.loop = loop
+        self.t0 = time.perf_counter()
+
+
+class InjectedCrash(Exception):
+    """Raised on the commit thread by the crash_at fault hook."""
+
+
+class KVSyncThread:
+    """One per mounted store.
+
+    data_sync() -- durability barrier for the data device (optional).
+    kv_sync(upto_seq) -- make every staged kv record with seq <=
+    upto_seq durable in ONE atomic submit (optional).
+    """
+
+    QUEUE_MAX = 1024        # backlog bound (bluestore throttle role)
+
+    def __init__(self, name: str,
+                 data_sync: Optional[Callable[[], None]] = None,
+                 kv_sync: Optional[Callable[[int], None]] = None,
+                 queue_max: int = QUEUE_MAX,
+                 gather_window: float = 0.0):
+        self.data_sync = data_sync
+        self.kv_sync = kv_sync
+        #: seconds to linger after the first item of a group so bursts
+        #: coalesce.  Stores whose commit has real cost (fsync) batch
+        #: naturally and leave this 0; RAM-backed stores set a tiny
+        #: window so group commit still engages under concurrency.
+        self.gather_window = gather_window
+        self.perf = PerfCounters(name)
+        for key in ("commit_batches", "txns", "data_fsyncs", "kv_syncs",
+                    "fsyncs_saved"):
+            self.perf.add_u64(key)
+        self.perf.add_avg("txns_per_batch")
+        self.perf.add_time("commit_lat")
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_max)
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._submitted = 0
+        self._completed = 0
+        # event-loop-side cork: submissions staged within one loop pass
+        # ship to the thread as ONE queue put (one lock round + one GIL
+        # handoff per pass instead of per transaction — the handoffs,
+        # not the queue, are what tax a busy event loop)
+        self._staged: List[_Item] = []
+        self._flush_scheduled = False
+        self.dead = False           # crashed (fault injection) or error
+        # --- test hooks ---
+        self.trace: Optional[Callable[[str, int], None]] = None
+        self.crash_at: Optional[str] = None
+        self.gate: Optional[threading.Event] = None   # holds the thread
+        #     before it takes its next group (deterministic batching)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="kv_sync_thread")
+        self._thread.start()
+
+    def submit(self, seq: int = 0, wrote_data: bool = False,
+               on_commit: Optional[Callable[[], None]] = None,
+               post: Optional[Callable[[], None]] = None) -> None:
+        """Enqueue one staged transaction batch.  Blocks (backpressure)
+        when the commit backlog is full.  Captures the running event
+        loop, if any, so callbacks are posted back to it; without a
+        loop they run on the commit thread itself, still in order.
+
+        With a loop, items cork on the loop side and ship to the thread
+        once per loop pass (call_soon flush) — submission order within
+        and across passes is preserved."""
+        loop = None
+        try:
+            import asyncio
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            pass
+        with self._lock:
+            self._submitted += 1
+        item = _Item(seq, wrote_data, on_commit, post, loop)
+        if loop is None:
+            self._q.put([item])
+            return
+        self._staged.append(item)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            loop.call_soon(self._flush_staged)
+
+    def _flush_staged(self) -> None:
+        self._flush_scheduled = False
+        if not self._staged:
+            return
+        items, self._staged = self._staged, []
+        self._q.put(items)
+
+    def flush(self, timeout: float = 60.0) -> None:
+        """Wait until every submitted batch is durable (callbacks may
+        still be pending on their event loop).  Ships any corked items
+        first.  Call from the submitting (event-loop) thread or from
+        loop-less code — a foreign thread racing the loop's scheduled
+        cork flush could put groups out of submission order.  Raises
+        when the thread is dead: returning quietly would let
+        sync()/apply_transaction report durability that never
+        happened."""
+        self._flush_staged()
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._completed < self._submitted and not self.dead:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError("commit flush timed out")
+                self._cv.wait(left)
+        if self.dead:
+            from ceph_tpu.store.objectstore import StoreError
+            raise StoreError("commit thread is dead; queued "
+                             "transactions were never made durable")
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        if not self.dead:
+            try:
+                self.flush()
+            except Exception:
+                pass   # teardown is best-effort; dead is handled below
+        self._q.put(_STOP)
+        self._thread.join(timeout=30.0)
+        self._thread = None
+
+    # ------------------------------------------------------------- internal
+    def _run(self) -> None:
+        while True:
+            got = self._q.get()
+            if got is _STOP:
+                return
+            if self.gate is not None:
+                self.gate.wait()
+            if self.gather_window > 0.0:
+                time.sleep(self.gather_window)
+            group: List[_Item] = list(got)
+            stop_after = False
+            while True:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    stop_after = True
+                    break
+                group.extend(nxt)
+            if self.dead:
+                self._finish(group)     # crashed: account, do nothing
+            else:
+                try:
+                    self._commit(group)
+                except InjectedCrash:
+                    self.dead = True
+                    self._finish(group)
+                except Exception:
+                    _log.exception("commit thread failed; store is dead")
+                    self.dead = True
+                    self._finish(group)
+            if stop_after:
+                return
+
+    def _inject(self, point: str, group: List[_Item]) -> None:
+        if self.trace is not None:
+            self.trace(point, len(group))
+        if self.crash_at == point:
+            raise InjectedCrash(point)
+
+    def _commit(self, group: List[_Item]) -> None:
+        self._inject("before_data_sync", group)
+        n_data = sum(1 for it in group if it.wrote_data)
+        if n_data and self.data_sync is not None:
+            self.data_sync()            # ONE barrier for the whole group
+            self.perf.inc("data_fsyncs")
+        self._inject("before_kv", group)
+        if self.kv_sync is not None:
+            # ONE atomic kv submit covering every record of the group,
+            # strictly after the data barrier (data-before-metadata)
+            self.kv_sync(max(it.seq for it in group))
+            self.perf.inc("kv_syncs")
+        self._inject("committed", group)
+        now = time.perf_counter()
+        self.perf.inc("commit_batches")
+        self.perf.inc("txns", len(group))
+        self.perf.tinc("txns_per_batch", len(group))
+        # the synchronous path would have paid one data fsync per
+        # data-writing txn plus one kv sync per txn; the group paid at
+        # most one of each.  Only barriers this store ACTUALLY has
+        # count — a RAM-backed store (no hooks) saves nothing.
+        would_have = (n_data if self.data_sync is not None else 0) \
+            + (len(group) if self.kv_sync is not None else 0)
+        actual = (1 if n_data and self.data_sync is not None else 0) \
+            + (1 if self.kv_sync is not None else 0)
+        self.perf.inc("fsyncs_saved", max(0, would_have - actual))
+        for it in group:
+            self.perf.tinc("commit_lat", now - it.t0)
+        self._complete(group)
+        with self._cv:
+            self._completed += len(group)
+            self._cv.notify_all()
+
+    def _finish(self, group: List[_Item]) -> None:
+        """Crashed path: account the items so flush() can't hang, but
+        run NO callbacks — these transactions never committed."""
+        with self._cv:
+            self._completed += len(group)
+            self._cv.notify_all()
+
+    def _complete(self, group: List[_Item]) -> None:
+        for it in group:
+            fns = [f for f in (it.on_commit, it.post) if f is not None]
+            if not fns:
+                continue
+            if it.loop is not None and not it.loop.is_closed():
+                # submission order is preserved: call_soon_threadsafe
+                # enqueues callbacks FIFO on the loop
+                for f in fns:
+                    try:
+                        it.loop.call_soon_threadsafe(self._guard, f)
+                    except RuntimeError:
+                        self._guard(f)   # loop closed mid-flight
+            else:
+                for f in fns:
+                    self._guard(f)
+
+    @staticmethod
+    def _guard(fn: Callable[[], None]) -> None:
+        try:
+            fn()
+        except Exception:
+            _log.exception("commit callback failed")
+
+    # ---------------------------------------------------------- inspection
+    def counters(self) -> dict:
+        d = self.perf.dump()
+        tpb = d.get("txns_per_batch", {})
+        lat = d.get("commit_lat", {})
+        n_b = tpb.get("avgcount", 0) or 0
+        n_l = lat.get("avgcount", 0) or 0
+        return {
+            "commit_batches": d.get("commit_batches", 0),
+            "txns": d.get("txns", 0),
+            "data_fsyncs": d.get("data_fsyncs", 0),
+            "kv_syncs": d.get("kv_syncs", 0),
+            "fsyncs": d.get("data_fsyncs", 0) + d.get("kv_syncs", 0),
+            "fsyncs_saved": d.get("fsyncs_saved", 0),
+            "txns_per_batch": (tpb.get("sum", 0.0) / n_b) if n_b else 0.0,
+            "commit_lat_ms": (lat.get("sum", 0.0) / n_l * 1e3)
+            if n_l else 0.0,
+        }

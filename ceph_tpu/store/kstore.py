@@ -31,7 +31,8 @@ from typing import Dict, List, Optional, Tuple
 from ceph_tpu.common.encoding import Decoder, Encoder
 from ceph_tpu.store.kv import FileDB, KeyValueDB, KVTransaction, MemDB
 from ceph_tpu.store.objectstore import (NoSuchCollection, NoSuchObject,
-                                        ObjectStore, Transaction, TxOp,
+                                        ObjectStore, StoreError,
+                                        Transaction, TxOp,
                                         OP_NOP, OP_TOUCH, OP_WRITE,
                                         OP_ZERO, OP_TRUNCATE, OP_REMOVE,
                                         OP_SETATTR, OP_SETATTRS,
@@ -131,6 +132,7 @@ class KStore(ObjectStore):
         self.db: Optional[KeyValueDB] = None
         # cid -> {oid key bytes -> ObjectId}
         self._objs: Dict[bytes, Dict[bytes, ObjectId]] = {}
+        self._committer = None
 
     # ------------------------------------------------------------ keys
     @staticmethod
@@ -160,8 +162,19 @@ class KStore(ObjectStore):
             ck, ok = mk[:clen], mk[clen:]
             oid = ObjectId.from_bytes(ok)
             self._objs.setdefault(ck, {})[ok] = oid
+        # group commit: transactions apply to memory inline; the commit
+        # thread makes the whole backlog durable with ONE WAL fsync
+        # (a MemDB substrate has no deferral — log_deferred is a no-op
+        # and the thread only groups/orders the commit callbacks)
+        from ceph_tpu.store.commit import KVSyncThread
+        self._committer = KVSyncThread("kstore_commit",
+                                       kv_sync=self.db.log_deferred)
+        self._committer.start()
 
     def umount(self) -> None:
+        if self._committer is not None:
+            self._committer.stop()
+            self._committer = None
         if self.db is not None:
             self.db.close()
             self.db = None
@@ -169,16 +182,30 @@ class KStore(ObjectStore):
     # ---------------------------------------------------------- writes
     def queue_transactions(self, txns: List[Transaction],
                            on_applied=None, on_commit=None) -> None:
+        if self._committer is not None and self._committer.dead:
+            # dead commit thread = WAL never syncs, acks never fire
+            raise StoreError("kstore commit thread is dead")
         tx = _Txn(self.db)
         for txn in txns:
             for op in txn.ops:
                 self._apply_op(tx, op)
-        self.db.submit(tx.kvt, sync=True)
+        # memory-apply now (read-your-writes); WAL durability rides the
+        # commit thread so concurrent batches share one fsync
+        seq = self.db.submit_deferred(tx.kvt)
         self.applied_seq += 1
         if on_applied:
             on_applied()
-        if on_commit:
+        if self._committer is not None:
+            self._committer.submit(seq=seq, on_commit=on_commit)
+        elif on_commit:
             on_commit()
+
+    def sync(self) -> None:
+        if self._committer is not None:
+            self._committer.flush()
+
+    def commit_counters(self) -> Dict[str, float]:
+        return self._committer.counters() if self._committer else {}
 
     def _onode(self, tx: _Txn, okey: bytes,
                create: bool) -> Optional[_Onode]:

@@ -20,8 +20,9 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 from bisect import bisect_left
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from ceph_tpu.store.wal import WriteAheadLog, atomic_snapshot
 
@@ -84,11 +85,28 @@ class KVTransaction:
 class KeyValueDB:
     """Abstract ordered kv store."""
 
+    #: True when submit_deferred really defers durability (FileDB);
+    #: backends without a durability cost just apply immediately
+    supports_deferred = False
+
     def create_transaction(self) -> KVTransaction:
         return KVTransaction()
 
     def submit(self, txn: KVTransaction, sync: bool = True) -> None:
         raise NotImplementedError
+
+    def submit_deferred(self, txn: KVTransaction) -> int:
+        """Apply txn to the visible (in-memory) state NOW; its
+        durability is deferred until ``log_deferred`` covers the
+        returned seq.  Default: no durability substrate — plain
+        submit."""
+        self.submit(txn, sync=True)
+        return 0
+
+    def log_deferred(self, upto_seq: int) -> int:
+        """Make every deferred record with seq <= upto_seq durable in
+        one group (single WAL fsync).  Returns the record count."""
+        return 0
 
     def get(self, prefix: str, key) -> Optional[bytes]:
         raise NotImplementedError
@@ -190,15 +208,31 @@ class FileDB(MemDB):
     fsync'd — the reference's journal-ahead rule (os/filestore/FileJournal).
     A torn tail record (bad crc / short read) is discarded and truncated on
     replay (wal.WriteAheadLog), exactly like the reference journal replay.
+
+    Group commit: ``submit_deferred`` applies to memory immediately
+    (read-your-writes for the event loop) and stages the encoded record;
+    a commit thread later calls ``log_deferred(upto_seq)`` to append the
+    whole backlog with ONE fsync (the BlueStore kv_sync_thread recipe).
+    All memory/WAL mutation and read paths take one RLock so the commit
+    thread and the event loop can share the instance; ``iterate``
+    materializes its rows under the lock for the same reason.
     """
 
     COMPACT_BYTES = 8 << 20
+
+    supports_deferred = True
 
     def __init__(self, path: str):
         super().__init__()
         self.path = path
         os.makedirs(path, exist_ok=True)
         self.seq = 0
+        self._mu = threading.RLock()
+        self._deferred: List[Tuple[int, bytes]] = []
+        #: called (under the lock) right before a snapshot compaction;
+        #: BlockStore points it at its data-device fsync so a snapshot
+        #: can never persist metadata whose data blocks aren't durable
+        self.pre_compact_hook: Optional[Callable[[], None]] = None
         self._load_snapshot()
         self._wal = WriteAheadLog(self._wal_path())
         for seq, payload in self._wal.replay():
@@ -229,24 +263,94 @@ class FileDB(MemDB):
             self._insert(k, v)
 
     def submit(self, txn: KVTransaction, sync: bool = True) -> None:
-        payload = txn.encode()
-        self._wal.append(self.seq + 1, payload, sync=sync)
-        self.seq += 1   # only after the record is durable
-        super().submit(txn)
-        if self._wal.size() > self.COMPACT_BYTES:
-            self.compact()
+        with self._mu:
+            if self._deferred:
+                # seqs must hit the WAL in order: flush the deferred
+                # backlog before appending a synchronous record — after
+                # the data barrier, since those records' data blocks may
+                # be pwritten but not yet fsync'd (data-before-metadata)
+                if self.pre_compact_hook is not None:
+                    self.pre_compact_hook()
+                self.log_deferred(self.seq)
+            payload = txn.encode()
+            self._wal.append(self.seq + 1, payload, sync=sync)
+            self.seq += 1   # only after the record is durable
+            super().submit(txn)
+            if self._wal.size() > self.COMPACT_BYTES:
+                self.compact()
+
+    def submit_deferred(self, txn: KVTransaction) -> int:
+        """Memory-apply now, WAL later (group commit).  A crash before
+        log_deferred loses the record — which is exactly the window the
+        store's on_commit callback has not yet acknowledged."""
+        with self._mu:
+            self.seq += 1
+            self._deferred.append((self.seq, txn.encode()))
+            super().submit(txn)
+            return self.seq
+
+    def log_deferred(self, upto_seq: int) -> int:
+        """Append every deferred record with seq <= upto_seq in ONE
+        group (single fsync).  Records staged after upto_seq stay
+        deferred: their data-device barrier may not have happened yet
+        (data-before-metadata)."""
+        with self._mu:
+            take = [r for r in self._deferred if r[0] <= upto_seq]
+            if not take:
+                return 0
+            self._deferred = [r for r in self._deferred
+                              if r[0] > upto_seq]
+            self._wal.append_many(take, sync=True)
+            if self._wal.size() > self.COMPACT_BYTES \
+                    and not self._deferred:
+                # compact only at a fully-logged boundary: the snapshot
+                # covers live memory, which includes any still-deferred
+                # records — never persist those before their barrier
+                self.compact()
+            return len(take)
 
     def compact(self) -> None:
-        out = bytearray(struct.pack("<QI", self.seq, len(self._keys)))
-        for k in self._keys:
-            v = self._map[k]
-            out += struct.pack("<I", len(k)) + k
-            out += struct.pack("<I", len(v)) + v
-        atomic_snapshot(self._snap_path(), bytes(out))
-        self._wal.rotate()
+        with self._mu:
+            if self.pre_compact_hook is not None:
+                # the snapshot persists CURRENT memory, which may hold
+                # records whose data blocks were only pwritten: barrier
+                # the data device first (COW data-before-metadata)
+                self.pre_compact_hook()
+            out = bytearray(struct.pack("<QI", self.seq, len(self._keys)))
+            for k in self._keys:
+                v = self._map[k]
+                out += struct.pack("<I", len(k)) + k
+                out += struct.pack("<I", len(v)) + v
+            atomic_snapshot(self._snap_path(), bytes(out))
+            self._wal.rotate()
+
+    # --- thread-safe read/apply views (commit thread vs event loop) ---
+    def get(self, prefix: str, key) -> Optional[bytes]:
+        with self._mu:
+            return super().get(prefix, key)
+
+    def iterate(self, prefix: str, start=b"", end=None):
+        with self._mu:
+            rows = list(super().iterate(prefix, start=start, end=end))
+        return iter(rows)
+
+    def iterate_all(self):
+        with self._mu:
+            rows = list(super().iterate_all())
+        return iter(rows)
 
     def close(self) -> None:
-        if not self._wal.closed:
-            if self._wal.size() > 0:   # nothing new since last snapshot?
-                self.compact()
-            self._wal.close()
+        with self._mu:
+            if not self._wal.closed:
+                if self._deferred:
+                    # records can still be pending here when the commit
+                    # thread died: their data blocks may be pwritten but
+                    # never fsync'd — run the data barrier FIRST so the
+                    # WAL flush can't persist metadata ahead of its data
+                    # (data-before-metadata, same rule as compact)
+                    if self.pre_compact_hook is not None:
+                        self.pre_compact_hook()
+                    self.log_deferred(self.seq)
+                if self._wal.size() > 0:   # nothing new since snapshot?
+                    self.compact()
+                self._wal.close()

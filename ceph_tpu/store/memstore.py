@@ -23,7 +23,8 @@ from ceph_tpu.store.objectstore import (
     OP_OMAP_CLEAR, OP_OMAP_RMKEYRANGE, OP_OMAP_RMKEYS, OP_OMAP_SETHEADER,
     OP_OMAP_SETKEYS, OP_REMOVE, OP_RMATTR, OP_RMCOLL, OP_SETATTR,
     OP_SETATTRS, OP_TOUCH, OP_TRUNCATE, OP_TRY_RENAME, OP_WRITE, OP_ZERO,
-    NoSuchCollection, NoSuchObject, ObjectStore, Transaction, TxOp,
+    NoSuchCollection, NoSuchObject, ObjectStore, StoreError, Transaction,
+    TxOp,
 )
 from ceph_tpu.store.types import CollectionId, ObjectId
 
@@ -47,30 +48,60 @@ class Obj:
 
 
 class MemStore(ObjectStore):
+    #: gather window for the commit thread: RAM has no fsync cost to
+    #: batch behind, so a tiny linger is what lets concurrent writers
+    #: share one commit batch (and keeps callback ordering pipelined)
+    GATHER_WINDOW = 0.0003
+
     def __init__(self, path: str = ""):
         super().__init__(path)
         self.colls: Dict[CollectionId, Dict[ObjectId, Obj]] = {}
         self.mounted = False
+        self._committer = None
 
     # --- lifecycle ---
     def mkfs(self) -> None:
         self.colls = {}
 
     def mount(self) -> None:
+        from ceph_tpu.store.commit import KVSyncThread
+        self._committer = KVSyncThread("memstore_commit",
+                                       gather_window=self.GATHER_WINDOW)
+        self._committer.start()
         self.mounted = True
 
     def umount(self) -> None:
+        if self._committer is not None:
+            self._committer.stop()
+            self._committer = None
         self.mounted = False
 
     # --- write path ---
     def queue_transactions(self, txns, on_applied=None, on_commit=None):
+        if self._committer is not None and self._committer.dead:
+            # dead commit thread = acks would never fire: fail loudly
+            raise StoreError("memstore commit thread is dead")
         for t in txns:
             self._apply(t)
         self.applied_seq += len(txns)
         if on_applied:
             on_applied()
-        if on_commit:
+        if on_commit is None:
+            return            # memory state IS the committed state
+        if self._committer is not None:
+            # ride the group-commit thread: callbacks fire in
+            # submission order and concurrent batches share one pass,
+            # so the OSD's ack pipeline behaves like the durable stores
+            self._committer.submit(on_commit=on_commit)
+        else:
             on_commit()
+
+    def sync(self) -> None:
+        if self._committer is not None:
+            self._committer.flush()
+
+    def commit_counters(self) -> Dict[str, float]:
+        return self._committer.counters() if self._committer else {}
 
     # read-path lookups (raise) -----------------------------------------
     def _coll(self, cid) -> Dict[ObjectId, Obj]:

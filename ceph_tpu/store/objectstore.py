@@ -241,7 +241,23 @@ class ObjectStore:
         raise NotImplementedError
 
     def apply_transaction(self, txn: Transaction) -> None:
+        """Apply txn and return once it is DURABLE: queue + drain the
+        commit pipeline.  Callers that can tolerate deferred durability
+        (the OSD's hot write path) use queue_transactions with an
+        on_commit callback instead and keep working while the group
+        commits."""
         self.queue_transactions([txn])
+        self.sync()
+
+    def sync(self) -> None:
+        """Block until every queued transaction is durable (the
+        reference ObjectStore::sync / flush_commit role).  Stores with
+        synchronous commit have nothing to wait for."""
+
+    def commit_counters(self) -> Dict[str, float]:
+        """Group-commit pipeline counters (commit_batches, txns,
+        fsyncs, txns_per_batch, ...); empty for synchronous stores."""
+        return {}
 
     # reads
     def read(self, cid, oid, off: int = 0, length: int = -1) -> bytes:

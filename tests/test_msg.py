@@ -261,3 +261,114 @@ def test_perf_msgr_harness():
     assert out["count"] == 100
     assert out["msgs_per_sec"] > 0
     assert out["p99_us"] >= out["p50_us"] > 0
+
+
+def test_corked_pump_coalesces_burst():
+    """A burst of messages queued in one event-loop tick drains as ONE
+    corked socket write (msgs/write > 1), while per-connection ordering
+    and the ack/replay protocol stay intact."""
+    async def run():
+        a, b, _, cb = await _pair()
+        n = 64
+        # queue the whole burst before yielding: the pump corks it
+        for i in range(n):
+            a.send_message(MTestEcho(i, bytes([i % 251]) * 512), b.addr)
+        await cb.wait_for(lambda c: len(c.msgs) >= n, timeout=30)
+        # ordering preserved through the cork
+        assert [m.n for m in cb.msgs] == list(range(n))
+        # coalesced: far fewer socket writes than messages
+        assert a._sock_write_msgs == n
+        assert a._sock_writes < n
+        assert a._sock_write_msgs / a._sock_writes > 1.0
+        # ack semantics intact: the peer's acks drain the replay buffer
+        conn = a.conns[b.addr.without_nonce()]
+
+        async def drained():
+            while conn.unacked:
+                await asyncio.sleep(0.005)
+        await asyncio.wait_for(drained(), 10)
+        assert conn.acked_seq == conn.out_seq
+        await a.shutdown()
+        await b.shutdown()
+    asyncio.run(run())
+
+
+def test_local_delivery_fast_path():
+    """Co-located messengers with ms_local_delivery skip the socket
+    entirely: messages arrive typed, ordered, and decoded from their own
+    serialized copy (object isolation), with zero corked socket writes
+    and the local counter accounting for every frame."""
+    async def run():
+        a, b, ca, cb = await _pair(ms_local_delivery=True)
+        n = 32
+        for i in range(n):
+            a.send_message(MTestEcho(i, bytes([i % 251]) * 256), b.addr)
+        await cb.wait_for(lambda c: len(c.msgs) >= n)
+        assert [m.n for m in cb.msgs] == list(range(n))
+        assert str(cb.msgs[0].src_name) == "osd.1"
+        # isolation: mutating the received blob can't touch the sender
+        assert cb.msgs[0].blob == bytes([0]) * 256
+        assert a._local_msgs == n
+        assert a._sock_writes == 0
+        # reply path rides local too (src_addr is b's registry key)
+        b.send_message(MTestEcho(99), cb.msgs[0].src_addr)
+        await ca.wait_for(lambda c: len(c.msgs) >= 1)
+        assert ca.msgs[0].n == 99 and b._local_msgs == 1
+        await a.shutdown()
+        await b.shutdown()
+    asyncio.run(run())
+
+
+def test_local_delivery_requires_both_ends_and_no_injection():
+    """The fast path only engages when BOTH ends opted in and nothing
+    requires real wire semantics — otherwise it falls back to TCP with
+    identical delivery behavior."""
+    async def run():
+        # receiver did not opt in -> TCP
+        a = make_messenger("osd.1", ms_local_delivery=True)
+        b = make_messenger("osd.2")
+        cb = Collector()
+        b.add_dispatcher(cb)
+        await a.bind()
+        await b.bind()
+        a.send_message(MTestEcho(1, b"x"), b.addr)
+        await cb.wait_for(lambda c: len(c.msgs) >= 1)
+        assert a._local_msgs == 0 and a._sock_writes > 0
+        await a.shutdown()
+        await b.shutdown()
+        # fault injection armed -> TCP (thrash semantics preserved)
+        c = make_messenger("osd.3", ms_local_delivery=True,
+                           ms_inject_socket_failures=10**9)
+        d = make_messenger("osd.4", ms_local_delivery=True)
+        cd = Collector()
+        d.add_dispatcher(cd)
+        await c.bind()
+        await d.bind()
+        c.send_message(MTestEcho(2, b"y"), d.addr)
+        await cd.wait_for(lambda c_: len(c_.msgs) >= 1)
+        assert c._local_msgs == 0
+        await c.shutdown()
+        await d.shutdown()
+    asyncio.run(run())
+
+
+def test_local_delivery_peer_shutdown_resets():
+    """A local session to a messenger that shut down behaves like a
+    torn-down lossy TCP session: the sender's dispatcher sees a reset
+    and the connection is dropped (higher layers own resend)."""
+    async def run():
+        a = make_messenger("client.1", ms_local_delivery=True)
+        b = make_messenger("osd.2", ms_local_delivery=True)
+        ca, cb = Collector(), Collector()
+        a.add_dispatcher(ca)
+        b.add_dispatcher(cb)
+        await a.bind()
+        await b.bind()
+        a.send_message(MTestEcho(1), b.addr)
+        await cb.wait_for(lambda c: len(c.msgs) >= 1)
+        await b.shutdown()
+        a.send_message(MTestEcho(2), b.addr)
+        await ca.wait_for(lambda c: len(c.resets) >= 1)
+        assert a.get_connection(b.addr) is None
+        await a.shutdown()
+    asyncio.run(run())

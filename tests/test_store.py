@@ -264,6 +264,11 @@ def test_store_callbacks_order(store):
         [Transaction().write(CID, OID, 0, b"x")],
         on_applied=lambda: events.append("applied"),
         on_commit=lambda: events.append("commit"))
+    # applied fires inline (state readable immediately); commit may ride
+    # the group-commit thread — sync() drains it (and with no event loop
+    # captured the callback runs on the commit thread before sync returns)
+    assert events[0] == "applied"
+    store.sync()
     assert events == ["applied", "commit"]
 
 
@@ -788,3 +793,111 @@ def test_deterministic_crash_replay_sweep(tmp_path):
             assert _store_fingerprint(s2) == \
                 clean_prefix_fingerprint(survivors), (mode, n)
             s2.umount()
+
+
+# ------------------------------------------------- group-commit pipeline
+
+def test_group_commit_callbacks_fire_in_submission_order(store):
+    """on_commit callbacks fire in submission order even when the commit
+    thread drains many queued batches in one group (ISSUE 1 invariant:
+    repop acks / pglog last_complete ride these callbacks)."""
+    import threading
+    _mkcoll(store)
+    committer = getattr(store, "_committer", None)
+    if committer is not None:
+        # hold the thread so every batch below lands in ONE group
+        committer.gate = threading.Event()
+    order = []
+    n = 24
+    for i in range(n):
+        store.queue_transactions(
+            [Transaction().write(CID, ObjectId(f"seq{i}", pool=1), 0,
+                                 bytes([i]) * 128)],
+            on_commit=lambda i=i: order.append(i))
+    if committer is not None:
+        committer.gate.set()
+    store.sync()
+    assert order == list(range(n))
+
+
+def test_blockstore_group_commit_shares_fsyncs(tmp_path):
+    """N concurrent transaction batches commit with fewer than N fsyncs:
+    the kv-sync thread issues ONE data barrier + ONE atomic kv submit
+    per group (BlueStore kv_sync_thread recipe)."""
+    import threading
+    from ceph_tpu.store.blockstore import BlockStore
+    s = BlockStore(str(tmp_path / "bs"))
+    s.mkfs()
+    s.mount()
+    _mkcoll(s)
+    base = s.commit_counters()
+    s._committer.gate = threading.Event()
+    n = 16
+    done = []
+    for i in range(n):
+        s.queue_transactions(
+            [Transaction().write(CID, ObjectId(f"grp{i}", pool=1), 0,
+                                 bytes([i]) * 4096)],
+            on_commit=lambda i=i: done.append(i))
+    s._committer.gate.set()
+    s.sync()
+    c = s.commit_counters()
+    txns = c["txns"] - base["txns"]
+    fsyncs = c["fsyncs"] - base["fsyncs"]
+    batches = c["commit_batches"] - base["commit_batches"]
+    assert txns == n and done == list(range(n))
+    assert batches < n                # grouping engaged
+    assert 1 <= fsyncs < n            # shared barriers, not per-txn
+    assert c["fsyncs_saved"] > base["fsyncs_saved"]
+    # group-committed state is really durable: crash-reopen sees it all
+    s2 = BlockStore(str(tmp_path / "bs"))   # no umount (power cut)
+    s2.mount()
+    for i in range(n):
+        assert s2.read(CID, ObjectId(f"grp{i}", pool=1)) == \
+            bytes([i]) * 4096
+    s2.umount()
+    s.umount()
+
+
+@pytest.mark.parametrize("point", ["before_data_sync", "before_kv"])
+def test_blockstore_crash_ordering_data_before_metadata(tmp_path, point):
+    """Fault-inject a power cut on the commit thread: a kv batch must
+    never be visible (replayable) before its data blocks are fsync'd.
+    The trace hook proves the data barrier strictly precedes the kv
+    submit; a crash at either point leaves the object invisible on
+    replay and fires NO commit callback."""
+    from ceph_tpu.store.blockstore import BlockStore, StoreError
+    path = str(tmp_path / "bs")
+    s = BlockStore(path)
+    s.mkfs()
+    s.mount()
+    s.apply_transaction(Transaction().create_collection(CID))  # durable
+    stages = []
+    s._committer.trace = lambda pt, n: stages.append(pt)
+    s._committer.crash_at = point
+    done = []
+    s.queue_transactions([Transaction().write(CID, OID, 0, b"doomed")],
+                         on_commit=lambda: done.append(1))
+    # sync fails LOUDLY: durability can no longer be promised
+    with pytest.raises(StoreError):
+        s.sync()
+    assert s._committer.dead
+    assert done == []                 # never committed, never acked
+    # and so do new writes (no silent phantom acceptance)
+    with pytest.raises(StoreError):
+        s.queue_transactions([Transaction().write(
+            CID, ObjectId("after", pool=1), 0, b"x")])
+    # applied state WAS readable in memory (apply/commit split) ...
+    assert s.read(CID, OID) == b"doomed"
+    if point == "before_kv":
+        # ... and the data barrier ran strictly before the kv submit
+        assert stages == ["before_data_sync", "before_kv"]
+    else:
+        assert stages == ["before_data_sync"]
+    # power cut: abandon without umount (umount would flush), reopen
+    s2 = BlockStore(path)
+    s2.mount()
+    assert s2._coll_exists(CID)       # the durable prefix survives
+    with pytest.raises(NoSuchObject):
+        s2.read(CID, OID)             # the un-fsync'd batch never lands
+    s2.umount()
