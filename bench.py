@@ -395,6 +395,8 @@ def stage_ec_e2e():
         return f
 
     async def run_once(batch_mode):
+        from ceph_tpu.msg import payload as payload_mod
+        payload_mod.reset_counters()
         cl = Cluster(ctx_factory=ctx_factory(batch_mode))
         admin = await cl.start(5)
         await admin.pool_create("bpool", pg_num=8,
@@ -430,6 +432,9 @@ def stage_ec_e2e():
             writes += osd.messenger._sock_writes
             msgs += osd.messenger._sock_write_msgs
             local += osd.messenger._local_msgs
+        # lazy-payload guard: with ms_local_delivery on, in-process hops
+        # must not serialize message bodies at all (read BEFORE stop)
+        enc = payload_mod.counters()
         await cl.stop()
         lats.sort()
         return {
@@ -449,6 +454,8 @@ def stage_ec_e2e():
             "msgs_per_sock_write": round(msgs / writes, 2)
             if writes else 0.0,
             "local_msgs": local,
+            "msg_encode_calls": enc["msg_encode_calls"],
+            "msg_encode_bytes": enc["msg_encode_bytes"],
         }
 
     on = asyncio.run(run_once("on"))
@@ -729,6 +736,8 @@ def main():
             "p50_ms": on["p50_ms"], "p99_ms": on["p99_ms"],
             "p50_ms_off": off["p50_ms"], "p99_ms_off": off["p99_ms"],
             "device_byte_fraction": on["device_frac"],
+            "msg_encode_calls": on.get("msg_encode_calls", 0),
+            "msg_encode_bytes": on.get("msg_encode_bytes", 0),
             "store_txns_per_commit_batch": on.get(
                 "store_txns_per_batch", 0.0),
             "store_fsyncs": on.get("store_fsyncs", 0),

@@ -114,3 +114,13 @@ class AsyncThrottle:
             self._waiters.popleft()
             self.cur += cost
             fut.set_result(None)
+
+    def open_wide(self) -> None:
+        """Disable the limit and admit every parked waiter — teardown
+        path (a dying endpoint must not strand producer tasks on a
+        budget nobody will release)."""
+        self.max = 0
+        while self._waiters:
+            fut, _ = self._waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)

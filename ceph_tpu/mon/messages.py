@@ -83,6 +83,10 @@ class MMonPaxos(Message):
                    dec.map_(lambda d: d.u64(), lambda d: d.bytes_()),
                    dec.u64(), dec.f64(), dec.u32())
 
+    def local_cost(self) -> int:
+        # byte-budget estimate for the local intake gate (msg/payload.py)
+        return 128 + sum(len(v) for v in self.values.values())
+
 
 # ---------------------------------------------------------------- commands
 
@@ -130,6 +134,9 @@ class MMonCommandAck(Message):
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MMonCommandAck":
         return cls(dec.u64(), dec.s32(), dec.string(), dec.bytes_(),
                    dec.s32())
+
+    def local_cost(self) -> int:
+        return 128 + len(self.outbl) + len(self.outs)
 
 
 # ----------------------------------------------------------- subscriptions
@@ -201,6 +208,10 @@ class MOSDMap(Message):
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDMap":
         return cls(dec.map_(lambda d: d.u32(), lambda d: d.bytes_()),
                    dec.map_(lambda d: d.u32(), lambda d: d.bytes_()))
+
+    def local_cost(self) -> int:
+        return (128 + sum(len(v) for v in self.incrementals.values())
+                + sum(len(v) for v in self.fulls.values()))
 
 
 # ----------------------------------------------------------- osd -> mon

@@ -10,10 +10,12 @@ from ceph_tpu.msg.message import (
     message_class, register_message,
 )
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
+from ceph_tpu.msg.payload import LazyPayload
 from ceph_tpu.msg.types import EntityAddr, EntityName
 
 __all__ = [
-    "Connection", "Dispatcher", "EntityAddr", "EntityName", "MPing",
-    "Message", "Messenger", "PRIO_DEFAULT", "PRIO_HIGH", "PRIO_HIGHEST",
-    "PRIO_LOW", "Policy", "message_class", "register_message",
+    "Connection", "Dispatcher", "EntityAddr", "EntityName", "LazyPayload",
+    "MPing", "Message", "Messenger", "PRIO_DEFAULT", "PRIO_HIGH",
+    "PRIO_HIGHEST", "PRIO_LOW", "Policy", "message_class",
+    "register_message",
 ]

@@ -11,9 +11,11 @@ mon/messages.py, …) and register themselves on import.
 
 from __future__ import annotations
 
+import copy
 from typing import Dict, Optional, Type
 
 from ceph_tpu.common.encoding import Decoder, Encodable, Encoder
+from ceph_tpu.msg import payload as payload_mod
 from ceph_tpu.msg.types import EntityAddr, EntityName
 
 # priorities (msg/Message.h CEPH_MSG_PRIO_*)
@@ -62,6 +64,43 @@ class Message(Encodable):
         # unforgeable (unlike src_addr, which is banner-claimed) — auth
         # session state keys on this
         self.transport_id: Optional[int] = None
+        # lazily-materialized wire body (msg/payload.py): encoded once,
+        # only when a frame actually hits a TCP socket
+        self._wire: Optional[bytes] = None
+
+    # --- lazy wire form (msg/payload.py) ---
+    def wire_bytes(self) -> bytes:
+        """Body bytes for a frame hitting a REAL socket.  Materialized
+        lazily, exactly once (fan-out to several peers encodes once),
+        and counted — ms_local_delivery never calls this, which is the
+        zero-encode invariant the payload counters guard.  Mutating a
+        message after its first send has always raced the corked pump;
+        with the cache it is simply ignored — build a fresh message."""
+        w = self._wire
+        if w is None:
+            w = self.to_bytes()
+            payload_mod.note_encode(len(w))
+            self._wire = w
+        return w
+
+    def local_view(self) -> "Message":
+        """The object graph a co-located receiver gets (zero encode /
+        decode).  Default: a SHALLOW instance copy — payloads and field
+        values are shared (sealed/immutable by discipline), but the
+        envelope is the receiver's own, so per-delivery transport
+        stamps (seq, src, transport_id, recv_stamp) on a multicast
+        send (MWatchNotify to N watchers) can never collide across
+        receivers.  Types whose receivers fill result fields in place
+        (MOSDOp) override with a deeper copy-on-send view;
+        payload-carrying types rely on sealed-frozen payloads plus
+        mutable() accessor copies."""
+        return copy.copy(self)
+
+    def local_cost(self) -> int:
+        """Byte-budget estimate for the local intake gate + dispatch
+        throttle (the wire path uses real frame length; the local path
+        must not encode just to weigh a message)."""
+        return 256
 
     def encode_payload(self, enc: Encoder) -> None:  # default: no body
         pass

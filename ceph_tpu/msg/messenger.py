@@ -35,6 +35,8 @@ from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
 from ceph_tpu.common.encoding import Decoder, Encoder
+from ceph_tpu.common.throttle import AsyncThrottle
+from ceph_tpu.msg import payload as payload_mod
 from ceph_tpu.msg.message import Message, message_class
 from ceph_tpu.msg.types import EntityAddr, EntityName
 
@@ -220,7 +222,10 @@ class Connection:
                     msg = self.out_q.popleft()
                     self.out_seq += 1
                     msg.seq = self.out_seq
-                    body = msg.to_bytes()
+                    # lazy payload: the body materializes HERE, at the
+                    # real socket boundary, exactly once per message
+                    # (fan-out reuses the cache; replay reuses frames)
+                    body = msg.wire_bytes()
                     payload = _MSG_HDR.pack(msg.seq, msg.TYPE,
                                             zlib.crc32(body)) + body
                     self.unacked.append((self.out_seq, payload))
@@ -334,14 +339,23 @@ class LocalConnection:
     ms_fast_dispatch role, widened from self-delivery to any co-located
     messenger — the deployment the QA cluster and bench actually run).
 
-    The message body is serialized exactly once (object isolation: the
-    receiver decodes its own copy, same as off the wire) and handed to
-    the peer messenger's intake queue in FIFO order.  Everything that
-    exists to survive an unreliable byte stream — framing, crc, acks,
-    replay, reconnect — is skipped: in-process delivery cannot drop or
-    reorder.  Fault-injection and cephx configs fall back to TCP at
-    routing time (_local_peer), so thrash/model-checker semantics and
-    auth gating are untouched."""
+    ZERO-ENCODE delivery (msg/payload.py): the receiver is handed the
+    message's ``local_view()`` — the live object graph, frozen/copied
+    per that type's discipline — in FIFO order; no body is serialized
+    or parsed on this path, which is the counter-guarded invariant.
+    Everything that exists to survive an unreliable byte stream —
+    framing, crc, acks, replay, reconnect — is skipped: in-process
+    delivery cannot drop or reorder.  Fault-injection and cephx configs
+    fall back to TCP at routing time (_local_peer), so thrash/
+    model-checker semantics and auth gating are untouched.
+
+    Backpressure: the receiver's per-sender intake queue is bounded by
+    a bytes budget (ms_dispatch_throttle_bytes — the role TCP's socket
+    buffers play).  While the budget has room, send() hands the message
+    over synchronously; once it fills, messages queue HERE and an async
+    pump awaits the receiver's gate — so a co-located flood parks the
+    sender's stream instead of growing intake RAM, without ever
+    head-of-line blocking other senders' queues."""
 
     is_local = True
 
@@ -351,37 +365,91 @@ class LocalConnection:
         self.addr = addr
         self.peer = peer
         self.conn_id = random.getrandbits(63)
+        self.out_q: Deque[Message] = deque()
         self.out_seq = 0
         self.closed = False
-        self._kick = _NullKick()   # mark_down compatibility
+        self._kick = asyncio.Event()   # mark_down compatibility
+        self._task: Optional[asyncio.Task] = None
+
+    def _peer_alive(self) -> Optional["Messenger"]:
+        peer = _LOCAL_ENDPOINTS.get(self.addr.without_nonce())
+        return peer if peer is self.peer else None
+
+    def _reset(self) -> None:
+        # peer endpoint went away (daemon shutdown/restart): behave
+        # like a torn-down TCP session — drop and let the caller's
+        # resend machinery (objecter, peering) recover via whatever
+        # endpoint rebinds
+        self.closed = True
+        self.out_q.clear()
+        self.msgr._drop_connection(self)
+        for d in self.msgr.dispatchers:
+            d.ms_handle_reset(self.addr)
 
     def send(self, msg: Message) -> None:
         if self.closed:
             return
-        peer = _LOCAL_ENDPOINTS.get(self.addr.without_nonce())
-        if peer is not self.peer:
-            # peer endpoint went away (daemon shutdown/restart): behave
-            # like a torn-down TCP session — drop and let the caller's
-            # resend machinery (objecter, peering) recover via whatever
-            # endpoint rebinds
-            self.closed = True
-            self.msgr._drop_connection(self)
-            for d in self.msgr.dispatchers:
-                d.ms_handle_reset(self.addr)
-            return
+        if self._task is None and not self.out_q:
+            peer = self._peer_alive()
+            if peer is None:
+                self._reset()
+                return
+            cost = msg.local_cost()
+            if peer._local_intake_gate(self.conn_id).get_or_fail(cost):
+                self._deliver(peer, msg, cost)   # uncongested fast path
+                return
+        # intake over budget (or a pump already draining a backlog):
+        # preserve FIFO by parking behind the async producer gate
+        self.out_q.append(msg)
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(
+                self._pump_local())
+
+    def _deliver(self, peer: "Messenger", msg: Message,
+                 cost: int) -> None:
         self.out_seq += 1
-        msg.seq = self.out_seq
+        view = msg.local_view()
+        view.seq = self.out_seq
         self.msgr._local_msgs += 1
+        payload_mod.note_local()
         peer._local_enqueue(self.msgr.name, self.msgr.addr,
-                            self.conn_id, msg.TYPE, msg.to_bytes())
+                            self.conn_id, view, cost)
+
+    async def _pump_local(self) -> None:
+        """Drains the backlog through the receiver's bytes-budget gate;
+        exits once empty (send() resumes the synchronous fast path)."""
+        try:
+            while self.out_q and not self.closed:
+                peer = self._peer_alive()
+                if peer is None:
+                    self._reset()
+                    return
+                msg = self.out_q[0]
+                cost = msg.local_cost()
+                gate = peer._local_intake_gate(self.conn_id)
+                await gate.get(cost)
+                if self.closed:
+                    gate.put(cost)
+                    return
+                if self._peer_alive() is None:   # died across the await
+                    self._reset()
+                    return
+                self.out_q.popleft()
+                self._deliver(peer, msg, cost)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self._task = None
 
     async def close(self) -> None:
         self.closed = True
-
-
-class _NullKick:
-    def set(self) -> None:
-        pass
+        self._kick.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
 
 
 class _AckBatcher:
@@ -445,12 +513,14 @@ class Messenger:
         # (msgs/write > 1 == the cork is earning its keep)
         self._sock_writes = 0
         self._sock_write_msgs = 0
-        # same-process fast-path accounting + intake: one queue+worker
-        # PER SENDER CONNECTION, mirroring the TCP path's per-peer
-        # reader tasks — a throttled client op must only back-pressure
-        # its own sender, never head-of-line block peer acks
+        # same-process fast-path accounting + intake: one
+        # queue+worker+bytes-gate PER SENDER CONNECTION, mirroring the
+        # TCP path's per-peer reader tasks + socket buffers — a
+        # throttled client op must only back-pressure its own sender,
+        # never head-of-line block peer acks
         self._local_msgs = 0
-        self._local_in: Dict[int, Tuple[asyncio.Queue, asyncio.Task]] = {}
+        self._local_in: Dict[
+            int, Tuple[asyncio.Queue, asyncio.Task, AsyncThrottle]] = {}
         # cephx hooks (msg/Messenger.h ms_get_authorizer /
         # ms_verify_authorizer dispatcher hooks, collapsed onto the
         # messenger since auth state lives with the owning stack):
@@ -551,60 +621,76 @@ class Messenger:
         return n > 0 and random.randrange(n) == 0
 
     # --- receive path (same-process fast path) ---
-    def _local_enqueue(self, peer_name: EntityName, peer_addr: EntityAddr,
-                       conn_id: int, mtype: int, body: bytes) -> None:
+    def _local_entry(self, conn_id: int):
         ent = self._local_in.get(conn_id)
         if ent is None:
             q: asyncio.Queue = asyncio.Queue()
+            # bytes-budget gate bounding THIS sender's intake queue
+            # (the role TCP's socket buffer plays); 0/neg = unbounded
+            gate = AsyncThrottle("ms_local_intake",
+                                 self.cfg["ms_dispatch_throttle_bytes"])
             task = asyncio.get_running_loop().create_task(
-                self._local_worker(q, conn_id))
-            ent = self._local_in[conn_id] = (q, task)
-        ent[0].put_nowait((peer_name, peer_addr, mtype, body))
+                self._local_worker(q, gate, conn_id))
+            ent = self._local_in[conn_id] = (q, task, gate)
+        return ent
 
-    async def _local_worker(self, q: asyncio.Queue,
+    def _local_intake_gate(self, conn_id: int) -> AsyncThrottle:
+        """The producer gate senders must pass (sync get_or_fail on the
+        uncongested path, async get from their pump once over budget)."""
+        return self._local_entry(conn_id)[2]
+
+    def _local_enqueue(self, peer_name: EntityName, peer_addr: EntityAddr,
+                       conn_id: int, msg: Message, cost: int) -> None:
+        """Zero-encode intake: `msg` is already the receiver-safe
+        local_view; the caller holds `cost` of this queue's gate."""
+        self._local_entry(conn_id)[0].put_nowait(
+            (peer_name, peer_addr, msg, cost))
+
+    async def _local_worker(self, q: asyncio.Queue, gate: AsyncThrottle,
                             conn_id: int) -> None:
-        """Drains ONE co-located sender's bodies in FIFO order — the
+        """Drains ONE co-located sender's messages in FIFO order — the
         local twin of a _serve_peer reader, minus everything that only
-        exists to survive a real socket.  Dispatch throttle still
-        applies and, as on TCP, stalls only THIS sender's stream while
-        the intake budget is full.  An idle worker retires itself so
-        sender reset/reconnect cycles (fresh conn_ids) can't accumulate
-        parked tasks; the entry pop and any _local_enqueue interleave
-        only at await points, so no message can slip into a popped
-        queue."""
+        exists to survive a real socket (no decode at all now: the view
+        object IS the delivery).  Dispatch throttle still applies and,
+        as on TCP, stalls only THIS sender's stream while the op budget
+        is full — the intake-gate budget is held across that wait, so
+        the backpressure reaches the sender.  An idle worker retires
+        itself so sender reset/reconnect cycles (fresh conn_ids) can't
+        accumulate parked tasks; retirement only happens with the gate
+        fully released — a producer acquires the gate and enqueues in
+        the same synchronous step, so gate.cur == 0 with an empty queue
+        proves no message can slip into the popped entry."""
         while True:
             if not q.empty():
-                # burst fast path: drain buffered bodies without the
+                # burst fast path: drain buffered messages without the
                 # per-message wait_for Task/timer overhead (the same
                 # no-yield drain a TCP reader gets from buffered frames;
                 # throttle awaits below still yield under pressure)
-                peer_name, peer_addr, mtype, body = q.get_nowait()
+                peer_name, peer_addr, msg, cost = q.get_nowait()
             else:
                 try:
-                    peer_name, peer_addr, mtype, body = \
+                    peer_name, peer_addr, msg, cost = \
                         await asyncio.wait_for(q.get(), 60.0)
                 except asyncio.TimeoutError:
-                    self._local_in.pop(conn_id, None)
-                    return
-            cls = message_class(mtype)
-            if cls is None:
-                self.log.warning(f"unknown local message type {mtype}")
-                continue
-            try:
-                msg = cls.from_bytes(body)
-            except Exception as e:
-                self.log.warning(
-                    f"local decode of {cls.__name__} failed: {e!r}")
-                continue
+                    # retire only when provably drained: q.empty() must
+                    # be re-checked here (an UNBOUNDED gate never bumps
+                    # cur, so a sender may have enqueued between the
+                    # timeout firing and this coroutine resuming); both
+                    # checks and the pop are one synchronous step, so
+                    # nothing can slip in after them
+                    if gate.cur == 0 and q.empty():
+                        self._local_in.pop(conn_id, None)
+                        return
+                    continue   # admitted-not-yet-enqueued producer races
             msg.src_name = peer_name
             msg.src_addr = peer_addr
             msg.transport_id = -conn_id   # local ids: distinct namespace
             msg.recv_stamp = time.monotonic()
             if (self.dispatch_throttle is not None
                     and msg.THROTTLE_DISPATCH):
-                cost = len(body)
                 await self.dispatch_throttle.get(cost)
                 msg.throttle_cost = cost
+            gate.put(cost)   # message left the intake queue
             self._dispatch(msg)
 
     # --- receive path ---
@@ -800,12 +886,16 @@ class Messenger:
         key = self.addr.without_nonce()
         if _LOCAL_ENDPOINTS.get(key) is self:
             del _LOCAL_ENDPOINTS[key]
-        for _, task in list(self._local_in.values()):
+        for _, task, gate in list(self._local_in.values()):
             task.cancel()
             try:
                 await task
             except (asyncio.CancelledError, Exception):
                 pass
+            # admit any sender pump parked on our intake gate so it can
+            # observe the deregistered endpoint and reset, instead of
+            # hanging on a budget nobody will ever release
+            gate.open_wide()
         self._local_in.clear()
         if self._server is not None:
             self._server.close()

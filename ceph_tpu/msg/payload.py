@@ -1,0 +1,183 @@
+"""Lazy message payloads: serialization is a TRANSPORT detail.
+
+Reference contrast: msg/Message.h treats a message as bytes-on-a-wire —
+encode_payload runs before any send.  In a TPU-native rebuild the common
+deployment co-locates OSD/mon/client daemons in one process (qa cluster,
+bench, mesh mode), where PR 1 profiling showed the e2e write path is
+CPU-bound on message/Transaction ENCODING, not on sockets or fsync.  So
+here a message *body* is decoupled from its *wire form*:
+
+  * ``LazyPayload`` carries a LIVE object (Transaction, LogEntry, ...)
+    plus the implicit encoder thunk (``obj.to_bytes``); it materializes
+    to bytes lazily, exactly once, and only when a frame actually hits a
+    TCP socket (``Message.wire_bytes`` -> ``encode_payload`` ->
+    ``LazyPayload.bytes``).
+  * ``ms_local_delivery`` hands the receiver the object graph itself —
+    zero encode, zero decode — under an enforced copy discipline:
+    sealing a payload FREEZES the underlying object (freeze-and-assert),
+    and receivers that need to mutate (a replica appending save_meta
+    ops to a received txn) must take ``mutable()`` copies.
+
+Module counters are the regression guard that keeps the encode round
+trip removed: a pure-local hop must never bump ``msg_encode_calls``
+(bench ec_e2e reports them; the perf-smoke suite fails on regression).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Type
+
+
+class _Counters:
+    """Process-wide body-encode accounting (one process == one bench /
+    qa cluster, so the aggregate is exactly the number the local-path
+    guard cares about)."""
+
+    __slots__ = ("encode_calls", "encode_bytes", "decode_calls",
+                 "local_msgs")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.encode_calls = 0
+        self.encode_bytes = 0
+        self.decode_calls = 0
+        self.local_msgs = 0
+
+
+_C = _Counters()
+
+
+def note_encode(nbytes: int) -> None:
+    """One full message body hit a real socket boundary."""
+    _C.encode_calls += 1
+    _C.encode_bytes += nbytes
+
+
+def note_decode() -> None:
+    _C.decode_calls += 1
+
+
+def note_local() -> None:
+    _C.local_msgs += 1
+
+
+def counters() -> dict:
+    return {"msg_encode_calls": _C.encode_calls,
+            "msg_encode_bytes": _C.encode_bytes,
+            "msg_decode_calls": _C.decode_calls,
+            "msg_local_msgs": _C.local_msgs}
+
+
+def reset_counters() -> None:
+    _C.reset()
+
+
+class LazyPayload:
+    """A message body part: live object OR wire bytes, converted lazily.
+
+    Exactly one of ``_obj`` / ``_raw`` is the source of truth at
+    construction; ``bytes()`` materializes the wire form once and caches
+    it, so a message fanned out to several TCP peers (repop to N
+    replicas) still encodes its txn a single time.
+
+    Copy discipline (receiver side):
+      * ``peek(cls)``  — read-only view; when live, this is the SENDER'S
+        object (frozen at seal time); mutating it is a bug the freeze
+        turns into a loud failure.
+      * ``mutable(cls)`` — receiver-owned copy, safe to mutate; cheap
+        (``mutable_copy``, a shallow op-list copy for Transaction) when
+        the type provides one, decode-from-bytes otherwise.
+    """
+
+    __slots__ = ("_obj", "_raw")
+
+    def __init__(self, obj=None, raw: Optional[bytes] = None):
+        self._obj = obj
+        self._raw = raw
+
+    # ------------------------------------------------------ construction
+    @classmethod
+    def seal(cls, obj) -> "LazyPayload":
+        """Wrap a live object and FREEZE it: once a payload is sealed
+        into a message the sender must not mutate it (its bytes may
+        already be cached / its graph already handed to a receiver)."""
+        freeze = getattr(obj, "freeze", None)
+        if callable(freeze):
+            freeze()
+        return cls(obj=obj)
+
+    @classmethod
+    def coerce(cls, v) -> "LazyPayload":
+        """Constructor helper: accept bytes (wire/decode path), an
+        already-built payload (fan-out sharing), or a live Encodable."""
+        if isinstance(v, LazyPayload):
+            return v
+        if v is None:
+            return cls(raw=b"")
+        if isinstance(v, (bytes, bytearray, memoryview)):
+            return cls(raw=bytes(v))
+        return cls.seal(v)
+
+    # ------------------------------------------------------------ access
+    def empty(self) -> bool:
+        return self._obj is None and not self._raw
+
+    def bytes(self) -> bytes:
+        """Wire form, materialized lazily and exactly once.  Objects
+        that keep their own framed-encoding cache (LogEntry
+        ``framed_bytes`` — pglog persistence already paid for it) are
+        asked for that instead of re-encoding."""
+        raw = self._raw
+        if raw is None:
+            fb = getattr(self._obj, "framed_bytes", None)
+            raw = self._raw = (fb() if callable(fb)
+                               else self._obj.to_bytes())
+        return raw
+
+    def peek(self, kind: Type):
+        """Read-only object view (zero-copy when live; decoded once and
+        cached on the wire path, so repeated accessor calls cost one
+        decode and share one object on BOTH transports)."""
+        if self._obj is not None:
+            return self._obj
+        if not self._raw:
+            return None
+        note_decode()
+        self._obj = kind.from_bytes(self._raw)
+        return self._obj
+
+    def mutable(self, kind: Type):
+        """Receiver-owned object, safe to mutate (copy discipline)."""
+        if self._obj is not None:
+            mc = getattr(self._obj, "mutable_copy", None)
+            if callable(mc):
+                return mc()
+            # no cheap copy on this type: isolate via the codec — and
+            # COUNT the encode it forces, so a local-path round trip
+            # sneaking back in can never hide from the zero-encode guard
+            if self._raw is None:
+                note_encode(len(self.bytes()))
+            note_decode()
+            return kind.from_bytes(self.bytes())
+        if not self._raw:
+            return kind()
+        note_decode()
+        return kind.from_bytes(self._raw)
+
+    def cost(self) -> int:
+        """Byte-budget estimate WITHOUT materializing (intake gates must
+        never force the encode they exist to avoid)."""
+        if self._raw is not None:
+            return len(self._raw)
+        approx = getattr(self._obj, "approx_size", None)
+        if callable(approx):
+            return approx()
+        return 256
+
+    def __repr__(self):
+        if self._raw is not None and self._obj is None:
+            return f"LazyPayload(raw={len(self._raw)}B)"
+        state = "materialized" if self._raw is not None else "lazy"
+        return f"LazyPayload({type(self._obj).__name__}, {state})"

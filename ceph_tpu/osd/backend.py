@@ -36,6 +36,7 @@ from ceph_tpu.osd.messages import (
     OP_ZERO,
 )
 from ceph_tpu.crush.constants import CRUSH_ITEM_NONE
+from ceph_tpu.msg.payload import LazyPayload
 from ceph_tpu.osd.pglog import LOG_DELETE, LOG_MODIFY, LogEntry
 from ceph_tpu.store.objectstore import (
     NoSuchCollection, NoSuchObject, Transaction,
@@ -491,7 +492,12 @@ class ReplicatedBackend(PGBackend):
         if not deletes:
             txn.setattr(pg.cid, soid, VERSION_XATTR, version.to_bytes())
         pg.append_log(txn, entry)
-        txn_bytes = txn.to_bytes()
+        # seal the txn + entry into lazy payloads: freezes the txn (no
+        # further sender mutation) and shares ONE encoder cache across
+        # the whole fan-out — bytes materialize only if a peer hop
+        # actually crosses a TCP socket (msg/payload.py)
+        txn_payload = LazyPayload.seal(txn)
+        log_payload = LazyPayload.seal(entry)
         # local apply now (memory is immediately readable); durability
         # rides the commit thread CONCURRENTLY with the replica round
         # trip — pglog last_complete advances from the commit callback
@@ -506,7 +512,7 @@ class ReplicatedBackend(PGBackend):
         fut = self._ack_init(tid, peers)
         for p in peers:
             self.osd.send_osd(p, MOSDRepOp(
-                pg.pgid, tid, txn_bytes, entry.to_bytes(), version,
+                pg.pgid, tid, txn_payload, log_payload, version,
                 self.osd.osdmap.epoch))
         if not await self._await_acks(fut):
             self._inflight.pop(tid, None)
@@ -552,8 +558,11 @@ class ReplicatedBackend(PGBackend):
     async def handle_sub_message(self, m) -> None:
         pg = self.pg
         if isinstance(m, MOSDRepOp):
-            txn = Transaction.from_bytes(m.txn_bytes)
-            entry = LogEntry.from_bytes(m.log_bytes)
+            # copy discipline: txn() is OUR mutable copy (save_meta
+            # appends below must never reach the sender or a sibling
+            # replica); the log entry is immutable and shared as-is
+            txn = m.txn()
+            entry = m.log_entry()
             advance = None
             if pg.log.head < entry.version:
                 pg.log.append(entry)
@@ -763,7 +772,6 @@ class ECBackend(PGBackend):
             for i, t in shard_txns.items():
                 t.setattr(cids[i], soid, VERSION_XATTR,
                           version.to_bytes())
-        entry_bytes = entry.to_bytes()
         # local shard applies in memory now; its durability overlaps
         # the sub-op fan-out (commit pipelining), and pglog
         # last_complete advances from the commit callback
@@ -774,7 +782,12 @@ class ECBackend(PGBackend):
             local_txn, on_commit=lambda: pg.complete_to(version))
         # fan out to the other shards; each position also goes to its
         # UP holder when that differs from acting (pg_temp backfill
-        # target keeps current while the complete copy serves)
+        # target keeps current while the complete copy serves).  The
+        # log-entry payload is shared across every sub-op and each
+        # position's txn payload across its acting+up targets, so over
+        # TCP each body encodes at most once; local hops encode nothing
+        log_payload = LazyPayload.seal(entry)
+        txn_payloads: Dict[int, LazyPayload] = {}
         tid = self.osd.next_tid()
         peers = set()
         sends = []
@@ -790,10 +803,12 @@ class ECBackend(PGBackend):
                         or t_osd == CRUSH_ITEM_NONE:
                     continue
                 peers.add(t_osd)
+                tp = txn_payloads.get(i)
+                if tp is None:
+                    tp = txn_payloads[i] = LazyPayload.seal(shard_txns[i])
                 sends.append((t_osd, MOSDECSubOpWrite(
-                    pg.pgid.with_shard(i), tid,
-                    shard_txns[i].to_bytes(), entry_bytes, version,
-                    self.osd.osdmap.epoch)))
+                    pg.pgid.with_shard(i), tid, tp, log_payload,
+                    version, self.osd.osdmap.epoch)))
         fut = self._ack_init(tid, peers)
         ex = getattr(self.osd, "mesh_exec", None)
         for osd_id, msg in sends:
@@ -1350,8 +1365,10 @@ class ECBackend(PGBackend):
     async def handle_sub_message(self, m) -> None:
         pg = self.pg
         if isinstance(m, MOSDECSubOpWrite):
-            txn = Transaction.from_bytes(m.txn_bytes)
-            entry = LogEntry.from_bytes(m.log_bytes)
+            # copy discipline: mutable txn copy, shared immutable entry
+            # (see ReplicatedBackend.handle_sub_message)
+            txn = m.txn()
+            entry = m.log_entry()
             advance = None
             if pg.log.head < entry.version:
                 pg.log.append(entry)

@@ -12,6 +12,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ceph_tpu.common.encoding import Decoder, Encodable, Encoder
 from ceph_tpu.msg.message import Message, PRIO_HIGH, register_message
+from ceph_tpu.msg.payload import LazyPayload
 from ceph_tpu.osd.types import ObjectLocator, PGId
 
 # client/op codes (include/rados.h CEPH_OSD_OP_*; subset the framework
@@ -93,6 +94,22 @@ class OSDOp(Encodable):
             from ceph_tpu.cls import method_is_write
             return method_is_write(self.name)
         return self.op in WRITE_OPS
+
+    def result_copy(self) -> "OSDOp":
+        """Receiver-side copy for zero-encode local delivery: shares the
+        immutable request fields (including the data bytes) but owns its
+        result fields, so an executing OSD never scribbles rval/outdata
+        onto the client's op vector (or a retried twin's)."""
+        return OSDOp(self.op, self.offset, self.length, self.name,
+                     self.data, self.kv, self.keys)
+
+    def cost(self) -> int:
+        n = 64 + len(self.data) + len(self.outdata) + len(self.name)
+        for k, v in self.kv.items():
+            n += len(k) + len(v)
+        for k in self.keys:
+            n += len(k)
+        return n
 
 
 class EVersion(Encodable):
@@ -180,6 +197,18 @@ class MOSDOp(Message):
             m.snapid = dec.u64()
         return m
 
+    def local_view(self) -> "MOSDOp":
+        # copy-on-send: the executing OSD fills rval/outdata in place
+        # and the reply carries the SAME op objects back — without this
+        # copy a resent op could race two OSDs over one result vector
+        return MOSDOp(self.pgid, self.oid, self.loc,
+                      [o.result_copy() for o in self.ops], self.tid,
+                      self.map_epoch, self.reqid, self.snap_seq,
+                      self.snaps, self.snapid)
+
+    def local_cost(self) -> int:
+        return 128 + sum(o.cost() for o in self.ops)
+
 
 @register_message
 class MOSDOpReply(Message):
@@ -203,34 +232,57 @@ class MOSDOpReply(Message):
         return cls(dec.u64(), dec.s32(),
                    dec.list_(lambda d: d.struct(OSDOp)), dec.u32())
 
+    def local_cost(self) -> int:
+        return 128 + sum(o.cost() for o in self.ops)
+
 
 @register_message
 class MOSDRepOp(Message):
-    """Primary -> replica transaction (messages/MOSDRepOp.h): the encoded
-    ObjectStore transaction + pg log entries to append."""
+    """Primary -> replica transaction (messages/MOSDRepOp.h): the
+    ObjectStore transaction + pg log entry to append, carried as LAZY
+    payloads (msg/payload.py): live Transaction/LogEntry objects that
+    serialize only when a frame actually hits a TCP socket.  The wire
+    format is unchanged ([txn bytes][log bytes]); on local delivery the
+    receiver gets the sealed object graph and MUST take ``txn()`` (a
+    mutable copy) before appending its own save_meta ops."""
     TYPE = 202
     PRIORITY = PRIO_HIGH
 
     def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
-                 txn_bytes: bytes = b"", log_bytes: bytes = b"",
+                 txn=b"", log=b"",
                  version: Optional[EVersion] = None, map_epoch: int = 0):
         super().__init__()
         self.pgid = pgid or PGId(0, 0)
         self.tid = tid
-        self.txn_bytes = txn_bytes
-        self.log_bytes = log_bytes
+        self.txn_payload = LazyPayload.coerce(txn)
+        self.log_payload = LazyPayload.coerce(log)
         self.version = version or EVersion()
         self.map_epoch = map_epoch
 
+    def txn(self):
+        """Receiver-owned Transaction (mutable copy — copy discipline)."""
+        from ceph_tpu.store.objectstore import Transaction
+        return self.txn_payload.mutable(Transaction)
+
+    def log_entry(self):
+        """The LogEntry to append (immutable: shared zero-copy when
+        delivered locally, so its framed-bytes cache is shared too)."""
+        from ceph_tpu.osd.pglog import LogEntry
+        return self.log_payload.peek(LogEntry)
+
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid).u64(self.tid)
-        enc.bytes_(self.txn_bytes).bytes_(self.log_bytes)
+        enc.bytes_(self.txn_payload.bytes())
+        enc.bytes_(self.log_payload.bytes())
         enc.struct(self.version).u32(self.map_epoch)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDRepOp":
         return cls(dec.struct(PGId), dec.u64(), dec.bytes_(), dec.bytes_(),
                    dec.struct(EVersion), dec.u32())
+
+    def local_cost(self) -> int:
+        return 128 + self.txn_payload.cost() + self.log_payload.cost()
 
 
 @register_message
@@ -261,22 +313,27 @@ class MOSDRepOpReply(Message):
 @register_message
 class MOSDECSubOpWrite(Message):
     """Primary -> EC shard write (messages/MOSDECSubOpWrite.h): the
-    per-shard transaction produced after the TPU encode."""
+    per-shard transaction produced after the TPU encode, payload-carried
+    like MOSDRepOp (the log-entry payload is SHARED across the whole
+    shard fan-out, so it encodes at most once per write)."""
     TYPE = 204
     PRIORITY = PRIO_HIGH
 
     def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
-                 txn_bytes: bytes = b"", log_bytes: bytes = b"",
+                 txn=b"", log=b"",
                  version: Optional[EVersion] = None, map_epoch: int = 0):
         super().__init__()
         self.pgid = pgid or PGId(0, 0)   # includes target shard
         self.tid = tid
-        self.txn_bytes = txn_bytes
-        self.log_bytes = log_bytes
+        self.txn_payload = LazyPayload.coerce(txn)
+        self.log_payload = LazyPayload.coerce(log)
         self.version = version or EVersion()
         self.map_epoch = map_epoch
 
+    txn = MOSDRepOp.txn
+    log_entry = MOSDRepOp.log_entry
     encode_payload = MOSDRepOp.encode_payload
+    local_cost = MOSDRepOp.local_cost
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int):
@@ -383,6 +440,10 @@ class MOSDECSubOpReadReply(Message):
             m.ss = dec.bytes_()
         return m
 
+    def local_cost(self) -> int:
+        return (128 + sum(len(d) for d in self.data) + len(self.ss)
+                + sum(len(k) + len(v) for k, v in self.attrs.items()))
+
 
 # ------------------------------------------------------------- heartbeats
 
@@ -455,6 +516,9 @@ class MPGNotify(Message):
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MPGNotify":
         return cls(dec.struct(PGId), dec.u32(), dec.bytes_(), dec.s32())
+
+    def local_cost(self) -> int:
+        return 128 + len(self.info_bytes)
 
 
 @register_message
@@ -540,6 +604,9 @@ class MPGLog(Message):
         m.backfill_from = dec.string()
         return m
 
+    def local_cost(self) -> int:
+        return 128 + len(self.info_bytes) + len(self.log_bytes)
+
 
 # --------------------------------------------------------------- recovery
 
@@ -615,6 +682,18 @@ class MPGPush(Message):
                 m.clones.append((dec.u64(), dec.bytes_(), dec.map_(
                     lambda d: d.string(), lambda d: d.bytes_())))
         return m
+
+    def local_cost(self) -> int:
+        n = 256 + len(self.data) + len(self.omap_header) \
+            + len(self.snapset)
+        for k, v in self.omap.items():
+            n += len(k) + len(v)
+        for k, v in self.attrs.items():
+            n += len(k) + len(v)
+        for _, cdata, cattrs in self.clones:
+            n += len(cdata) + sum(len(k) + len(v)
+                                  for k, v in cattrs.items())
+        return n
 
 
 @register_message
@@ -795,6 +874,9 @@ class MWatchNotify(Message):
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MWatchNotify":
         return cls(dec.struct(PGId), dec.string(), dec.u64(),
                    dec.bytes_(), dec.s32())
+
+    def local_cost(self) -> int:
+        return 128 + len(self.payload)
 
 
 @register_message

@@ -177,6 +177,15 @@ class PG:
     # --------------------------------------------------------- persistence
     def save_meta(self, txn: Transaction) -> None:
         from ceph_tpu.common.encoding import Encoder
+        # copy discipline (msg/payload.py): a txn received over
+        # ms_local_delivery is the SENDER'S sealed object — appending
+        # our meta ops to it would leak into the primary and every
+        # sibling replica.  Receivers must use m.txn() (mutable copy);
+        # a real raise (not an -O-strippable assert) turns a violation
+        # into a loud failure instead of silent cross-daemon corruption.
+        if getattr(txn, "frozen", False):
+            raise ValueError(
+                "save_meta on a frozen payload-shared txn — use m.txn()")
         txn.touch(self.cid, self.meta_oid)
         txn.omap_setkeys(self.cid, self.meta_oid, {
             b"info": self.info.to_bytes(),

@@ -213,9 +213,18 @@ class FileDB(MemDB):
     (read-your-writes for the event loop) and stages the encoded record;
     a commit thread later calls ``log_deferred(upto_seq)`` to append the
     whole backlog with ONE fsync (the BlueStore kv_sync_thread recipe).
-    All memory/WAL mutation and read paths take one RLock so the commit
-    thread and the event loop can share the instance; ``iterate``
-    materializes its rows under the lock for the same reason.
+
+    Two locks split memory from I/O so event-loop reads never stall for
+    a barrier (the PR 1 known hazard: ``db.get``/``iterate`` blocked for
+    the whole WAL group fsync / snapshot compaction):
+      * ``_mu`` (RLock) — guards ONLY in-memory state (map/keys, seq,
+        the deferred backlog); held for microseconds.
+      * ``_io`` (Lock)  — serializes WAL appends, fsyncs and snapshot
+        compaction so records hit the log in seq order; the group fsync
+        and the data-device barrier run under ``_io`` alone, with the
+        backlog STAGED under ``_mu`` and flushed outside it.
+    Lock order is strictly ``_io`` -> ``_mu``; readers take ``_mu``
+    only; ``iterate`` materializes its rows under the lock.
     """
 
     COMPACT_BYTES = 8 << 20
@@ -228,17 +237,29 @@ class FileDB(MemDB):
         os.makedirs(path, exist_ok=True)
         self.seq = 0
         self._mu = threading.RLock()
+        self._io = threading.Lock()
         self._deferred: List[Tuple[int, bytes]] = []
-        #: called (under the lock) right before a snapshot compaction;
+        #: called under _io (NOT _mu — it must never block readers)
+        #: right before a snapshot compaction / backlog flush persists;
         #: BlockStore points it at its data-device fsync so a snapshot
         #: can never persist metadata whose data blocks aren't durable
         self.pre_compact_hook: Optional[Callable[[], None]] = None
+        #: set when a WAL append failed AFTER memory was applied: the
+        #: in-memory state is ahead of the durable log and can never be
+        #: reconciled, so the instance refuses further writes (the
+        #: deferred path gets the same wedge from a dead KVSyncThread)
+        self._broken: Optional[str] = None
         self._load_snapshot()
         self._wal = WriteAheadLog(self._wal_path())
         for seq, payload in self._wal.replay():
             if seq > self.seq:
                 super().submit(KVTransaction.decode(payload))
                 self.seq = seq
+
+    def _check_broken(self) -> None:
+        if self._broken is not None:
+            raise RuntimeError(f"FileDB {self.path} is broken "
+                               f"(memory ahead of WAL): {self._broken}")
 
     # --- persistence ---
     def _snap_path(self):
@@ -263,27 +284,49 @@ class FileDB(MemDB):
             self._insert(k, v)
 
     def submit(self, txn: KVTransaction, sync: bool = True) -> None:
-        with self._mu:
-            if self._deferred:
-                # seqs must hit the WAL in order: flush the deferred
-                # backlog before appending a synchronous record — after
-                # the data barrier, since those records' data blocks may
-                # be pwritten but not yet fsync'd (data-before-metadata)
+        with self._io:
+            self._check_broken()
+            payload = txn.encode()
+            with self._mu:
+                # reserve OUR seq first, applying memory in the same
+                # critical section (memory order == seq/replay order
+                # even against a racing submit_deferred on the same
+                # key).  Any deferred record staged BEFORE this point
+                # has a lower seq and is flushed below, strictly ahead
+                # of our append; one staged AFTER has a higher seq and
+                # stays deferred — so the WAL file order always equals
+                # seq order and replay can never skip a durable record.
+                self.seq += 1
+                seq = self.seq
+                super().submit(txn)
+                backlog = bool(self._deferred)
+            if backlog:
+                # flush the lower-seq backlog before appending our
+                # record — after the data barrier, since those records'
+                # data blocks may be pwritten but not yet fsync'd
+                # (data-before-metadata; their pwrites happened before
+                # their submit_deferred returned, i.e. before the hook)
                 if self.pre_compact_hook is not None:
                     self.pre_compact_hook()
-                self.log_deferred(self.seq)
-            payload = txn.encode()
-            self._wal.append(self.seq + 1, payload, sync=sync)
-            self.seq += 1   # only after the record is durable
-            super().submit(txn)
+                self._log_deferred_io(seq - 1)
+            # memory was applied above: a failed append would leave it
+            # ahead of the durable log forever — poison the instance so
+            # LATER writes wedge loudly instead of persisting state a
+            # crash would replay without this record
+            try:
+                self._wal.append(seq, payload, sync=sync)  # no _mu held
+            except Exception as e:
+                self._broken = f"append of seq {seq} failed: {e!r}"
+                raise
             if self._wal.size() > self.COMPACT_BYTES:
-                self.compact()
+                self._compact_io()
 
     def submit_deferred(self, txn: KVTransaction) -> int:
         """Memory-apply now, WAL later (group commit).  A crash before
         log_deferred loses the record — which is exactly the window the
         store's on_commit callback has not yet acknowledged."""
         with self._mu:
+            self._check_broken()
             self.seq += 1
             self._deferred.append((self.seq, txn.encode()))
             super().submit(txn)
@@ -294,35 +337,57 @@ class FileDB(MemDB):
         group (single fsync).  Records staged after upto_seq stay
         deferred: their data-device barrier may not have happened yet
         (data-before-metadata)."""
+        with self._io:
+            return self._log_deferred_io(upto_seq)
+
+    def _log_deferred_io(self, upto_seq: int) -> int:
+        """Caller holds ``_io``.  The backlog is collected under ``_mu``
+        but the group append/fsync runs outside it, so event-loop reads
+        proceed for the whole barrier duration."""
         with self._mu:
             take = [r for r in self._deferred if r[0] <= upto_seq]
             if not take:
                 return 0
             self._deferred = [r for r in self._deferred
                               if r[0] > upto_seq]
-            self._wal.append_many(take, sync=True)
-            if self._wal.size() > self.COMPACT_BYTES \
-                    and not self._deferred:
-                # compact only at a fully-logged boundary: the snapshot
-                # covers live memory, which includes any still-deferred
-                # records — never persist those before their barrier
-                self.compact()
-            return len(take)
+        try:
+            self._wal.append_many(take, sync=True)  # fsync: no _mu held
+        except Exception as e:
+            # the taken records left the backlog but never reached the
+            # log — memory is ahead of durable state for good
+            self._broken = f"group append upto {upto_seq} failed: {e!r}"
+            raise
+        with self._mu:
+            fully_logged = not self._deferred
+        if self._wal.size() > self.COMPACT_BYTES and fully_logged:
+            # compact only at a fully-logged boundary: the snapshot
+            # covers live memory, which includes any still-deferred
+            # records — never persist those before their barrier
+            self._compact_io()
+        return len(take)
 
     def compact(self) -> None:
+        with self._io:
+            self._compact_io()
+
+    def _compact_io(self) -> None:
+        """Caller holds ``_io``.  The snapshot image is built under
+        ``_mu`` (consistent seq + state); the data-device barrier and
+        the snapshot write/rename/rotate run outside it.  Ordering: any
+        record in the image had its data pwritten before its
+        submit_deferred returned (i.e. before the image was built), so
+        the barrier AFTER building still covers every block the
+        snapshot references (COW data-before-metadata)."""
         with self._mu:
-            if self.pre_compact_hook is not None:
-                # the snapshot persists CURRENT memory, which may hold
-                # records whose data blocks were only pwritten: barrier
-                # the data device first (COW data-before-metadata)
-                self.pre_compact_hook()
             out = bytearray(struct.pack("<QI", self.seq, len(self._keys)))
             for k in self._keys:
                 v = self._map[k]
                 out += struct.pack("<I", len(k)) + k
                 out += struct.pack("<I", len(v)) + v
-            atomic_snapshot(self._snap_path(), bytes(out))
-            self._wal.rotate()
+        if self.pre_compact_hook is not None:
+            self.pre_compact_hook()
+        atomic_snapshot(self._snap_path(), bytes(out))
+        self._wal.rotate()
 
     # --- thread-safe read/apply views (commit thread vs event loop) ---
     def get(self, prefix: str, key) -> Optional[bytes]:
@@ -340,17 +405,21 @@ class FileDB(MemDB):
         return iter(rows)
 
     def close(self) -> None:
-        with self._mu:
-            if not self._wal.closed:
-                if self._deferred:
-                    # records can still be pending here when the commit
-                    # thread died: their data blocks may be pwritten but
-                    # never fsync'd — run the data barrier FIRST so the
-                    # WAL flush can't persist metadata ahead of its data
-                    # (data-before-metadata, same rule as compact)
-                    if self.pre_compact_hook is not None:
-                        self.pre_compact_hook()
-                    self.log_deferred(self.seq)
-                if self._wal.size() > 0:   # nothing new since snapshot?
-                    self.compact()
-                self._wal.close()
+        with self._io:
+            if self._wal.closed:
+                return
+            with self._mu:
+                upto = self.seq
+                backlog = bool(self._deferred)
+            if backlog:
+                # records can still be pending here when the commit
+                # thread died: their data blocks may be pwritten but
+                # never fsync'd — run the data barrier FIRST so the
+                # WAL flush can't persist metadata ahead of its data
+                # (data-before-metadata, same rule as compact)
+                if self.pre_compact_hook is not None:
+                    self.pre_compact_hook()
+                self._log_deferred_io(upto)
+            if self._wal.size() > 0:   # nothing new since snapshot?
+                self._compact_io()
+            self._wal.close()

@@ -88,7 +88,15 @@ class TxOp(Encodable):
 
 
 class Transaction(Encodable):
-    """Atomic mutation batch (os/ObjectStore.h:209-239 builder methods)."""
+    """Atomic mutation batch (os/ObjectStore.h:209-239 builder methods).
+
+    Lazy-payload copy discipline (msg/payload.py): a txn sealed into a
+    message is shared between the sender's store apply, the wire
+    encoder, and — under ms_local_delivery — the receivers themselves.
+    ``freeze()`` seals it (builders then fail loudly); a receiver that
+    must mutate (save_meta appends) takes ``mutable_copy()``, which is
+    a shallow op-list copy: TxOps are immutable once built, so sharing
+    them is safe and copies stay O(ops), never O(bytes)."""
 
     __slots__ = ("ops",)
 
@@ -97,6 +105,33 @@ class Transaction(Encodable):
 
     def empty(self) -> bool:
         return not self.ops
+
+    def freeze(self) -> "Transaction":
+        """Seal against mutation: ops becomes a tuple, so any builder
+        append raises AttributeError (freeze-and-assert)."""
+        if isinstance(self.ops, list):
+            self.ops = tuple(self.ops)
+        return self
+
+    @property
+    def frozen(self) -> bool:
+        return isinstance(self.ops, tuple)
+
+    def mutable_copy(self) -> "Transaction":
+        t = Transaction()
+        t.ops = list(self.ops)
+        return t
+
+    def approx_size(self) -> int:
+        """Byte-budget estimate without encoding (intake gates)."""
+        n = 32
+        for op in self.ops:
+            n += 64 + len(op.data) + len(op.name)
+            for k, v in op.kv.items():
+                n += len(k) + len(v)
+            for k in op.keys:
+                n += len(k)
+        return n
 
     def append(self, other: "Transaction") -> "Transaction":
         self.ops.extend(other.ops)

@@ -352,6 +352,82 @@ def test_local_delivery_requires_both_ends_and_no_injection():
     asyncio.run(run())
 
 
+def test_local_delivery_bounded_intake_backpressures_sender():
+    """The local intake queue is bounded by a bytes budget tied to
+    ms_dispatch_throttle_bytes: a flood from a co-located sender parks
+    on the async producer gate (messages stay in the SENDER'S queue)
+    instead of growing receiver intake RAM; once the receiver drains,
+    everything arrives in order with nothing lost."""
+
+    @register_message
+    class MTestThrottled(Message):
+        TYPE = 9002
+        THROTTLE_DISPATCH = True
+
+        def __init__(self, n: int = 0, blob: bytes = b""):
+            super().__init__()
+            self.n = n
+            self.blob = blob
+
+        def encode_payload(self, enc: Encoder) -> None:
+            enc.u64(self.n).bytes_(self.blob)
+
+        @classmethod
+        def decode_payload(cls, dec: Decoder, struct_v: int):
+            return cls(dec.u64(), dec.bytes_())
+
+        def local_cost(self) -> int:
+            return len(self.blob)
+
+    class Releasing(Collector):
+        """Dispatcher that completes each op instantly (releases its
+        dispatch-throttle budget), like the OSD does at op finish."""
+
+        def __init__(self, msgr):
+            super().__init__()
+            self.msgr = msgr
+
+        def ms_dispatch(self, msg) -> bool:
+            super().ms_dispatch(msg)
+            self.msgr.put_dispatch_throttle(msg)
+            return True
+
+    async def run():
+        from ceph_tpu.common.throttle import AsyncThrottle
+        a = make_messenger("osd.1", ms_local_delivery=True,
+                           ms_dispatch_throttle_bytes=4096)
+        b = make_messenger("osd.2", ms_local_delivery=True,
+                           ms_dispatch_throttle_bytes=4096)
+        cb = Releasing(b)
+        b.add_dispatcher(cb)
+        await a.bind()
+        await b.bind()
+        # receiver's op budget: exhausted, so its local worker blocks on
+        # dispatch WHILE HOLDING intake budget — the TCP-equivalent of
+        # a reader stalled over a full throttle
+        b.dispatch_throttle = AsyncThrottle("t", 8192)
+        await b.dispatch_throttle.get(8192)
+        n, blob = 24, bytes(1024)
+        for i in range(n):
+            a.send_message(MTestThrottled(i, blob), b.addr)
+        await asyncio.sleep(0.1)
+        conn = a.conns[b.addr.without_nonce()]
+        # intake admitted at most the bytes budget; the rest is parked
+        # at the sender behind the async gate
+        gate = b._local_intake_gate(conn.conn_id)
+        assert gate.cur <= 4096 + 1024
+        assert len(conn.out_q) >= n - 6
+        assert len(cb.msgs) == 0          # nothing dispatched yet
+        # drain: release the receiver's op budget
+        b.dispatch_throttle.put(8192)
+        await cb.wait_for(lambda c: len(c.msgs) >= n, timeout=20)
+        assert [m.n for m in cb.msgs] == list(range(n))
+        assert a._local_msgs == n and a._sock_writes == 0
+        await a.shutdown()
+        await b.shutdown()
+    asyncio.run(run())
+
+
 def test_local_delivery_peer_shutdown_resets():
     """A local session to a messenger that shut down behaves like a
     torn-down lossy TCP session: the sender's dispatcher sees a reset

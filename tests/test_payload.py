@@ -1,0 +1,275 @@
+"""Lazy message-payload subsystem (msg/payload.py): copy discipline,
+lazy<->wire equivalence, and the zero-encode local-path guard.
+
+Covers the ISSUE 4 acceptance points:
+- a replica mutating a received Transaction is never observable by the
+  sender or by a second replica (freeze-and-assert + mutable copies);
+- the same message delivered locally and over TCP (fault injection
+  forces the TCP path) produces byte-identical wire frames and equal
+  receiver state;
+- a pure-local repop round performs ZERO body encodes
+  (counter-asserted on a real replicated mini-cluster).
+"""
+
+import asyncio
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+from ceph_tpu.common.encoding import Decoder, Encoder
+from ceph_tpu.msg import LazyPayload, Message, register_message
+from ceph_tpu.msg import payload as payload_mod
+from ceph_tpu.osd.messages import (
+    EVersion, MOSDOp, MOSDRepOp, OP_WRITE, OSDOp,
+)
+from ceph_tpu.osd.pglog import LogEntry
+from ceph_tpu.osd.types import PGId
+from ceph_tpu.store.objectstore import Transaction
+from ceph_tpu.store.types import CollectionId, ObjectId
+
+
+def _sample_txn() -> Transaction:
+    t = Transaction()
+    cid = CollectionId.pg(1, 3, -1)
+    t.write(cid, ObjectId("obj"), 0, b"payload-bytes" * 32)
+    t.setattr(cid, ObjectId("obj"), "_ver", b"1'7")
+    t.omap_setkeys(cid, ObjectId("_pgmeta_"), {b"k": b"v"})
+    return t
+
+
+def _sample_entry() -> LogEntry:
+    return LogEntry(1, "obj", EVersion(1, 7), EVersion(1, 6), "c.1")
+
+
+# ------------------------------------------------------- unit: LazyPayload
+
+def test_payload_materializes_once_and_wire_matches_eager():
+    txn = _sample_txn()
+    eager = txn.to_bytes()
+    p = LazyPayload.seal(txn)
+    assert p.bytes() == eager
+    assert p.bytes() is p.bytes()          # cached, not re-encoded
+    # raw payloads pass through untouched (decode path)
+    assert LazyPayload.coerce(eager).bytes() == eager
+
+
+def test_seal_freezes_sender_txn():
+    txn = _sample_txn()
+    LazyPayload.seal(txn)
+    assert txn.frozen
+    with pytest.raises(AttributeError):
+        txn.touch(CollectionId.pg(1, 3, -1), ObjectId("x"))
+    # a mutable copy is open for business and isolated
+    cp = txn.mutable_copy()
+    cp.touch(CollectionId.pg(1, 3, -1), ObjectId("x"))
+    assert len(cp.ops) == len(txn.ops) + 1
+
+
+def test_repop_receiver_mutation_is_not_observable():
+    """Two replicas mutate their received txns; the sender's txn and the
+    sibling replica's copy never see it (the save_meta scenario)."""
+    txn, entry = _sample_txn(), _sample_entry()
+    n_ops = len(txn.ops)
+    tp, lp = LazyPayload.seal(txn), LazyPayload.seal(entry)
+    m1 = MOSDRepOp(PGId(1, 3), 7, tp, lp, EVersion(1, 7), 5)
+    m2 = MOSDRepOp(PGId(1, 3), 7, tp, lp, EVersion(1, 7), 5)
+    r1, r2 = m1.txn(), m2.txn()
+    r1.omap_setkeys(CollectionId.pg(1, 3, -1), ObjectId("_pgmeta_"),
+                    {b"info": b"replica1-meta"})
+    r2.remove(CollectionId.pg(1, 3, -1), ObjectId("obj"))
+    assert len(txn.ops) == n_ops            # sender untouched
+    assert len(r1.ops) == n_ops + 1
+    assert len(r2.ops) == n_ops + 1
+    assert r1.ops[-1].op != r2.ops[-1].op   # replicas isolated
+    # the shared immutable entry is the same object on both sides
+    assert m1.log_entry() is entry
+
+
+def test_save_meta_asserts_on_frozen_txn():
+    """The exact ISSUE hazard: save_meta on the sender's sealed txn must
+    fail loudly, not silently leak meta ops across daemons."""
+    txn = _sample_txn()
+    LazyPayload.seal(txn)
+
+    class _FakePG:
+        pass
+
+    from ceph_tpu.osd.pg import PG
+    with pytest.raises(ValueError):
+        PG.save_meta(_FakePG(), txn)
+
+
+def test_local_view_isolates_transport_envelope():
+    """A multicast send (one message object to N co-located receivers,
+    e.g. MWatchNotify to every watcher) must give each receiver its own
+    envelope: per-delivery transport stamps can never collide."""
+    from ceph_tpu.osd.messages import MWatchNotify
+    m = MWatchNotify(PGId(1, 0), "o", 7, b"notify-payload", 0)
+    v1, v2 = m.local_view(), m.local_view()
+    assert v1 is not m and v1 is not v2
+    v1.seq, v2.seq = 5, 9
+    v1.transport_id, v2.transport_id = -1, -2
+    assert (v1.seq, v1.transport_id) == (5, -1)
+    assert m.seq == 0 and m.transport_id is None
+    # the payload itself is shared, not copied
+    assert v1.payload is m.payload
+
+
+def test_mosdop_local_view_isolates_result_fields():
+    ops = [OSDOp(OP_WRITE, 0, 5, data=b"hello")]
+    m = MOSDOp(PGId(1, 0), "o", None, ops, tid=9)
+    view = m.local_view()
+    assert view.ops[0] is not ops[0]
+    assert view.ops[0].data is ops[0].data      # bytes shared, not copied
+    view.ops[0].rval = -5
+    view.ops[0].outdata = b"result"
+    assert ops[0].rval == 0 and ops[0].outdata == b""
+
+
+def test_wire_bytes_counted_and_cached():
+    payload_mod.reset_counters()
+    m = MOSDRepOp(PGId(1, 3), 7, LazyPayload.seal(_sample_txn()),
+                  LazyPayload.seal(_sample_entry()), EVersion(1, 7), 5)
+    w1 = m.wire_bytes()
+    w2 = m.wire_bytes()
+    assert w1 is w2
+    c = payload_mod.counters()
+    assert c["msg_encode_calls"] == 1
+    assert c["msg_encode_bytes"] == len(w1)
+    # the wire form equals an eagerly-built bytes-carrying message
+    eager = MOSDRepOp(PGId(1, 3), 7, _sample_txn().to_bytes(),
+                      _sample_entry().to_bytes(), EVersion(1, 7), 5)
+    assert w1 == eager.to_bytes()
+    # and decodes back to equal receiver state
+    rt = MOSDRepOp.from_bytes(w1)
+    assert rt.txn().to_bytes() == _sample_txn().to_bytes()
+    assert rt.log_entry() == _sample_entry()
+
+
+def test_fanout_shares_one_encode():
+    """N peers' messages share the payload: TCP fan-out pays ONE txn
+    encode (payload cache), local fan-out pays zero."""
+    txn = _sample_txn()
+    tp = LazyPayload.seal(txn)
+    lp = LazyPayload.seal(_sample_entry())
+    msgs = [MOSDRepOp(PGId(1, 3), 7, tp, lp, EVersion(1, 7), 5)
+            for _ in range(3)]
+    bodies = [m.wire_bytes() for m in msgs]
+    assert bodies[0] == bodies[1] == bodies[2]
+    # the txn payload materialized once; each message envelope is its
+    # own (seq-independent) encode on top of the shared cache
+    assert tp.bytes() is tp.bytes()
+
+
+# --------------------------------------------- e2e: local vs TCP delivery
+
+@register_message
+class MPayloadProbe(Message):
+    """Test-only payload-carrying message (registered at a high type
+    code so the corpus never sees it)."""
+    TYPE = 9100
+
+    def __init__(self, txn=b"", log=b""):
+        super().__init__()
+        self.txn_payload = LazyPayload.coerce(txn)
+        self.log_payload = LazyPayload.coerce(log)
+
+    def txn(self):
+        return self.txn_payload.mutable(Transaction)
+
+    def log_entry(self):
+        return self.log_payload.peek(LogEntry)
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.bytes_(self.txn_payload.bytes())
+        enc.bytes_(self.log_payload.bytes())
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int):
+        return cls(dec.bytes_(), dec.bytes_())
+
+
+def _probe_pair_run(coro):
+    return asyncio.run(coro)
+
+
+def test_local_and_tcp_paths_agree():
+    """The same message content delivered locally (zero-encode) and over
+    TCP (fault injection forces the wire) yields equal receiver state,
+    and the TCP frame is byte-identical to eager encoding."""
+    import test_msg as tm
+
+    async def run():
+        # --- local pair: zero encodes, live graph delivery
+        a, b, _, cb = await tm._pair(ms_local_delivery=True)
+        payload_mod.reset_counters()
+        a.send_message(MPayloadProbe(LazyPayload.seal(_sample_txn()),
+                                     LazyPayload.seal(_sample_entry())),
+                       b.addr)
+        await cb.wait_for(lambda c: len(c.msgs) >= 1)
+        local_msg = cb.msgs[0]
+        assert payload_mod.counters()["msg_encode_calls"] == 0
+        local_txn = local_msg.txn()
+        local_entry = local_msg.log_entry()
+        await a.shutdown()
+        await b.shutdown()
+
+        # --- TCP pair: huge 1-in-N injection arms wire semantics
+        # without ever actually firing, forcing the fallback path
+        c_, d, _, cd = await tm._pair(ms_local_delivery=True,
+                                      ms_inject_socket_failures=10**9)
+        payload_mod.reset_counters()
+        msg = MPayloadProbe(LazyPayload.seal(_sample_txn()),
+                            LazyPayload.seal(_sample_entry()))
+        c_.send_message(msg, d.addr)
+        await cd.wait_for(lambda col: len(col.msgs) >= 1)
+        tcp_msg = cd.msgs[0]
+        cnt = payload_mod.counters()
+        assert cnt["msg_encode_calls"] >= 1    # the wire hop encoded
+        assert c_._local_msgs == 0
+        # wire frame byte-identical to eager encoding
+        assert msg.wire_bytes() == MPayloadProbe(
+            _sample_txn().to_bytes(),
+            _sample_entry().to_bytes()).to_bytes()
+        # equal receiver state across the two transports
+        assert tcp_msg.txn().to_bytes() == local_txn.to_bytes()
+        assert tcp_msg.log_entry() == local_entry
+        await c_.shutdown()
+        await d.shutdown()
+
+    _probe_pair_run(run())
+
+
+def test_zero_encode_pure_local_repop_round():
+    """Counter-asserted acceptance: a replicated write (repop fan-out +
+    acks + client reply, every daemon co-located with
+    ms_local_delivery) performs ZERO message body encodes."""
+    from ceph_tpu.qa.cluster import Cluster, make_ctx
+
+    def ctx_f(name):
+        c = make_ctx(name)
+        c.config.set("ms_local_delivery", True)
+        return c
+
+    async def run():
+        cl = Cluster(ctx_factory=ctx_f)
+        admin = await cl.start(3)
+        await admin.pool_create("lzp", pg_num=4)
+        io = admin.open_ioctx("lzp")
+        await io.write_full("warm", b"w" * 512)   # settle peering/maps
+        payload_mod.reset_counters()
+        blobs = {f"lz{i:02d}": bytes([i]) * 2048 for i in range(8)}
+        await asyncio.gather(*[io.write_full(k, v)
+                               for k, v in blobs.items()])
+        for k, v in blobs.items():
+            assert await io.read(k) == v
+        cnt = payload_mod.counters()
+        local = sum(o.messenger._local_msgs for o in cl.osds.values())
+        await cl.stop()
+        assert local > 0, "local fast path never engaged"
+        assert cnt["msg_encode_calls"] == 0, cnt
+        assert cnt["msg_encode_bytes"] == 0, cnt
+
+    asyncio.run(run())

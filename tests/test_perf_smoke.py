@@ -98,7 +98,12 @@ def test_cluster_rw_over_local_delivery(tmp_path):
     """E2E guard for the messenger's same-process fast path: a cluster
     with ms_local_delivery on serves writes+reads correctly (EC pool,
     so sub-op fan-out and acks all ride local), with the client's data
-    ops actually taking the local path and replies corked off sockets."""
+    ops actually taking the local path — and, since ISSUE 4's lazy
+    payloads, performing ZERO message body encodes: every hop hands
+    over the live object graph, so any encode call on this path is a
+    regression (the counter is the guard that keeps the encode->decode
+    round trip removed)."""
+    from ceph_tpu.msg import payload as payload_mod
     from ceph_tpu.qa.cluster import Cluster, make_ctx
 
     def ctx_f(name):
@@ -112,6 +117,7 @@ def test_cluster_rw_over_local_delivery(tmp_path):
         await admin.pool_create("lp", pg_num=4,
                                 pool_type="erasure", k=2, m=2)
         io = admin.open_ioctx("lp")
+        payload_mod.reset_counters()
         blobs = {f"lo{i:03d}": bytes([i]) * (4096 + i) for i in range(24)}
         await asyncio.gather(*[io.write_full(k, v)
                                for k, v in blobs.items()])
@@ -119,7 +125,12 @@ def test_cluster_rw_over_local_delivery(tmp_path):
             assert await io.read(k) == v
         local = sum(o.messenger._local_msgs for o in cl.osds.values())
         local += admin.messenger._local_msgs
+        enc = payload_mod.counters()
         assert local > 0, "fast path never engaged"
+        # lazy-payload invariant: the pure-local I/O burst (client ops,
+        # EC sub-op fan-out, acks, replies) encoded NOTHING
+        assert enc["msg_encode_calls"] == 0, enc
+        assert enc["msg_encode_bytes"] == 0, enc
         await cl.stop()
 
     asyncio.run(run())

@@ -93,6 +93,90 @@ def test_filedb_torn_tail(tmp_path):
     db3.close()
 
 
+def test_filedb_reads_dont_block_on_group_fsync(tmp_path):
+    """ISSUE 4 satellite (ROADMAP known hazard): the WAL group fsync on
+    the commit thread must NOT hold the memory lock — event-loop reads
+    (get/iterate) proceed for the whole barrier duration."""
+    import threading
+    import time as _time
+
+    db = FileDB(str(tmp_path / "kv"))
+    db.submit(db.create_transaction().set("p", "seed", b"v"))
+    for i in range(8):
+        db.submit_deferred(
+            db.create_transaction().set("p", f"d{i}", str(i).encode()))
+
+    entered, release = threading.Event(), threading.Event()
+    orig = db._wal.append_many
+
+    def slow_append(recs, sync=True):
+        entered.set()
+        assert release.wait(10), "test wedged: releaser never ran"
+        orig(recs, sync=sync)
+
+    db._wal.append_many = slow_append
+    flusher = threading.Thread(target=db.log_deferred, args=(db.seq,))
+    flusher.start()
+    assert entered.wait(10)
+
+    # the "fsync" is in flight and will stay stuck until `release`:
+    # reads must complete NOW (they only need the memory lock)
+    done = threading.Event()
+
+    def reader():
+        for _ in range(50):
+            assert db.get("p", "seed") == b"v"
+            assert db.get("p", "d0") == b"0"       # deferred: visible
+            assert [k for k, _ in db.iterate("p", start=b"d")][0] == b"d0"
+        done.set()
+
+    r = threading.Thread(target=reader)
+    r.start()
+    assert done.wait(5.0), \
+        "db.get/iterate stalled behind the WAL group fsync"
+    release.set()
+    flusher.join(10)
+    r.join(5)
+    db._wal.append_many = orig
+    # durability unaffected: reopen sees every record
+    db.close()
+    db2 = FileDB(str(tmp_path / "kv"))
+    assert db2.get("p", "d7") == b"7"
+    db2.close()
+
+
+def test_filedb_concurrent_submit_and_log_deferred(tmp_path):
+    """Seq order on the WAL survives submit() racing log_deferred()
+    across threads (the _io lock serializes appenders; _mu only guards
+    memory)."""
+    import threading
+
+    db = FileDB(str(tmp_path / "kv"))
+    stop = threading.Event()
+
+    def committer():
+        while not stop.is_set():
+            db.log_deferred(db.seq)
+
+    t = threading.Thread(target=committer)
+    t.start()
+    try:
+        for i in range(200):
+            if i % 3 == 0:
+                db.submit(db.create_transaction()
+                          .set("s", f"k{i:03d}", b"sync"))
+            else:
+                db.submit_deferred(db.create_transaction()
+                                   .set("s", f"k{i:03d}", b"def"))
+    finally:
+        stop.set()
+        t.join(10)
+    db.close()
+    db2 = FileDB(str(tmp_path / "kv"))
+    assert len(db2.keys("s")) == 200
+    db2.close()
+
+
 def test_memdb_remove_prefix_high_bytes():
     # regression: keys whose suffix starts with many 0xff bytes must be
     # removed by rmkeys_by_prefix and must not desync the sorted index
