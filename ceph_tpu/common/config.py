@@ -268,6 +268,11 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("osd_pool_default_min_size", "int", 0, "0 = size - size/2"),
     Option("osd_pool_default_pg_num", "int", 8, "pgs per new pool"),
     Option("osd_op_queue", "str", "wpq", "op scheduler (config_opts.h:706)"),
+    Option("osd_pg_max_inflight_ops", "int", 16,
+           "per-PG client-op window: ops on disjoint objects run "
+           "concurrently up to this depth, dependency-tracked by "
+           "object id (ShardedOpWQ + ObjectContext rw-state role); "
+           "1 = the old serial worker"),
     Option("osd_op_num_shards", "int", 5, "sharded op queue shards"),
     Option("osd_op_num_threads_per_shard", "int", 2, ""),
     Option("osd_recovery_max_active", "int", 3, "parallel recovery ops"),

@@ -48,6 +48,15 @@ class PerfCounters:
         with self._lock:
             self._vals[key] = v
 
+    def set_max(self, key: str, v: float) -> None:
+        """High-water-mark gauge: keep the larger of stored/new — for
+        groups shared by many samplers (e.g. every PG of an OSD feeds
+        one osd_op_window group), where a plain set() would let a
+        shallow sampler clobber a deeper one's mark."""
+        with self._lock:
+            if v > self._vals.get(key, 0):
+                self._vals[key] = v
+
     def tinc(self, key: str, seconds: float) -> None:
         with self._lock:
             self._sums[key] = self._sums.get(key, 0.0) + seconds

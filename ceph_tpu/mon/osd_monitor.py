@@ -44,6 +44,15 @@ class OSDMonitor(PaxosService):
         # set` arriving in that window (pending_inc resets on propose,
         # so neither it nor osdmap.flags carries the in-flight value)
         self._flags_target: Optional[int] = None
+        # ONE MOSDMap message per (start, end) epoch range, shared
+        # across every subscriber session: committed epochs are
+        # immutable, so the same Message object — and therefore its
+        # lazily-materialized wire-byte cache — serves all of them.
+        # Previously each subscriber push built and encoded its own
+        # copy (N encodes per epoch on an N-daemon cluster).
+        self._osdmap_msg_cache: Dict[tuple, MOSDMap] = {}
+        self.osdmap_msgs_built = 0     # cache misses (one per range)
+        self.osdmap_msgs_shared = 0    # cache hits (re-used messages)
 
     # ----------------------------------------------------------- state io
     def refresh(self) -> None:
@@ -128,7 +137,28 @@ class OSDMonitor(PaxosService):
 
     def build_osdmap_msg(self, start: int, end: int) -> MOSDMap:
         """Incrementals [start..end]; falls back to a full map when the
-        range predates start or is trimmed."""
+        range predates start or is trimmed.
+
+        The built message is CACHED per range and shared across
+        subscribers: the messenger encodes a message's body at most
+        once (Message.wire_bytes), so a 5-OSD cluster pays ONE encode
+        per epoch instead of five — and local-delivery receivers share
+        the object graph with zero encodes.  Safe because epoch blobs
+        are immutable and nothing mutates a message after send."""
+        key = (start, end)
+        cached = self._osdmap_msg_cache.get(key)
+        if cached is not None:
+            self.osdmap_msgs_shared += 1
+            return cached
+        msg = self._build_osdmap_msg(start, end)
+        if end >= 1:
+            self.osdmap_msgs_built += 1
+            if len(self._osdmap_msg_cache) >= 64:
+                self._osdmap_msg_cache.clear()
+            self._osdmap_msg_cache[key] = msg
+        return msg
+
+    def _build_osdmap_msg(self, start: int, end: int) -> MOSDMap:
         msg = MOSDMap()
         if end < 1:
             return msg   # nothing committed yet

@@ -486,6 +486,11 @@ class ReplicatedBackend(PGBackend):
             # clears it after flushing to the base pool
             from ceph_tpu.osd.tiering import DIRTY_XATTR
             txn.setattr(pg.cid, soid, DIRTY_XATTR, b"1")
+        # SUBMIT SECTION — await-free from version assignment through
+        # the fan-out sends below: under the per-PG op window this is
+        # what keeps pglog versions dense/ordered across concurrent
+        # ops and queue_transactions order == pglog order (the PR-1
+        # in-order commit callbacks ride that)
         version = pg.next_version()
         entry = LogEntry(LOG_DELETE if deletes else LOG_MODIFY, m.oid,
                          version, pg.info.last_update, m.reqid)
@@ -698,7 +703,6 @@ class ECBackend(PGBackend):
                        OP_OMAP_RM_KEYS, OP_OMAP_SET_HEADER}
         if any(op.op in unsupported for op in writes):
             return -errno.EOPNOTSUPP
-        version = pg.next_version()
         deletes = any(op.op == OP_DELETE for op in writes)
         # one txn PER SHARD, addressed at that shard's own collection
         # (each shard osd stores under <pool>.<seed>s<shard>_head);
@@ -766,6 +770,16 @@ class ECBackend(PGBackend):
                     t.rmattr(cids[i], soid, op.name)
             else:
                 return -errno.EOPNOTSUPP
+        # SUBMIT SECTION — version assignment through fan-out send is
+        # await-free, which is what makes this path re-entrant under
+        # the per-PG op window: concurrent ops on disjoint objects each
+        # take the next version atomically with their log append, so
+        # pglog versions stay dense/ordered and queue_transactions
+        # submission order == pglog order (the PR-1 in-order commit
+        # callbacks depend on it).  The old placement — version taken
+        # BEFORE the encode awaits — would hand two concurrent ops the
+        # same version.
+        version = pg.next_version()
         entry = LogEntry(LOG_DELETE if deletes else LOG_MODIFY, m.oid,
                          version, pg.info.last_update, m.reqid)
         if not deletes:

@@ -67,6 +67,15 @@ class OSD(Dispatcher):
         for key in ("scrubs_light", "scrubs_deep", "scrub_errors",
                     "scrub_repaired"):
             self.perf_scrub.add_u64(key)
+        # per-PG op-window pipelining evidence, aggregated OSD-wide
+        # (`perf dump` osd_op_window): inflight_depth is sampled at
+        # every admission, so sum/avgcount is the achieved mean depth
+        # — bench ec_e2e and test_perf_smoke read it
+        self.perf_window = ctx.perf.create("osd_op_window")
+        for key in ("ops_admitted", "window_drains",
+                    "max_inflight_depth"):
+            self.perf_window.add_u64(key)
+        self.perf_window.add_avg("inflight_depth")
         self._scrub_task: Optional[asyncio.Task] = None
         from ceph_tpu.common.op_tracker import OpTracker
         self.op_tracker = OpTracker()
@@ -431,8 +440,7 @@ class OSD(Dispatcher):
                 # own map advance will instantiate the PG if we belong
                 from ceph_tpu.osd.pglog import PGInfo
                 self.send_osd(m.from_osd, MPGNotify(
-                    m.pgid, m.epoch, PGInfo(m.pgid).to_bytes(),
-                    self.whoami))
+                    m.pgid, m.epoch, PGInfo(m.pgid), self.whoami))
             return True
         if isinstance(m, MPGRemove):
             self._handle_pg_remove(m)

@@ -84,6 +84,12 @@ class ECBatchQueue:
                     "host_requests", "host_bytes"):
             self.perf.add_u64(key)
         self.perf.add_avg("batch_fill")    # requests per device launch
+        # concurrent encodes parked in the collector at each arrival:
+        # with the per-PG op window (osd_pg_max_inflight_ops) every PG
+        # contributes several stripes, so mean pending_depth > 1 is
+        # the batch collector actually filling (it never could when
+        # each PG held one op in flight)
+        self.perf.add_avg("pending_depth")
         self._device_ok: Optional[bool] = None
         self._probe_started = False
 
@@ -161,6 +167,7 @@ class ECBatchQueue:
             _Req((mat.shape, mat.tobytes()),
                  np.ascontiguousarray(mat, np.uint8), chunks, fut))
         self._pending_bytes += nbytes
+        self.perf.tinc("pending_depth", len(self._pending))
         self._wake.set()
         if self._task is None or self._task.done():
             self._task = loop.create_task(self._collector())

@@ -495,22 +495,44 @@ class MPGQuery(Message):
         return cls(dec.struct(PGId), dec.u32(), dec.s32())
 
 
+def _pg_state_payload(v) -> LazyPayload:
+    """Coerce a PGInfo/PGLog message field into a LazyPayload.  Bytes
+    and payloads pass through (wire/decode path, fan-out sharing); a
+    LIVE object is SNAPSHOTTED via its cheap ``mutable_copy`` — the
+    sender's pg keeps mutating its info/log after the send, and both
+    the lazily-materialized wire bytes and the local-delivery object
+    graph must reflect the state at construction time."""
+    if isinstance(v, (LazyPayload, bytes, bytearray, memoryview)) \
+            or v is None:
+        return LazyPayload.coerce(v)
+    return LazyPayload.seal(v.mutable_copy())
+
+
 @register_message
 class MPGNotify(Message):
-    """Peer replies with (or proactively sends) its pg_info bytes."""
+    """Peer replies with (or proactively sends) its pg_info — carried
+    as a LAZY payload (msg/payload.py): encodes only at a real TCP
+    socket, wire format unchanged (ROADMAP named the MPGLog/MPGNotify
+    pre-encode as the cold-path leftover)."""
     TYPE = 211
     PRIORITY = PRIO_HIGH
 
     def __init__(self, pgid: Optional[PGId] = None, epoch: int = 0,
-                 info_bytes: bytes = b"", from_osd: int = -1):
+                 info=b"", from_osd: int = -1):
         super().__init__()
         self.pgid = pgid or PGId(0, 0)
         self.epoch = epoch
-        self.info_bytes = info_bytes
+        self.info_payload = _pg_state_payload(info)
         self.from_osd = from_osd
 
+    def info(self):
+        """Receiver-owned PGInfo (mutable copy — copy discipline)."""
+        from ceph_tpu.osd.pglog import PGInfo
+        return self.info_payload.mutable(PGInfo)
+
     def encode_payload(self, enc: Encoder) -> None:
-        enc.struct(self.pgid).u32(self.epoch).bytes_(self.info_bytes)
+        enc.struct(self.pgid).u32(self.epoch)
+        enc.bytes_(self.info_payload.bytes())
         enc.s32(self.from_osd)
 
     @classmethod
@@ -518,7 +540,7 @@ class MPGNotify(Message):
         return cls(dec.struct(PGId), dec.u32(), dec.bytes_(), dec.s32())
 
     def local_cost(self) -> int:
-        return 128 + len(self.info_bytes)
+        return 128 + self.info_payload.cost()
 
 
 @register_message
@@ -563,19 +585,26 @@ class MPGLogRequest(Message):
 
 @register_message
 class MPGLog(Message):
-    """Log (+info) shipped to a peer (MOSDPGLog): activation / catch-up."""
+    """Log (+info) shipped to a peer (MOSDPGLog): activation / catch-up.
+
+    Both bodies are LAZY payloads: the sender passes its live PGInfo/
+    PGLog (snapshotted cheaply at construction — entry objects shared,
+    list copied), bytes materialize only at a real TCP socket, and
+    co-located receivers take ``info()``/``log()`` mutable copies with
+    zero encode/decode.  Wire format is byte-identical to the old
+    eager encoding (tests/test_payload.py asserts it)."""
     TYPE = 213
     PRIORITY = PRIO_HIGH
 
     def __init__(self, pgid: Optional[PGId] = None, epoch: int = 0,
-                 info_bytes: bytes = b"", log_bytes: bytes = b"",
+                 info=b"", log=b"",
                  from_osd: int = -1, activate: bool = False,
                  full_resync: bool = False, backfill_done: bool = False):
         super().__init__()
         self.pgid = pgid or PGId(0, 0)
         self.epoch = epoch
-        self.info_bytes = info_bytes
-        self.log_bytes = log_bytes
+        self.info_payload = _pg_state_payload(info)
+        self.log_payload = _pg_state_payload(log)
         self.from_osd = from_osd
         self.activate = activate
         # backfill-style resync: receiver must drop objects the primary
@@ -590,9 +619,21 @@ class MPGLog(Message):
         # (last_backfill resume, PG.h:1911)
         self.backfill_from = ""
 
+    def info(self):
+        """Receiver-owned PGInfo (mutable copy — copy discipline)."""
+        from ceph_tpu.osd.pglog import PGInfo
+        return self.info_payload.mutable(PGInfo)
+
+    def log(self):
+        """Receiver-owned PGLog (mutable copy: receivers adopt it as
+        their own log and keep appending)."""
+        from ceph_tpu.osd.pglog import PGLog
+        return self.log_payload.mutable(PGLog)
+
     def encode_payload(self, enc: Encoder) -> None:
-        enc.struct(self.pgid).u32(self.epoch).bytes_(self.info_bytes)
-        enc.bytes_(self.log_bytes).s32(self.from_osd)
+        enc.struct(self.pgid).u32(self.epoch)
+        enc.bytes_(self.info_payload.bytes())
+        enc.bytes_(self.log_payload.bytes()).s32(self.from_osd)
         enc.boolean(self.activate).boolean(self.full_resync)
         enc.boolean(self.backfill_done)
         enc.string(self.backfill_from)
@@ -605,7 +646,8 @@ class MPGLog(Message):
         return m
 
     def local_cost(self) -> int:
-        return 128 + len(self.info_bytes) + len(self.log_bytes)
+        return (128 + self.info_payload.cost()
+                + self.log_payload.cost())
 
 
 # --------------------------------------------------------------- recovery

@@ -99,6 +99,20 @@ class PG:
         else:
             self._op_queue = _FifoQueue()
         self._worker_task: Optional[asyncio.Task] = None
+        # per-PG op pipelining (osd/sequencer.py): up to
+        # osd_pg_max_inflight_ops client ops run concurrently as their
+        # own tasks, dependency-tracked by object id; barrier-class
+        # work drains the window first.  The depth counters live in
+        # one OSD-wide perf group so bench/perf-smoke can read the
+        # achieved pipelining without walking every PG.
+        from ceph_tpu.osd.sequencer import OpSequencer
+        self.op_window = OpSequencer(
+            osd.cfg["osd_pg_max_inflight_ops"],
+            perf=getattr(osd, "perf_window", None))
+        # task -> its MOSDOp: stop() must release each admitted op's
+        # OSD-wide accounting (dispatch throttle, OpTracker) even when
+        # the cancelled task never reached _do_client_op's finally
+        self._window_tasks: Dict[asyncio.Task, MOSDOp] = {}
         # request/reply matching for peering + recovery
         self._notify_waiters: Dict[int, asyncio.Future] = {}
         self._log_waiters: Dict[int, asyncio.Future] = {}
@@ -122,6 +136,7 @@ class PG:
         self._hitset_rotated = 0.0
         self._hitset_seq = 0
         self._hitsets_loaded = False
+        self._hitset_persisting = False   # windowed-op re-entrancy guard
         from ceph_tpu.osd.backend import ECBackend, ReplicatedBackend
         self.backend = (ECBackend(self) if pool.is_erasure()
                         else ReplicatedBackend(self))
@@ -367,16 +382,22 @@ class PG:
             if t is not None:
                 t.cancel()
         self._peering_task = self._worker_task = None
+        # in-flight windowed ops: cancel their tasks AND release their
+        # OSD-wide accounting here — a task cancelled while parked in
+        # slot.wait() (or never scheduled at all) would otherwise leak
+        # its dispatch-throttle budget and OpTracker entry forever
+        # (the throttle is OSD-wide: enough leaks wedge client intake)
+        for t, m in list(self._window_tasks.items()):
+            t.cancel()
+            self._finish_client_op(m)
+        self._window_tasks.clear()
         # drain queued-but-never-run ops so their TrackedOps don't sit in
         # the OpTracker's in-flight dump forever (the client will resend
         # against the new mapping on the next map epoch)
         while not self._op_queue.empty():
             m = self._op_queue.get_nowait()
-            tracked = getattr(m, "_tracked", None)
-            if tracked is not None and self.osd is not None:
-                self.osd.op_tracker.finish(tracked)
-            if self.osd is not None:
-                self.osd.messenger.put_dispatch_throttle(m)
+            if self.osd is not None and isinstance(m, MOSDOp):
+                self._finish_client_op(m)
 
     # ------------------------------------------------------------- peering
     async def _peer(self) -> None:
@@ -424,6 +445,15 @@ class PG:
         return probe, sorted(set(blocked))
 
     async def _peer_inner(self, epoch: int) -> None:
+        # window-drain-on-epoch-change (ROADMAP invariant): ops admitted
+        # under the old interval must finish or abort before peering
+        # mutates the log/info they execute against.  on_interval_change
+        # already failed their ack/read futures, so the drain completes
+        # promptly; ops that arrive from here on queue behind the
+        # worker's inline wait-for-active and hold no window slot.
+        await self.op_window.drain()
+        if epoch != self.interval_epoch:
+            return   # superseded while draining
         # The interval record kept incrementally by advance_map is only a
         # cache: a full-map jump (mon's >100-epoch subscription fallback)
         # would have collapsed every missed epoch into one interval with
@@ -578,11 +608,9 @@ class PG:
             self.pgid.with_shard(peer_shard), epoch, since,
             self.osd.whoami))
         try:
-            info_b, log_b = await asyncio.wait_for(fut, 15.0)
+            auth_info, auth_log = await asyncio.wait_for(fut, 15.0)
         finally:
             self._log_waiters.pop(peer, None)
-        auth_info = PGInfo.from_bytes(info_b)
-        auth_log = PGLog.from_bytes(log_b)
         # divergent local branch? (we have entries the auth log lacks)
         if auth_info.last_update < self.info.last_update:
             for e in self.log.rewind_to(auth_info.last_update):
@@ -854,7 +882,7 @@ class PG:
             self.peer_missing[p] = pm
             msg = MPGLog(
                 self.pgid.with_shard(self.shard_of(p)), epoch,
-                self.info.to_bytes(), self.log.to_bytes(), me,
+                self.info, self.log, me,
                 activate=True, full_resync=full_resync)
             msg.backfill_from = backfill_from
             self.osd.send_osd(p, msg)
@@ -925,8 +953,8 @@ class PG:
                             self.peer_info[p].backfill_complete = True
                         self.osd.send_osd(p, MPGLog(
                             self.pgid.with_shard(self.shard_of(p)),
-                            epoch, self.info.to_bytes(),
-                            self.log.to_bytes(), self.osd.whoami,
+                            epoch, self.info, self.log,
+                            self.osd.whoami,
                             activate=True, backfill_done=True))
                 self.log_.debug(f"{self.pgid} recovery complete")
                 if epoch == self.interval_epoch:
@@ -975,12 +1003,12 @@ class PG:
     # --------------------------------------------- peering message handlers
     def on_query(self, m: MPGQuery) -> None:
         self.osd.send_osd(m.from_osd, MPGNotify(
-            m.pgid, m.epoch, self.info.to_bytes(), self.osd.whoami))
+            m.pgid, m.epoch, self.info, self.osd.whoami))
 
     def on_notify(self, m: MPGNotify) -> None:
         fut = self._notify_waiters.get(m.from_osd)
         if fut is not None and not fut.done():
-            fut.set_result(PGInfo.from_bytes(m.info_bytes))
+            fut.set_result(m.info())
             return
         if (self.state == STATE_ACTIVE and self.is_primary()
                 and m.from_osd not in self.acting
@@ -1012,14 +1040,16 @@ class PG:
                                      self.info.last_update)
             return
         self.osd.send_osd(m.from_osd, MPGLog(
-            m.pgid, m.epoch, self.info.to_bytes(), self.log.to_bytes(),
+            m.pgid, m.epoch, self.info, self.log,
             self.osd.whoami, activate=False))
 
     def on_pg_log(self, m: MPGLog) -> None:
         if m.activate:
-            # primary activated us: adopt info/log (replica path)
+            # primary activated us: adopt info/log (replica path).
+            # m.log()/m.info() are OUR mutable copies (copy discipline:
+            # we adopt-and-append; the sender's snapshot stays frozen)
             since = self.info.last_update
-            new_log = PGLog.from_bytes(m.log_bytes)
+            new_log = m.log()
             txn = Transaction()
             if m.full_resync:
                 # drop what the primary will re-push: everything beyond
@@ -1075,7 +1105,7 @@ class PG:
                         self.missing.add(oid, e.version)
             prev_lb = self.info.last_backfill
             prev_lc = min(since, self.info.last_complete)
-            self.info = PGInfo.from_bytes(m.info_bytes)
+            self.info = m.info()
             self.info.pgid = self.pgid
             if self.missing and not m.full_resync:
                 self.info.last_complete = since   # honest cursor
@@ -1108,7 +1138,7 @@ class PG:
         else:
             fut = self._log_waiters.get(m.from_osd)
             if fut is not None and not fut.done():
-                fut.set_result((m.info_bytes, m.log_bytes))
+                fut.set_result((m.info(), m.log()))
 
     def on_push(self, m: MPGPush) -> None:
         def _ack():
@@ -1171,6 +1201,9 @@ class PG:
         now = _time.monotonic()
         if now - self._hitset_rotated < self.pool.hit_set_period:
             return
+        if self._hitset_persisting:
+            return   # a concurrent windowed op is already rotating
+        self._hitset_persisting = True
         sealed = self.hitset.current
         self.hitset.rotate()
         self._hitset_rotated = now
@@ -1187,6 +1220,8 @@ class PG:
                     self, f"_hitset_{old:016x}", [OSDOp(OP_DELETE)])
         except Exception:
             self.log_.exception(f"{self.pgid} hitset persist failed")
+        finally:
+            self._hitset_persisting = False
 
     async def _load_hitsets(self) -> None:
         """New primary: adopt the persisted hit-set window
@@ -1237,23 +1272,69 @@ class PG:
             klass = "client"
         self._op_queue.put_nowait(m, klass)
 
+    def _is_barrier_op(self, m: MOSDOp) -> bool:
+        """Whole-PG dependency class: ops that read or mutate PG-scope
+        state and must not interleave with per-object ops — pool-scope
+        ops carry no object id (PGLS listings and friends); everything
+        object-addressed is covered by the per-object chains (cls write
+        methods stage onto their own object only in this codebase)."""
+        return not m.oid
+
     async def _worker(self) -> None:
+        """The single ADMITTER (ShardedOpWQ role): dequeues in FIFO
+        order and feeds the dependency-tracked window (osd/sequencer.py)
+        — client ops on disjoint objects run concurrently as their own
+        tasks, same-object ops chain in queue order, barrier-class work
+        (scrub, agent passes, pool-scope ops) drains the window and
+        runs alone.  Replica sub-ops stay inline on the worker: their
+        apply path has no awaits before queue_transactions, so they
+        pipeline through the commit thread already and their arrival
+        order (== the primary's pglog submission order) is preserved."""
         from ceph_tpu.osd.messages import MPGScrub, MPGScrubScan
         from ceph_tpu.osd import scrub as scrub_mod
+        seq = self.op_window
         while True:
             m = await self._op_queue.get()
             try:
                 if callable(m):
-                    # internal work item (tier agent pass): serialized
-                    # with client ops on the same queue
+                    # internal work item (tier agent pass): iterates
+                    # PG objects — whole-PG barrier class
+                    await seq.drain()
                     await m()
                 elif isinstance(m, MOSDOp):
-                    await self._do_client_op(m)
+                    if self._is_barrier_op(m) \
+                            or self.state != STATE_ACTIVE:
+                        # barrier class — and any op arriving while
+                        # not active runs INLINE (window empty): its
+                        # wait-for-active must park the admission
+                        # queue, never occupy a window slot peering's
+                        # drain would then deadlock against
+                        await seq.drain()
+                        await self._do_client_op(m)
+                    else:
+                        await seq.wait_slot()
+                        m._windowed = True
+                        # writeback-tier reads are admitted EXCLUSIVE:
+                        # a cache miss promotes (an internal WRITE of
+                        # the object) — two shared readers of the same
+                        # cold object would otherwise race duplicate
+                        # promotes outside the per-object chain
+                        write = any(o.is_write() for o in m.ops) or (
+                            self.pool.is_tier()
+                            and self.pool.cache_mode == "writeback")
+                        slot = seq.admit(m.oid, write)
+                        task = asyncio.get_running_loop().create_task(
+                            self._run_windowed(m, slot))
+                        self._window_tasks[task] = m
+                        task.add_done_callback(
+                            lambda t: self._window_tasks.pop(t, None))
                 elif isinstance(m, MPGScrub):
-                    # scrub rides the op queue: no client write can
-                    # interleave with the scan (reference write blocking).
-                    # Stamps advance only when the scrub really ran — a
-                    # drop (re-peering) leaves the PG due for retry.
+                    # scrub drains the window: no client op can
+                    # interleave with the scan (reference write
+                    # blocking).  Stamps advance only when the scrub
+                    # really ran — a drop (re-peering) leaves the PG
+                    # due for retry.
+                    await seq.drain()
                     try:
                         if self.is_primary() and \
                                 self.state == STATE_ACTIVE:
@@ -1271,6 +1352,32 @@ class PG:
             except Exception:
                 self.log_.exception(f"{self.pgid} op failed: {m}")
 
+    async def _run_windowed(self, m: MOSDOp, slot) -> None:
+        """One admitted client op: wait out its object-dependency
+        chain, execute, release the slot (always — a failed op must
+        never wedge its successors)."""
+        try:
+            await slot.wait()
+            await self._do_client_op(m)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.log_.exception(f"{self.pgid} op failed: {m}")
+        finally:
+            self.op_window.release(slot)
+
+    def _finish_client_op(self, m: MOSDOp) -> None:
+        """Release one client op's OSD-wide accounting — OpTracker
+        entry + messenger dispatch-throttle budget.  IDEMPOTENT
+        (_tracked nulled, throttle_cost zeroed inside the messenger):
+        both the op's own finally and PG.stop()'s cancellation sweep
+        may call it for the same op."""
+        tracked = getattr(m, "_tracked", None)
+        if tracked is not None:
+            m._tracked = None
+            self.osd.op_tracker.finish(tracked)
+        self.osd.messenger.put_dispatch_throttle(m)
+
     async def _do_client_op(self, m: MOSDOp) -> None:
         """ReplicatedPG::do_op/execute_ctx distilled."""
         tracked = getattr(m, "_tracked", None)
@@ -1279,10 +1386,8 @@ class PG:
         try:
             await self._do_client_op_inner(m)
         finally:
-            if tracked is not None:
-                self.osd.op_tracker.finish(tracked)
-            # op done: release its intake budget (throttle backpressure)
-            self.osd.messenger.put_dispatch_throttle(m)
+            # op done: release tracker + intake budget (backpressure)
+            self._finish_client_op(m)
 
     async def _do_client_op_inner(self, m: MOSDOp) -> None:
         if not self.is_primary():
@@ -1291,6 +1396,14 @@ class PG:
                 m.tid, -errno.EAGAIN, map_epoch=self.osd.osdmap.epoch))
             return
         if self.state != STATE_ACTIVE:
+            if getattr(m, "_windowed", False):
+                # admitted while active, interval changed before we
+                # ran: abort NOW.  Parking here would hold a window
+                # slot peering's drain is waiting on (circular wait);
+                # the client resends against the new mapping anyway
+                self.osd.reply_to(m, MOSDOpReply(
+                    m.tid, -errno.EAGAIN, map_epoch=self.osd.osdmap.epoch))
+                return
             try:
                 await asyncio.wait_for(self._active_event.wait(), 30.0)
             except asyncio.TimeoutError:

@@ -110,6 +110,25 @@ class PGInfo(Encodable):
         self.last_scrub_stamp = 0
         self.last_deep_scrub_stamp = 0
 
+    def mutable_copy(self) -> "PGInfo":
+        """Cheap field copy (msg/payload.py copy discipline): senders
+        snapshot their live info into MPGLog/MPGNotify payloads and
+        receivers take their own copy — zero encode on local hops."""
+        c = PGInfo(self.pgid)
+        c.last_update = self.last_update
+        c.last_complete = self.last_complete
+        c.log_tail = self.log_tail
+        c.last_epoch_started = self.last_epoch_started
+        c.same_interval_since = self.same_interval_since
+        c.last_backfill = self.last_backfill
+        c.last_scrub_stamp = self.last_scrub_stamp
+        c.last_deep_scrub_stamp = self.last_deep_scrub_stamp
+        return c
+
+    def approx_size(self) -> int:
+        """Byte estimate for intake gates (must not force an encode)."""
+        return 96 + len(self.last_backfill)
+
     @property
     def backfill_complete(self) -> bool:
         """Derived view of the cursor (the old PG-level boolean)."""
@@ -233,6 +252,21 @@ class PGLog(Encodable):
     def reqids(self) -> Dict[str, EVersion]:
         """reqid -> version for duplicate-op detection (PGLog dup index)."""
         return {e.reqid: e.version for e in self.entries if e.reqid}
+
+    def mutable_copy(self) -> "PGLog":
+        """Cheap snapshot (msg/payload.py copy discipline): the entry
+        LIST is copied, the immutable LogEntry objects — and their
+        framed-bytes caches — are shared.  Senders snapshot into MPGLog
+        payloads (the live log keeps appending after send); receivers
+        adopt their own copy."""
+        c = PGLog()
+        c.entries = list(self.entries)
+        c.tail = self.tail
+        return c
+
+    def approx_size(self) -> int:
+        """Byte estimate for intake gates (must not force an encode)."""
+        return 32 + 64 * len(self.entries)
 
     def merge_from(self, other: "PGLog", since: EVersion) -> List[LogEntry]:
         """Append other's entries newer than ``since`` (== our head when

@@ -107,6 +107,37 @@ class Cluster:
             assert asyncio.get_event_loop().time() < deadline
             await asyncio.sleep(0.05)
 
+    async def write_burst(self, io, blobs: dict, iodepth: int = 16):
+        """Issue the writes with a bounded client iodepth (obj_bencher
+        concurrentios role).  iodepth > 1 is what lets the OSD-side
+        per-PG op window (osd_pg_max_inflight_ops) actually fill —
+        serial awaits can never have more than one op in flight."""
+        sem = asyncio.Semaphore(max(1, iodepth))
+
+        async def one(name, data):
+            async with sem:
+                await io.write_full(name, data)
+
+        await asyncio.gather(*[one(n, d) for n, d in blobs.items()])
+
+    def window_counters(self) -> dict:
+        """Aggregated per-PG op-window evidence across all OSDs:
+        mean/max in-flight depth + admissions (osd_op_window group)."""
+        s = n = admitted = drains = 0
+        mx = 0
+        for osd in self.osds.values():
+            d = osd.perf_window.dump()
+            depth = d.get("inflight_depth", {})
+            s += depth.get("sum", 0.0)
+            n += depth.get("avgcount", 0)
+            admitted += int(d.get("ops_admitted", 0))
+            drains += int(d.get("window_drains", 0))
+            mx = max(mx, int(d.get("max_inflight_depth", 0)))
+        return {"mean_inflight_depth": (s / n) if n else 0.0,
+                "max_inflight_depth": mx,
+                "ops_admitted": admitted,
+                "window_drains": drains}
+
     async def stop(self):
         for c in self.clients:
             await c.shutdown()

@@ -336,10 +336,15 @@ class BlockStore(ObjectStore):
         # event loop applies in memory; this thread batches the data
         # fsync + kv WAL sync for every transaction in flight
         self.db.pre_compact_hook = self._data_barrier
+        # small static gather base: the auto-tuner tracks the MEASURED
+        # barrier cost (EWMA) clamped to 4x this — on tmpfs the window
+        # stays at the ~0.1ms a cheap fsync costs, on a real disk it
+        # grows to the clamp so co-arriving txns share the 4ms+ fsync
         self._committer = KVSyncThread(
             "blockstore_commit",
             data_sync=self._data_barrier,
-            kv_sync=self.db.log_deferred)
+            kv_sync=self.db.log_deferred,
+            gather_window=0.001)
         self._committer.start()
         self.mounted = True
 

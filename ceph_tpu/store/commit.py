@@ -67,19 +67,32 @@ class KVSyncThread:
                  data_sync: Optional[Callable[[], None]] = None,
                  kv_sync: Optional[Callable[[int], None]] = None,
                  queue_max: int = QUEUE_MAX,
-                 gather_window: float = 0.0):
+                 gather_window: float = 0.0,
+                 auto_tune: bool = True):
         self.data_sync = data_sync
         self.kv_sync = kv_sync
         #: seconds to linger after the first item of a group so bursts
         #: coalesce.  Stores whose commit has real cost (fsync) batch
         #: naturally and leave this 0; RAM-backed stores set a tiny
         #: window so group commit still engages under concurrency.
+        #: This is the STATIC base; with auto_tune the effective window
+        #: tracks the observed barrier cost instead (see
+        #: _effective_window) — lingering longer than a barrier costs
+        #: buys nothing, and a static guess on a device whose fsync is
+        #: 4x slower under-batches by the same factor.
         self.gather_window = gather_window
+        #: adapt the window to the measured barrier latency (EWMA),
+        #: clamped to [0, 4x the static value].  Only engages on stores
+        #: with a REAL barrier hook — a RAM store has no fsync signal
+        #: to tune from and keeps its static window.
+        self.auto_tune = auto_tune
+        self._barrier_ewma: Optional[float] = None
         self.perf = PerfCounters(name)
         for key in ("commit_batches", "txns", "data_fsyncs", "kv_syncs",
                     "fsyncs_saved"):
             self.perf.add_u64(key)
         self.perf.add_avg("txns_per_batch")
+        self.perf.add_avg("commit_inflight")
         self.perf.add_time("commit_lat")
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_max)
         self._thread: Optional[threading.Thread] = None
@@ -185,8 +198,20 @@ class KVSyncThread:
                 return
             if self.gate is not None:
                 self.gate.wait()
-            if self.gather_window > 0.0:
-                time.sleep(self.gather_window)
+            win = self._effective_window()
+            if win > 0.0:
+                # linger ONLY when more submissions are actually in
+                # flight beyond what this group already holds: a lone
+                # closed-loop writer (iodepth 1) is blocked on THIS
+                # commit, so sleeping would add pure latency with zero
+                # batching gain — the exact p50 floor the bench
+                # measures.  Concurrent writers have submitted (or
+                # corked) before blocking, so the backlog check sees
+                # them.
+                with self._lock:
+                    backlog = self._submitted - self._completed
+                if backlog > len(got):
+                    time.sleep(win)
             group: List[_Item] = list(got)
             stop_after = False
             while True:
@@ -219,18 +244,45 @@ class KVSyncThread:
         if self.crash_at == point:
             raise InjectedCrash(point)
 
+    def _effective_window(self) -> float:
+        """The gather window actually slept: the EWMA of observed
+        barrier cost, clamped to [0, 4x] of the static value — linger
+        about as long as one barrier costs (that is exactly the span
+        co-arriving transactions can share), never more than 4x the
+        configured base.  Falls back to the static window while there
+        is no auto-tune signal (disabled, no real barrier hooks, or no
+        sample yet)."""
+        base = self.gather_window
+        if not self.auto_tune or self._barrier_ewma is None \
+                or base <= 0.0:
+            return base
+        return min(max(self._barrier_ewma, 0.0), 4.0 * base)
+
     def _commit(self, group: List[_Item]) -> None:
+        with self._lock:
+            # backlog depth at group start (submitted-not-yet-durable):
+            # the write-path pipelining evidence `perf dump` reports
+            self.perf.tinc("commit_inflight",
+                           self._submitted - self._completed)
         self._inject("before_data_sync", group)
         n_data = sum(1 for it in group if it.wrote_data)
+        t_barrier0 = time.perf_counter()
+        ran_barrier = False
         if n_data and self.data_sync is not None:
             self.data_sync()            # ONE barrier for the whole group
             self.perf.inc("data_fsyncs")
+            ran_barrier = True
         self._inject("before_kv", group)
         if self.kv_sync is not None:
             # ONE atomic kv submit covering every record of the group,
             # strictly after the data barrier (data-before-metadata)
             self.kv_sync(max(it.seq for it in group))
             self.perf.inc("kv_syncs")
+            ran_barrier = True
+        if ran_barrier:
+            dt = time.perf_counter() - t_barrier0
+            self._barrier_ewma = dt if self._barrier_ewma is None \
+                else 0.8 * self._barrier_ewma + 0.2 * dt
         self._inject("committed", group)
         now = time.perf_counter()
         self.perf.inc("commit_batches")
@@ -288,8 +340,10 @@ class KVSyncThread:
         d = self.perf.dump()
         tpb = d.get("txns_per_batch", {})
         lat = d.get("commit_lat", {})
+        inf = d.get("commit_inflight", {})
         n_b = tpb.get("avgcount", 0) or 0
         n_l = lat.get("avgcount", 0) or 0
+        n_i = inf.get("avgcount", 0) or 0
         return {
             "commit_batches": d.get("commit_batches", 0),
             "txns": d.get("txns", 0),
@@ -300,4 +354,10 @@ class KVSyncThread:
             "txns_per_batch": (tpb.get("sum", 0.0) / n_b) if n_b else 0.0,
             "commit_lat_ms": (lat.get("sum", 0.0) / n_l * 1e3)
             if n_l else 0.0,
+            # auto-tune evidence: the window actually slept (EWMA of
+            # barrier cost clamped to 4x static) + mean backlog depth
+            "gather_window_ms": round(self._effective_window() * 1e3, 4),
+            "gather_window_static_ms": round(self.gather_window * 1e3, 4),
+            "commit_inflight": (inf.get("sum", 0.0) / n_i)
+            if n_i else 0.0,
         }

@@ -167,8 +167,12 @@ class KStore(ObjectStore):
         # (a MemDB substrate has no deferral — log_deferred is a no-op
         # and the thread only groups/orders the commit callbacks)
         from ceph_tpu.store.commit import KVSyncThread
+        # static gather base for the barrier-cost auto-tuner (see
+        # BlockStore.mount): effective window = ewma(WAL fsync cost)
+        # clamped to [0, 4x this]
         self._committer = KVSyncThread("kstore_commit",
-                                       kv_sync=self.db.log_deferred)
+                                       kv_sync=self.db.log_deferred,
+                                       gather_window=0.001)
         self._committer.start()
 
     def umount(self) -> None:

@@ -65,6 +65,13 @@ async def bench(io, seconds: int, mode: str, block: int,
         "seconds": round(wall, 3),
         "ops": stats["ops"],
         "bytes": stats["bytes"],
+        # client iodepth (closed-loop writers): must exceed 1 for the
+        # OSD-side per-PG op window to fill (obj_bencher concurrentios)
+        "iodepth": concurrency,
+        # achieved concurrency: ops * mean latency / wall — how much of
+        # the requested iodepth the cluster actually sustained
+        "achieved_iodepth": round(stats["lat_sum"] / wall, 2)
+        if wall else 0.0,
         "mb_per_sec": round(stats["bytes"] / wall / 1e6, 3),
         "iops": round(stats["ops"] / wall, 1),
         "avg_lat_ms": round(1000 * stats["lat_sum"] / ops, 3),
@@ -148,7 +155,10 @@ def main(argv=None) -> int:
     ap.add_argument("--dir", default="./vcluster")
     ap.add_argument("-p", "--pool", default="rbd")
     ap.add_argument("-b", "--block-size", type=int, default=4 << 20)
-    ap.add_argument("-t", "--concurrent", type=int, default=16)
+    ap.add_argument("-t", "--concurrent", "--iodepth", type=int,
+                    default=16,
+                    help="closed-loop writer count (bench iodepth; the "
+                         "per-PG op window only fills when this > 1)")
     ap.add_argument("-s", "--snap", default="",
                     help="read from this pool snapshot")
     ap.add_argument("op", help="put|get|rm|ls|stat|bench|lspools|df|"

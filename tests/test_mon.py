@@ -325,3 +325,59 @@ def test_object_names_with_cursor_sentinel_rejected():
             await io.write_full("bad\U0010ffffname", b"x")
         await cl.stop()
     asyncio.run(run())
+
+
+def test_osdmap_msg_shared_across_subscribers():
+    """ISSUE 5 satellite: the mon builds ONE MOSDMap message per epoch
+    range and shares it across subscriber sessions — the message's
+    lazy wire cache then means ONE body encode per epoch range no
+    matter how many daemons subscribe (previously each push re-built
+    and re-encoded its own copy)."""
+    from ceph_tpu.msg import payload as payload_mod
+
+    async def run():
+        monmap, mons = await start_mons(1)
+        leader = await wait_quorum(mons)
+        client, cmsgr = await make_client(monmap)
+        # commit a couple of epochs so there is a real range to ship
+        await client.command({"prefix": "osd crush build-simple",
+                              "num_osds": 4})
+        await client.command({"prefix": "osd setmaxosd", "num": 8})
+        e = leader.osdmon.osdmap.epoch
+        assert e >= 2
+        m1 = leader.osdmon.build_osdmap_msg(1, e)
+        m2 = leader.osdmon.build_osdmap_msg(1, e)
+        assert m1 is m2                      # one message per range
+        assert leader.osdmon.osdmap_msgs_shared >= 1
+        payload_mod.reset_counters()
+        w1, w2 = m1.wire_bytes(), m2.wire_bytes()
+        assert w1 is w2                      # one ENCODE per range
+        assert payload_mod.counters()["msg_encode_calls"] == 1
+        # a different range is its own (cached) message
+        m3 = leader.osdmon.build_osdmap_msg(e, e)
+        assert m3 is not m1
+        assert leader.osdmon.build_osdmap_msg(e, e) is m3
+        await stop_all(mons, [cmsgr])
+
+    asyncio.run(run())
+
+
+def test_osdmap_encode_shared_in_multi_osd_cluster():
+    """5 subscribing OSDs (plus the admin client) ride shared MOSDMap
+    messages: the mon re-uses cached messages across sessions, so
+    builds stay bounded by distinct epoch RANGES (not sessions) and
+    sharing actually happens during boot."""
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(5)
+        await admin.pool_create("shr", pg_num=4)
+        io = admin.open_ioctx("shr")
+        await io.write_full("o", b"x")
+        osdmon = cl.mons[0].osdmon
+        built, shared = osdmon.osdmap_msgs_built, osdmon.osdmap_msgs_shared
+        # with 6+ subscribers tracking the same epochs, pushes must hit
+        # the cache: encodes scale with distinct ranges, not sessions
+        assert shared > 0, (built, shared)
+        await cl.stop()
+
+    asyncio.run(run())

@@ -117,6 +117,74 @@ def test_local_view_isolates_transport_envelope():
     assert v1.payload is m.payload
 
 
+def test_mpglog_mpgnotify_lazy_wire_identity_and_roundtrip():
+    """ISSUE 5 satellite: MPGLog/MPGNotify no longer pre-encode their
+    info/log at construction — wire bytes must stay byte-identical to
+    the old eager encoding, the decode round trip must reproduce the
+    sender's state, and the sender's live info/log mutating AFTER the
+    send must not leak into the payload (snapshot-at-construction)."""
+    from ceph_tpu.osd.messages import MPGLog, MPGNotify
+    from ceph_tpu.osd.pglog import PGInfo, PGLog
+
+    info = PGInfo(PGId(2, 5))
+    info.last_update = EVersion(3, 41)
+    info.last_complete = EVersion(3, 40)
+    info.last_epoch_started = 3
+    log = PGLog()
+    for v in (40, 41):
+        log.append(LogEntry(1, f"obj{v}", EVersion(3, v),
+                            EVersion(3, v - 1), f"c.{v}"))
+    # byte-identity vs the old eager construction (bytes passed in)
+    lazy = MPGLog(PGId(2, 5), 9, info, log, 1, activate=True)
+    lazy.backfill_from = "bf"
+    eager = MPGLog(PGId(2, 5), 9, info.to_bytes(), log.to_bytes(), 1,
+                   activate=True)
+    eager.backfill_from = "bf"
+    assert lazy.to_bytes() == eager.to_bytes()
+    nlazy = MPGNotify(PGId(2, 5), 9, info, 1)
+    neager = MPGNotify(PGId(2, 5), 9, info.to_bytes(), 1)
+    assert nlazy.to_bytes() == neager.to_bytes()
+    # round trip: receiver state equals sender state at send time
+    rt = MPGLog.from_bytes(lazy.to_bytes())
+    ri, rl = rt.info(), rt.log()
+    assert ri.last_update == info.last_update
+    assert ri.last_epoch_started == info.last_epoch_started
+    assert [e.version for e in rl.entries] \
+        == [e.version for e in log.entries]
+    assert MPGNotify.from_bytes(nlazy.to_bytes()).info().last_update \
+        == info.last_update
+    # snapshot discipline: sender keeps appending after construction
+    log.append(LogEntry(1, "obj42", EVersion(3, 42), EVersion(3, 41)))
+    info.last_update = EVersion(3, 42)
+    assert len(lazy.log().entries) == 2
+    assert lazy.info().last_update == EVersion(3, 41)
+    # receiver copies are isolated from each other (adopt-and-append)
+    l1, l2 = lazy.log(), lazy.log()
+    l1.append(LogEntry(1, "x", EVersion(3, 42), EVersion(3, 41)))
+    assert len(l2.entries) == 2
+
+
+def test_mpglog_local_delivery_zero_encode():
+    """The info/log payloads hand a co-located receiver mutable copies
+    without ever serializing (msg_encode_calls stays 0)."""
+    from ceph_tpu.osd.messages import MPGLog
+    from ceph_tpu.osd.pglog import PGInfo, PGLog
+
+    info = PGInfo(PGId(1, 1))
+    info.last_update = EVersion(2, 7)
+    log = PGLog()
+    log.append(LogEntry(1, "o", EVersion(2, 7), EVersion(2, 6)))
+    payload_mod.reset_counters()
+    m = MPGLog(PGId(1, 1), 4, info, log, 0, activate=True)
+    view = m.local_view()
+    ri, rl = view.info(), view.log()
+    assert ri.last_update == EVersion(2, 7)
+    assert rl.entries[0] is log.entries[0]   # immutable entries shared
+    c = payload_mod.counters()
+    assert c["msg_encode_calls"] == 0, c
+    assert c["msg_encode_bytes"] == 0, c
+
+
 def test_mosdop_local_view_isolates_result_fields():
     ops = [OSDOp(OP_WRITE, 0, 5, data=b"hello")]
     m = MOSDOp(PGId(1, 0), "o", None, ops, tid=9)

@@ -33,6 +33,11 @@ class SlowBarrierBlockStore(BlockStore):
     def mount(self):
         super().mount()
         self._committer.gather_window = 0.008
+        # pin the window: this store EMULATES a device with a fixed
+        # gather; the auto-tuner (tracks real barrier cost) would
+        # shrink it toward the 1ms fake barrier and the test would
+        # measure the tuner, not the group-commit machinery
+        self._committer.auto_tune = False
 
     def _data_barrier(self):
         time.sleep(0.001)
@@ -92,6 +97,36 @@ def test_cluster_write_burst_engages_group_commit(tmp_path):
     assert txns >= N_OBJS, txns
     assert fsyncs < txns, (fsyncs, txns)
     assert batches < txns and txns / batches > 1.0, (batches, txns)
+
+
+def test_pg_op_window_depth_engages():
+    """Regression guard for ISSUE 5's per-PG op pipelining (the twin
+    of the zero-encode guard): a concurrent write burst against a
+    single-PG pool must reach a counter-proven mean in-flight depth
+    > 1 — a reversion to the serial one-op-per-PG worker pins the
+    sampled depth at exactly 1.0 and fails here instead of only
+    showing up as flat bench numbers."""
+    from ceph_tpu.qa.cluster import Cluster
+
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(3)
+        # ONE pg: every write lands in the same window, so the client
+        # iodepth (24) translates directly into window depth
+        await admin.pool_create("winpool", pg_num=1)
+        io = admin.open_ioctx("winpool")
+        blobs = {f"w{i:03d}": bytes([i]) * 4096 for i in range(24)}
+        await cl.write_burst(io, blobs, iodepth=24)
+        win = cl.window_counters()
+        for k, v in blobs.items():
+            assert await io.read(k) == v
+        await cl.stop()
+        return win
+
+    win = asyncio.run(run())
+    assert win["ops_admitted"] >= 24, win
+    assert win["mean_inflight_depth"] > 1.0, win
+    assert win["max_inflight_depth"] > 1, win
 
 
 def test_cluster_rw_over_local_delivery(tmp_path):
