@@ -395,6 +395,11 @@ def stage_ec_e2e():
             # overhead the real system wouldn't pay either (it maps
             # co-located shards onto ICI collectives, SURVEY §2.4)
             c.config.set("ms_local_delivery", True)
+            # per-op span tracing: every microsecond of the write path
+            # is attributed to a named stage (common/tracer.py); the
+            # run reports the per-stage p50/p99 breakdown + the
+            # unattributed fraction
+            c.config.set("op_tracing", True)
             return c
         return f
 
@@ -443,12 +448,21 @@ def stage_ec_e2e():
         # per-PG op window evidence (achieved pipelining depth): one
         # aggregation lives in qa/cluster.py, shared with the tests
         win = cl.window_counters()
+        # per-op tracer: stage breakdown vs the independently measured
+        # e2e latencies — the unattributed fraction is the part of the
+        # op path no named stage covers (read BEFORE stop)
+        bd = cl.stage_breakdown(measured_e2e_s=sum(lats))
         # lazy-payload guard: with ms_local_delivery on, in-process hops
         # must not serialize message bodies at all (read BEFORE stop)
         enc = payload_mod.counters()
         await cl.stop()
         lats.sort()
+        stage_p = {name: [d["p50_ms"], d["p99_ms"]]
+                   for name, d in bd["stages"].items()}
         return {
+            "stage_p50_p99_ms": stage_p,
+            "attributed_s": bd["attributed_s"],
+            "unattributed_frac": bd["unattributed_frac"],
             "iodepth": iodepth,
             "pg_num": pg_num,
             "mean_inflight_depth": round(win["mean_inflight_depth"], 2),
@@ -766,6 +780,10 @@ def main():
             "p50_ms": on["p50_ms"], "p99_ms": on["p99_ms"],
             "p50_ms_off": off["p50_ms"], "p99_ms_off": off["p99_ms"],
             "device_byte_fraction": on["device_frac"],
+            # per-op tracer profile: stage -> [p50_ms, p99_ms], plus
+            # the fraction of measured e2e no named stage covers
+            "stage_p50_p99_ms": on.get("stage_p50_p99_ms", {}),
+            "unattributed_frac": on.get("unattributed_frac", 0.0),
             "msg_encode_calls": on.get("msg_encode_calls", 0),
             "msg_encode_bytes": on.get("msg_encode_bytes", 0),
             "store_txns_per_commit_batch": on.get(

@@ -29,7 +29,7 @@ class ObjectOperationError(Exception):
 
 class _InFlight:
     __slots__ = ("tid", "oid", "loc", "ops", "fut", "attempts", "snapid",
-                 "snapc")
+                 "snapc", "span", "span_sent")
 
     def __init__(self, tid, oid, loc, ops, fut, snapid=0, snapc=None):
         self.tid = tid
@@ -40,6 +40,8 @@ class _InFlight:
         self.attempts = 0
         self.snapid = snapid
         self.snapc = snapc      # (seq, [snapids]) selfmanaged override
+        self.span = None        # tracer span (op_tracing only)
+        self.span_sent = False  # first-send cut taken (resends skip)
 
 
 class Objecter(Dispatcher):
@@ -73,6 +75,13 @@ class Objecter(Dispatcher):
                     self._resend_later(op))
                 return True
             del self._inflight[m.tid]
+            if op.span is not None and not op.span.finished:
+                # close the trace: the reply transit back is the last
+                # chain segment, then op_total (t0 -> now) lands as the
+                # aux e2e the coverage guard measures the chain against
+                tr = self.ctx.tracer
+                op.span.cut("ack_delivery", tr.hist)
+                tr.finish(op.span)
             if not op.fut.done():
                 op.fut.set_result(m)
             return True
@@ -136,11 +145,21 @@ class Objecter(Dispatcher):
             elif pool is not None:
                 snap_seq = pool.snap_seq
                 snaps = sorted(pool.snaps, reverse=True)
-        self.messenger.send_message(
-            MOSDOp(pg, op.oid, loc, op.ops, op.tid,
+        m = MOSDOp(pg, op.oid, loc, op.ops, op.tid,
                    self.osdmap.epoch, reqid, snap_seq=snap_seq,
-                   snaps=snaps, snapid=op.snapid), addr,
-            peer_type="osd")
+                   snaps=snaps, snapid=op.snapid)
+        span = op.span
+        if span is not None and not op.span_sent:
+            # trace context rides the op: payload fields for the wire,
+            # the live span for zero-encode local delivery.  Resends
+            # after a map change keep the op's span but take no further
+            # client_submit cut (the chain cursor is mid-path by then).
+            m.trace_id, m.span_id = span.trace_id, span.span_id
+            m._span = span
+        self.messenger.send_message(m, addr, peer_type="osd")
+        if span is not None and not op.span_sent:
+            op.span_sent = True
+            span.cut("client_submit", self.ctx.tracer.hist)
 
     async def op_submit(self, oid: str, loc: ObjectLocator,
                         ops: List[OSDOp], timeout: float = 120.0,
@@ -156,6 +175,9 @@ class Objecter(Dispatcher):
         tid = self._tid
         fut = asyncio.get_running_loop().create_future()
         op = _InFlight(tid, oid, loc, ops, fut, snapid, snapc)
+        tr = self.ctx.tracer
+        if tr.enabled:
+            op.span = tr.start("osd_op")
         self._inflight[tid] = op
         self._send(op)
         try:

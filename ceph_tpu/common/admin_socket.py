@@ -32,6 +32,10 @@ class AdminSocket:
             "list available commands")
         self.register("perf dump", lambda cmd: ctx.perf.dump(),
                       "dump perf counters")
+        self.register("perf histogram dump",
+                      lambda cmd: ctx.perf.dump_histograms(),
+                      "latency histograms (log2-us buckets, "
+                      "p50/p99/p999) per counter group")
         self.register("config show", lambda cmd: ctx.config.dump(),
                       "dump current config values")
         self.register("config set", self._config_set,

@@ -336,4 +336,13 @@ DEFAULT_OPTIONS: List[Option] = [
            "service ticket lifetime (auth_service_ticket_ttl)"),
     Option("lockdep", "bool", False,
            "lock-order cycle detection (common/lockdep.cc role)"),
+    Option("op_tracing", "bool", False,
+           "Dapper-style per-op span tracing + per-stage latency "
+           "histograms (common/tracer.py; blkin/TrackedOp/"
+           "perf_histogram role).  Off by default and fully off-path "
+           "when off: no span allocation, no extra clock reads"),
+    Option("osd_op_complaint_time", "float", 30.0,
+           "ops in flight longer than this log one slow-op complaint "
+           "and count in the osd.slow_ops counter "
+           "(osd_op_complaint_time, osd/OSD.cc check_ops_in_flight)"),
 ]

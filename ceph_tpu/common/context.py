@@ -29,6 +29,8 @@ class Context:
             max_recent=self.config["log_max_recent"],
         )
         self.perf = PerfCountersCollection()
+        from ceph_tpu.common.tracer import Tracer
+        self.tracer = Tracer(self)
         self.cluster_log = ClusterLog(name)
         self.admin_socket = None  # attached by daemons (common/admin_socket.py)
         self.config.add_observer(["log_level"], self._on_log_level)

@@ -4,7 +4,15 @@ Reference parity: common/TrackedOp.h:31,57,125 (OpTracker/TrackedOp/
 OpHistory) — every client op registers on arrival, marks named events
 with timestamps, and lands in a bounded history ring on completion;
 dumped via the admin socket as dump_ops_in_flight / dump_historic_ops
-(osd/OSD.cc:1790-1801).
+(osd/OSD.cc:1790-1801).  Slow-op complaints follow
+OSD::check_ops_in_flight: ops older than osd_op_complaint_time log
+once, bump the osd.slow_ops counter, and land in a dedicated history
+ring served as dump_historic_slow_ops.
+
+Clock discipline: ages and durations use time.monotonic() — wall-clock
+steps (ntp, operator date set) must never make an op's age negative or
+trip a spurious slow-op storm.  Wall time appears ONLY in dump output,
+reconstructed from a wall anchor taken at op creation.
 """
 
 from __future__ import annotations
@@ -16,41 +24,63 @@ from typing import Deque, Dict, List, Optional
 
 
 class TrackedOp:
-    __slots__ = ("seq", "desc", "start", "events", "done_at")
+    __slots__ = ("seq", "desc", "start", "wall_start", "events",
+                 "done_at", "complained", "span")
 
     def __init__(self, seq: int, desc: str):
         self.seq = seq
         self.desc = desc
-        self.start = time.time()
+        # monotonic is the measuring clock; the wall anchor exists only
+        # so dumps can show human-readable stamps
+        self.start = time.monotonic()
+        self.wall_start = time.time()
         self.events: List[tuple] = [(self.start, "initiated")]
         self.done_at: Optional[float] = None
+        self.complained = False      # slow-op logged once already
+        self.span = None             # live tracer span (event mirror)
 
     def mark(self, event: str) -> None:
-        self.events.append((time.time(), event))
+        self.events.append((time.monotonic(), event))
+        if self.span is not None:
+            # OpTracker marks become span events (TrackedOp -> blkin)
+            self.span.event(event)
 
     def age(self) -> float:
-        return (self.done_at or time.time()) - self.start
+        return (self.done_at or time.monotonic()) - self.start
+
+    def _wall(self, t_mono: float) -> float:
+        return self.wall_start + (t_mono - self.start)
 
     def dump(self) -> Dict:
-        return {
+        d = {
             "seq": self.seq,
             "description": self.desc,
-            "initiated_at": self.start,
+            "initiated_at": self.wall_start,
             "age": round(self.age(), 6),
-            "events": [{"time": round(t, 6), "event": e}
+            "events": [{"time": round(self._wall(t), 6), "event": e}
                        for t, e in self.events],
         }
+        if self.span is not None:
+            d["trace"] = self.span.dump()
+        return d
 
 
 class OpTracker:
     """Per-daemon op registry (common/TrackedOp.h OpTracker)."""
 
     def __init__(self, history_size: int = 20,
-                 history_duration: float = 600.0):
+                 history_duration: float = 600.0,
+                 complaint_time: float = 30.0,
+                 perf=None, logger=None):
         self._seq = itertools.count(1)
         self._inflight: Dict[int, TrackedOp] = {}
         self._history: Deque[TrackedOp] = deque(maxlen=history_size)
+        self._slow_history: Deque[TrackedOp] = deque(maxlen=history_size)
         self.history_duration = history_duration
+        self.complaint_time = complaint_time
+        self.perf = perf              # group carrying the slow_ops u64
+        self.logger = logger
+        self.slow_op_count = 0
 
     def create(self, desc: str) -> TrackedOp:
         op = TrackedOp(next(self._seq), desc)
@@ -59,9 +89,31 @@ class OpTracker:
 
     def finish(self, op: TrackedOp, event: str = "done") -> None:
         op.mark(event)
-        op.done_at = time.time()
+        op.done_at = time.monotonic()
         self._inflight.pop(op.seq, None)
         self._history.append(op)
+        if op.complained:
+            self._slow_history.append(op)
+
+    def check_slow(self) -> int:
+        """Scan in-flight ops for slow ones (OSD::check_ops_in_flight):
+        each op complains at most ONCE — one log line + one slow_ops
+        bump per op, however long it lingers.  Returns how many new
+        complaints this pass raised."""
+        raised = 0
+        for op in list(self._inflight.values()):
+            if op.complained or op.age() <= self.complaint_time:
+                continue
+            op.complained = True
+            op.mark("slow_op_complaint")
+            self.slow_op_count += 1
+            raised += 1
+            if self.perf is not None:
+                self.perf.inc("slow_ops")
+            if self.logger is not None:
+                self.logger.warning(
+                    f"slow request {op.age():.3f}s in flight: {op.desc}")
+        return raised
 
     def dump_in_flight(self) -> Dict:
         ops = [o.dump() for o in
@@ -69,7 +121,14 @@ class OpTracker:
         return {"num_ops": len(ops), "ops": ops}
 
     def dump_historic(self) -> Dict:
-        now = time.time()
+        now = time.monotonic()
         ops = [o.dump() for o in self._history
                if now - (o.done_at or now) <= self.history_duration]
         return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_slow_ops(self) -> Dict:
+        now = time.monotonic()
+        ops = [o.dump() for o in self._slow_history
+               if now - (o.done_at or now) <= self.history_duration]
+        return {"num_ops": len(ops), "complaint_time": self.complaint_time,
+                "total_slow_ops": self.slow_op_count, "ops": ops}

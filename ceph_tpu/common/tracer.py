@@ -1,0 +1,245 @@
+"""Distributed per-op span tracing for the RADOS write path.
+
+Reference parity: the combination of blkin/zipkin tracing hooks
+(common/zipkin_trace.h), TrackedOp event marks (common/TrackedOp.h) and
+PerfHistogram (common/perf_histogram.h) — Dapper-style spans (Sigelman
+et al., 2010) threaded through client → messenger → PG → backend →
+store, with every named stage interval landing in a log2-bucketed
+latency histogram so "37 ms/op of overhead" decomposes into named
+microseconds.
+
+Design:
+
+  * The Objecter issues (trace_id, span_id) per client op.  The ids
+    ride the op-path messages as versioned trailing fields (MOSDOp v3,
+    MOSDOpReply/MOSDRepOp/MOSDECSubOpWrite v2); zero-encode local
+    delivery carries the LIVE ``Span`` object itself (``Message._span``
+    survives ``local_view()``), so co-located daemons cut stages on the
+    client's span under one shared monotonic clock.  A TCP receiver
+    adopts a fresh span handle from the wire ids and records its local
+    stages into its own histograms under the same trace.
+
+  * A span is a CUT CHAIN: ``cut(stage)`` attributes everything since
+    the previous cut to ``stage`` and advances the cursor, so the chain
+    stages tile the op's wall time with no gaps and no double counting.
+    The difference between an externally measured e2e latency and the
+    chain sum is therefore an honest *unattributed-time fraction*
+    (event-loop resume hops, uninstrumented paths) — bench ec_e2e
+    reports it and test_perf_smoke guards it ≥90% attributed.
+
+  * Auxiliary stages (``repl_*`` replica-side work, ``op_total``)
+    OVERLAP chain stages (a replica applies inside the primary's
+    ``replica_rtt``) and are excluded from the chain sum.
+
+  * Fully off-path when disabled (``op_tracing=false``, the default):
+    no span allocation, no clock reads — every call site guards on
+    ``tracer.enabled`` / ``span is not None``, and the tracer caches
+    the config flag with an observer so the check is one attribute
+    load per op.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.common.perf_counters import PerfHistogram
+
+#: Stages that tile the client-visible op timeline (the cut chain, in
+#: path order).  Everything else (repl_*, op_total) is auxiliary and
+#: overlaps these — never sum the two sets together.
+CHAIN_STAGES = (
+    "client_submit",    # objecter: op build + target calc + send
+    "deliver",          # messenger transit + intake queue (pre-throttle)
+    "throttle_wait",    # dispatch-throttle wait (OSD intake budget)
+    "queue_wait",       # PG op queue + sequencer slot admission wait
+    "admit_wait",       # sequencer window-slot wait (window full)
+    "dep_wait",         # per-object dependency chain wait
+    "prepare",          # guards, recover-before-write, cow, txn build
+    "ec_encode",        # EC: encode awaits + per-shard txn build
+    "store_apply",      # version + pglog append + store apply/enqueue
+    "submit",           # payload seal + replica/shard fan-out sends
+    "replica_rtt",      # all replica/shard acks gathered
+    "commit_wait",      # residual local group-commit wait (post-acks)
+    "op_exec",          # read-class execution (reads only)
+    "ack_delivery",     # reply transit back to the client dispatch
+)
+
+#: Auxiliary (non-chain) stages, for dump annotation.
+AUX_STAGES = ("op_total", "repl_apply", "repl_commit")
+
+STAGE_GROUP = "op_stages"
+
+
+class Span:
+    """One traced op (or sub-op): ids + the stage cut chain."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "t0",
+                 "_cursor", "stages", "events", "finished")
+
+    def __init__(self, trace_id: int, span_id: int, name: str = "op",
+                 parent_id: int = 0, t0: Optional[float] = None):
+        now = time.monotonic() if t0 is None else t0
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.t0 = now
+        self._cursor = now
+        self.stages: List[Tuple[str, float]] = []
+        self.events: List[Tuple[float, str]] = []
+        self.finished = False
+
+    def cut(self, stage: str, hist=None) -> float:
+        """Attribute everything since the last cut to `stage`, advance
+        the cursor, and (optionally) record into `hist` — the calling
+        daemon's op_stages group, so attribution lands where the time
+        was actually spent."""
+        if self.finished:
+            return 0.0
+        now = time.monotonic()
+        dt = now - self._cursor
+        self._cursor = now
+        self.stages.append((stage, dt))
+        if hist is not None:
+            hist.hinc(stage, dt)
+        return dt
+
+    def event(self, name: str) -> None:
+        """Point-in-time span event (OpTracker marks land here)."""
+        self.events.append((time.monotonic(), name))
+
+    def finish(self, hist=None) -> float:
+        """Close the span; records the aux `op_total` (t0 → now) which
+        the coverage guard measures the chain sum against."""
+        if self.finished:
+            return 0.0
+        self.finished = True
+        total = time.monotonic() - self.t0
+        self.stages.append(("op_total", total))
+        if hist is not None:
+            hist.hinc("op_total", total)
+        return total
+
+    def dump(self) -> Dict[str, object]:
+        return {
+            "trace_id": f"{self.trace_id:x}",
+            "span_id": f"{self.span_id:x}",
+            "name": self.name,
+            "stages": [{"stage": s, "ms": round(dt * 1e3, 4)}
+                       for s, dt in self.stages],
+            "events": [e for _, e in self.events],
+        }
+
+
+class Tracer:
+    """Per-context tracing frontend: enablement cache + stage group.
+
+    One per Context (client and every daemon own one); spans travel
+    between them, histogram records stay local to the recorder."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self._hist = None
+        try:
+            self.enabled = bool(ctx.config["op_tracing"])
+        except KeyError:
+            self.enabled = False
+        try:
+            ctx.config.add_observer(["op_tracing"], self._on_cfg)
+        except Exception:
+            pass
+
+    def _on_cfg(self, changed: set) -> None:
+        self.enabled = bool(self.ctx.config["op_tracing"])
+
+    @property
+    def hist(self):
+        """This daemon's stage-histogram group (lazy: groups only exist
+        on contexts that actually record)."""
+        if self._hist is None:
+            self._hist = self.ctx.perf.create(STAGE_GROUP)
+        return self._hist
+
+    def start(self, name: str = "osd_op") -> Optional[Span]:
+        """New root span, or None when tracing is off (callers guard
+        every downstream touch on that None)."""
+        if not self.enabled:
+            return None
+        return Span(random.getrandbits(63) | 1,
+                    random.getrandbits(63) | 1, name)
+
+    def adopt(self, trace_id: int, span_id: int,
+              t0: Optional[float] = None) -> Span:
+        """Span handle for wire-propagated ids (TCP receive side): the
+        cursor starts at t0 (receive stamp) so local stages attribute
+        correctly; the network transit itself stays unattributed here."""
+        return Span(trace_id, span_id, "remote", parent_id=span_id,
+                    t0=t0)
+
+    def finish(self, span: Span) -> float:
+        return span.finish(self.hist)
+
+
+# ---------------------------------------------------------- aggregation
+
+def merge_stage_histograms(ctxs) -> Dict[str, PerfHistogram]:
+    """Merge every context's op_stages group into fresh per-stage
+    histograms (bench + qa aggregate client and all daemons of an
+    in-process cluster with this)."""
+    merged: Dict[str, PerfHistogram] = {}
+    for ctx in ctxs:
+        group = ctx.perf._groups.get(STAGE_GROUP) \
+            if hasattr(ctx.perf, "_groups") else None
+        if group is None:
+            continue
+        for stage, h in group.histograms().items():
+            merged.setdefault(stage, PerfHistogram()).merge(h)
+    return merged
+
+
+def stage_table(perf_collection) -> Dict[str, object]:
+    """`dump_op_stages` admin-socket body: per-stage quantiles from this
+    daemon's op_stages group, chain stages in path order first."""
+    group = perf_collection._groups.get(STAGE_GROUP)
+    if group is None:
+        return {"stages": {}, "chain_s": 0.0}
+    hists = group.histograms()
+    stages: Dict[str, Dict] = {}
+    for name in CHAIN_STAGES:
+        if name in hists:
+            stages[name] = hists[name].dump()
+    for name, h in sorted(hists.items()):
+        if name not in stages:
+            d = h.dump()
+            d["aux"] = True
+            stages[name] = d
+    chain_s = sum(hists[n].sum for n in CHAIN_STAGES if n in hists)
+    return {"stages": stages, "chain_s": round(chain_s, 6)}
+
+
+def breakdown(merged: Dict[str, PerfHistogram],
+              measured_e2e_s: Optional[float] = None) -> Dict[str, object]:
+    """Stage breakdown + unattributed fraction from merged histograms.
+
+    measured_e2e_s: externally measured total op seconds (sum of
+    client-observed latencies).  Falls back to the op_total histogram
+    (span creation → reply dispatch) when absent."""
+    stages = {}
+    for name in CHAIN_STAGES + AUX_STAGES:
+        h = merged.get(name)
+        if h is not None and h.count:
+            stages[name] = h.dump()
+    attributed = sum(merged[n].sum for n in CHAIN_STAGES if n in merged)
+    total = measured_e2e_s
+    if total is None:
+        ot = merged.get("op_total")
+        total = ot.sum if ot is not None else 0.0
+    unattr = max(0.0, 1.0 - attributed / total) if total else 0.0
+    return {
+        "stages": stages,
+        "attributed_s": round(attributed, 6),
+        "measured_s": round(total, 6),
+        "unattributed_frac": round(unattr, 4),
+    }

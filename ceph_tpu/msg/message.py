@@ -67,6 +67,11 @@ class Message(Encodable):
         # lazily-materialized wire body (msg/payload.py): encoded once,
         # only when a frame actually hits a TCP socket
         self._wire: Optional[bytes] = None
+        # live tracer span (common/tracer.py): never encoded — wire
+        # hops carry (trace_id, span_id) payload fields instead, while
+        # zero-encode local delivery hands the receiver this object so
+        # co-located daemons cut stages under one shared clock
+        self._span = None
 
     # --- lazy wire form (msg/payload.py) ---
     def wire_bytes(self) -> bytes:

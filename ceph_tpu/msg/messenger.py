@@ -688,8 +688,16 @@ class Messenger:
             msg.recv_stamp = time.monotonic()
             if (self.dispatch_throttle is not None
                     and msg.THROTTLE_DISPATCH):
+                # op tracing: the live span rode local_view — attribute
+                # transit-so-far as `deliver` and the budget wait as
+                # `throttle_wait` into THIS daemon's stage histograms
+                span = msg._span if self.ctx.tracer.enabled else None
+                if span is not None:
+                    span.cut("deliver", self.ctx.tracer.hist)
                 await self.dispatch_throttle.get(cost)
                 msg.throttle_cost = cost
+                if span is not None:
+                    span.cut("throttle_wait", self.ctx.tracer.hist)
             gate.put(cost)   # message left the intake queue
             self._dispatch(msg)
 
@@ -804,8 +812,14 @@ class Messenger:
                         if (self.dispatch_throttle is not None
                                 and msg.THROTTLE_DISPATCH):
                             cost = len(payload)
+                            span = msg._span
+                            if span is not None:
+                                span.cut("deliver", self.ctx.tracer.hist)
                             await self.dispatch_throttle.get(cost)
                             msg.throttle_cost = cost
+                            if span is not None:
+                                span.cut("throttle_wait",
+                                         self.ctx.tracer.hist)
                         self._dispatch(msg)
                 elif tag == TAG_KEEPALIVE:
                     pass
@@ -854,6 +868,18 @@ class Messenger:
             msg.auth_entity = auth_ticket.entity
             msg.auth_caps = auth_ticket.caps
         msg.recv_stamp = time.monotonic()
+        # op tracing across a REAL wire: adopt the propagated span
+        # context so downstream stage cuts attribute into THIS daemon's
+        # histograms under the sender's trace (the transit itself stays
+        # unattributed — different clocks cannot be differenced safely).
+        # Only throttled client-op classes consume an adopted span —
+        # replies resolve against the client's own op.span and replica
+        # sub-ops record aux stages off the raw ids — so everything
+        # else skips the per-message allocation
+        if (msg.THROTTLE_DISPATCH and self.ctx.tracer.enabled
+                and getattr(msg, "trace_id", 0)):
+            msg._span = self.ctx.tracer.adopt(
+                msg.trace_id, msg.span_id, t0=msg.recv_stamp)
         return msg
 
     def _dispatch(self, msg: Message) -> None:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import errno
+import time
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -51,6 +52,27 @@ VERSION_XATTR = "_ver"     # log version of the stored object state:
 class PGIntervalChanged(Exception):
     """The PG's acting set changed while an op was in flight; the op must
     abort promptly (client retries against the new mapping)."""
+
+
+class _ReplTrace:
+    """Replica-side aux stage clock (op tracer): repl_apply = sub-op
+    receipt -> txn queued, repl_commit = queued -> group-commit
+    callback.  Both overlap the primary's replica_rtt chain stage and
+    are recorded as auxiliary only."""
+
+    __slots__ = ("hist", "t0", "t_q")
+
+    def __init__(self, hist):
+        self.hist = hist
+        self.t0 = time.monotonic()
+        self.t_q = 0.0
+
+    def applied(self) -> None:
+        self.t_q = time.monotonic()
+        self.hist.hinc("repl_apply", self.t_q - self.t0)
+
+    def committed(self) -> None:
+        self.hist.hinc("repl_commit", time.monotonic() - self.t_q)
 
 
 class PGBackend:
@@ -101,6 +123,14 @@ class PGBackend:
             return True
         except (asyncio.TimeoutError, PGIntervalChanged):
             return False
+
+    def _repl_trace(self, m) -> "Optional[_ReplTrace]":
+        """Aux stage recorder for a traced replica sub-op, or None when
+        the op is untraced / this daemon's tracing is off."""
+        tr = self.osd.ctx.tracer
+        if tr.enabled and m.trace_id:
+            return _ReplTrace(tr.hist)
+        return None
 
     def _queue_txn(self, txn: Transaction,
                    on_commit=None) -> asyncio.Future:
@@ -486,6 +516,14 @@ class ReplicatedBackend(PGBackend):
             # clears it after flushing to the base pool
             from ceph_tpu.osd.tiering import DIRTY_XATTR
             txn.setattr(pg.cid, soid, DIRTY_XATTR, b"1")
+        # op tracing: the chain cursor last cut at dep_wait/queue_wait —
+        # everything up to here (guards, cow, cls, txn build) is the
+        # `prepare` stage; cuts below are synchronous, so the submit
+        # section stays await-free
+        span = m._span
+        th = self.osd.ctx.tracer.hist if span is not None else None
+        if span is not None:
+            span.cut("prepare", th)
         # SUBMIT SECTION — await-free from version assignment through
         # the fan-out sends below: under the per-PG op window this is
         # what keeps pglog versions dense/ordered across concurrent
@@ -508,6 +546,8 @@ class ReplicatedBackend(PGBackend):
         # trip — pglog last_complete advances from the commit callback
         commit_fut = self._queue_txn(
             txn, on_commit=lambda: pg.complete_to(version))
+        if span is not None:
+            span.cut("store_apply", th)
         # fan out to acting AND up: an up-but-not-acting member (pg_temp
         # backfill target) must see every write or its copy stales
         peers = {o for o in set(pg.acting) | set(pg.up)
@@ -516,14 +556,24 @@ class ReplicatedBackend(PGBackend):
         tid = self.osd.next_tid()
         fut = self._ack_init(tid, peers)
         for p in peers:
-            self.osd.send_osd(p, MOSDRepOp(
-                pg.pgid, tid, txn_payload, log_payload, version,
-                self.osd.osdmap.epoch))
+            rep = MOSDRepOp(pg.pgid, tid, txn_payload, log_payload,
+                            version, self.osd.osdmap.epoch)
+            if span is not None:
+                # propagate the trace so replica-side stage records
+                # land under the client's trace (wire: payload fields)
+                rep.trace_id, rep.span_id = span.trace_id, span.span_id
+            self.osd.send_osd(p, rep)
+        if span is not None:
+            span.cut("submit", th)
         if not await self._await_acks(fut):
             self._inflight.pop(tid, None)
             return -errno.EAGAIN   # interval change in flight: client resends
+        if span is not None:
+            span.cut("replica_rtt", th)
         if not await self._await_commit(commit_fut):
             return -errno.EAGAIN   # local store wedged: client resends
+        if span is not None:
+            span.cut("commit_wait", th)
         return 0
 
     async def do_reads(self, m: MOSDOp) -> int:
@@ -563,6 +613,7 @@ class ReplicatedBackend(PGBackend):
     async def handle_sub_message(self, m) -> None:
         pg = self.pg
         if isinstance(m, MOSDRepOp):
+            rt = self._repl_trace(m)
             # copy discipline: txn() is OUR mutable copy (save_meta
             # appends below must never reach the sender or a sibling
             # replica); the log entry is immutable and shared as-is
@@ -581,6 +632,8 @@ class ReplicatedBackend(PGBackend):
             src = int(m.src_name.id)
             reply = MOSDRepOpReply(pg.pgid, m.tid, 0, True,
                                    self.osd.whoami)
+            if rt is not None:
+                rt.applied()
 
             def _committed():
                 # last_complete and the repop ack advance TOGETHER from
@@ -590,6 +643,8 @@ class ReplicatedBackend(PGBackend):
                 # this one's group commits (commit pipelining)
                 if advance is not None:
                     pg.complete_to(advance)
+                if rt is not None:
+                    rt.committed()
                 self.osd.send_osd(src, reply)
 
             self.osd.store.queue_transactions([txn],
@@ -732,6 +787,12 @@ class ECBackend(PGBackend):
                     t.remove(cids[i], soid)
                     t.clone(cids[i], src, soid)
         writes = [op for op in writes if op.op != OP_ROLLBACK]
+        # op tracing: guards/cls/cow so far = `prepare`; the writes loop
+        # below holds the encode awaits = `ec_encode`
+        span = m._span
+        th = self.osd.ctx.tracer.hist if span is not None else None
+        if span is not None:
+            span.cut("prepare", th)
         from ceph_tpu.common.crc import crc32c
         from ceph_tpu.osd.scrub import CRC_XATTR
         empty_crc = str(crc32c(b"")).encode()
@@ -770,6 +831,8 @@ class ECBackend(PGBackend):
                     t.rmattr(cids[i], soid, op.name)
             else:
                 return -errno.EOPNOTSUPP
+        if span is not None:
+            span.cut("ec_encode", th)
         # SUBMIT SECTION — version assignment through fan-out send is
         # await-free, which is what makes this path re-entrant under
         # the per-PG op window: concurrent ops on disjoint objects each
@@ -794,6 +857,8 @@ class ECBackend(PGBackend):
         pg.append_log(local_txn, entry)
         commit_fut = self._queue_txn(
             local_txn, on_commit=lambda: pg.complete_to(version))
+        if span is not None:
+            span.cut("store_apply", th)
         # fan out to the other shards; each position also goes to its
         # UP holder when that differs from acting (pg_temp backfill
         # target keeps current while the complete copy serves).  The
@@ -820,9 +885,13 @@ class ECBackend(PGBackend):
                 tp = txn_payloads.get(i)
                 if tp is None:
                     tp = txn_payloads[i] = LazyPayload.seal(shard_txns[i])
-                sends.append((t_osd, MOSDECSubOpWrite(
+                sub = MOSDECSubOpWrite(
                     pg.pgid.with_shard(i), tid, tp, log_payload,
-                    version, self.osd.osdmap.epoch)))
+                    version, self.osd.osdmap.epoch)
+                if span is not None:
+                    sub.trace_id = span.trace_id
+                    sub.span_id = span.span_id
+                sends.append((t_osd, sub))
         fut = self._ack_init(tid, peers)
         ex = getattr(self.osd, "mesh_exec", None)
         for osd_id, msg in sends:
@@ -832,11 +901,17 @@ class ECBackend(PGBackend):
                                              self.osd.whoami):
                 continue
             self.osd.send_osd(osd_id, msg)
+        if span is not None:
+            span.cut("submit", th)
         if not await self._await_acks(fut):
             self._inflight.pop(tid, None)
             return -errno.EAGAIN
+        if span is not None:
+            span.cut("replica_rtt", th)
         if not await self._await_commit(commit_fut):
             return -errno.EAGAIN
+        if span is not None:
+            span.cut("commit_wait", th)
         return 0
 
     # -------------------------------------------------------------- reads
@@ -1379,6 +1454,7 @@ class ECBackend(PGBackend):
     async def handle_sub_message(self, m) -> None:
         pg = self.pg
         if isinstance(m, MOSDECSubOpWrite):
+            rt = self._repl_trace(m)
             # copy discipline: mutable txn copy, shared immutable entry
             # (see ReplicatedBackend.handle_sub_message)
             txn = m.txn()
@@ -1396,12 +1472,16 @@ class ECBackend(PGBackend):
             src = int(m.src_name.id)
             reply = MOSDECSubOpWriteReply(pg.pgid, m.tid, 0,
                                           self.my_shard, self.osd.whoami)
+            if rt is not None:
+                rt.applied()
 
             def _committed():
                 # EC sub-op ack + last_complete ride the commit callback
                 # in submission order (see MOSDRepOp above)
                 if advance is not None:
                     pg.complete_to(advance)
+                if rt is not None:
+                    rt.committed()
                 self.osd.send_osd(src, reply)
 
             self.osd.store.queue_transactions([txn],

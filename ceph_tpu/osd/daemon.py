@@ -77,8 +77,13 @@ class OSD(Dispatcher):
             self.perf_window.add_u64(key)
         self.perf_window.add_avg("inflight_depth")
         self._scrub_task: Optional[asyncio.Task] = None
+        # daemon-scope counters (osd.slow_ops etc — osd/OSD.cc l_osd_*)
+        self.perf_osd = ctx.perf.create("osd")
+        self.perf_osd.add_u64("slow_ops")
         from ceph_tpu.common.op_tracker import OpTracker
-        self.op_tracker = OpTracker()
+        self.op_tracker = OpTracker(
+            complaint_time=self.cfg["osd_op_complaint_time"],
+            perf=self.perf_osd, logger=self.logger)
         self.admin_socket = None
         self._stats_task: Optional[asyncio.Task] = None
         self.mesh_exec = None    # set when osd_mesh_mode=on (start())
@@ -517,6 +522,18 @@ class OSD(Dispatcher):
         m._tracked = self.op_tracker.create(
             f"osd_op({m.src_name} {m.oid} tid {m.tid} "
             f"{'+'.join(str(o.op) for o in m.ops)})")
+        # op tracing: local delivery carried the live span; a wire hop
+        # carried ids the messenger adopted into m._span.  Linking the
+        # TrackedOp makes every mark() a span event (TrackedOp->blkin).
+        # A daemon with tracing OFF drops the span here — per-daemon
+        # enablement means no cuts, no histograms, no clock reads on
+        # this host even when the CLIENT traced the op (the client's
+        # chain then books the gap into ack_delivery)
+        if m._span is not None:
+            if not self.ctx.tracer.enabled:
+                m._span = None
+            else:
+                m._tracked.span = m._span
         from ceph_tpu.osd.messages import OP_NOTIFY
         if m.ops and all(o.op == OP_NOTIFY for o in m.ops):
             # notify gathers remote acks for seconds and touches no
@@ -567,6 +584,16 @@ class OSD(Dispatcher):
             lambda cmd: self.op_tracker.dump_historic(),
             "recently completed client ops")
         sock.register(
+            "dump_historic_slow_ops",
+            lambda cmd: self.op_tracker.dump_historic_slow_ops(),
+            "recently completed ops that exceeded "
+            "osd_op_complaint_time (osd/OSD.cc parity)")
+        sock.register(
+            "dump_op_stages",
+            lambda cmd: self._dump_op_stages(),
+            "per-stage write-path latency breakdown "
+            "(op tracer histograms: p50/p99/p999 per stage)")
+        sock.register(
             "status", lambda cmd: {
                 "whoami": self.whoami,
                 "osdmap_epoch": self.osdmap.epoch,
@@ -588,6 +615,12 @@ class OSD(Dispatcher):
             "osd/OSD.cc:5583); args: [count [size]]")
         await sock.start()
         self.admin_socket = sock
+
+    def _dump_op_stages(self) -> dict:
+        from ceph_tpu.common import tracer as tracer_mod
+        out = tracer_mod.stage_table(self.ctx.perf)
+        out["op_tracing"] = bool(self.ctx.tracer.enabled)
+        return out
 
     async def _store_bench(self, count: int, size: int) -> dict:
         """Timed object writes straight at the ObjectStore — measures
@@ -819,6 +852,9 @@ class OSD(Dispatcher):
         while self.running:
             await asyncio.sleep(interval)
             try:
+                # slow-op sweep rides the heartbeat cadence (the
+                # reference's check_ops_in_flight tick)
+                self.op_tracker.check_slow()
                 now = time.monotonic()
                 peers = self._hb_peers()
                 stale = [p for p in peers

@@ -155,9 +155,12 @@ class EVersion(Encodable):
 class MOSDOp(Message):
     """Client -> primary OSD op (messages/MOSDOp.h).  v2 adds the snap
     context for writes (snap_seq + existing snap ids) and the read
-    snapid (0 = head), mirroring MOSDOp's snapc/snapid fields."""
+    snapid (0 = head), mirroring MOSDOp's snapc/snapid fields.  v3 adds
+    the optional trace header (trace_id/span_id, 0 = untraced —
+    common/tracer.py; blkin trace info role): old decoders skip it via
+    struct framing, old bytes decode as untraced."""
     TYPE = 200
-    STRUCT_V = 2
+    STRUCT_V = 3
     THROTTLE_DISPATCH = True     # client data ops bound OSD intake
 
     def __init__(self, pgid: Optional[PGId] = None, oid: str = "",
@@ -177,6 +180,8 @@ class MOSDOp(Message):
         self.snap_seq = snap_seq      # write snapc: newest pool snap seq
         self.snaps = snaps or []      # write snapc: existing snap ids
         self.snapid = snapid          # read target snap (0 = head)
+        self.trace_id = 0             # tracer span context (0 = none)
+        self.span_id = 0
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid).string(self.oid).struct(self.loc)
@@ -185,6 +190,7 @@ class MOSDOp(Message):
         enc.u64(self.snap_seq)
         enc.list_(self.snaps, lambda e, v: e.u64(v))
         enc.u64(self.snapid)
+        enc.u64(self.trace_id).u64(self.span_id)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDOp":
@@ -195,16 +201,24 @@ class MOSDOp(Message):
             m.snap_seq = dec.u64()
             m.snaps = dec.list_(lambda d: d.u64())
             m.snapid = dec.u64()
+        if struct_v >= 3:
+            m.trace_id = dec.u64()
+            m.span_id = dec.u64()
         return m
 
     def local_view(self) -> "MOSDOp":
         # copy-on-send: the executing OSD fills rval/outdata in place
         # and the reply carries the SAME op objects back — without this
         # copy a resent op could race two OSDs over one result vector
-        return MOSDOp(self.pgid, self.oid, self.loc,
+        view = MOSDOp(self.pgid, self.oid, self.loc,
                       [o.result_copy() for o in self.ops], self.tid,
                       self.map_epoch, self.reqid, self.snap_seq,
                       self.snaps, self.snapid)
+        view.trace_id, view.span_id = self.trace_id, self.span_id
+        # zero-encode local delivery carries the LIVE span: co-located
+        # daemons cut stages on the client's span object directly
+        view._span = self._span
+        return view
 
     def local_cost(self) -> int:
         return 128 + sum(o.cost() for o in self.ops)
@@ -212,7 +226,10 @@ class MOSDOp(Message):
 
 @register_message
 class MOSDOpReply(Message):
+    """v2 adds the trace header mirrored back from the request, so a
+    wire client can correlate replies to its spans."""
     TYPE = 201
+    STRUCT_V = 2
 
     def __init__(self, tid: int = 0, result: int = 0,
                  ops: Optional[List[OSDOp]] = None, map_epoch: int = 0):
@@ -221,16 +238,23 @@ class MOSDOpReply(Message):
         self.result = result
         self.ops = ops or []        # carry back per-op rval/outdata
         self.map_epoch = map_epoch
+        self.trace_id = 0
+        self.span_id = 0
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u64(self.tid).s32(self.result)
         enc.list_(self.ops, lambda e, o: e.struct(o))
         enc.u32(self.map_epoch)
+        enc.u64(self.trace_id).u64(self.span_id)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDOpReply":
-        return cls(dec.u64(), dec.s32(),
-                   dec.list_(lambda d: d.struct(OSDOp)), dec.u32())
+        m = cls(dec.u64(), dec.s32(),
+                dec.list_(lambda d: d.struct(OSDOp)), dec.u32())
+        if struct_v >= 2:
+            m.trace_id = dec.u64()
+            m.span_id = dec.u64()
+        return m
 
     def local_cost(self) -> int:
         return 128 + sum(o.cost() for o in self.ops)
@@ -244,8 +268,11 @@ class MOSDRepOp(Message):
     serialize only when a frame actually hits a TCP socket.  The wire
     format is unchanged ([txn bytes][log bytes]); on local delivery the
     receiver gets the sealed object graph and MUST take ``txn()`` (a
-    mutable copy) before appending its own save_meta ops."""
+    mutable copy) before appending its own save_meta ops.  v2 adds the
+    trace header (the primary's span context) so replica-side stage
+    records land under the client's trace."""
     TYPE = 202
+    STRUCT_V = 2
     PRIORITY = PRIO_HIGH
 
     def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
@@ -258,6 +285,8 @@ class MOSDRepOp(Message):
         self.log_payload = LazyPayload.coerce(log)
         self.version = version or EVersion()
         self.map_epoch = map_epoch
+        self.trace_id = 0
+        self.span_id = 0
 
     def txn(self):
         """Receiver-owned Transaction (mutable copy — copy discipline)."""
@@ -275,11 +304,16 @@ class MOSDRepOp(Message):
         enc.bytes_(self.txn_payload.bytes())
         enc.bytes_(self.log_payload.bytes())
         enc.struct(self.version).u32(self.map_epoch)
+        enc.u64(self.trace_id).u64(self.span_id)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDRepOp":
-        return cls(dec.struct(PGId), dec.u64(), dec.bytes_(), dec.bytes_(),
-                   dec.struct(EVersion), dec.u32())
+        m = cls(dec.struct(PGId), dec.u64(), dec.bytes_(), dec.bytes_(),
+                dec.struct(EVersion), dec.u32())
+        if struct_v >= 2:
+            m.trace_id = dec.u64()
+            m.span_id = dec.u64()
+        return m
 
     def local_cost(self) -> int:
         return 128 + self.txn_payload.cost() + self.log_payload.cost()
@@ -315,8 +349,10 @@ class MOSDECSubOpWrite(Message):
     """Primary -> EC shard write (messages/MOSDECSubOpWrite.h): the
     per-shard transaction produced after the TPU encode, payload-carried
     like MOSDRepOp (the log-entry payload is SHARED across the whole
-    shard fan-out, so it encodes at most once per write)."""
+    shard fan-out, so it encodes at most once per write).  v2 adds the
+    trace header like MOSDRepOp."""
     TYPE = 204
+    STRUCT_V = 2
     PRIORITY = PRIO_HIGH
 
     def __init__(self, pgid: Optional[PGId] = None, tid: int = 0,
@@ -329,6 +365,8 @@ class MOSDECSubOpWrite(Message):
         self.log_payload = LazyPayload.coerce(log)
         self.version = version or EVersion()
         self.map_epoch = map_epoch
+        self.trace_id = 0
+        self.span_id = 0
 
     txn = MOSDRepOp.txn
     log_entry = MOSDRepOp.log_entry
@@ -337,8 +375,12 @@ class MOSDECSubOpWrite(Message):
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int):
-        return cls(dec.struct(PGId), dec.u64(), dec.bytes_(), dec.bytes_(),
-                   dec.struct(EVersion), dec.u32())
+        m = cls(dec.struct(PGId), dec.u64(), dec.bytes_(), dec.bytes_(),
+                dec.struct(EVersion), dec.u32())
+        if struct_v >= 2:
+            m.trace_id = dec.u64()
+            m.span_id = dec.u64()
+        return m
 
 
 @register_message

@@ -108,7 +108,8 @@ class PG:
         from ceph_tpu.osd.sequencer import OpSequencer
         self.op_window = OpSequencer(
             osd.cfg["osd_pg_max_inflight_ops"],
-            perf=getattr(osd, "perf_window", None))
+            perf=getattr(osd, "perf_window", None),
+            tracer=getattr(osd.ctx, "tracer", None))
         # task -> its MOSDOp: stop() must release each admitted op's
         # OSD-wide accounting (dispatch throttle, OpTracker) even when
         # the cancelled task never reached _do_client_op's finally
@@ -1309,10 +1310,13 @@ class PG:
                         # wait-for-active must park the admission
                         # queue, never occupy a window slot peering's
                         # drain would then deadlock against
+                        if m._span is not None:
+                            m._span.cut("queue_wait",
+                                        self.osd.ctx.tracer.hist)
                         await seq.drain()
                         await self._do_client_op(m)
                     else:
-                        await seq.wait_slot()
+                        await seq.wait_slot(m._span)
                         m._windowed = True
                         # writeback-tier reads are admitted EXCLUSIVE:
                         # a cache miss promotes (an internal WRITE of
@@ -1358,6 +1362,8 @@ class PG:
         never wedge its successors)."""
         try:
             await slot.wait()
+            if m._span is not None:
+                m._span.cut("dep_wait", self.osd.ctx.tracer.hist)
             await self._do_client_op(m)
         except asyncio.CancelledError:
             raise
@@ -1463,10 +1469,17 @@ class PG:
                 result = await self.backend.submit_client_write(m)
             else:
                 result = await self.backend.do_reads(m)
+                if m._span is not None:
+                    # reads have no submit/commit cuts: attribute the
+                    # whole execution here so the chain stays tiled
+                    m._span.cut("op_exec", self.osd.ctx.tracer.hist)
         except PGIntervalChanged:
             result = -errno.EAGAIN
-        self.osd.reply_to(m, MOSDOpReply(
-            m.tid, result, m.ops, self.osd.osdmap.epoch))
+        reply = MOSDOpReply(m.tid, result, m.ops, self.osd.osdmap.epoch)
+        if m._span is not None:
+            reply.trace_id = m._span.trace_id
+            reply.span_id = m._span.span_id
+        self.osd.reply_to(m, reply)
 
     # -------------------------------------------------------- watch/notify
     def handle_watch(self, m, op) -> None:

@@ -75,7 +75,7 @@ class OpSequencer:
     and draining await — asyncio's run-to-completion makes the
     bookkeeping race-free without locks."""
 
-    def __init__(self, max_inflight: int, perf=None):
+    def __init__(self, max_inflight: int, perf=None, tracer=None):
         self.max_inflight = max(1, int(max_inflight))
         self.active = 0            # admitted, not yet released
         self.max_depth = 0         # high-water mark (counter)
@@ -85,15 +85,22 @@ class OpSequencer:
         self._idle = asyncio.Event()
         self._idle.set()
         self.perf = perf           # shared "osd_op_window" group or None
+        self.tracer = tracer       # op tracer (stage histograms) or None
 
     # -------------------------------------------------------------- admit
-    async def wait_slot(self) -> None:
+    async def wait_slot(self, span=None) -> None:
         """Admission backpressure: block the admitter while the window
         is full (the op queue keeps buffering behind it, and the
-        messenger dispatch throttle pushes back on clients)."""
+        messenger dispatch throttle pushes back on clients).  A traced
+        op cuts `queue_wait` (dispatch -> here: PG op-queue time) on
+        entry and `admit_wait` (a full window's slot wait) on exit."""
+        if span is not None and self.tracer is not None:
+            span.cut("queue_wait", self.tracer.hist)
         while self.active >= self.max_inflight:
             self._slot_free.clear()
             await self._slot_free.wait()
+        if span is not None and self.tracer is not None:
+            span.cut("admit_wait", self.tracer.hist)
 
     def admit(self, oid: str, write: bool) -> OpSlot:
         """Synchronously register one op: takes a window slot and links

@@ -28,6 +28,11 @@ FAST_CFG = {
     "osd_heartbeat_interval": 0.3,
     "osd_heartbeat_grace": 1.5,
     "mon_osd_down_out_interval": 3.0,
+    # quiet stderr (warnings only): daemon INFO chatter from dozens of
+    # in-process clusters corrupts pytest's progress lines when a
+    # background thread logs between tests; the in-memory ring still
+    # records every level for `log dump` assertions/introspection
+    "log_level": 0,
 }
 
 
@@ -137,6 +142,24 @@ class Cluster:
                 "max_inflight_depth": mx,
                 "ops_admitted": admitted,
                 "window_drains": drains}
+
+    def stage_histograms(self) -> dict:
+        """Merged op-tracer stage histograms across every daemon and
+        client of this in-process cluster: {stage: PerfHistogram}.
+        Empty unless the contexts ran with op_tracing=true."""
+        from ceph_tpu.common import tracer as tracer_mod
+        ctxs = [o.ctx for o in self.osds.values()]
+        ctxs += [m.ctx for m in self.mons]
+        ctxs += [c.ctx for c in self.clients]
+        return tracer_mod.merge_stage_histograms(ctxs)
+
+    def stage_breakdown(self, measured_e2e_s=None) -> dict:
+        """Per-stage quantiles + attributed/unattributed split (see
+        tracer.breakdown): the profile bench ec_e2e reports and
+        test_perf_smoke guards."""
+        from ceph_tpu.common import tracer as tracer_mod
+        return tracer_mod.breakdown(self.stage_histograms(),
+                                    measured_e2e_s)
 
     async def stop(self):
         for c in self.clients:

@@ -94,6 +94,10 @@ class KVSyncThread:
         self.perf.add_avg("txns_per_batch")
         self.perf.add_avg("commit_inflight")
         self.perf.add_time("commit_lat")
+        # full latency distribution (perf_histogram role): the mean
+        # above hides the p99 the op tracer's commit-group-wait stage
+        # needs to be checked against
+        self.perf.add_hist("commit_lat_hist")
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_max)
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -299,6 +303,7 @@ class KVSyncThread:
         self.perf.inc("fsyncs_saved", max(0, would_have - actual))
         for it in group:
             self.perf.tinc("commit_lat", now - it.t0)
+            self.perf.hinc("commit_lat_hist", now - it.t0)
         self._complete(group)
         with self._cv:
             self._completed += len(group)
@@ -341,6 +346,7 @@ class KVSyncThread:
         tpb = d.get("txns_per_batch", {})
         lat = d.get("commit_lat", {})
         inf = d.get("commit_inflight", {})
+        hist = d.get("commit_lat_hist", {})
         n_b = tpb.get("avgcount", 0) or 0
         n_l = lat.get("avgcount", 0) or 0
         n_i = inf.get("avgcount", 0) or 0
@@ -354,6 +360,8 @@ class KVSyncThread:
             "txns_per_batch": (tpb.get("sum", 0.0) / n_b) if n_b else 0.0,
             "commit_lat_ms": (lat.get("sum", 0.0) / n_l * 1e3)
             if n_l else 0.0,
+            "commit_lat_p50_ms": hist.get("p50_ms", 0.0),
+            "commit_lat_p99_ms": hist.get("p99_ms", 0.0),
             # auto-tune evidence: the window actually slept (EWMA of
             # barrier cost clamped to 4x static) + mean backlog depth
             "gather_window_ms": round(self._effective_window() * 1e3, 4),

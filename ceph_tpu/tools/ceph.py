@@ -124,6 +124,23 @@ def build_command(args, extra) -> dict:
     return cmd
 
 
+def _render_stage_table(stages: dict) -> str:
+    """Aligned per-stage latency table (dump_op_stages sugar)."""
+    rows = [f"{'stage':<16} {'count':>8} {'avg_ms':>10} {'p50_ms':>10} "
+            f"{'p99_ms':>10} {'p999_ms':>10}"]
+    for name, d in stages.items():
+        if not isinstance(d, dict) or "p50_ms" not in d:
+            continue
+        tag = "*" if d.get("aux") else " "
+        rows.append(
+            f"{name:<15}{tag} {d.get('count', 0):>8} "
+            f"{d.get('avg_ms', 0.0):>10.3f} {d.get('p50_ms', 0.0):>10.3f} "
+            f"{d.get('p99_ms', 0.0):>10.3f} {d.get('p999_ms', 0.0):>10.3f}")
+    rows.append("(* = auxiliary stage, overlaps the chain — not part "
+                "of the attributed sum)")
+    return "\n".join(rows)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ceph")
     ap.add_argument("--dir", default="./vcluster", help="cluster dir")
@@ -142,6 +159,11 @@ def main(argv=None) -> int:
         import json as _json
         from ceph_tpu.common.admin_socket import admin_command
         out = admin_command(args.admin_daemon, " ".join(args.command))
+        if isinstance(out, dict) and isinstance(out.get("stages"), dict) \
+                and out["stages"]:
+            # op-stage breakdown (dump_op_stages): render the table a
+            # human actually wants next to the raw JSON consumers parse
+            print(_render_stage_table(out["stages"]), file=sys.stderr)
         print(_json.dumps(out, indent=2, default=str))
         return 1 if isinstance(out, dict) and "error" in out else 0
     return asyncio.run(run(args, extra))

@@ -79,6 +79,23 @@ async def bench(io, seconds: int, mode: str, block: int,
     }
 
 
+def _client_stage_quantiles(ctx) -> dict:
+    """Per-stage p50/p99 from THIS client's op tracer (op_tracing=true
+    in the cluster conf).  Against an in-process cluster the stages
+    cover the whole path; over TCP the client sees its own side
+    (client_submit / ack_delivery / op_total) and each daemon's share
+    is served by its admin socket (`dump_op_stages`)."""
+    from ceph_tpu.common import tracer as tracer_mod
+    merged = tracer_mod.merge_stage_histograms([ctx])
+    if not merged:
+        return {}
+    return {"stages": {
+        name: {"p50_ms": d["p50_ms"], "p99_ms": d["p99_ms"],
+               "count": d["count"]}
+        for name, d in ((n, h.dump()) for n, h in sorted(merged.items()))
+        if d["count"]}}
+
+
 async def run(args) -> int:
     from ceph_tpu.client.rados import Rados
     from ceph_tpu.common.context import Context
@@ -141,6 +158,7 @@ async def run(args) -> int:
             mode = args.args[1] if len(args.args) > 1 else "write"
             out = await bench(io, seconds, mode, args.block_size,
                               args.concurrent)
+            out.update(_client_stage_quantiles(ctx))
             print(json.dumps(out))
         else:
             print(f"unknown op {args.op}", file=sys.stderr)

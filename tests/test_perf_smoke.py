@@ -129,6 +129,69 @@ def test_pg_op_window_depth_engages():
     assert win["max_inflight_depth"] > 1, win
 
 
+def test_tracing_stage_coverage_and_zero_encode():
+    """ISSUE 6 regression guard for the op tracer, twin of the
+    zero-encode guard: on an EC mini-cluster with op_tracing on,
+    (a) the chain stages must attribute >= 90% of the independently
+    measured e2e op latency — a dropped cut or broken span propagation
+    silently un-names the write path and fails here, and (b) tracing
+    must add ZERO message-body encodes on the local path (the live
+    span rides local_view; the trace header only encodes on TCP)."""
+    import time as _time
+
+    from ceph_tpu.msg import payload as payload_mod
+    from ceph_tpu.qa.cluster import Cluster, make_ctx
+
+    def ctx_f(name):
+        c = make_ctx(name)
+        c.config.set("ms_local_delivery", True)
+        c.config.set("op_tracing", True)
+        return c
+
+    async def run():
+        cl = Cluster(ctx_factory=ctx_f)
+        admin = await cl.start(4)
+        await admin.pool_create("trpool", pg_num=4,
+                                pool_type="erasure", k=2, m=2)
+        await _settle(cl, 4 * 4)
+        io = admin.open_ioctx("trpool")
+        payload_mod.reset_counters()
+        blobs = {f"tr{i:03d}": bytes([i]) * 8192 for i in range(24)}
+        lats = []
+        sem = asyncio.Semaphore(8)
+
+        async def one(name, data):
+            async with sem:
+                t0 = _time.perf_counter()
+                await io.write_full(name, data)
+                lats.append(_time.perf_counter() - t0)
+
+        await asyncio.gather(*[one(n, d) for n, d in blobs.items()])
+        bd = cl.stage_breakdown(measured_e2e_s=sum(lats))
+        enc = payload_mod.counters()
+        merged = cl.stage_histograms()
+        for k, v in blobs.items():
+            assert await io.read(k) == v
+        await cl.stop()
+        return bd, enc, merged
+
+    bd, enc, merged = asyncio.run(run())
+    # (b) tracing must not reintroduce encodes on the pure-local path
+    assert enc["msg_encode_calls"] == 0, enc
+    assert enc["msg_encode_bytes"] == 0, enc
+    # every write produced a finished span
+    assert merged["op_total"].count >= 24, merged["op_total"].count
+    # the EC write path stages all recorded samples
+    for stage in ("client_submit", "prepare", "ec_encode", "store_apply",
+                  "submit", "replica_rtt", "ack_delivery", "repl_apply"):
+        assert stage in merged and merged[stage].count > 0, stage
+    # (a) no silent unattributed gap: named stages cover >= 90% of the
+    # measured e2e latency
+    assert bd["measured_s"] > 0
+    assert bd["attributed_s"] >= 0.9 * bd["measured_s"], bd
+    assert bd["unattributed_frac"] < 0.10, bd
+
+
 def test_cluster_rw_over_local_delivery(tmp_path):
     """E2E guard for the messenger's same-process fast path: a cluster
     with ms_local_delivery on serves writes+reads correctly (EC pool,
