@@ -56,7 +56,8 @@ class ObjectCacher:
         self._tx_done = asyncio.Event()    # pulses per TX completion
         self._flush_wake = asyncio.Event()
         self._flusher_task: Optional[asyncio.Task] = None
-        self._lock = asyncio.Lock()
+        from ceph_tpu.common.lockdep import make_async_lock
+        self._lock = make_async_lock("object_cacher:_lock")
         self.stats = {"hit_bytes": 0, "miss_bytes": 0, "flushes": 0,
                       "evictions": 0}
 

@@ -335,7 +335,17 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("auth_ticket_ttl", "float", 3600.0,
            "service ticket lifetime (auth_service_ticket_ttl)"),
     Option("lockdep", "bool", False,
-           "lock-order cycle detection (common/lockdep.cc role)"),
+           "lock-order cycle detection (common/lockdep.cc role): "
+           "asyncio + thread locks built through the lockdep "
+           "factories record an acquisition-order graph; inversions "
+           "are reported with both backtraces (qa clusters fail at "
+           "teardown on findings).  Zero overhead when off"),
+    Option("lockdep_stall_budget", "float", 0.0,
+           "loop-stall sanitizer: flag synchronous event-loop "
+           "sections longer than this many seconds, attributed to "
+           "the last op-tracer stage cut on the loop (0 = off; keep "
+           "off on shared/loaded hosts — wall-clock stalls from CPU "
+           "contention are indistinguishable from code stalls)"),
     Option("op_tracing", "bool", False,
            "Dapper-style per-op span tracing + per-stage latency "
            "histograms (common/tracer.py; blkin/TrackedOp/"

@@ -1,21 +1,50 @@
-"""lockdep: lock-ordering cycle detection for asyncio locks.
+"""lockdep: runtime lock-order + event-loop sanitizer (invariant
+sanitizer, part 2 — the static half is ceph_tpu/devtools).
 
 Reference parity: src/common/lockdep.cc — every named lock acquisition
 records an ordering edge (held -> acquiring) in a global graph; an
 acquisition that would close a cycle is a potential deadlock and is
 reported with both acquisition backtraces.  The reference hooks
-pthread mutexes; here DepLock wraps asyncio.Lock and the "thread" is
-the current asyncio task.
+pthread mutexes; here there are three instrumented surfaces:
 
-Enable per-context with config lockdep=true; lock-holders construct
-their locks through make_lock (the MDS mutex does today; new multi-lock
-daemons should follow).  Disabled, the factory returns a plain
-asyncio.Lock — zero overhead.
+  * ``DepLock``       — asyncio.Lock wrapper; the "thread" is the
+                        current asyncio task.  Also detects CROSS-LOOP
+                        misuse (an asyncio lock acquired from a second
+                        event loop / foreign thread — a class of bug
+                        asyncio reports only as an opaque RuntimeError
+                        deep inside a future callback).
+  * ``DepThreadLock`` — threading.Lock/RLock wrapper for the real
+                        multi-lock modules (FileDB ``_io``/``_mu``, the
+                        kv-sync thread, BlockStore): the documented
+                        ``_io -> _mu`` order becomes a CHECKED edge in
+                        the same graph, not a comment.
+  * ``LoopStallMonitor`` — flags synchronous event-loop sections
+                        longer than a budget, attributed to the last
+                        op-tracer stage cut on the loop thread (PR 6).
+
+Gating — zero overhead when off:
+  * asyncio locks: ``make_lock(ctx, name)`` returns a plain
+    asyncio.Lock unless the context config has ``lockdep=true``.
+  * thread locks / module surfaces have no Context at hand (FileDB is
+    constructed from a path), so they gate on the process-wide
+    ``enable()``/``disable()`` switch instead; ``make_thread_lock`` /
+    ``make_async_lock`` return PLAIN stdlib locks while disabled — no
+    wrapper object, no graph, no allocation (the perf-smoke suite
+    guards this).
+
+Reporting: thread-lock violations and loop stalls are RECORDED (not
+raised — poisoning a store's internal locking mid-flight would turn a
+diagnosis into a second failure) and surfaced by ``report()``; the qa
+Cluster fails loudly at teardown when the report is non-empty.  The
+asyncio ``DepLock`` raises ``LockOrderViolation`` at the acquisition
+site like the reference aborts, and records the same entry.
 """
 
 from __future__ import annotations
 
 import asyncio
+import threading
+import time
 import traceback
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -24,23 +53,35 @@ class LockOrderViolation(Exception):
     pass
 
 
+def _stack(limit: int = 10) -> str:
+    return "".join(traceback.format_stack(limit=limit)[:-2])
+
+
 class _Graph:
     def __init__(self):
         # edge a -> b: lock a was held while acquiring b
         self.edges: Dict[str, Set[str]] = {}
         self.where: Dict[Tuple[str, str], str] = {}
+        # the graph itself is shared by every thread AND the event
+        # loop (DepThreadLock + DepLock feed one ordering domain):
+        # add() both traverses and mutates edge sets, so it needs its
+        # own mutex or a concurrent acquisition crashes mid-iteration
+        self._g = threading.Lock()
 
     def add(self, held: str, acquiring: str) -> Optional[List[str]]:
         """Record edge; returns a cycle path if this edge closes one."""
         if acquiring == held:
             return [held, held]
-        path = self._find_path(acquiring, held)
-        if path is not None:
-            return path + [acquiring]
-        self.edges.setdefault(held, set()).add(acquiring)
-        self.where.setdefault(
-            (held, acquiring),
-            "".join(traceback.format_stack(limit=8)))
+        with self._g:
+            path = self._find_path(acquiring, held)
+            if path is not None:
+                return path + [acquiring]
+            if acquiring not in self.edges.get(held, ()):
+                # capture the establishing backtrace only for a NEW
+                # edge — repeat acquisitions of a known-good order
+                # must stay cheap
+                self.edges.setdefault(held, set()).add(acquiring)
+                self.where[(held, acquiring)] = _stack()
         return None
 
     def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
@@ -58,12 +99,71 @@ class _Graph:
         return None
 
     def clear(self) -> None:
-        self.edges.clear()
-        self.where.clear()
+        with self._g:
+            self.edges.clear()
+            self.where.clear()
 
 
 GRAPH = _Graph()
-_held: Dict[int, List[str]] = {}    # task id -> lock names held (ordered)
+_held: Dict[int, List[str]] = {}       # task id -> lock names (ordered)
+_t_held: Dict[int, List[str]] = {}     # thread id -> lock names (ordered)
+
+# ----------------------------------------------------------- enable/report
+
+_enabled = False
+_violations: List[dict] = []
+_MAX_VIOLATIONS = 128      # a hot inversion must not balloon RAM
+
+
+def enable() -> None:
+    """Process-wide gate for the surfaces that have no Context (thread
+    locks, module factories).  qa clusters flip this for every test."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def record(kind: str, **info) -> dict:
+    """Append one sanitizer finding ({kind: lock_order | cross_loop |
+    loop_stall, ...}).  Returns the entry (tests inspect it)."""
+    entry = {"kind": kind, **info}
+    if len(_violations) < _MAX_VIOLATIONS:
+        _violations.append(entry)
+    return entry
+
+
+def report() -> List[dict]:
+    """Findings recorded since the last reset()."""
+    return list(_violations)
+
+
+def render_report(entries: Optional[List[dict]] = None) -> str:
+    entries = report() if entries is None else entries
+    out = []
+    for e in entries:
+        head = {k: v for k, v in e.items()
+                if not k.endswith("stack")}
+        out.append(f"--- {head}")
+        for k in ("prior_stack", "stack"):
+            if e.get(k):
+                out.append(f"{k}:\n{e[k]}")
+    return "\n".join(out)
+
+
+def reset() -> None:
+    """Test isolation: wipe the order graph, held maps and findings."""
+    GRAPH.clear()
+    _held.clear()
+    _t_held.clear()
+    _violations.clear()
 
 
 def _task_key() -> int:
@@ -71,25 +171,60 @@ def _task_key() -> int:
     return id(t) if t is not None else 0
 
 
+def _check_order(held: List[str], name: str, domain: str
+                 ) -> Optional[dict]:
+    """Shared will-lock check: returns the violation entry (already
+    recorded) when acquiring `name` under `held` closes a cycle."""
+    for h in held:
+        cycle = GRAPH.add(h, name)
+        if cycle is not None:
+            order = " -> ".join(cycle)
+            return record(
+                "lock_order", domain=domain, order=order,
+                acquiring=name, holding=h,
+                prior_stack=GRAPH.where.get((cycle[0], cycle[1]), ""),
+                stack=_stack())
+    return None
+
+
+# ------------------------------------------------------------ asyncio lock
+
 class DepLock:
     """asyncio.Lock with ordering checks (lockdep_will_lock role)."""
 
     def __init__(self, name: str):
         self.name = name
         self._lock = asyncio.Lock()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._bind_stack = ""
 
     async def __aenter__(self):
+        loop = asyncio.get_running_loop()
+        if self._loop is None:
+            self._loop = loop
+            self._bind_stack = _stack()
+        elif loop is not self._loop:
+            # cross-loop / cross-thread misuse: a second event loop
+            # awaiting this lock can never be woken by the first one's
+            # release callbacks — report it HERE with both stacks
+            # instead of the opaque "attached to a different loop"
+            # failure asyncio produces later
+            entry = record(
+                "cross_loop", name=self.name,
+                prior_stack=self._bind_stack, stack=_stack())
+            raise LockOrderViolation(
+                f"asyncio lock {self.name!r} acquired from a second "
+                f"event loop/thread; first bound at:\n"
+                f"{entry['prior_stack']}")
         key = _task_key()
         held = _held.setdefault(key, [])
-        for h in held:
-            cycle = GRAPH.add(h, self.name)
-            if cycle is not None:
-                order = " -> ".join(cycle)
-                first = GRAPH.where.get((cycle[0], cycle[1]), "")
-                raise LockOrderViolation(
-                    f"lock cycle {order}: acquiring {self.name!r} while "
-                    f"holding {h!r}, but the reverse order was "
-                    f"established here:\n{first}")
+        entry = _check_order(held, self.name, "task")
+        if entry is not None:
+            raise LockOrderViolation(
+                f"lock cycle {entry['order']}: acquiring "
+                f"{self.name!r} while holding {entry['holding']!r}, "
+                f"but the reverse order was established here:\n"
+                f"{entry['prior_stack']}")
         await self._lock.acquire()
         held.append(self.name)
         return self
@@ -105,6 +240,58 @@ class DepLock:
         return self._lock.locked()
 
 
+# ------------------------------------------------------------- thread lock
+
+class DepThreadLock:
+    """threading.Lock/RLock with ordering checks in the shared graph.
+
+    Violations are RECORDED (report()), never raised: the write path
+    must keep running so teardown can attach the full report.  Works as
+    the lock behind a ``threading.Condition`` (delegating
+    acquire/release is all Condition needs)."""
+
+    __slots__ = ("name", "_lock", "_rlock")
+
+    def __init__(self, name: str, rlock: bool = False):
+        self.name = name
+        self._rlock = rlock
+        self._lock = threading.RLock() if rlock else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        tid = threading.get_ident()
+        held = _t_held.setdefault(tid, [])
+        # ordering is only provable for BLOCKING acquisition (a failed
+        # try-lock can't deadlock), and a reentrant re-acquire of an
+        # RLock adds no new edge
+        if blocking and held and \
+                not (self._rlock and self.name in held):
+            _check_order(held, self.name, "thread")
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            held.append(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        held = _t_held.get(threading.get_ident())
+        if held:
+            # last occurrence: an RLock may appear several times
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == self.name:
+                    del held[i]
+                    break
+
+    def __enter__(self) -> "DepThreadLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+
+# -------------------------------------------------------------- factories
+
 def make_lock(ctx, name: str):
     """Factory: a checked DepLock when ctx config lockdep=true, a plain
     asyncio.Lock otherwise (zero overhead when off)."""
@@ -115,7 +302,82 @@ def make_lock(ctx, name: str):
     return DepLock(name) if enabled else asyncio.Lock()
 
 
-def reset() -> None:
-    """Test isolation: wipe the global order graph."""
-    GRAPH.clear()
-    _held.clear()
+def make_async_lock(name: str):
+    """Context-less asyncio variant, gated on the module switch (for
+    lock holders constructed without a Context in reach)."""
+    return DepLock(name) if _enabled else asyncio.Lock()
+
+
+def make_thread_lock(name: str, rlock: bool = False):
+    """Thread-lock factory, gated on the module switch.  Disabled, the
+    caller gets the PLAIN stdlib lock — no wrapper allocation, no graph
+    participation (perf-smoke guards this stays true)."""
+    if _enabled:
+        return DepThreadLock(name, rlock=rlock)
+    return threading.RLock() if rlock else threading.Lock()
+
+
+# ---------------------------------------------------------- stall monitor
+
+class LoopStallMonitor:
+    """Event-loop responsiveness sanitizer.
+
+    A daemon thread posts a heartbeat callback onto the watched loop
+    and measures how long the loop takes to run it.  A gap longer than
+    ``budget`` seconds means some synchronous section monopolized the
+    loop for that long (every co-located daemon stalls with it); the
+    finding records the measured gap and the last op-tracer stage cut
+    on the loop thread — with tracing on, that names the owning stage.
+
+    Start from the loop thread (``start()`` captures it for stage
+    attribution).  Findings land in the shared lockdep report."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop,
+                 budget: float, poll: Optional[float] = None):
+        self.loop = loop
+        self.budget = float(budget)
+        #: probe cadence: fine enough to catch budget-scale stalls,
+        #: coarse enough to stay invisible in profiles
+        self.poll = poll if poll is not None else \
+            max(0.01, self.budget / 4)
+        self.stalls = 0
+        self._loop_thread = threading.get_ident()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "LoopStallMonitor":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name="lockdep-stall-monitor")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            beat = threading.Event()
+            t0 = time.monotonic()
+            try:
+                self.loop.call_soon_threadsafe(beat.set)
+            except RuntimeError:
+                return                      # loop closed: done
+            if not beat.wait(self.budget):
+                # over budget: keep waiting so the recorded duration is
+                # the REAL gap, not just "more than budget"
+                while not beat.wait(1.0):
+                    if self._stop.is_set() or self.loop.is_closed():
+                        return
+                dt = time.monotonic() - t0
+                self.stalls += 1
+                from ceph_tpu.common import tracer as tracer_mod
+                record("loop_stall", seconds=round(dt, 4),
+                       budget=self.budget,
+                       stage=tracer_mod.last_stage(self._loop_thread)
+                       or "untraced")
+            self._stop.wait(self.poll)

@@ -33,7 +33,7 @@ class TrackedOp:
         # monotonic is the measuring clock; the wall anchor exists only
         # so dumps can show human-readable stamps
         self.start = time.monotonic()
-        self.wall_start = time.time()
+        self.wall_start = time.time()  # lint: allow[MONO05] dump anchor only
         self.events: List[tuple] = [(self.start, "initiated")]
         self.done_at: Optional[float] = None
         self.complained = False      # slow-op logged once already

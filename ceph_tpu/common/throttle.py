@@ -15,10 +15,15 @@ from typing import Optional
 
 class Throttle:
     def __init__(self, name: str, max_: int):
+        from ceph_tpu.common.lockdep import make_thread_lock
         self.name = name
         self.max = max_
         self.cur = 0
-        self._cv = threading.Condition()
+        # condition over a lockdep-tracked lock (plain when off): the
+        # throttle is taken from both the event loop and worker
+        # threads, so it participates in the acquisition-order graph
+        self._cv = threading.Condition(
+            make_thread_lock(f"throttle:{name}"))
 
     def get(self, c: int = 1) -> None:
         if self.max <= 0:

@@ -41,10 +41,22 @@ Design:
 from __future__ import annotations
 
 import random
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
 from ceph_tpu.common.perf_counters import PerfHistogram
+
+#: thread id -> the stage most recently cut on that thread.  The
+#: lockdep LoopStallMonitor reads this to name the owning stage of an
+#: over-budget synchronous section; written only when tracing is on
+#: (cut() never runs otherwise), so the off-path guarantee holds.
+_last_stage: Dict[int, str] = {}
+
+
+def last_stage(thread_id: Optional[int] = None) -> Optional[str]:
+    return _last_stage.get(
+        threading.get_ident() if thread_id is None else thread_id)
 
 #: Stages that tile the client-visible op timeline (the cut chain, in
 #: path order).  Everything else (repl_*, op_total) is auxiliary and
@@ -102,6 +114,7 @@ class Span:
         dt = now - self._cursor
         self._cursor = now
         self.stages.append((stage, dt))
+        _last_stage[threading.get_ident()] = stage
         if hist is not None:
             hist.hinc(stage, dt)
         return dt

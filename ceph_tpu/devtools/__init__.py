@@ -1,0 +1,18 @@
+"""Developer correctness tooling (invariant sanitizer, part 1).
+
+Reference parity: Ceph ships its own correctness machinery —
+src/common/lockdep.cc (runtime lock-order graph) and the debug mutex
+ownership asserts — because in a storage system the invariants ARE the
+product.  This package is the STATIC half of that idea for this
+codebase: an AST lint pass (``ceph_tpu.devtools.lint``) with named
+rules, each mechanically enforcing one PR-landed write-path invariant
+(ROADMAP "Invariants" block cross-references the rule IDs).
+
+The runtime half (thread-lock order graph, cross-loop asyncio misuse,
+event-loop stall sanitizer) lives in ``ceph_tpu/common/lockdep.py``.
+
+Run standalone:  ``python -m ceph_tpu.devtools.lint``
+Run under tier-1: ``tests/test_invariants.py`` lints the live package
+and fails on any violation, so an invariant regression is a test
+failure, not a separate CI pipeline.
+"""

@@ -1,0 +1,135 @@
+"""Invariant lint engine + CLI.
+
+Usage:
+    python -m ceph_tpu.devtools.lint              # lint the live package
+    python -m ceph_tpu.devtools.lint --json       # machine-readable
+    python -m ceph_tpu.devtools.lint --rule AF01  # one rule only
+    python -m ceph_tpu.devtools.lint path.py ...  # explicit targets
+
+Exit status 0 = clean, 1 = violations, 2 = usage/parse error.  The
+tier-1 suite (tests/test_invariants.py) runs the same engine in-process
+over the live tree and fails on any violation, so an invariant
+regression is a test failure — not a separate pipeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ceph_tpu.devtools.rules import RULE_IDS, RULES, FileInfo, Violation
+
+
+def package_root() -> str:
+    """The ceph_tpu package directory (the default lint target)."""
+    import ceph_tpu
+    return os.path.dirname(os.path.abspath(ceph_tpu.__file__))
+
+
+def _iter_py(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+        else:
+            yield p
+
+
+def lint_file(path: str, root: Optional[str] = None,
+              rule: Optional[str] = None) -> List[Violation]:
+    root = root or package_root()
+    rel = os.path.relpath(os.path.abspath(path), root).replace(
+        os.sep, "/")
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, rel, rule=rule)
+
+
+def lint_source(source: str, rel: str,
+                rule: Optional[str] = None) -> List[Violation]:
+    """Lint one source blob (tests feed fixture snippets through
+    this).  ``rel`` drives the module-scoped rules (MONO05 op-path set,
+    BLK04 exemptions), so fixtures pick their rule context via a fake
+    relative path."""
+    fi = FileInfo(rel, source)
+    out: List[Violation] = []
+    for rid, (_desc, fn) in RULES.items():
+        if rule is not None and rid != rule \
+                and not (rid == "FP02" and rule == "SEND03"):
+            continue
+        for v in fn(fi):
+            if rule is not None and v.rule != rule:
+                continue
+            if fi.waived(v.rule, v.line):
+                continue
+            out.append(v)
+    out.sort(key=lambda v: (v.rel, v.line, v.rule))
+    return out
+
+
+def lint_paths(paths: Optional[Iterable[str]] = None,
+               rule: Optional[str] = None
+               ) -> Tuple[List[Violation], List[str]]:
+    """Lint files/dirs (default: the live package).  Returns
+    (violations, parse_errors)."""
+    root = package_root()
+    targets = list(paths) if paths else [root]
+    violations: List[Violation] = []
+    errors: List[str] = []
+    for path in _iter_py(targets):
+        try:
+            violations.extend(lint_file(path, root=root, rule=rule))
+        except SyntaxError as e:
+            errors.append(f"{path}: parse error: {e}")
+    return violations, errors
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ceph_tpu.devtools.lint",
+        description="invariant sanitizer: static rules over the "
+                    "ceph_tpu package (see devtools/rules.py)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--rule", choices=sorted(RULE_IDS),
+                    help="run a single rule")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, (desc, _fn) in sorted(RULES.items()):
+            print(f"{rid:8s} {desc}")
+        print(f"{'SEND03':8s} no message mutation after first send "
+              f"(runs with FP02)")
+        return 0
+
+    violations, errors = lint_paths(args.paths or None, rule=args.rule)
+    if args.json:
+        print(json.dumps({
+            "violations": [v.__dict__ for v in violations],
+            "errors": errors,
+        }, indent=1))
+    else:
+        for v in violations:
+            print(v.render())
+        for e in errors:
+            print(e, file=sys.stderr)
+        if not violations and not errors:
+            print(f"invariant lint clean "
+                  f"({len(RULE_IDS)} rules)")
+    if errors:
+        return 2
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

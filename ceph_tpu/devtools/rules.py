@@ -1,0 +1,521 @@
+"""Named invariant-lint rules over per-file ASTs.
+
+Each rule mechanically enforces one PR-landed write-path invariant
+(the ROADMAP "Invariants" block cross-references these IDs):
+
+  AF01  awaitfree        — no await/async-with/async-for/yield inside a
+                           ``# awaitfree:begin`` / ``# awaitfree:end``
+                           region (the PR-5 submit-section invariant:
+                           version -> append_log -> queue_transactions
+                           -> fan-out with no suspension point).
+  FP02  frozen-payload   — no payload-field mutation on objects obtained
+                           from ``Message.local_view()`` /
+                           ``LazyPayload.peek()`` / ``m.log_entry()``;
+                           receivers that mutate must rebind through
+                           ``mutable()`` / ``mutable_copy()`` (PR-4 copy
+                           discipline).  Envelope/transport stamps
+                           (seq, src_*, recv_stamp, ...) are receiver-
+                           owned and exempt.
+  SEND03 sealed-send     — never mutate a message after its first send
+                           (its wire bytes may already be cached / its
+                           graph already handed to a local receiver).
+  BLK04 no-blocking      — no blocking calls (time.sleep, sync file
+                           open, os.fsync, socket/subprocess
+                           constructors) inside ``async def`` bodies;
+                           the store commit-thread modules are exempt
+                           (their blocking runs on the kv-sync thread).
+  MONO05 monotonic       — no wall-clock ``time.time()`` in op-path
+                           modules (PR-6 discipline: ages/durations use
+                           time.monotonic; wall time only in dump
+                           output or persisted cross-restart stamps,
+                           which carry an explicit waiver).
+  LOCK06 lock-order      — never acquire ``_io`` inside a ``with
+                           self._mu`` block: the FileDB order is
+                           strictly ``_io -> _mu`` (PR-4 invariant; the
+                           runtime lockdep checks the same edge
+                           dynamically).
+  FIN07 finally-release  — every windowed-op slot release
+                           (``*window*.release(...)``) sits in a
+                           ``finally`` block, so a failed op can never
+                           wedge its dependency chain (PR-5 invariant).
+
+Waivers: a site that is allowed to break a rule for a documented reason
+carries ``# lint: allow[RULE] reason`` on the same line or the line
+directly above.  Waivers are counted and reported; an undocumented
+violation fails the lint (and therefore tier-1).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
+
+# ------------------------------------------------------------------ model
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    rel: str          # package-relative path ("osd/pg.py")
+    line: int
+    msg: str
+
+    def render(self) -> str:
+        return f"{self.rel}:{self.line}: {self.rule} {self.msg}"
+
+
+class FileInfo:
+    """One parsed source file + the comment/waiver side channel the AST
+    does not carry."""
+
+    WAIVER_RE = re.compile(r"#\s*lint:\s*allow\[([A-Z0-9]+)\]")
+
+    def __init__(self, rel: str, source: str):
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        #: lineno -> REAL comment token text (tokenize, not a naive
+        #: '#' scan: a docstring documenting the sentinel syntax must
+        #: never register as a sentinel)
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError):
+            pass
+        #: lineno -> waived rule ids (a waiver covers its own line and
+        #: the line directly below, so it can sit above a long call)
+        self.waivers: Dict[int, Set[str]] = {}
+        for ln, c in self.comments.items():
+            m = self.WAIVER_RE.search(c)
+            if m:
+                self.waivers.setdefault(ln, set()).add(m.group(1))
+                self.waivers.setdefault(ln + 1, set()).add(m.group(1))
+        self.aliases = _import_aliases(self.tree)
+
+    def waived(self, rule: str, line: int) -> bool:
+        return rule in self.waivers.get(line, ())
+
+
+def _import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Local name -> canonical dotted origin, so ``import time as
+    _time; _time.time()`` still normalizes to ``time.time``."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _dotted(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Normalized dotted name of a Name/Attribute chain, aliases
+    resolved on the root segment; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    parts.reverse()
+    parts[0] = aliases.get(parts[0], parts[0])
+    return ".".join(parts)
+
+
+def _attr_text(node: ast.AST) -> Optional[str]:
+    """Raw dotted source text (no alias resolution): for receiver
+    matching like ``self.op_window``."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+# ----------------------------------------------------------- AF01 regions
+
+_AF_BEGIN = "awaitfree:begin"
+_AF_END = "awaitfree:end"
+
+_SUSPEND_NODES = (ast.Await, ast.AsyncWith, ast.AsyncFor,
+                  ast.Yield, ast.YieldFrom)
+
+
+def check_af01(fi: FileInfo) -> Iterator[Violation]:
+    regions: List[Tuple[int, int]] = []
+    start: Optional[int] = None
+    for ln in sorted(fi.comments):
+        c = fi.comments[ln]
+        if _AF_BEGIN in c:
+            if start is not None:
+                yield Violation("AF01", fi.rel, ln,
+                                f"nested awaitfree:begin (previous at "
+                                f"line {start} not closed)")
+            start = ln
+        elif _AF_END in c:
+            if start is None:
+                yield Violation("AF01", fi.rel, ln,
+                                "awaitfree:end without begin")
+            else:
+                regions.append((start, ln))
+                start = None
+    if start is not None:
+        yield Violation("AF01", fi.rel, start,
+                        "awaitfree:begin never closed")
+    if not regions:
+        return
+    for node in ast.walk(fi.tree):
+        if isinstance(node, _SUSPEND_NODES):
+            ln = node.lineno
+            for lo, hi in regions:
+                if lo < ln < hi:
+                    kind = type(node).__name__.lower()
+                    yield Violation(
+                        "AF01", fi.rel, ln,
+                        f"{kind} inside awaitfree region (lines "
+                        f"{lo}-{hi}): the submit section must hold no "
+                        f"suspension point")
+                    break
+
+
+# ------------------------------------------------------------------- FP02
+
+#: methods whose result is the SENDER'S frozen object (read-only view)
+_TAINT_METHODS = {"local_view", "peek", "log_entry"}
+#: methods whose result is a receiver-owned mutable copy (sanctioned)
+_SANCTION_METHODS = {"mutable", "mutable_copy", "result_copy", "copy",
+                     "deepcopy"}
+#: transport/envelope fields the messenger stamps per delivery — the
+#: receiver owns the envelope, only the payload graph is frozen
+_ENVELOPE_FIELDS = {"seq", "src_name", "src_addr", "recv_stamp",
+                    "connection", "transport_id", "_span", "_wire",
+                    "_tracked", "_windowed"}
+_MUTATOR_CALLS = {"append", "extend", "insert", "add", "update",
+                  "clear", "remove", "pop", "popitem", "setdefault",
+                  "sort", "reverse"}
+
+
+class _FnScan(ast.NodeVisitor):
+    """Shared per-function linear scan for the dataflow-ish rules
+    (FP02 taint tracking, SEND03 sent tracking).  Visits statements in
+    source order; nested function defs open their own scope."""
+
+    def __init__(self, fi: FileInfo, out: List[Violation]):
+        self.fi = fi
+        self.out = out
+        self.tainted: Dict[str, int] = {}     # name -> taint line
+        self.sent: Dict[str, int] = {}        # name -> first-send line
+
+    # -- helpers
+    def _call_attr(self, call: ast.Call) -> Optional[str]:
+        if isinstance(call.func, ast.Attribute):
+            return call.func.attr
+        return None
+
+    def _root_name(self, node: ast.AST) -> Optional[str]:
+        # walk through attribute AND subscript links: the root of
+        # `view.ops[0].rval` is `view` (mutating an op inside a frozen
+        # view's list is the most realistic receiver-side violation)
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    # -- taint/sent bookkeeping
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._check_store_targets(node.targets, node.lineno)
+        taints = False
+        if isinstance(node.value, ast.Call):
+            attr = self._call_attr(node.value)
+            if attr in _TAINT_METHODS:
+                taints = True
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                self.sent.pop(t.id, None)
+                if taints:
+                    self.tainted[t.id] = node.lineno
+                else:
+                    self.tainted.pop(t.id, None)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_store_targets([node.target], node.lineno)
+        self.generic_visit(node)
+
+    def _field_off_root(self, node: ast.AST) -> Optional[str]:
+        """The FIRST attribute above the root name: for
+        `view.ops[0].rval` that is "ops" — the envelope-field check
+        applies to the field actually hanging off the frozen view."""
+        field = None
+        while True:
+            if isinstance(node, ast.Attribute):
+                field = node.attr
+                node = node.value
+            elif isinstance(node, ast.Subscript):
+                node = node.value
+            else:
+                break
+        return field if isinstance(node, ast.Name) else None
+
+    def _check_store_targets(self, targets, line: int) -> None:
+        for t in targets:
+            stores = t.elts if isinstance(t, ast.Tuple) else [t]
+            for s in stores:
+                if not isinstance(s, (ast.Attribute, ast.Subscript)):
+                    continue
+                root = self._root_name(s)
+                field = self._field_off_root(s)
+                if root is None or field is None:
+                    continue
+                if root in self.tainted and \
+                        field not in _ENVELOPE_FIELDS:
+                    if not self.fi.waived("FP02", line):
+                        self.out.append(Violation(
+                            "FP02", self.fi.rel, line,
+                            f"mutation of frozen view {root!r} "
+                            f"(tainted at line {self.tainted[root]}): "
+                            f"take mutable()/mutable_copy() first"))
+                if root in self.sent and \
+                        field not in _ENVELOPE_FIELDS:
+                    if not self.fi.waived("SEND03", line):
+                        self.out.append(Violation(
+                            "SEND03", self.fi.rel, line,
+                            f"mutation of {root!r} after its first "
+                            f"send (line {self.sent[root]}): wire "
+                            f"bytes may already be cached — build a "
+                            f"fresh message"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        attr = self._call_attr(node)
+        # frozen-view mutator method call (view.ops.append(...))
+        if attr in _MUTATOR_CALLS and isinstance(node.func,
+                                                 ast.Attribute):
+            recv = node.func.value
+            root = self._root_name(recv)
+            # only receiver chains rooted AT the tainted name itself
+            # (entry.xattrs.update) — a tainted name merely appearing
+            # as an argument is fine
+            if root in self.tainted and \
+                    not self.fi.waived("FP02", node.lineno):
+                self.out.append(Violation(
+                    "FP02", self.fi.rel, node.lineno,
+                    f"mutating call .{attr}() on frozen view "
+                    f"{root!r}: take mutable()/mutable_copy() first"))
+        # which positional argument is the MESSAGE being sent
+        # (reply_to(request, reply) sends its second arg — the first
+        # is the request being answered, which stays mutable)
+        send_arg = {"send_osd": 1, "send_message": 0,
+                    "reply_to": 1}.get(attr or "")
+        if send_arg is not None and len(node.args) > send_arg:
+            arg = node.args[send_arg]
+            if isinstance(arg, ast.Name):
+                self.sent.setdefault(arg.id, node.lineno)
+        self.generic_visit(node)
+
+    # nested defs get their own scope
+    def visit_FunctionDef(self, node):          # noqa: N802
+        _scan_function(self.fi, node, self.out)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _scan_function(fi: FileInfo, fn, out: List[Violation]) -> None:
+    scan = _FnScan(fi, out)
+    for stmt in fn.body:
+        scan.visit(stmt)
+
+
+def check_fp02_send03(fi: FileInfo) -> Iterator[Violation]:
+    out: List[Violation] = []
+    for node in fi.tree.body:
+        _walk_defs(fi, node, out)
+    yield from out
+
+
+def _walk_defs(fi: FileInfo, node: ast.AST, out: List[Violation]) -> None:
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        _scan_function(fi, node, out)
+    elif isinstance(node, ast.ClassDef):
+        for child in node.body:
+            _walk_defs(fi, child, out)
+
+
+# ------------------------------------------------------------------- BLK04
+
+#: commit-thread modules (their blocking runs on the kv-sync thread,
+#: never the event loop) and the offline CLI tools (each runs its own
+#: short-lived loop; reading a local file inline is the point)
+_BLK_EXEMPT_FILES = {"store/commit.py", "store/wal.py", "store/kv.py"}
+_BLK_EXEMPT_PREFIXES = ("tools/",)
+_BLOCKING_CALLS = {
+    "time.sleep", "os.fsync", "os.fdatasync", "os.sync",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "socket.socket", "socket.create_connection",
+    "open", "io.open",
+}
+
+
+class _AsyncScan(ast.NodeVisitor):
+    def __init__(self, fi: FileInfo, out: List[Violation]):
+        self.fi = fi
+        self.out = out
+        self.async_depth = 0
+
+    def visit_AsyncFunctionDef(self, node):     # noqa: N802
+        self.async_depth += 1
+        self.generic_visit(node)
+        self.async_depth -= 1
+
+    def visit_FunctionDef(self, node):          # noqa: N802
+        # a nested sync def's body is not (necessarily) loop-side
+        saved, self.async_depth = self.async_depth, 0
+        self.generic_visit(node)
+        self.async_depth = saved
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.async_depth:
+            name = _dotted(node.func, self.fi.aliases)
+            if name in _BLOCKING_CALLS and \
+                    not self.fi.waived("BLK04", node.lineno):
+                self.out.append(Violation(
+                    "BLK04", self.fi.rel, node.lineno,
+                    f"blocking call {name}() in async def: this "
+                    f"stalls the whole event loop (move it to the "
+                    f"commit thread or an executor)"))
+        self.generic_visit(node)
+
+
+def check_blk04(fi: FileInfo) -> Iterator[Violation]:
+    if fi.rel in _BLK_EXEMPT_FILES or \
+            fi.rel.startswith(_BLK_EXEMPT_PREFIXES):
+        return
+    out: List[Violation] = []
+    _AsyncScan(fi, out).visit(fi.tree)
+    yield from out
+
+
+# ------------------------------------------------------------------ MONO05
+
+_OP_PATH_PREFIXES = ("osd/", "msg/", "client/", "store/", "ec/")
+_OP_PATH_FILES = {"common/op_tracker.py", "common/tracer.py",
+                  "common/throttle.py", "common/wpq.py"}
+
+
+def _is_op_path(rel: str) -> bool:
+    return rel.startswith(_OP_PATH_PREFIXES) or rel in _OP_PATH_FILES
+
+
+def check_mono05(fi: FileInfo) -> Iterator[Violation]:
+    if not _is_op_path(fi.rel):
+        return
+    for node in ast.walk(fi.tree):
+        if isinstance(node, ast.Call) and \
+                _dotted(node.func, fi.aliases) == "time.time" and \
+                not fi.waived("MONO05", node.lineno):
+            yield Violation(
+                "MONO05", fi.rel, node.lineno,
+                "wall-clock time.time() in an op-path module: ages/"
+                "durations must use time.monotonic() (wall time only "
+                "in dump output / persisted stamps, with a waiver)")
+
+
+# ------------------------------------------------------------------ LOCK06
+
+#: (inner, outer) pairs that must never nest: acquiring `inner` while
+#: lexically inside a `with ...outer` block inverts the checked order
+_FORBIDDEN_NESTING = (("_io", "_mu"),)
+
+
+class _WithScan(ast.NodeVisitor):
+    def __init__(self, fi: FileInfo, out: List[Violation]):
+        self.fi = fi
+        self.out = out
+        self.stack: List[str] = []
+
+    def _items(self, node) -> List[str]:
+        names = []
+        for item in node.items:
+            t = _attr_text(item.context_expr)
+            if t:
+                names.append(t.rsplit(".", 1)[-1])
+        return names
+
+    def _visit_with(self, node) -> None:
+        names = self._items(node)
+        for name in names:
+            for inner, outer in _FORBIDDEN_NESTING:
+                if name == inner and outer in self.stack and \
+                        not self.fi.waived("LOCK06", node.lineno):
+                    self.out.append(Violation(
+                        "LOCK06", self.fi.rel, node.lineno,
+                        f"acquiring {inner!r} while holding "
+                        f"{outer!r}: the checked lock order is "
+                        f"{inner} -> {outer} (FileDB invariant)"))
+        self.stack.extend(names)
+        self.generic_visit(node)
+        del self.stack[len(self.stack) - len(names):]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+
+def check_lock06(fi: FileInfo) -> Iterator[Violation]:
+    out: List[Violation] = []
+    _WithScan(fi, out).visit(fi.tree)
+    yield from out
+
+
+# ------------------------------------------------------------------- FIN07
+
+
+def check_fin07(fi: FileInfo) -> Iterator[Violation]:
+    in_finally: Set[int] = set()
+    for node in ast.walk(fi.tree):
+        if isinstance(node, ast.Try):
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    in_finally.add(id(sub))
+    for node in ast.walk(fi.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "release"):
+            continue
+        recv = _attr_text(node.func.value) or ""
+        if "window" not in recv:
+            continue
+        if id(node) not in in_finally and \
+                not fi.waived("FIN07", node.lineno):
+            yield Violation(
+                "FIN07", fi.rel, node.lineno,
+                f"windowed-slot release on {recv!r} outside a "
+                f"finally block: a failed op would wedge its "
+                f"object-dependency chain (PR-5 invariant)")
+
+
+# --------------------------------------------------------------- registry
+
+RULES: Dict[str, Tuple[str, Callable[[FileInfo], Iterator[Violation]]]] = {
+    "AF01": ("submit section is await-free", check_af01),
+    "FP02": ("frozen-payload copy discipline", check_fp02_send03),
+    "BLK04": ("no blocking calls on the event loop", check_blk04),
+    "MONO05": ("monotonic clock discipline in op paths", check_mono05),
+    "LOCK06": ("FileDB lock order _io -> _mu", check_lock06),
+    "FIN07": ("windowed slot release under finally", check_fin07),
+}
+#: SEND03 is produced by the FP02 scanner (shared dataflow pass) but is
+#: its own rule id for waivers/filtering
+RULE_IDS = tuple(RULES) + ("SEND03",)

@@ -55,7 +55,8 @@ class Journaler:
         self._seq = 0
         self._obj = 0
         self._obj_bytes = 0
-        self._lock = asyncio.Lock()
+        from ceph_tpu.common.lockdep import make_async_lock
+        self._lock = make_async_lock(f"journaler:{journal_id}")
 
     # ------------------------------------------------------------- metadata
     # Every field is its OWN omap key on the header object, so concurrent

@@ -528,7 +528,10 @@ class ReplicatedBackend(PGBackend):
         # the fan-out sends below: under the per-PG op window this is
         # what keeps pglog versions dense/ordered across concurrent
         # ops and queue_transactions order == pglog order (the PR-1
-        # in-order commit callbacks ride that)
+        # in-order commit callbacks ride that).  Machine-checked: the
+        # invariant lint (devtools rule AF01) fails on any suspension
+        # point between the sentinels.
+        # awaitfree:begin replicated-submit
         version = pg.next_version()
         entry = LogEntry(LOG_DELETE if deletes else LOG_MODIFY, m.oid,
                          version, pg.info.last_update, m.reqid)
@@ -565,6 +568,7 @@ class ReplicatedBackend(PGBackend):
             self.osd.send_osd(p, rep)
         if span is not None:
             span.cut("submit", th)
+        # awaitfree:end replicated-submit
         if not await self._await_acks(fut):
             self._inflight.pop(tid, None)
             return -errno.EAGAIN   # interval change in flight: client resends
@@ -841,7 +845,8 @@ class ECBackend(PGBackend):
         # submission order == pglog order (the PR-1 in-order commit
         # callbacks depend on it).  The old placement — version taken
         # BEFORE the encode awaits — would hand two concurrent ops the
-        # same version.
+        # same version.  Machine-checked by devtools rule AF01.
+        # awaitfree:begin ec-submit
         version = pg.next_version()
         entry = LogEntry(LOG_DELETE if deletes else LOG_MODIFY, m.oid,
                          version, pg.info.last_update, m.reqid)
@@ -903,6 +908,7 @@ class ECBackend(PGBackend):
             self.osd.send_osd(osd_id, msg)
         if span is not None:
             span.cut("submit", th)
+        # awaitfree:end ec-submit
         if not await self._await_acks(fut):
             self._inflight.pop(tid, None)
             return -errno.EAGAIN

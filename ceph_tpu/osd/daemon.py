@@ -780,7 +780,9 @@ class OSD(Dispatcher):
         from ceph_tpu.osd.osdmap import FLAG_NODEEP_SCRUB, FLAG_NOSCRUB
         while self.running:
             await asyncio.sleep(poll)
-            now = int(_time.time() * 1000)
+            # compared against the PERSISTED (wall-clock) PGInfo scrub
+            # stamps — see scrub.py: monotonic resets across restarts
+            now = int(_time.time() * 1000)  # lint: allow[MONO05] persisted stamp
             # cluster flags gate SCHEDULED scrubs only; operator `pg
             # scrub` commands still run (OSD::sched_scrub noscrub)
             no_light = bool(self.osdmap.flags & FLAG_NOSCRUB)

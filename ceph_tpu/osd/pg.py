@@ -1317,6 +1317,10 @@ class PG:
                         await self._do_client_op(m)
                     else:
                         await seq.wait_slot(m._span)
+                        # dependency registration is SYNCHRONOUS at
+                        # admission (per-object order == queue order);
+                        # machine-checked by devtools rule AF01
+                        # awaitfree:begin window-admission
                         m._windowed = True
                         # writeback-tier reads are admitted EXCLUSIVE:
                         # a cache miss promotes (an internal WRITE of
@@ -1332,6 +1336,7 @@ class PG:
                         self._window_tasks[task] = m
                         task.add_done_callback(
                             lambda t: self._window_tasks.pop(t, None))
+                        # awaitfree:end window-admission
                 elif isinstance(m, MPGScrub):
                     # scrub drains the window: no client op can
                     # interleave with the scan (reference write

@@ -99,7 +99,7 @@ async def scrub_pg(pg, deep: bool, repair: bool = True) -> Dict:
     """Primary-side scrub: gather maps, compare, repair.  Runs as a PG
     op-queue item, so no client write interleaves."""
     osd = pg.osd
-    t0 = time.time()
+    t0 = time.monotonic()   # elapsed-time measurement (MONO05)
     maps: Dict[int, Dict[str, ScrubEntry]] = {
         osd.whoami: build_scrub_map(pg, deep)}
     # gather peer maps (their scans also ride their op queues)
@@ -135,7 +135,10 @@ async def scrub_pg(pg, deep: bool, repair: bool = True) -> Dict:
         errors, repaired, inconsistent = await _scrub_replicated(
             pg, maps, all_oids, deep, repair)
 
-    now_ms = int(time.time() * 1000)
+    # persisted PGInfo stamp, compared across daemon restarts by the
+    # scrub scheduler — monotonic resets per process, so this one stays
+    # wall-clock by design
+    now_ms = int(time.time() * 1000)   # lint: allow[MONO05] persisted stamp
     pg.info.last_scrub_stamp = now_ms
     if deep:
         pg.info.last_deep_scrub_stamp = now_ms
@@ -155,14 +158,15 @@ async def scrub_pg(pg, deep: bool, repair: bool = True) -> Dict:
         osd.perf_scrub.inc("scrub_repaired", repaired)
         pg.log_.warning(
             f"{pg.pgid} {'deep-' if deep else ''}scrub: {errors} errors, "
-            f"{repaired} repaired ({time.time() - t0:.2f}s)")
+            f"{repaired} repaired ({time.monotonic() - t0:.2f}s)")
         # operator-visible cluster log event (LogClient -> LogMonitor)
         osd.ctx.cluster_log.warn(
             f"pg {pg.pgid} {'deep-' if deep else ''}scrub: {errors} "
             f"errors, {repaired} repaired")
     else:
         pg.log_.info(f"{pg.pgid} {'deep-' if deep else ''}scrub ok "
-                     f"({len(all_oids)} objects, {time.time() - t0:.2f}s)")
+                     f"({len(all_oids)} objects, "
+                     f"{time.monotonic() - t0:.2f}s)")
     return {"errors": errors, "repaired": repaired,
             "objects": len(all_oids), "inconsistent": inconsistent}
 

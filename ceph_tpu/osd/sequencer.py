@@ -102,6 +102,10 @@ class OpSequencer:
         if span is not None and self.tracer is not None:
             span.cut("admit_wait", self.tracer.hist)
 
+    # awaitfree:begin sequencer-admit-release (admission registration
+    # and slot release are synchronous BY CONTRACT — the window's
+    # bookkeeping is race-free only because no suspension point can
+    # interleave two admissions; devtools rule AF01 enforces it)
     def admit(self, oid: str, write: bool) -> OpSlot:
         """Synchronously register one op: takes a window slot and links
         it into its object's dependency chain.  MUST be called from the
@@ -167,6 +171,7 @@ class OpSequencer:
         self._slot_free.set()
         if self.active == 0:
             self._idle.set()
+    # awaitfree:end sequencer-admit-release
 
     # -------------------------------------------------------------- drain
     async def drain(self) -> None:

@@ -33,6 +33,15 @@ FAST_CFG = {
     # background thread logs between tests; the in-memory ring still
     # records every level for `log dump` assertions/introspection
     "log_level": 0,
+    # invariant sanitizer (common/lockdep.py): every e2e test doubles
+    # as a race/ordering regression test — lock acquisitions through
+    # the lockdep factories build the order graph and Cluster.stop()
+    # FAILS on any recorded inversion / cross-loop misuse.  The
+    # loop-stall budget stays 0 here: on this shared container,
+    # CPU-contention stalls are indistinguishable from code stalls
+    # (3x run-to-run throughput variance); stall-focused tests opt in
+    # via lockdep_stall_budget.
+    "lockdep": True,
 }
 
 
@@ -54,10 +63,21 @@ class Cluster:
         # durable backend (e.g. BlockStore on a tmp dir) instead of the
         # MemStore default
         self.store_factory = store_factory
+        self._stall_monitor = None
 
     async def start(self, n_osds: int, osds_per_host: int = 1):
         self.monmap.fsid = "e2e-fsid"
         ctx = self.make_ctx("mon.a")
+        # runtime invariant sanitizer: the module-level gate covers the
+        # lock holders that have no Context in reach (FileDB, commit
+        # thread); findings are surfaced — loudly — by stop()
+        from ceph_tpu.common import lockdep
+        if ctx.config["lockdep"]:
+            lockdep.enable()
+        budget = ctx.config["lockdep_stall_budget"]
+        if budget > 0:
+            self._stall_monitor = lockdep.LoopStallMonitor(
+                asyncio.get_running_loop(), budget).start()
         msgr = Messenger(ctx, EntityName("mon", "a"))
         self.monmap.add("a", await msgr.bind())
         mon = Monitor(ctx, "a", self.monmap, MemDB(), msgr)
@@ -162,9 +182,47 @@ class Cluster:
                                     measured_e2e_s)
 
     async def stop(self):
-        for c in self.clients:
-            await c.shutdown()
-        for o in list(self.osds.values()):
-            await o.shutdown()
-        for m in self.mons:
-            await m.shutdown()
+        try:
+            for c in self.clients:
+                await c.shutdown()
+            for o in list(self.osds.values()):
+                await o.shutdown()
+            for m in self.mons:
+                await m.shutdown()
+        except BaseException as e:
+            # shutdown wedged — which is exactly when the sanitizer
+            # report (a recorded deadlock cycle, say) EXPLAINS the
+            # failure: attach it to the propagating error instead of
+            # resetting it into the void
+            findings = self._drain_sanitizer()
+            if findings:
+                from ceph_tpu.common.lockdep import render_report
+                raise AssertionError(
+                    f"cluster shutdown failed WITH {len(findings)} "
+                    f"sanitizer finding(s):\n"
+                    f"{render_report(findings)}") from e
+            raise
+        findings = self._drain_sanitizer()
+        if findings:
+            from ceph_tpu.common.lockdep import render_report
+            raise AssertionError(
+                f"invariant sanitizer: {len(findings)} finding(s) at "
+                f"cluster teardown:\n{render_report(findings)}")
+
+    def _drain_sanitizer(self) -> list:
+        """Collect sanitizer findings and reset the process-wide state
+        (enable flag, order graph) so one test's edges can never bleed
+        a false cycle into the next.  Always runs, even when daemon
+        shutdown itself failed — a leaked enable would silently tax
+        every later test."""
+        from ceph_tpu.common import lockdep
+        had_monitor = self._stall_monitor is not None
+        if had_monitor:
+            self._stall_monitor.stop()
+            self._stall_monitor = None
+        if not lockdep.is_enabled() and not had_monitor:
+            return []
+        findings = lockdep.report()
+        lockdep.disable()
+        lockdep.reset()
+        return findings

@@ -137,8 +137,8 @@ class Allocator:
     referencing them is durable, while the event loop allocates."""
 
     def __init__(self):
-        import threading
-        self._mu = threading.Lock()
+        from ceph_tpu.common.lockdep import make_thread_lock
+        self._mu = make_thread_lock("blockstore:alloc:_mu")
         self.free: List[List[int]] = []   # sorted [off, len]
         self.device_size = 0
 

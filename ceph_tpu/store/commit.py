@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ceph_tpu.common.lockdep import make_thread_lock
 from ceph_tpu.common.perf_counters import PerfCounters
 
 _log = logging.getLogger("ceph-tpu.store.commit")
@@ -100,7 +101,10 @@ class KVSyncThread:
         self.perf.add_hist("commit_lat_hist")
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_max)
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        # lockdep-wrapped when the sanitizer is on: the commit thread
+        # holds this while the event loop submits, so an ordering slip
+        # against the store's own locks is a real deadlock class
+        self._lock = make_thread_lock(f"kvsync:{name}:_lock")
         self._cv = threading.Condition(self._lock)
         self._submitted = 0
         self._completed = 0

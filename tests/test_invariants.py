@@ -1,0 +1,450 @@
+"""Invariant sanitizer: static checker (devtools lint) + runtime
+lockdep/loop-stall sanitizer (common/lockdep.py).
+
+Three layers of coverage:
+
+  1. The live package must lint CLEAN — any write-path invariant
+     regression (an await sneaking into a submit section, a wall clock
+     in an op path, a slot release escaping its finally) is a tier-1
+     test failure right here, not a review comment.
+  2. Fixture snippets per rule: each must trip EXACTLY its rule, so a
+     rule that rots into a no-op (or starts over-matching) fails too.
+  3. Runtime injection: a real ``_mu -> _io`` lock-order inversion, a
+     cross-loop asyncio-lock misuse and an over-budget synchronous
+     loop section must each land in the lockdep report with the
+     offending acquisition stacks / owning stage attached.
+"""
+
+import asyncio
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common import lockdep
+from ceph_tpu.devtools.lint import lint_paths, lint_source
+
+# ===================================================== 1. live tree clean
+
+
+def test_live_package_lints_clean():
+    violations, errors = lint_paths()
+    assert not errors, errors
+    assert not violations, \
+        "invariant lint violations on the live tree:\n" + \
+        "\n".join(v.render() for v in violations)
+
+
+def test_cli_entry_point_runs_standalone():
+    # the console entry the CI/tooling satellite promises: standalone
+    # module invocation, exit 0 on the clean tree
+    out = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.devtools.lint",
+         "--list-rules"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    for rid in ("AF01", "FP02", "SEND03", "BLK04", "MONO05",
+                "LOCK06", "FIN07"):
+        assert rid in out.stdout
+
+
+# ================================================ 2. one fixture per rule
+
+
+def _rules_of(src: str, rel: str):
+    return sorted({v.rule for v in lint_source(src, rel)})
+
+
+def test_af01_await_inside_submit_section():
+    src = (
+        "async def submit(pg):\n"
+        "    # awaitfree:begin fixture-submit\n"
+        "    version = pg.next_version()\n"
+        "    await pg.flush()\n"
+        "    # awaitfree:end fixture-submit\n"
+        "    return version\n"
+    )
+    assert _rules_of(src, "osd/fixture.py") == ["AF01"]
+
+
+def test_af01_async_with_and_unbalanced_sentinel():
+    src = (
+        "async def submit(pg, lock):\n"
+        "    # awaitfree:begin fixture\n"
+        "    async with lock:\n"
+        "        pg.append_log()\n"
+        "    # awaitfree:end fixture\n"
+    )
+    assert _rules_of(src, "osd/fixture.py") == ["AF01"]
+    src2 = (
+        "async def submit(pg):\n"
+        "    # awaitfree:begin never-closed\n"
+        "    pg.append_log()\n"
+    )
+    assert _rules_of(src2, "osd/fixture.py") == ["AF01"]
+
+
+def test_af01_clean_region_passes():
+    src = (
+        "async def submit(pg):\n"
+        "    chunks = await pg.encode()\n"
+        "    # awaitfree:begin fixture\n"
+        "    version = pg.next_version()\n"
+        "    pg.append_log(version, chunks)\n"
+        "    # awaitfree:end fixture\n"
+        "    await pg.gather_acks()\n"
+    )
+    assert _rules_of(src, "osd/fixture.py") == []
+
+
+def test_fp02_mutating_a_local_view():
+    src = (
+        "def deliver(msg):\n"
+        "    view = msg.local_view()\n"
+        "    view.ops = []\n"
+    )
+    assert _rules_of(src, "msg/fixture.py") == ["FP02"]
+
+
+def test_fp02_mutator_call_on_peeked_payload():
+    src = (
+        "def apply(m, pg):\n"
+        "    entry = m.log_entry()\n"
+        "    entry.xattrs.update({'a': 1})\n"
+    )
+    assert _rules_of(src, "osd/fixture.py") == ["FP02"]
+
+
+def test_fp02_mutation_through_subscript_chain():
+    # mutating an op INSIDE the frozen view's list — the most
+    # realistic receiver-side slip (result fields belong on the
+    # receiver's own result_copy op shells, not the sender's)
+    src = (
+        "def fill(msg):\n"
+        "    view = msg.local_view()\n"
+        "    view.ops[0].rval = 0\n"
+    )
+    assert _rules_of(src, "osd/fixture.py") == ["FP02"]
+    src2 = (
+        "def fill(msg, data):\n"
+        "    view = msg.local_view()\n"
+        "    view.ops[0].outdata.append(data)\n"
+    )
+    assert _rules_of(src2, "osd/fixture.py") == ["FP02"]
+
+
+def test_fp02_envelope_stamp_and_mutable_copy_pass():
+    src = (
+        "def deliver(msg, seq):\n"
+        "    view = msg.local_view()\n"
+        "    view.seq = seq\n"            # receiver-owned envelope
+        "    txn = view.payload.mutable(Transaction)\n"
+        "    txn.ops = []\n"              # sanctioned mutable copy
+    )
+    assert _rules_of(src, "msg/fixture.py") == []
+
+
+def test_send03_mutation_after_first_send():
+    src = (
+        "def fan_out(osd, peer, rep):\n"
+        "    osd.send_osd(peer, rep)\n"
+        "    rep.version = 3\n"
+    )
+    assert _rules_of(src, "osd/fixture.py") == ["SEND03"]
+
+
+def test_send03_reply_to_request_stays_mutable():
+    # reply_to(request, reply) SENDS the reply; stamping tracker state
+    # onto the request afterwards is the normal intake path
+    src = (
+        "def intake(osd, m, tracker):\n"
+        "    osd.reply_to(m, make_reply(m))\n"
+        "    m.oid = normalize(m.oid)\n"
+    )
+    assert _rules_of(src, "osd/fixture.py") == []
+
+
+def test_blk04_blocking_call_in_async_def():
+    src = (
+        "import time as _time\n"
+        "async def tick(self):\n"
+        "    _time.sleep(0.1)\n"          # alias must not hide it
+    )
+    assert _rules_of(src, "osd/fixture.py") == ["BLK04"]
+    src2 = (
+        "async def load(path):\n"
+        "    with open(path) as f:\n"
+        "        return f.read()\n"
+    )
+    assert _rules_of(src2, "mon/fixture.py") == ["BLK04"]
+
+
+def test_blk04_commit_thread_module_exempt():
+    src = (
+        "import time\n"
+        "async def gather(self):\n"
+        "    time.sleep(0.001)\n"
+    )
+    assert _rules_of(src, "store/commit.py") == []
+
+
+def test_mono05_wall_clock_in_op_path():
+    src = (
+        "import time\n"
+        "def age(op):\n"
+        "    return time.time() - op.start\n"
+    )
+    assert _rules_of(src, "osd/fixture.py") == ["MONO05"]
+    # same code outside the op-path module set is fine (mon leases,
+    # rgw mtimes and friends are wall-clock protocol data)
+    assert _rules_of(src, "mon/fixture.py") == []
+
+
+def test_mono05_waiver_comment_is_honored():
+    src = (
+        "import time\n"
+        "def stamp(info):\n"
+        "    # lint: allow[MONO05] persisted cross-restart stamp\n"
+        "    info.last_scrub_stamp = time.time()\n"
+    )
+    assert _rules_of(src, "osd/fixture.py") == []
+
+
+def test_lock06_io_acquired_under_mu():
+    src = (
+        "def bad(self, txn):\n"
+        "    with self._mu:\n"
+        "        with self._io:\n"
+        "            self.apply(txn)\n"
+    )
+    assert _rules_of(src, "store/fixture.py") == ["LOCK06"]
+    good = (
+        "def good(self, txn):\n"
+        "    with self._io:\n"
+        "        with self._mu:\n"
+        "            self.apply(txn)\n"
+    )
+    assert _rules_of(good, "store/fixture.py") == []
+
+
+def test_fin07_slot_release_outside_finally():
+    src = (
+        "async def run(self, m, slot):\n"
+        "    await self.do_op(m)\n"
+        "    self.op_window.release(slot)\n"
+    )
+    assert _rules_of(src, "osd/fixture.py") == ["FIN07"]
+    good = (
+        "async def run(self, m, slot):\n"
+        "    try:\n"
+        "        await self.do_op(m)\n"
+        "    finally:\n"
+        "        self.op_window.release(slot)\n"
+    )
+    assert _rules_of(good, "osd/fixture.py") == []
+
+
+# ============================================= 3. runtime lockdep layer
+
+
+@pytest.fixture
+def clean_lockdep():
+    lockdep.reset()
+    lockdep.enable()
+    yield
+    lockdep.disable()
+    lockdep.reset()
+
+
+def test_injected_mu_io_inversion_is_reported(clean_lockdep):
+    """The FileDB invariant as a CHECKED edge: establish the legal
+    _io -> _mu order, then take the locks inverted from another thread
+    — the report must carry both acquisition stacks."""
+    mu = lockdep.DepThreadLock("filedb:/x:_mu", rlock=True)
+    io = lockdep.DepThreadLock("filedb:/x:_io")
+    with io:
+        with mu:                       # legal order: _io -> _mu
+            pass
+
+    def inverted():
+        with mu:
+            with io:                   # inversion
+                pass
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join(5.0)
+    rep = [e for e in lockdep.report() if e["kind"] == "lock_order"]
+    assert len(rep) == 1, lockdep.report()
+    e = rep[0]
+    assert e["acquiring"] == "filedb:/x:_io"
+    assert e["holding"] == "filedb:/x:_mu"
+    # both backtraces: where the legal order was established, and the
+    # offending acquisition
+    assert "in inverted" in e["stack"]
+    assert e["prior_stack"].strip()
+
+
+def test_rlock_reentrancy_is_not_a_cycle(clean_lockdep):
+    mu = lockdep.DepThreadLock("r:_mu", rlock=True)
+    with mu:
+        with mu:                       # reentrant, legal
+            pass
+    assert lockdep.report() == []
+
+
+def test_cross_loop_asyncio_misuse_is_reported(clean_lockdep):
+    """An asyncio lock bound to one event loop, then acquired from a
+    second loop on another thread: the release callbacks of loop A can
+    never wake a waiter on loop B — report it at the acquisition."""
+    lock = lockdep.DepLock("mds.mutex")
+
+    async def use():
+        async with lock:
+            pass
+
+    asyncio.run(use())                 # binds the lock to loop 1
+
+    result = {}
+
+    def second_loop():
+        try:
+            asyncio.run(use())         # fresh loop: misuse
+        except lockdep.LockOrderViolation as e:
+            result["err"] = e
+
+    t = threading.Thread(target=second_loop)
+    t.start()
+    t.join(5.0)
+    assert "err" in result
+    rep = [e for e in lockdep.report() if e["kind"] == "cross_loop"]
+    assert len(rep) == 1
+    assert rep[0]["name"] == "mds.mutex"
+    assert rep[0]["prior_stack"].strip() and rep[0]["stack"].strip()
+
+
+def test_asyncio_lock_order_cycle_still_raises(clean_lockdep):
+    """The original DepLock contract (test_mgr_tools covers it too):
+    recorded AND raised."""
+    async def run():
+        a, b = lockdep.DepLock("a"), lockdep.DepLock("b")
+        async with a:
+            async with b:
+                pass
+        with pytest.raises(lockdep.LockOrderViolation):
+            async with b:
+                async with a:
+                    pass
+
+    asyncio.run(run())
+    assert any(e["kind"] == "lock_order" for e in lockdep.report())
+
+
+def test_loop_stall_monitor_detects_and_attributes(clean_lockdep):
+    """A synchronous 0.3s section on the loop with a 50ms budget must
+    be flagged, attributed to the last tracer stage cut on the loop
+    thread."""
+    from ceph_tpu.common.tracer import Span
+
+    async def main():
+        mon = lockdep.LoopStallMonitor(
+            asyncio.get_running_loop(), budget=0.05).start()
+        await asyncio.sleep(0.1)       # monitor sees a healthy loop
+        span = Span(1, 1)
+        span.cut("prepare")            # names the owning stage
+        time.sleep(0.3)                # the stall (deliberate, BLK04-
+        #   exempt here: tests are not linted)
+        await asyncio.sleep(0.1)       # heartbeat lands, stall closes
+        mon.stop()
+        return mon.stalls
+
+    stalls = asyncio.run(main())
+    assert stalls >= 1
+    rep = [e for e in lockdep.report() if e["kind"] == "loop_stall"]
+    assert rep, lockdep.report()
+    assert rep[0]["seconds"] >= 0.2
+    assert rep[0]["stage"] == "prepare"
+
+
+def test_factories_are_off_path_when_disabled():
+    """The zero-overhead-when-off contract: disabled factories hand
+    back PLAIN stdlib locks — no wrapper, no graph participation."""
+    lockdep.disable()
+    lockdep.reset()
+    assert type(lockdep.make_thread_lock("x")) is type(threading.Lock())
+    assert type(lockdep.make_thread_lock("x", rlock=True)) \
+        is type(threading.RLock())
+    assert isinstance(lockdep.make_async_lock("x"), asyncio.Lock)
+    assert not isinstance(lockdep.make_async_lock("x"),
+                          lockdep.DepLock)
+    # and nothing records
+    lk = lockdep.make_thread_lock("y")
+    with lk:
+        pass
+    assert lockdep.GRAPH.edges == {}
+    assert lockdep.report() == []
+
+
+def test_filedb_locks_follow_the_gate(tmp_path):
+    from ceph_tpu.store.kv import FileDB
+    lockdep.disable()
+    plain = FileDB(str(tmp_path / "plain"))
+    assert not isinstance(plain._mu, lockdep.DepThreadLock)
+    plain.close()
+    lockdep.enable()
+    try:
+        checked = FileDB(str(tmp_path / "checked"))
+        assert isinstance(checked._mu, lockdep.DepThreadLock)
+        assert isinstance(checked._io, lockdep.DepThreadLock)
+        # exercise the real write path: the _io -> _mu edge lands in
+        # the graph and no violation is recorded (clean order)
+        t = checked.create_transaction()
+        t.set("p", b"k", b"v")
+        checked.submit(t, sync=True)
+        checked.close()
+        assert [e for e in lockdep.report()
+                if e["kind"] == "lock_order"] == []
+        assert any("_mu" in str(dsts)
+                   for dsts in lockdep.GRAPH.edges.values()) or \
+            lockdep.GRAPH.edges, "expected _io -> _mu edges recorded"
+    finally:
+        lockdep.disable()
+        lockdep.reset()
+
+
+def test_cluster_teardown_fails_loudly_on_findings():
+    """The qa satellite: an e2e test that leaks a sanitizer finding
+    must fail at Cluster.stop() with the report attached — and the
+    process-wide state must still be reset for the next test."""
+    from ceph_tpu.qa.cluster import Cluster
+
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(1)
+        assert lockdep.is_enabled()
+        lockdep.record("lock_order", domain="thread",
+                       order="a -> b -> a", acquiring="a", holding="b",
+                       prior_stack="prior", stack="now")
+        with pytest.raises(AssertionError,
+                           match="invariant sanitizer"):
+            await cl.stop()
+        assert admin is not None
+
+    asyncio.run(run())
+    assert not lockdep.is_enabled()
+    assert lockdep.report() == []
+
+
+def test_cluster_teardown_clean_when_no_findings():
+    from ceph_tpu.qa.cluster import Cluster
+
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(1)
+        await admin.mon_command({"prefix": "status"})
+        await cl.stop()
+
+    asyncio.run(run())
+    assert not lockdep.is_enabled()

@@ -232,3 +232,51 @@ def test_cluster_rw_over_local_delivery(tmp_path):
         await cl.stop()
 
     asyncio.run(run())
+
+
+def test_sanitizer_fully_off_path_when_disabled():
+    """ISSUE 7 off-path guard: with lockdep=false the invariant
+    sanitizer must leave ZERO footprint on the write path — the
+    commit-thread and payload-path locks are plain stdlib locks (no
+    wrapper allocation), the order graph stays empty, nothing is
+    recorded — while the pipelining/zero-encode evidence counters look
+    exactly as they do with the sanitizer on (the suite's other
+    perf-smoke tests run under FAST_CFG's lockdep=true, so the two
+    configurations are both continuously proven)."""
+    from ceph_tpu.common import lockdep
+    from ceph_tpu.msg import payload as payload_mod
+    from ceph_tpu.qa.cluster import Cluster, make_ctx
+
+    def ctx_off(name):
+        c = make_ctx(name)
+        c.config.set("lockdep", False)
+        c.config.set("ms_local_delivery", True)
+        return c
+
+    async def run():
+        cl = Cluster(ctx_factory=ctx_off)
+        admin = await cl.start(3)
+        assert not lockdep.is_enabled()
+        await admin.pool_create("offpool", pg_num=1)
+        io = admin.open_ioctx("offpool")
+        payload_mod.reset_counters()
+        blobs = {f"o{i:03d}": bytes([i]) * 4096 for i in range(24)}
+        await cl.write_burst(io, blobs, iodepth=24)
+        win = cl.window_counters()
+        enc = payload_mod.counters()
+        # no lockdep allocations anywhere on this cluster's stores
+        for osd in cl.osds.values():
+            committer = getattr(osd.store, "_committer", None)
+            if committer is not None:
+                assert not isinstance(committer._lock,
+                                      lockdep.DepThreadLock)
+        assert lockdep.GRAPH.edges == {}
+        assert lockdep.report() == []
+        await cl.stop()
+        return win, enc
+
+    win, enc = asyncio.run(run())
+    # the same evidence the lockdep=true twin tests assert: window
+    # pipelining engages and the local path encodes nothing
+    assert win["mean_inflight_depth"] > 1.0, win
+    assert enc["msg_encode_calls"] == 0, enc
