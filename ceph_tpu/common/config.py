@@ -345,7 +345,11 @@ DEFAULT_OPTIONS: List[Option] = [
            "sections longer than this many seconds, attributed to "
            "the last op-tracer stage cut on the loop (0 = off; keep "
            "off on shared/loaded hosts — wall-clock stalls from CPU "
-           "contention are indistinguishable from code stalls)"),
+           "contention are indistinguishable from code stalls.  "
+           "Under the deterministic sim loop (devtools/schedule.py) "
+           "the monitor attaches to the loop itself and wall-times "
+           "every callback: exhaustive detection, replayable "
+           "attribution — sim runs can afford a budget)"),
     Option("op_tracing", "bool", False,
            "Dapper-style per-op span tracing + per-stage latency "
            "histograms (common/tracer.py; blkin/TrackedOp/"

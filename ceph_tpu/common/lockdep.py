@@ -113,6 +113,12 @@ _t_held: Dict[int, List[str]] = {}     # thread id -> lock names (ordered)
 _enabled = False
 _violations: List[dict] = []
 _MAX_VIOLATIONS = 128      # a hot inversion must not balloon RAM
+#: lock_order dedup: (domain, acquiring, holding) -> the ONE recorded
+#: entry.  A hot inversion fires at every acquisition site; the report
+#: carries each unique cycle once with every observed stack attached
+#: (capped), not one entry per hit.
+_seen_cycles: Dict[Tuple[str, str, str], dict] = {}
+_MAX_CYCLE_STACKS = 8
 
 
 def enable() -> None:
@@ -150,11 +156,18 @@ def render_report(entries: Optional[List[dict]] = None) -> str:
     out = []
     for e in entries:
         head = {k: v for k, v in e.items()
-                if not k.endswith("stack")}
+                if not k.endswith("stack") and k != "stacks"}
         out.append(f"--- {head}")
         for k in ("prior_stack", "stack"):
             if e.get(k):
                 out.append(f"{k}:\n{e[k]}")
+        extra = e.get("stacks") or []
+        for i, s in enumerate(extra[1:], 2):
+            # count = total HITS of the edge; len(extra) = distinct
+            # acquisition sites captured (capped) — label both so a
+            # hot single-site inversion doesn't read as many sites
+            out.append(f"also observed from site {i} of {len(extra)} "
+                       f"(edge hit {e.get('count', 1)}x total):\n{s}")
     return "\n".join(out)
 
 
@@ -164,6 +177,7 @@ def reset() -> None:
     _held.clear()
     _t_held.clear()
     _violations.clear()
+    _seen_cycles.clear()
 
 
 def _task_key() -> int:
@@ -174,16 +188,34 @@ def _task_key() -> int:
 def _check_order(held: List[str], name: str, domain: str
                  ) -> Optional[dict]:
     """Shared will-lock check: returns the violation entry (already
-    recorded) when acquiring `name` under `held` closes a cycle."""
+    recorded) when acquiring `name` under `held` closes a cycle.
+
+    DEDUPED per unique (domain, acquiring, holding) edge pair: the
+    first hit records the entry; later hits from OTHER acquisition
+    sites attach their stack to it (entry["stacks"], count bumped)
+    instead of rendering the same cycle once per site."""
     for h in held:
         cycle = GRAPH.add(h, name)
         if cycle is not None:
+            key = (domain, name, h)
+            stack = _stack()
+            prior = _seen_cycles.get(key)
+            if prior is not None:
+                prior["count"] = prior.get("count", 1) + 1
+                stacks = prior.setdefault("stacks", [prior["stack"]])
+                if len(stacks) < _MAX_CYCLE_STACKS \
+                        and stack not in stacks:
+                    stacks.append(stack)
+                return prior
             order = " -> ".join(cycle)
-            return record(
+            entry = record(
                 "lock_order", domain=domain, order=order,
-                acquiring=name, holding=h,
+                acquiring=name, holding=h, count=1,
                 prior_stack=GRAPH.where.get((cycle[0], cycle[1]), ""),
-                stack=_stack())
+                stack=stack)
+            entry["stacks"] = [stack]
+            _seen_cycles[key] = entry
+            return entry
     return None
 
 
@@ -353,7 +385,37 @@ class LoopStallMonitor:
             self._thread.start()
         return self
 
+    def attach_virtual(self, loop) -> "LoopStallMonitor":
+        """Sim-mode wiring (devtools/schedule.DeterministicLoop): no
+        probe thread — the deterministic loop wall-times EVERY callback
+        it runs and reports over-budget synchronous sections here.
+        Unlike the sampling thread (a coin flip against container CPU
+        noise), detection is exhaustive and the attribution — which
+        callback, which tracer stage — is identical on every replay of
+        the same seed, so stall budgets are usable under FAST_CFG sim
+        runs where the thread probe had to stay off."""
+        self._virtual_loop = loop
+        loop.stall_observer = self._on_callback
+        return self
+
+    def _on_callback(self, seconds: float, label: str) -> None:
+        """Per-callback hook from the deterministic loop."""
+        if seconds < self.budget:
+            return
+        self.stalls += 1
+        from ceph_tpu.common import tracer as tracer_mod
+        record("loop_stall", seconds=round(seconds, 4),
+               budget=self.budget,
+               stage=tracer_mod.last_stage(self._loop_thread)
+               or "untraced",
+               callback=label)
+
     def stop(self) -> None:
+        vloop = getattr(self, "_virtual_loop", None)
+        if vloop is not None:
+            vloop.stall_observer = None
+            self._virtual_loop = None
+            return
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)

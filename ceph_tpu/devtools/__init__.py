@@ -1,18 +1,26 @@
-"""Developer correctness tooling (invariant sanitizer, part 1).
+"""Developer correctness tooling (the invariant sanitizer).
 
 Reference parity: Ceph ships its own correctness machinery —
 src/common/lockdep.cc (runtime lock-order graph) and the debug mutex
 ownership asserts — because in a storage system the invariants ARE the
-product.  This package is the STATIC half of that idea for this
-codebase: an AST lint pass (``ceph_tpu.devtools.lint``) with named
-rules, each mechanically enforcing one PR-landed write-path invariant
-(ROADMAP "Invariants" block cross-references the rule IDs).
+product.  Three layers here:
 
-The runtime half (thread-lock order graph, cross-loop asyncio misuse,
-event-loop stall sanitizer) lives in ``ceph_tpu/common/lockdep.py``.
+  1. STATIC — ``ceph_tpu.devtools.lint``: an AST pass with named
+     rules, each mechanically enforcing one PR-landed write-path
+     invariant, including the project-wide cross-daemon protocol map
+     (PROTO08/REPLY09/EPOCH10).  ROADMAP's "Invariants" block
+     cross-references the rule IDs.
+  2. RUNTIME — ``ceph_tpu/common/lockdep.py``: thread-lock order
+     graph, cross-loop asyncio misuse, event-loop stall sanitizer.
+  3. SCHEDULES — ``ceph_tpu.devtools.schedule``: a seeded
+     deterministic event loop (virtual time, permuted task wake order,
+     replayable trace hash) that runs whole qa clusters, enumerates
+     commit-thread crash points, and asserts the machine-checked
+     invariants after every explored interleaving.
 
-Run standalone:  ``python -m ceph_tpu.devtools.lint``
-Run under tier-1: ``tests/test_invariants.py`` lints the live package
-and fails on any violation, so an invariant regression is a test
+Run standalone:  ``python -m ceph_tpu.devtools.lint`` (``--json`` for
+the CI document).  Run under tier-1: ``tests/test_invariants.py``
+lints the live package and ``tests/test_schedule.py`` explores >= 64
+schedules + all crash points, so an invariant regression is a test
 failure, not a separate CI pipeline.
 """

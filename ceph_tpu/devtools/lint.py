@@ -6,10 +6,13 @@ Usage:
     python -m ceph_tpu.devtools.lint --rule AF01  # one rule only
     python -m ceph_tpu.devtools.lint path.py ...  # explicit targets
 
-Exit status 0 = clean, 1 = violations, 2 = usage/parse error.  The
-tier-1 suite (tests/test_invariants.py) runs the same engine in-process
-over the live tree and fails on any violation, so an invariant
-regression is a test failure — not a separate pipeline.
+Exit status is STABLE (CI keys on it): 0 = clean, 1 = violations,
+2 = usage/parse error.  The ``--json`` document carries a ``schema``
+version, the exit code it implies, and a per-rule summary (violation +
+waiver counts) so CI can diff rule regressions without parsing render
+strings.  The tier-1 suite (tests/test_invariants.py) runs the same
+engine in-process over the live tree and fails on any violation, so an
+invariant regression is a test failure — not a separate pipeline.
 """
 
 from __future__ import annotations
@@ -20,7 +23,11 @@ import os
 import sys
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from ceph_tpu.devtools.rules import RULE_IDS, RULES, FileInfo, Violation
+from ceph_tpu.devtools.rules import (PROJECT_RULES, RULE_IDS, RULES,
+                                     FileInfo, Violation)
+
+#: bumped whenever the --json document shape changes incompatibly
+JSON_SCHEMA = 1
 
 
 def package_root() -> str:
@@ -42,6 +49,36 @@ def _iter_py(paths: Iterable[str]) -> Iterable[str]:
             yield p
 
 
+def _file_rules(fi: FileInfo, rule: Optional[str]) -> List[Violation]:
+    out: List[Violation] = []
+    for rid, (_desc, fn) in RULES.items():
+        if rule is not None and rid != rule \
+                and not (rid == "FP02" and rule == "SEND03"):
+            continue
+        for v in fn(fi):
+            if rule is not None and v.rule != rule:
+                continue
+            if fi.waived(v.rule, v.line):
+                continue
+            out.append(v)
+    return out
+
+
+def _project_rules(files: List[FileInfo],
+                   rule: Optional[str]) -> List[Violation]:
+    out: List[Violation] = []
+    by_rel = {fi.rel: fi for fi in files}
+    for rid, (_desc, fn) in PROJECT_RULES.items():
+        if rule is not None and rid != rule:
+            continue
+        for v in fn(files):
+            fi = by_rel.get(v.rel)
+            if fi is not None and fi.waived(v.rule, v.line):
+                continue
+            out.append(v)
+    return out
+
+
 def lint_file(path: str, root: Optional[str] = None,
               rule: Optional[str] = None) -> List[Violation]:
     root = root or package_root()
@@ -56,39 +93,101 @@ def lint_source(source: str, rel: str,
                 rule: Optional[str] = None) -> List[Violation]:
     """Lint one source blob (tests feed fixture snippets through
     this).  ``rel`` drives the module-scoped rules (MONO05 op-path set,
-    BLK04 exemptions), so fixtures pick their rule context via a fake
-    relative path."""
+    BLK04 exemptions, REPLY09/EPOCH10 osd scope), so fixtures pick
+    their rule context via a fake relative path.  Project rules
+    (PROTO08) need a file SET — see lint_project_sources."""
     fi = FileInfo(rel, source)
-    out: List[Violation] = []
-    for rid, (_desc, fn) in RULES.items():
-        if rule is not None and rid != rule \
-                and not (rid == "FP02" and rule == "SEND03"):
-            continue
-        for v in fn(fi):
-            if rule is not None and v.rule != rule:
-                continue
-            if fi.waived(v.rule, v.line):
-                continue
-            out.append(v)
+    out = _file_rules(fi, rule)
     out.sort(key=lambda v: (v.rel, v.line, v.rule))
     return out
+
+
+def lint_project_sources(sources: List[Tuple[str, str]],
+                         rule: Optional[str] = None) -> List[Violation]:
+    """Run the PROJECT rules (PROTO08) over an in-memory file set of
+    (rel, source) pairs — the fixture entry point."""
+    files = [FileInfo(rel, src) for rel, src in sources]
+    out = _project_rules(files, rule)
+    out.sort(key=lambda v: (v.rel, v.line, v.rule))
+    return out
+
+
+def _collect(paths: Optional[Iterable[str]], rule: Optional[str]
+             ) -> Tuple[List[Violation], List[str], List[FileInfo]]:
+    root = package_root()
+    targets = list(paths) if paths else [root]
+    violations: List[Violation] = []
+    errors: List[str] = []
+    files: List[FileInfo] = []
+    for path in _iter_py(targets):
+        rel = os.path.relpath(os.path.abspath(path), root).replace(
+            os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                fi = FileInfo(rel, f.read())
+        except SyntaxError as e:
+            errors.append(f"{path}: parse error: {e}")
+            continue
+        except OSError as e:
+            errors.append(f"{path}: {e}")
+            continue
+        files.append(fi)
+        violations.extend(_file_rules(fi, rule))
+    violations.extend(_project_rules(files, rule))
+    violations.sort(key=lambda v: (v.rel, v.line, v.rule))
+    return violations, errors, files
 
 
 def lint_paths(paths: Optional[Iterable[str]] = None,
                rule: Optional[str] = None
                ) -> Tuple[List[Violation], List[str]]:
     """Lint files/dirs (default: the live package).  Returns
-    (violations, parse_errors)."""
-    root = package_root()
-    targets = list(paths) if paths else [root]
-    violations: List[Violation] = []
-    errors: List[str] = []
-    for path in _iter_py(targets):
-        try:
-            violations.extend(lint_file(path, root=root, rule=rule))
-        except SyntaxError as e:
-            errors.append(f"{path}: parse error: {e}")
+    (violations, parse_errors).  Project rules run over whatever set
+    was collected; edges into roles with no module present are skipped
+    (see rules.check_proto08)."""
+    violations, errors, _files = _collect(paths, rule)
     return violations, errors
+
+
+def _waiver_counts(files: List[FileInfo]) -> Dict[str, int]:
+    """Waiver COMMENTS per rule id (each waiver registers two covered
+    lines in fi.waivers; count the comment lines themselves)."""
+    out: Dict[str, int] = {}
+    for fi in files:
+        for ln, text in fi.comments.items():
+            m = FileInfo.WAIVER_RE.search(text)
+            if m:
+                out[m.group(1)] = out.get(m.group(1), 0) + 1
+    return out
+
+
+def lint_report(paths: Optional[Iterable[str]] = None,
+                rule: Optional[str] = None) -> dict:
+    """Full machine-readable report: the --json document.  Everything
+    in it is JSON-native (round-trips through json.dumps/loads)."""
+    violations, errors, files = _collect(paths, rule)
+    waived = _waiver_counts(files)
+    descs = {rid: desc for rid, (desc, _fn) in RULES.items()}
+    descs.update({rid: desc for rid, (desc, _fn) in PROJECT_RULES.items()})
+    descs["SEND03"] = "no message mutation after first send"
+    rules_summary = {
+        rid: {
+            "description": descs[rid],
+            "violations": sum(1 for v in violations if v.rule == rid),
+            "waived": waived.get(rid, 0),
+        }
+        for rid in sorted(RULE_IDS)
+    }
+    exit_code = 2 if errors else (1 if violations else 0)
+    return {
+        "schema": JSON_SCHEMA,
+        "clean": not violations and not errors,
+        "exit": exit_code,
+        "files": len(files),
+        "rules": rules_summary,
+        "violations": [dict(v.__dict__) for v in violations],
+        "errors": list(errors),
+    }
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -101,34 +200,39 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--rule", choices=sorted(RULE_IDS),
                     help="run a single rule")
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable output")
+                    help="machine-readable output (schema-versioned; "
+                         "exit code mirrors the 'exit' field)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rid, (desc, _fn) in sorted(RULES.items()):
             print(f"{rid:8s} {desc}")
+        for rid, (desc, _fn) in sorted(PROJECT_RULES.items()):
+            print(f"{rid:8s} {desc} (project-wide)")
         print(f"{'SEND03':8s} no message mutation after first send "
               f"(runs with FP02)")
         return 0
 
-    violations, errors = lint_paths(args.paths or None, rule=args.rule)
+    report = lint_report(args.paths or None, rule=args.rule)
     if args.json:
-        print(json.dumps({
-            "violations": [v.__dict__ for v in violations],
-            "errors": errors,
-        }, indent=1))
+        print(json.dumps(report, indent=1))
     else:
-        for v in violations:
-            print(v.render())
-        for e in errors:
+        for v in report["violations"]:
+            print(f"{v['rel']}:{v['line']}: {v['rule']} {v['msg']}")
+        for e in report["errors"]:
             print(e, file=sys.stderr)
-        if not violations and not errors:
+        if report["clean"]:
             print(f"invariant lint clean "
-                  f"({len(RULE_IDS)} rules)")
-    if errors:
-        return 2
-    return 1 if violations else 0
+                  f"({len(RULE_IDS)} rules, {report['files']} files)")
+        else:
+            per_rule = {rid: s["violations"]
+                        for rid, s in report["rules"].items()
+                        if s["violations"]}
+            print(f"invariant lint: "
+                  f"{len(report['violations'])} violation(s) "
+                  f"{per_rule}", file=sys.stderr)
+    return report["exit"]
 
 
 if __name__ == "__main__":

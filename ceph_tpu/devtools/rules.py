@@ -38,6 +38,34 @@ Each rule mechanically enforces one PR-landed write-path invariant
                            (``*window*.release(...)``) sits in a
                            ``finally`` block, so a failed op can never
                            wedge its dependency chain (PR-5 invariant).
+  PROTO08 protocol-map   — cross-daemon message-graph exhaustiveness
+                           (PROJECT rule: runs over the whole linted
+                           set, not one file).  Every registered
+                           message type sent to a daemon role via a
+                           ``peer_type="..."`` literal (or
+                           ``send_osd``) must have an
+                           ``isinstance``-dispatch handler in that
+                           role's dispatcher modules — an unhandled
+                           wire type is a silent drop the sender waits
+                           out as a timeout.
+  REPLY09 reply-or-requeue — in osd/ modules, any function that owns a
+                           reply path (calls ``reply_to``) must
+                           discharge the consumed op on every early
+                           ``return``: a reply, a requeue
+                           (``queue_op``/``put_nowait``), or a task
+                           handoff (``create_task``) must precede the
+                           return on its path, else the client waits
+                           out the full objecter timeout and the
+                           dispatch-throttle budget leaks until
+                           completion paths notice.
+  EPOCH10 epoch-guard    — osd/ message handlers (``on_*``,
+                           ``_handle_*``, ``handle_sub_message``) that
+                           mutate PG/daemon state must compare an
+                           epoch/interval field (``.epoch``,
+                           ``same_interval_since``, ``interval_epoch``,
+                           ``map_epoch``) before the first mutation —
+                           applying a stale-interval message is the
+                           classic split-brain write race.
 
 Waivers: a site that is allowed to break a rule for a documented reason
 carries ``# lint: allow[RULE] reason`` on the same line or the line
@@ -506,6 +534,347 @@ def check_fin07(fi: FileInfo) -> Iterator[Violation]:
                 f"object-dependency chain (PR-5 invariant)")
 
 
+# ------------------------------------------------------------------ REPLY09
+
+#: osd/ functions that call one of these OWN a reply path
+_R9_TRIGGERS = {"reply_to"}
+#: statements containing one of these discharge the consumed op on the
+#: path they sit on: a reply, a requeue, or a task handoff (kept
+#: narrow — a generic container .append() is NOT a discharge)
+_R9_DISCHARGE = {"reply_to", "queue_op", "put_nowait", "create_task",
+                 "send_osd", "send_message", "requeue"}
+
+
+def _terminates(stmts) -> bool:
+    """True when the block can never fall through (its last statement
+    returns or raises)."""
+    return bool(stmts) and isinstance(stmts[-1], (ast.Return, ast.Raise))
+
+
+def _own_body_calls(fn) -> Iterator[ast.Call]:
+    """Calls in fn's own body, not descending into nested defs."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _has_call_attr(node: ast.AST, names: Set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in names:
+            return True
+    return False
+
+
+class _ReplyScan:
+    """Path-sensitive-ish scan: walk statements in order carrying a
+    "discharged on this path" flag.  A compound statement's branches
+    each inherit the flag at entry; a discharge inside ONE branch
+    leaks to the code after the compound only when every branch that
+    can fall through discharged (a branch ending in return/raise does
+    not fall through).  Loop bodies may run zero times, so their
+    discharges never propagate past the loop."""
+
+    def __init__(self, fi: FileInfo, out: List[Violation]):
+        self.fi = fi
+        self.out = out
+
+    def scan(self, stmts, discharged: bool) -> bool:
+        """Check every return in this block; returns the discharge
+        state at the block's fall-through."""
+        d = discharged
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(st, ast.Return):
+                ok = d or (st.value is not None
+                           and _has_call_attr(st.value, _R9_DISCHARGE))
+                if not ok and not self.fi.waived("REPLY09", st.lineno):
+                    self.out.append(Violation(
+                        "REPLY09", self.fi.rel, st.lineno,
+                        "early return without replying/requeuing the "
+                        "consumed op on this path: the client waits "
+                        "out its full timeout (reply, queue_op, or "
+                        "waive with the drop's justification)"))
+                continue
+            if isinstance(st, ast.If):
+                d_body = self.scan(st.body, d)
+                d_else = self.scan(st.orelse, d) if st.orelse else d
+                outs = []
+                if not _terminates(st.body):
+                    outs.append(d_body)
+                if not st.orelse:
+                    outs.append(d)          # implicit empty else
+                elif not _terminates(st.orelse):
+                    outs.append(d_else)
+                # both arms terminate => code below is unreachable on
+                # this path; keep d (harmlessly conservative)
+                d = all(outs) if outs else d
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                d = self.scan(st.body, d)   # single path: propagates
+            elif isinstance(st, ast.Try):
+                # body/handlers are conditional paths: scan them for
+                # returns but don't let their discharges leak; the
+                # finally block always runs and propagates
+                self.scan(st.body, d)
+                for h in st.handlers:
+                    self.scan(h.body, d)
+                self.scan(st.orelse, d)
+                d = self.scan(st.finalbody, d)
+            elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                # may run zero times: no propagation past the loop
+                self.scan(st.body, d)
+                self.scan(st.orelse, d)
+            elif _has_call_attr(st, _R9_DISCHARGE):
+                d = True
+        return d
+
+
+def check_reply09(fi: FileInfo) -> Iterator[Violation]:
+    if not fi.rel.startswith("osd/"):
+        return
+    out: List[Violation] = []
+    for node in ast.walk(fi.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not any(isinstance(c.func, ast.Attribute)
+                   and c.func.attr in _R9_TRIGGERS
+                   for c in _own_body_calls(node)):
+            continue
+        _ReplyScan(fi, out).scan(node.body, False)
+    yield from out
+
+
+# ------------------------------------------------------------------ EPOCH10
+
+#: method calls that PERSIST or mutate PG/daemon replicated state
+_E10_MUT_CALLS = {"save_meta", "apply_transaction", "queue_transactions",
+                  "apply_push"}
+#: state attributes off self/pg whose assignment (or container
+#: mutation) is a replicated-state write
+_E10_MUT_ATTRS = {"info", "log", "state", "missing", "reqids",
+                  "peer_info", "peer_missing", "past_intervals"}
+_E10_CONTAINER_MUTS = {"append", "add", "pop", "clear", "update",
+                       "remove"}
+#: attribute names whose mere mention before the first mutation counts
+#: as an interval/epoch guard
+_E10_GUARDS = {"epoch", "same_interval_since", "interval_epoch",
+               "map_epoch"}
+_E10_ROOTS = {"self", "pg"}
+
+
+def _chain_names(node: ast.AST) -> Tuple[Optional[str], List[str]]:
+    """(root Name id, [attr chain bottom-up]) through Attribute and
+    Subscript links."""
+    attrs: List[str] = []
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute):
+            attrs.append(node.attr)
+        node = node.value
+    root = node.id if isinstance(node, ast.Name) else None
+    return root, attrs
+
+
+def _e10_first_mutation(fn) -> Optional[int]:
+    first: Optional[int] = None
+
+    def note(ln: int) -> None:
+        nonlocal first
+        if first is None or ln < first:
+            first = ln
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                root, attrs = _chain_names(t)
+                if root in _E10_ROOTS and attrs \
+                        and attrs[-1] in _E10_MUT_ATTRS:
+                    note(node.lineno)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in _E10_MUT_CALLS:
+                note(node.lineno)
+            elif attr in _E10_CONTAINER_MUTS:
+                root, attrs = _chain_names(node.func.value)
+                if root in _E10_ROOTS and \
+                        any(a in _E10_MUT_ATTRS for a in attrs):
+                    note(node.lineno)
+    return first
+
+
+def check_epoch10(fi: FileInfo) -> Iterator[Violation]:
+    if not fi.rel.startswith("osd/"):
+        return
+    for node in ast.walk(fi.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        name = node.name
+        if not (name.startswith("on_") or name.startswith("_handle_")
+                or name == "handle_sub_message"):
+            continue
+        args = node.args.args
+        if len(args) < 2:
+            continue        # not a (self, m) message handler
+        mut_line = _e10_first_mutation(node)
+        if mut_line is None:
+            continue
+        guarded = any(
+            isinstance(sub, ast.Attribute) and sub.attr in _E10_GUARDS
+            and sub.lineno < mut_line
+            for sub in ast.walk(node))
+        if guarded:
+            continue
+        if fi.waived("EPOCH10", mut_line) or \
+                fi.waived("EPOCH10", node.lineno):
+            continue
+        yield Violation(
+            "EPOCH10", fi.rel, mut_line,
+            f"handler {name}() mutates PG state with no epoch/interval "
+            f"guard before the first mutation: a stale-interval "
+            f"message must be dropped, not applied "
+            f"(compare m.epoch against same_interval_since first)")
+
+
+# ------------------------------------------------------------------ PROTO08
+
+#: daemon role -> the modules whose isinstance-dispatch handles that
+#: role's inbound messages (a daemon's embedded MonClient rides the
+#: same messenger, so it is part of the daemon's handler surface)
+ROLE_MODULES: Dict[str, Tuple[str, ...]] = {
+    "osd": ("osd/daemon.py", "osd/tiering.py", "mon/client.py"),
+    "mon": ("mon/monitor.py",),
+    "mds": ("services/mds.py", "mon/client.py"),
+    "client": ("mon/client.py", "client/rados.py",
+               "client/objecter.py", "services/cephfs.py"),
+}
+
+
+def _registered_messages(files: List[FileInfo]) -> Set[str]:
+    out: Set[str] = set()
+    for fi in files:
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.ClassDef) and any(
+                    isinstance(d, ast.Name)
+                    and d.id == "register_message"
+                    for d in node.decorator_list):
+                out.add(node.name)
+    return out
+
+
+def _handled_names(fi: FileInfo) -> Set[str]:
+    """Every class name this module dispatches on via isinstance()."""
+    out: Set[str] = set()
+    for node in ast.walk(fi.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "isinstance"
+                and len(node.args) == 2):
+            continue
+        spec = node.args[1]
+        names = spec.elts if isinstance(spec, ast.Tuple) else [spec]
+        for n in names:
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                out.add(n.attr)
+    return out
+
+
+def _send_edges(fi: FileInfo, registered: Set[str]
+                ) -> Iterator[Tuple[str, str, int]]:
+    """(message class, target role, line) for every send site whose
+    message type and target role are statically knowable: a
+    peer_type="..." string literal on send_message, or send_osd (peer
+    role is osd by construction).  reply_to and variable peer types
+    carry no static target and produce no edge."""
+    for node in ast.walk(fi.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        local: Dict[str, str] = {}
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Call) \
+                    and isinstance(sub.value.func, ast.Name) \
+                    and sub.value.func.id in registered:
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        local[t.id] = sub.value.func.id
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)):
+                continue
+            attr = sub.func.attr
+            role: Optional[str] = None
+            msg_expr: Optional[ast.AST] = None
+            if attr == "send_message":
+                for kw in sub.keywords:
+                    if kw.arg == "peer_type" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str):
+                        role = kw.value.value
+                if sub.args:
+                    msg_expr = sub.args[0]
+            elif attr == "send_osd" and len(sub.args) >= 2:
+                role = "osd"
+                msg_expr = sub.args[1]
+            if role is None or msg_expr is None:
+                continue
+            cls: Optional[str] = None
+            if isinstance(msg_expr, ast.Call) \
+                    and isinstance(msg_expr.func, ast.Name) \
+                    and msg_expr.func.id in registered:
+                cls = msg_expr.func.id
+            elif isinstance(msg_expr, ast.Name):
+                cls = local.get(msg_expr.id)
+            if cls is not None:
+                yield cls, role, sub.lineno
+
+
+def check_proto08(files: List[FileInfo]) -> Iterator[Violation]:
+    """PROJECT rule: needs the whole linted set.  Edges whose target
+    role has no module present in the set are skipped (linting a single
+    file must not fabricate missing-handler noise)."""
+    by_rel = {fi.rel: fi for fi in files}
+    registered = _registered_messages(files)
+    handled: Dict[str, Set[str]] = {}
+    for role, mods in ROLE_MODULES.items():
+        present = [by_rel[m] for m in mods if m in by_rel]
+        if not present:
+            continue
+        handled[role] = set()
+        for fi in present:
+            handled[role] |= _handled_names(fi)
+    seen: Set[Tuple[str, str]] = set()
+    for fi in files:
+        if fi.rel.startswith(("tools/", "devtools/")):
+            continue
+        for cls, role, line in _send_edges(fi, registered):
+            if role not in handled:
+                continue
+            if cls in handled[role]:
+                continue
+            if fi.waived("PROTO08", line):
+                continue
+            if (cls, role) in seen:
+                continue        # one report per (type, role) pair
+            seen.add((cls, role))
+            yield Violation(
+                "PROTO08", fi.rel, line,
+                f"{cls} is sent to role {role!r} but no dispatcher in "
+                f"{list(ROLE_MODULES[role])} handles it "
+                f"(isinstance check missing): the send is a silent "
+                f"drop on the receiver")
+
+
 # --------------------------------------------------------------- registry
 
 RULES: Dict[str, Tuple[str, Callable[[FileInfo], Iterator[Violation]]]] = {
@@ -515,7 +884,19 @@ RULES: Dict[str, Tuple[str, Callable[[FileInfo], Iterator[Violation]]]] = {
     "MONO05": ("monotonic clock discipline in op paths", check_mono05),
     "LOCK06": ("FileDB lock order _io -> _mu", check_lock06),
     "FIN07": ("windowed slot release under finally", check_fin07),
+    "REPLY09": ("handlers reply or requeue on every path", check_reply09),
+    "EPOCH10": ("epoch/interval guard before PG-state mutation",
+                check_epoch10),
 }
+
+#: project-wide rules: run over the WHOLE linted file set at once
+PROJECT_RULES: Dict[str, Tuple[str,
+                               Callable[[List[FileInfo]],
+                                        Iterator[Violation]]]] = {
+    "PROTO08": ("cross-daemon message graph is exhaustive",
+                check_proto08),
+}
+
 #: SEND03 is produced by the FP02 scanner (shared dataflow pass) but is
 #: its own rule id for waivers/filtering
-RULE_IDS = tuple(RULES) + ("SEND03",)
+RULE_IDS = tuple(RULES) + tuple(PROJECT_RULES) + ("SEND03",)

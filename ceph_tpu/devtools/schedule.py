@@ -1,0 +1,695 @@
+"""Deterministic schedule explorer (invariant sanitizer, part 3).
+
+Reference parity: FoundationDB's deterministic simulation (one seeded
+scheduler owns every interleaving; a failing run replays byte-for-byte
+from its seed) and CHESS-style bounded schedule exploration (Musuvathi
+et al., OSDI'08: permute the runnable set at every scheduling point and
+enumerate crash points).  PR 7's lint + lockdep layers catch
+STRUCTURAL concurrency violations; this module explores ORDERINGS —
+the pipelined write path has otherwise only ever run under whatever
+schedule this box's event loop happens to produce.
+
+Three pieces:
+
+  * ``DeterministicLoop`` — an asyncio event loop whose ready queue is
+    permuted by a seeded controller at every scheduling point, whose
+    clock is VIRTUAL (``loop.time()`` only advances when the loop is
+    idle, jumping straight to the next timer — a FAST_CFG cluster boots
+    with zero wall-clock sleeping), and which records every scheduling
+    decision into a running trace hash: same seed, same code => byte-
+    identical trace, so a failing schedule pins as a one-line
+    regression test carrying its seed.
+
+    Scheduling discipline: only TASK steps (coroutine wakeups) are
+    permuted — callbacks scheduled with plain ``call_soon`` keep their
+    FIFO contract relative to each other (the platform guarantee the
+    commit thread's in-order completion discipline legitimately relies
+    on), so every explored schedule is one asyncio itself could
+    legally produce.
+
+  * A commit-layer observer + invariant checks: after every schedule
+    the machine-checked write-path invariants must hold — dense
+    in-order pglog versions, ``last_complete`` monotone under
+    ``complete_to``, no commit callback before its group's durability
+    point and none after a crash point, window slots balanced (no
+    leaked sequencer slot / OpTracker entry / dispatch-throttle
+    budget), zero local-path encodes.
+
+  * ``explore()`` — runs the EC mini-workload under N seeded
+    schedules, then enumerates crash points at the PR-1 commit-thread
+    fault-injection hooks (before_data_sync / before_kv / committed,
+    occurrence-indexed) and checks that no acked write is ever lost
+    and no phantom ack survives a crash.
+
+Replay: every report carries its seed; ``run_ec_mini(seed=S)``
+reproduces the exact interleaving (within one interpreter process —
+across processes PYTHONHASHSEED changes set iteration orders).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import contextlib
+import hashlib
+import random
+import selectors
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+# ------------------------------------------------------------ controllers
+
+
+class ScheduleController:
+    """Picks which runnable candidate runs next.  Base = FIFO."""
+
+    def pick(self, labels: Sequence[str]) -> int:
+        return 0
+
+
+class RandomScheduler(ScheduleController):
+    """Seeded uniform choice over the runnable set at every scheduling
+    point — the CHESS-style random walk through interleaving space."""
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self._rng = random.Random(seed)
+
+    def pick(self, labels: Sequence[str]) -> int:
+        return self._rng.randrange(len(labels))
+
+
+class AdversarialScheduler(ScheduleController):
+    """Starves task steps whose label contains ``victim`` while
+    ``active()`` holds (everything else runs first; when victims are
+    the ONLY runnable candidates they still run, so no livelock).
+    Deterministic — no randomness.  This is how a test forces e.g.
+    "the interval change lands BEFORE the admitted windowed op runs"."""
+
+    def __init__(self, victim: str,
+                 active: Optional[Callable[[], bool]] = None):
+        self.victim = victim
+        self.active = active or (lambda: True)
+
+    def pick(self, labels: Sequence[str]) -> int:
+        if not self.active():
+            return 0
+        for i, lab in enumerate(labels):
+            if self.victim not in lab:
+                return i
+        return 0        # only victims runnable: no legal starvation
+
+
+# ------------------------------------------------------------ ready queue
+
+
+def _label(handle) -> str:
+    """Deterministic label for a ready handle: coroutine qualname for
+    task steps, callback qualname otherwise."""
+    cb = getattr(handle, "_callback", None)
+    owner = getattr(cb, "__self__", None)
+    if isinstance(owner, asyncio.Task):
+        try:
+            return "task:" + owner.get_coro().__qualname__
+        except Exception:
+            return "task:?"
+    qn = getattr(cb, "__qualname__", None)
+    if qn:
+        return "cb:" + qn
+    return "cb:" + type(cb).__name__
+
+
+def _is_task_step(handle) -> bool:
+    return isinstance(
+        getattr(getattr(handle, "_callback", None), "__self__", None),
+        asyncio.Task)
+
+
+class _PermutedReady(collections.deque):
+    """Drop-in for BaseEventLoop._ready: append/popleft/clear/len as a
+    deque, but popleft consults the loop's schedule controller to pick
+    WHICH runnable handle goes next.  Candidates = every task step +
+    the FIRST plain callback (plain call_soon callbacks keep FIFO
+    among themselves — the documented asyncio contract in-order commit
+    completion relies on).
+
+    append/popleft share a lock: ``call_soon_threadsafe`` appends from
+    foreign threads (the idle selector path exists exactly to serve
+    them), and an append landing mid-scan — or between the rotate/pop/
+    rotate steps — would either raise "deque mutated during iteration"
+    or let the new handle ride the rotation out of FIFO position."""
+
+    loop: "DeterministicLoop" = None  # set right after construction
+
+    def __init__(self, *a):
+        super().__init__(*a)
+        self._plock = threading.Lock()
+
+    def append(self, h) -> None:
+        with self._plock:
+            collections.deque.append(self, h)
+
+    def popleft(self):
+        with self._plock:
+            n = len(self)
+            j = 0
+            if n > 1 and self.loop is not None:
+                cands: List[int] = []
+                first_plain: Optional[int] = None
+                for i, h in enumerate(self):
+                    if getattr(h, "_cancelled", False):
+                        continue
+                    if _is_task_step(h):
+                        cands.append(i)
+                    elif first_plain is None:
+                        first_plain = i
+                if first_plain is not None:
+                    cands.append(first_plain)
+                    cands.sort()
+                if len(cands) > 1:
+                    j = self.loop._pick_index(cands, self)
+                elif cands:
+                    j = cands[0]
+            if j:
+                self.rotate(-j)
+            h = collections.deque.popleft(self)
+            if j:
+                self.rotate(j)
+        if self.loop is not None:
+            self.loop._note_pick(j, h, n)
+        return h
+
+
+class _VirtualSelector:
+    """Selector wrapper: never blocks wall-clock on timer waits.  With
+    no IO events and no ready callbacks it JUMPS the loop's virtual
+    clock to the next scheduled timer; only a loop with neither timers
+    nor ready work (waiting on a foreign thread) does a short real
+    wait so call_soon_threadsafe wake-ups can land."""
+
+    def __init__(self, inner, loop: "DeterministicLoop"):
+        self._inner = inner
+        self._loop = loop
+
+    def select(self, timeout=None):
+        loop = self._loop
+        loop._close_cb_measure()
+        events = self._inner.select(0)
+        if events or timeout == 0:
+            return events
+        if loop._scheduled:
+            loop._advance_to(loop._scheduled[0]._when)
+            return events
+        if timeout is None:
+            return self._inner.select(loop.idle_wait)
+        return events
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+# ---------------------------------------------------------------- the loop
+
+
+class DeterministicLoop(asyncio.SelectorEventLoop):
+    """Seeded deterministic asyncio loop: permuted ready queue, virtual
+    time, trace hash.  See the module docstring."""
+
+    deterministic = True
+
+    def __init__(self, seed: int = 0,
+                 controller: Optional[ScheduleController] = None,
+                 trace_tail: int = 4096):
+        super().__init__(selectors.SelectSelector())
+        self.seed = seed
+        self.controller = controller if controller is not None \
+            else RandomScheduler(seed)
+        self._vt = 0.0
+        self._steps = 0
+        self._hash = hashlib.sha256()
+        #: bounded tail of scheduling decisions — the interleaving
+        #: trace printed for a failing schedule
+        self.trace_tail: collections.deque = collections.deque(
+            maxlen=trace_tail)
+        #: LoopStallMonitor.attach_virtual hook: called with
+        #: (wall_seconds, label) after every callback when set
+        self.stall_observer = None
+        self._cb_t0: Optional[float] = None
+        self._cb_label = ""
+        #: real select timeout when truly idle (waiting on a thread)
+        self.idle_wait = 0.02
+        ready = _PermutedReady()
+        ready.loop = self
+        self._ready = ready
+        self._selector = _VirtualSelector(self._selector, self)
+
+    # --- virtual clock ---
+    def time(self) -> float:
+        return self._vt
+
+    def _advance_to(self, when: float) -> None:
+        if when > self._vt:
+            self._vt = when
+            self._trace(f"adv:{when:.6f}")
+
+    # --- schedule bookkeeping ---
+    def _trace(self, line: str) -> None:
+        self._hash.update(line.encode())
+        self._hash.update(b"\n")
+        self.trace_tail.append(line)
+
+    def _pick_index(self, cands: List[int], ready) -> int:
+        labels = [_label(ready[i]) for i in cands]
+        k = self.controller.pick(labels)
+        if not 0 <= k < len(cands):
+            k = 0
+        return cands[k]
+
+    def _note_pick(self, idx: int, handle, nready: int) -> None:
+        self._close_cb_measure()
+        self._steps += 1
+        label = _label(handle)
+        self._trace(f"{self._steps}:{nready}:{idx}:{label}")
+        if self.stall_observer is not None:
+            self._cb_t0 = time.monotonic()
+            self._cb_label = label
+
+    def _close_cb_measure(self) -> None:
+        if self._cb_t0 is not None:
+            obs = self.stall_observer
+            if obs is not None:
+                obs(time.monotonic() - self._cb_t0, self._cb_label)
+            self._cb_t0 = None
+
+    # --- results ---
+    @property
+    def steps(self) -> int:
+        return self._steps
+
+    def trace_hash(self) -> str:
+        """Running hash over every scheduling decision + virtual-time
+        advance so far.  Identical across two runs of the same seed +
+        workload in one interpreter."""
+        return self._hash.hexdigest()
+
+
+def run_deterministic(main_factory, *, seed: int = 0,
+                      controller: Optional[ScheduleController] = None):
+    """Run ``await main_factory()`` to completion under a fresh
+    DeterministicLoop.  Commit threads started inside run INLINE
+    (store/commit.py SIM_INLINE) — the one interleaving source the
+    scheduler cannot permute deterministically is removed; the commit
+    code path itself is unchanged.  Returns (result, loop)."""
+    from ceph_tpu.store import commit as commit_mod
+    loop = DeterministicLoop(seed=seed, controller=controller)
+    old_inline = commit_mod.SIM_INLINE
+    rng_state = random.getstate()
+    commit_mod.SIM_INLINE = True
+    random.seed(seed)
+    asyncio.set_event_loop(loop)
+    try:
+        result = loop.run_until_complete(main_factory())
+        return result, loop
+    finally:
+        commit_mod.SIM_INLINE = old_inline
+        random.setstate(rng_state)
+        # asyncio.run-style teardown: cancel stragglers (objecter
+        # resend backoffs, parked queue getters) so their finallys run
+        # instead of flooding stderr with destroyed-pending warnings
+        # that would bury a failing schedule's seed/trace report
+        try:
+            pending = asyncio.all_tasks(loop)
+            for t in pending:
+                t.cancel()
+            if pending:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True))
+            loop.run_until_complete(loop.shutdown_asyncgens())
+        except Exception:
+            pass
+        asyncio.set_event_loop(None)
+        loop.close()
+
+
+# ------------------------------------------------------- commit observer
+
+
+class CommitObserver:
+    """store/commit.py OBSERVER hook: checks the ack-vs-durability
+    ordering invariants across every store of the sim —
+
+      * a commit callback may only fire for items whose group already
+        passed its durability point ("committed" injection hook);
+      * a store whose commit thread crashed must never fire another
+        callback (no phantom acks after a crash point)."""
+
+    def __init__(self):
+        self.findings: List[str] = []
+        self._committed: Dict[str, Set[int]] = {}
+        self._crashed: Set[str] = set()
+
+    def __call__(self, store: str, event: str,
+                 idxs: List[int]) -> None:
+        if event == "committed":
+            self._committed.setdefault(store, set()).update(idxs)
+        elif event == "crashed":
+            self._crashed.add(store)
+        elif event == "callbacks":
+            if store in self._crashed:
+                self.findings.append(
+                    f"phantom ack: {store} fired commit callbacks for "
+                    f"items {idxs} AFTER its crash point")
+            missing = [i for i in idxs
+                       if i not in self._committed.get(store, ())]
+            if missing:
+                self.findings.append(
+                    f"ack before durability: {store} fired commit "
+                    f"callbacks for items {missing} before their "
+                    f"group's durability point")
+
+
+@contextlib.contextmanager
+def commit_observation(obs: Optional[CommitObserver] = None):
+    from ceph_tpu.store import commit as commit_mod
+    obs = obs or CommitObserver()
+    prev = commit_mod.OBSERVER
+    commit_mod.OBSERVER = obs
+    try:
+        yield obs
+    finally:
+        commit_mod.OBSERVER = prev
+
+
+@contextlib.contextmanager
+def watch_last_complete(findings: List[str]):
+    """Class-level canary on PG.complete_to: the committed cursor must
+    never regress through the commit-callback path."""
+    from ceph_tpu.osd.pg import PG
+    orig = PG.complete_to
+
+    def watched(self, version):
+        before = self.info.last_complete
+        orig(self, version)
+        if self.info.last_complete < before:
+            findings.append(
+                f"last_complete regressed on {self.pgid}: "
+                f"{before} -> {self.info.last_complete}")
+
+    PG.complete_to = watched
+    try:
+        yield
+    finally:
+        PG.complete_to = orig
+
+
+# ------------------------------------------------------ invariant checks
+
+
+def check_cluster_invariants(cl, *, encode_base: int,
+                             findings: List[str]) -> None:
+    """The machine-checked write-path invariants, asserted against a
+    QUIESCED cluster (windows drained, no client op in flight)."""
+    from ceph_tpu.msg import payload as payload_mod
+    for osd in cl.osds.values():
+        for pg in osd.pgs.values():
+            entries = pg.log.entries
+            vs = [e.version.version for e in entries]
+            if vs != sorted(vs) or \
+                    (vs and vs != list(range(vs[0], vs[0] + len(vs)))):
+                findings.append(
+                    f"pglog versions not dense/in-order on "
+                    f"osd.{osd.whoami} {pg.pgid}: {vs}")
+            if pg.info.last_update < pg.info.last_complete:
+                findings.append(
+                    f"last_complete {pg.info.last_complete} ahead of "
+                    f"last_update {pg.info.last_update} on "
+                    f"osd.{osd.whoami} {pg.pgid}")
+            if not pg.op_window.balanced():
+                findings.append(
+                    f"window slots unbalanced on osd.{osd.whoami} "
+                    f"{pg.pgid}: active={pg.op_window.active} "
+                    f"gates={list(pg.op_window._gates)}")
+        if osd.op_tracker._inflight:
+            findings.append(
+                f"OpTracker leak on osd.{osd.whoami}: "
+                f"{list(osd.op_tracker._inflight)} still in flight "
+                f"after quiesce")
+        thr = osd.messenger.dispatch_throttle
+        if thr is not None and thr.cur != 0:
+            findings.append(
+                f"dispatch-throttle leak on osd.{osd.whoami}: "
+                f"cur={thr.cur} after quiesce")
+    encodes = payload_mod.counters()["msg_encode_calls"] - encode_base
+    if encodes:
+        findings.append(
+            f"local path encoded: msg_encode_calls grew by {encodes} "
+            f"on an all-local sim cluster")
+
+
+# ------------------------------------------------------- the mini workload
+
+
+@dataclass
+class ScheduleReport:
+    seed: int
+    trace_hash: str = ""
+    steps: int = 0
+    findings: List[str] = field(default_factory=list)
+    crash: Optional[Tuple[int, str, int]] = None
+    acked: int = 0
+    unacked: int = 0
+    trace_tail: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def render(self) -> str:
+        head = (f"seed={self.seed} crash={self.crash} "
+                f"steps={self.steps} hash={self.trace_hash[:16]} "
+                f"acked={self.acked} unacked={self.unacked}")
+        if self.ok:
+            return head + " OK"
+        tail = "\n".join(self.trace_tail[-40:])
+        return (head + "\n  " + "\n  ".join(self.findings)
+                + f"\nlast scheduling decisions:\n{tail}")
+
+
+async def _quiesce(cl, timeout: float = 120.0) -> None:
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        busy = any(pg.op_window.active
+                   for osd in cl.osds.values()
+                   for pg in osd.pgs.values())
+        busy = busy or any(osd.op_tracker._inflight
+                           for osd in cl.osds.values())
+        if not busy:
+            return
+        await asyncio.sleep(0.5)
+
+
+async def _ec_mini_body(report: ScheduleReport, *,
+                        n_objects: int, iodepth: int,
+                        pool_type: str, k: int, m: int, n_osds: int,
+                        crash: Optional[Tuple[int, str, int]],
+                        inject_probe: Optional[Callable] = None) -> None:
+    from ceph_tpu.msg import payload as payload_mod
+    from ceph_tpu.qa.cluster import Cluster, make_sim_ctx
+    findings = report.findings
+    encode_base = payload_mod.counters()["msg_encode_calls"]
+    cl = Cluster(ctx_factory=make_sim_ctx)
+    admin = await cl.start(n_osds)
+    if pool_type == "erasure":
+        await admin.pool_create("sim", pg_num=1, pool_type="erasure",
+                                k=k, m=m)
+    else:
+        await admin.pool_create("sim", pg_num=1)
+    io = admin.open_ioctx("sim")
+    # warm the PG (activation) so the burst exercises the WINDOW, and
+    # so boot-time commits sit outside the crash-point enumeration
+    await io.write_full("warm", b"w")
+    if crash is not None:
+        osd_id, point, skip = crash
+        committer = cl.osds[osd_id].store._committer
+        committer.crash_at = point
+        committer.crash_skip = skip
+    if inject_probe is not None:
+        inject_probe(cl)
+    blobs = {f"sim{i:02d}": bytes([65 + i % 26]) * 512
+             for i in range(n_objects)}
+    acked: Dict[str, bytes] = {}
+    sem = asyncio.Semaphore(iodepth)
+
+    async def one(name: str, data: bytes) -> None:
+        async with sem:
+            try:
+                await asyncio.wait_for(io.write_full(name, data), 45.0)
+                acked[name] = data
+            except (Exception, asyncio.CancelledError):
+                # timed out / store dead: UNACKED — the invariant then
+                # is that the cluster never claimed durability for it
+                pass
+
+    await asyncio.gather(*(one(n, d) for n, d in blobs.items()),
+                         return_exceptions=True)
+    report.acked = len(acked)
+    report.unacked = len(blobs) - len(acked)
+    await _quiesce(cl)
+    # no phantom acks: every ACKED write must read back intact, even
+    # after a commit-thread crash somewhere in the acting set
+    for name, data in acked.items():
+        try:
+            got = await asyncio.wait_for(io.read(name), 30.0)
+        except (Exception, asyncio.CancelledError):
+            findings.append(f"acked write {name!r} unreadable after "
+                            f"crash/quiesce")
+            continue
+        if got != data:
+            findings.append(f"acked write {name!r} corrupt: "
+                            f"{len(got)} bytes != {len(data)}")
+    if crash is not None:
+        committer = cl.osds[crash[0]].store._committer
+        if not committer.dead:
+            findings.append(
+                f"armed crash {crash} never fired (crash_skip "
+                f"{committer.crash_skip} left): the enumerated "
+                f"occurrence was not reached under this schedule")
+    check_cluster_invariants(cl, encode_base=encode_base,
+                             findings=findings)
+    try:
+        await cl.stop()
+    except AssertionError as e:
+        findings.append(f"lockdep findings at teardown: {e}")
+    except Exception as e:
+        findings.append(f"cluster stop failed: {e!r}")
+
+
+def run_ec_mini(seed: int = 0, *,
+                controller: Optional[ScheduleController] = None,
+                n_objects: int = 6, iodepth: int = 4,
+                pool_type: str = "erasure", k: int = 2, m: int = 2,
+                n_osds: int = 4,
+                crash: Optional[Tuple[int, str, int]] = None,
+                inject_probe: Optional[Callable] = None
+                ) -> ScheduleReport:
+    """One schedule of the ec_e2e mini-workload under the deterministic
+    loop: boot a FAST_CFG sim cluster, burst writes through the per-PG
+    window, quiesce, check every machine-checked invariant, tear down.
+    ``crash`` = (osd_id, injection_point, occurrence) arms the PR-1
+    commit-thread fault hook on that OSD's store."""
+    report = ScheduleReport(seed=seed, crash=crash)
+
+    async def main():
+        with commit_observation() as obs, \
+                watch_last_complete(report.findings):
+            await _ec_mini_body(
+                report, n_objects=n_objects, iodepth=iodepth,
+                pool_type=pool_type, k=k, m=m, n_osds=n_osds,
+                crash=crash, inject_probe=inject_probe)
+            report.findings.extend(obs.findings)
+
+    try:
+        _, loop = run_deterministic(main, seed=seed,
+                                    controller=controller)
+        report.trace_hash = loop.trace_hash()
+        report.steps = loop.steps
+        report.trace_tail = list(loop.trace_tail)
+    except (Exception, asyncio.CancelledError) as e:
+        # a wedged/crashed schedule IS a finding, not a test error
+        report.findings.append(
+            f"schedule did not complete: {type(e).__name__}: {e}")
+    return report
+
+
+# ------------------------------------------------------------ exploration
+
+
+@dataclass
+class ExploreReport:
+    schedules: List[ScheduleReport] = field(default_factory=list)
+    crash_runs: List[ScheduleReport] = field(default_factory=list)
+    crash_points: List[Tuple[int, str, int]] = field(
+        default_factory=list)
+
+    @property
+    def failures(self) -> List[ScheduleReport]:
+        return [r for r in self.schedules + self.crash_runs
+                if not r.ok]
+
+    def render_failures(self) -> str:
+        return "\n\n".join(r.render() for r in self.failures)
+
+
+#: the PR-1 commit-thread fault-injection points, in stage order:
+#: crash before the group's data fsync, between data fsync and the
+#: atomic kv submit, and after durability but before callbacks run
+CRASH_POINTS = ("before_data_sync", "before_kv", "committed")
+
+
+def enumerate_crash_points(crash_osd: int = 0,
+                           max_occurrences: int = 4,
+                           **workload_kw) -> List[Tuple[int, str, int]]:
+    """Probe run (seed 0, FIFO): count how many times each injection
+    point fires on crash_osd's store during the workload, then emit
+    every (osd, point, occurrence) pair up to max_occurrences."""
+    if "controller" in workload_kw:
+        raise ValueError("enumerate_crash_points owns the schedule "
+                         "(FIFO): occurrence indices are only "
+                         "meaningful under the schedule they were "
+                         "counted on")
+    counts: Dict[str, int] = {}
+
+    def probe(cl):
+        committer = cl.osds[crash_osd].store._committer
+        orig = committer.trace
+
+        def counting(point: str, n: int) -> None:
+            counts[point] = counts.get(point, 0) + 1
+            if orig is not None:
+                orig(point, n)
+
+        committer.trace = counting
+
+    rep = run_ec_mini(seed=0, controller=ScheduleController(),
+                      inject_probe=probe, **workload_kw)
+    if not rep.ok:
+        raise AssertionError(
+            "crash-point probe run itself failed:\n" + rep.render())
+    return [(crash_osd, pt, occ)
+            for pt in CRASH_POINTS
+            for occ in range(min(counts.get(pt, 0), max_occurrences))]
+
+
+def explore(n_schedules: int = 8, *, seeds: Optional[Sequence[int]] = None,
+            crash_osd: int = 0, max_crash_occurrences: int = 4,
+            with_crashes: bool = True, **workload_kw) -> ExploreReport:
+    """Bounded exploration: N seeded schedules of the mini-workload,
+    plus every enumerated commit-thread crash point under the FIFO
+    schedule.  Every report is replayable from its seed.  The
+    controllers are owned here (RandomScheduler per seed; FIFO for the
+    crash phase) — pass seeds to vary coverage, not a controller."""
+    if "controller" in workload_kw:
+        raise ValueError("explore() owns the schedule controllers "
+                         "(RandomScheduler per seed, FIFO for crash "
+                         "replays); vary `seeds` instead")
+    out = ExploreReport()
+    for seed in (seeds if seeds is not None else range(n_schedules)):
+        out.schedules.append(run_ec_mini(seed=seed, **workload_kw))
+    if with_crashes:
+        out.crash_points = enumerate_crash_points(
+            crash_osd=crash_osd,
+            max_occurrences=max_crash_occurrences, **workload_kw)
+        for cp in out.crash_points:
+            # replay the EXACT schedule the occurrences were counted
+            # under (FIFO, seed 0): commit-group structure is
+            # schedule-dependent, so any other schedule could leave
+            # the armed (point, occurrence) unreached and silently
+            # degrade the run to a no-crash schedule — run_ec_mini
+            # reports an unfired armed crash as a finding
+            out.crash_runs.append(
+                run_ec_mini(seed=0, controller=ScheduleController(),
+                            crash=cp, **workload_kw))
+    return out

@@ -617,6 +617,15 @@ class ReplicatedBackend(PGBackend):
     async def handle_sub_message(self, m) -> None:
         pg = self.pg
         if isinstance(m, MOSDRepOp):
+            if m.map_epoch < pg.info.same_interval_since:
+                # stale-interval sub-op (found by the schedule
+                # explorer / rule EPOCH10): a primary of a CLOSED
+                # interval fanned this out before it learned the new
+                # map.  Applying it would graft a divergent entry onto
+                # a log the new interval's peering has already judged;
+                # drop it — the old primary's in-flight ack wait aborts
+                # on its own interval change and the client resends
+                return
             rt = self._repl_trace(m)
             # copy discipline: txn() is OUR mutable copy (save_meta
             # appends below must never reach the sender or a sibling
@@ -1460,6 +1469,12 @@ class ECBackend(PGBackend):
     async def handle_sub_message(self, m) -> None:
         pg = self.pg
         if isinstance(m, MOSDECSubOpWrite):
+            if m.map_epoch < pg.info.same_interval_since:
+                # stale-interval shard write: same drop rule as the
+                # replicated sub-op path (see ReplicatedBackend) — a
+                # closed interval's fan-out must not append to a log
+                # the new interval already peered over
+                return
             rt = self._repl_trace(m)
             # copy discipline: mutable txn copy, shared immutable entry
             # (see ReplicatedBackend.handle_sub_message)

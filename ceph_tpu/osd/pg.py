@@ -1045,6 +1045,13 @@ class PG:
             self.osd.whoami, activate=False))
 
     def on_pg_log(self, m: MPGLog) -> None:
+        if m.activate and m.epoch < self.info.same_interval_since:
+            # stale activation (found by the schedule explorer / rule
+            # EPOCH10): a primary of a CLOSED interval activating us
+            # after we already advanced to a newer interval would
+            # clobber info/log state the new interval's peering owns.
+            # Drop it; the live primary re-activates under its epoch.
+            return
         if m.activate:
             # primary activated us: adopt info/log (replica path).
             # m.log()/m.info() are OUR mutable copies (copy discipline:
@@ -1141,6 +1148,10 @@ class PG:
             if fut is not None and not fut.done():
                 fut.set_result((m.info(), m.log()))
 
+    # pushes carry no interval epoch: staleness is arbitrated
+    # per-object by log VERSION in apply_push (never install below what
+    # we already applied), and the ack rides the commit callback
+    # lint: allow[EPOCH10] per-object version arbitration (apply_push)
     def on_push(self, m: MPGPush) -> None:
         def _ack():
             # the ack (and any local pull waiter) fires from the store

@@ -173,6 +173,13 @@ class OpSequencer:
             self._idle.set()
     # awaitfree:end sequencer-admit-release
 
+    def balanced(self) -> bool:
+        """True when every admitted slot has been released and no
+        object gate is left dangling — the quiesced-window invariant
+        the schedule explorer asserts after every explored schedule
+        (a leaked slot wedges the PG's dependency chains forever)."""
+        return self.active == 0 and not self._gates
+
     # -------------------------------------------------------------- drain
     async def drain(self) -> None:
         """Wait for the window to empty — the whole-PG barrier.  Used

@@ -45,9 +45,32 @@ FAST_CFG = {
 }
 
 
+#: deterministic-simulation overrides (devtools/schedule.py): clusters
+#: under the DeterministicLoop run fully in-process — every daemon pair
+#: on the zero-encode local path (TCP would reintroduce kernel-timing
+#: nondeterminism) — and with wall-clock failure detectors disarmed:
+#: the sim's virtual clock freezes while callbacks run, but heartbeat
+#: staleness is judged against time.monotonic, so a CPU-slow schedule
+#: would otherwise fabricate failure reports and osdmap churn that
+#: differ run to run.
+SIM_CFG = {
+    **FAST_CFG,
+    "ms_local_delivery": True,
+    "osd_heartbeat_grace": 3600.0,
+    "mon_osd_down_out_interval": 3600.0,
+}
+
+
 def make_ctx(name):
     ctx = Context(name)
     for k, v in FAST_CFG.items():
+        ctx.config.set(k, v)
+    return ctx
+
+
+def make_sim_ctx(name):
+    ctx = Context(name)
+    for k, v in SIM_CFG.items():
         ctx.config.set(k, v)
     return ctx
 
@@ -76,8 +99,15 @@ class Cluster:
             lockdep.enable()
         budget = ctx.config["lockdep_stall_budget"]
         if budget > 0:
-            self._stall_monitor = lockdep.LoopStallMonitor(
-                asyncio.get_running_loop(), budget).start()
+            loop = asyncio.get_running_loop()
+            mon = lockdep.LoopStallMonitor(loop, budget)
+            if getattr(loop, "deterministic", False):
+                # sim mode: the deterministic loop times every callback
+                # itself — exhaustive, replayable stall attribution
+                # instead of a probe thread racing container CPU noise
+                self._stall_monitor = mon.attach_virtual(loop)
+            else:
+                self._stall_monitor = mon.start()
         msgr = Messenger(ctx, EntityName("mon", "a"))
         self.monmap.add("a", await msgr.bind())
         mon = Monitor(ctx, "a", self.monmap, MemDB(), msgr)

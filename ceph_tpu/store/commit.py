@@ -37,17 +37,37 @@ _log = logging.getLogger("ceph-tpu.store.commit")
 
 _STOP = object()
 
+#: Deterministic-simulation switch (devtools/schedule.py): when True,
+#: threads STARTED from then on run INLINE — no kv-sync thread is
+#: spawned; corked groups commit synchronously at the loop-side flush
+#: point.  The commit code path (_commit, fault injection, counters,
+#: callback posting) is byte-identical; only the thread handoff — the
+#: one nondeterministic interleaving the schedule explorer cannot
+#: control — is removed.  Never set outside a sim run.
+SIM_INLINE = False
+
+#: Observer hook for the schedule explorer's commit-order invariant
+#: ("no ack before durability"): called as OBSERVER(store_name, event,
+#: item_indices) with event in {"committed", "callbacks", "crashed"}.
+#: None (the default) costs one attribute load per group.
+OBSERVER: Optional[Callable[[str, str, List[int]], None]] = None
+
 
 class _Item:
-    __slots__ = ("seq", "wrote_data", "on_commit", "post", "loop", "t0")
+    __slots__ = ("seq", "wrote_data", "on_commit", "post", "loop", "t0",
+                 "idx")
 
-    def __init__(self, seq, wrote_data, on_commit, post, loop):
+    def __init__(self, seq, wrote_data, on_commit, post, loop, idx=0):
         self.seq = seq
         self.wrote_data = wrote_data
         self.on_commit = on_commit
         self.post = post
         self.loop = loop
         self.t0 = time.perf_counter()
+        #: process-unique submission index (the seq field is
+        #: store-assigned and 0 for RAM stores): the explorer's
+        #: phantom-ack check keys on this
+        self.idx = idx
 
 
 class InjectedCrash(Exception):
@@ -63,6 +83,7 @@ class KVSyncThread:
     """
 
     QUEUE_MAX = 1024        # backlog bound (bluestore throttle role)
+    _instances = 0          # name-uniquifier (see __init__)
 
     def __init__(self, name: str,
                  data_sync: Optional[Callable[[], None]] = None,
@@ -70,6 +91,12 @@ class KVSyncThread:
                  queue_max: int = QUEUE_MAX,
                  gather_window: float = 0.0,
                  auto_tune: bool = True):
+        # unique per instance: co-located stores of the same backend
+        # (a 4-OSD in-process cluster = four "memstore_commit"s) must
+        # be distinguishable in the schedule explorer's commit-order
+        # observations; mount order is deterministic under sim
+        KVSyncThread._instances += 1
+        self.name = f"{name}#{KVSyncThread._instances}"
         self.data_sync = data_sync
         self.kv_sync = kv_sync
         #: seconds to linger after the first item of a group so bursts
@@ -118,11 +145,20 @@ class KVSyncThread:
         # --- test hooks ---
         self.trace: Optional[Callable[[str, int], None]] = None
         self.crash_at: Optional[str] = None
+        #: occurrence-indexed crash injection: skip this many hits of
+        #: crash_at's point before raising — the schedule explorer
+        #: enumerates (point, occurrence) pairs, not just first-hit
+        self.crash_skip = 0
         self.gate: Optional[threading.Event] = None   # holds the thread
         #     before it takes its next group (deterministic batching)
+        #: captured at start(): inline (sim) vs threaded commit
+        self._inline = False
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
+        if SIM_INLINE:
+            self._inline = True
+            return
         if self._thread is not None and self._thread.is_alive():
             return
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -148,9 +184,13 @@ class KVSyncThread:
             pass
         with self._lock:
             self._submitted += 1
-        item = _Item(seq, wrote_data, on_commit, post, loop)
+            idx = self._submitted
+        item = _Item(seq, wrote_data, on_commit, post, loop, idx=idx)
         if loop is None:
-            self._q.put([item])
+            if self._inline:
+                self._run_group([item])
+            else:
+                self._q.put([item])
             return
         self._staged.append(item)
         if not self._flush_scheduled:
@@ -162,7 +202,29 @@ class KVSyncThread:
         if not self._staged:
             return
         items, self._staged = self._staged, []
-        self._q.put(items)
+        if self._inline:
+            # sim mode: the loop-pass cork IS the commit group; no
+            # thread handoff, no gather linger — deterministic
+            self._run_group(items)
+        else:
+            self._q.put(items)
+
+    def _run_group(self, group: List[_Item]) -> None:
+        """One group through the commit path, on the calling thread
+        (inline sim mode).  Identical failure semantics to _run: an
+        injected crash or commit error kills the store 'thread'."""
+        if self.dead:
+            self._finish(group)
+            return
+        try:
+            self._commit(group)
+        except InjectedCrash:
+            self.dead = True
+            self._finish(group)
+        except Exception:
+            _log.exception("inline commit failed; store is dead")
+            self.dead = True
+            self._finish(group)
 
     def flush(self, timeout: float = 60.0) -> None:
         """Wait until every submitted batch is durable (callbacks may
@@ -176,7 +238,8 @@ class KVSyncThread:
         self._flush_staged()
         deadline = time.monotonic() + timeout
         with self._cv:
-            while self._completed < self._submitted and not self.dead:
+            while self._completed < self._submitted and not self.dead \
+                    and not self._inline:
                 left = deadline - time.monotonic()
                 if left <= 0:
                     raise TimeoutError("commit flush timed out")
@@ -187,6 +250,13 @@ class KVSyncThread:
                              "transactions were never made durable")
 
     def stop(self) -> None:
+        if self._inline:
+            if not self.dead:
+                try:
+                    self.flush()
+                except Exception:
+                    pass
+            return
         if self._thread is None:
             return
         if not self.dead:
@@ -250,7 +320,15 @@ class KVSyncThread:
         if self.trace is not None:
             self.trace(point, len(group))
         if self.crash_at == point:
-            raise InjectedCrash(point)
+            if self.crash_skip > 0:
+                self.crash_skip -= 1
+            else:
+                raise InjectedCrash(point)
+
+    def _notify(self, event: str, group: List[_Item]) -> None:
+        obs = OBSERVER
+        if obs is not None:
+            obs(self.name, event, [it.idx for it in group])
 
     def _effective_window(self) -> float:
         """The gather window actually slept: the EWMA of observed
@@ -292,6 +370,7 @@ class KVSyncThread:
             self._barrier_ewma = dt if self._barrier_ewma is None \
                 else 0.8 * self._barrier_ewma + 0.2 * dt
         self._inject("committed", group)
+        self._notify("committed", group)
         now = time.perf_counter()
         self.perf.inc("commit_batches")
         self.perf.inc("txns", len(group))
@@ -316,11 +395,13 @@ class KVSyncThread:
     def _finish(self, group: List[_Item]) -> None:
         """Crashed path: account the items so flush() can't hang, but
         run NO callbacks — these transactions never committed."""
+        self._notify("crashed", group)
         with self._cv:
             self._completed += len(group)
             self._cv.notify_all()
 
     def _complete(self, group: List[_Item]) -> None:
+        self._notify("callbacks", group)
         for it in group:
             fns = [f for f in (it.on_commit, it.post) if f is not None]
             if not fns:

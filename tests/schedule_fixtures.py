@@ -1,0 +1,101 @@
+"""Seeded-bug fixtures for the deterministic schedule explorer.
+
+Each context manager re-introduces one HISTORICAL write-path hazard so
+tests/test_schedule.py can assert the explorer actually detects the
+class of bug it exists for (a checker that has never caught its target
+bug is a no-op with good marketing):
+
+  * ``out_of_order_version_assignment`` — the pre-PR-5 structure:
+    pglog version assigned BEFORE a suspension point, log appended
+    after it.  Two concurrent ops on disjoint objects can then append
+    out of assignment order, leaving the pglog non-dense (a gap the
+    in-order group-commit callbacks silently mis-account).  PR 5 fixed
+    this by assigning versions inside the await-free submit section
+    (rule AF01 guards the structure; the explorer guards the BEHAVIOR).
+
+  * ``commit_callbacks_before_durability`` — a commit thread that runs
+    its completion callbacks before the group's durability barrier.
+    Acks (client replies, repop acks, last_complete) then vouch for
+    writes a crash at the PR-1 fault-injection points would lose —
+    the phantom-ack class the data-before-metadata discipline exists
+    to prevent.
+
+Both patch at class level and restore on exit; apply them INSIDE the
+test, around the run_ec_mini/explore call.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+
+
+@contextlib.contextmanager
+def out_of_order_version_assignment():
+    """Reintroduce the pre-PR-5 hazard on ReplicatedBackend: a private
+    version counter advances at op ARRIVAL, then the op yields once
+    before entering the (otherwise unchanged) submit path, which is
+    forced to use the early-assigned version.  Any schedule that wakes
+    two ops out of assignment order appends a gapped/misordered pglog
+    — exactly what dense-version checking must catch."""
+    from ceph_tpu.osd.backend import ReplicatedBackend
+    from ceph_tpu.osd.messages import EVersion
+
+    orig_submit = ReplicatedBackend.submit_client_write
+
+    async def buggy(self, m):
+        pg = self.pg
+        cnt = pg.__dict__.get("_fx_version_counter")
+        if cnt is None:
+            cnt = pg.info.last_update.version
+        cnt += 1
+        pg.__dict__["_fx_version_counter"] = cnt
+        forced = EVersion(pg.osd.osdmap.epoch, cnt)
+        # the bug: a suspension point between version assignment and
+        # the log append — another op can interleave here
+        await asyncio.sleep(0)
+        # force the original submit path to use the stale version.
+        # The instance attribute shadows the class method and is
+        # consumed synchronously (no await precedes next_version in
+        # the replicated submit path), so concurrent ops cannot read
+        # each other's forced version.
+        pg.__dict__["next_version"] = lambda: forced
+        try:
+            return await orig_submit(self, m)
+        finally:
+            pg.__dict__.pop("next_version", None)
+
+    ReplicatedBackend.submit_client_write = buggy
+    try:
+        yield
+    finally:
+        ReplicatedBackend.submit_client_write = orig_submit
+
+
+@contextlib.contextmanager
+def commit_callbacks_before_durability():
+    """Reintroduce the phantom-ack hazard on KVSyncThread: completion
+    callbacks fire BEFORE the group's data/kv barrier instead of
+    after.  The commit-order observer flags every group ("ack before
+    durability"); with a crash armed at before_data_sync the acks have
+    already escaped for a group that never became durable."""
+    from ceph_tpu.store.commit import KVSyncThread
+
+    orig_commit = KVSyncThread._commit
+    orig_complete = KVSyncThread._complete
+
+    def buggy(self, group):
+        orig_complete(self, group)          # BUG: acks first
+        # suppress the in-order completion the real path runs after
+        # durability — the callbacks must not fire twice
+        self._complete = lambda g: None
+        try:
+            orig_commit(self, group)
+        finally:
+            del self._complete
+
+    KVSyncThread._commit = buggy
+    try:
+        yield
+    finally:
+        KVSyncThread._commit = orig_commit
