@@ -385,7 +385,7 @@ def stage_ec_e2e():
 
     N_OBJS, OBJ_SIZE, CONC = 192, 64 * 1024, 16
 
-    def ctx_factory(batch_mode):
+    def ctx_factory(batch_mode, shards=4, op_batching=True):
         def f(name):
             c = make_ctx(name)
             c.config.set("osd_ec_batch_device", batch_mode)
@@ -400,13 +400,22 @@ def stage_ec_e2e():
             # run reports the per-stage p50/p99 breakdown + the
             # unattributed fraction
             c.config.set("op_tracing", True)
+            # sharded data plane (ISSUE 10): shards=1 + op_batching
+            # off reproduces the pre-shard plane bit-for-bit (the
+            # axis baseline); inline lanes (no shard threads) win on
+            # this GIL-bound 2-core container — see the shards axis
+            c.config.set("osd_op_num_shards", shards)
+            c.config.set("osd_shard_threads", False)
+            c.config.set("objecter_op_batching", op_batching)
             return c
         return f
 
-    async def run_once(batch_mode, iodepth=CONC, pg_num=8):
+    async def run_once(batch_mode, iodepth=CONC, pg_num=8, shards=4,
+                       op_batching=True):
         from ceph_tpu.msg import payload as payload_mod
         payload_mod.reset_counters()
-        cl = Cluster(ctx_factory=ctx_factory(batch_mode))
+        cl = Cluster(ctx_factory=ctx_factory(batch_mode, shards,
+                                             op_batching))
         admin = await cl.start(5)
         # pg_num 8 for the HEADLINE on/off runs (comparable with the
         # r1-r5 recorded series); the op-window axis runs pg_num 4 so
@@ -455,11 +464,33 @@ def stage_ec_e2e():
         # lazy-payload guard: with ms_local_delivery on, in-process hops
         # must not serialize message bodies at all (read BEFORE stop)
         enc = payload_mod.counters()
+        # sharded-plane evidence: handoff batching + sub-op inline
+        # applies (osd_shard_handoff group), objecter corked batches
+        shard_c = {}
+        for osd in cl.osds.values():
+            for k in ("handoff_ops", "handoff_wakeups",
+                      "direct_local_ops", "subop_inline"):
+                shard_c[k] = shard_c.get(k, 0) \
+                    + int(osd.shards.counters().get(k, 0))
+        obj_batches = admin.objecter.batches_sent
+        obj_batched_ops = admin.objecter.ops_batched
         await cl.stop()
         lats.sort()
         stage_p = {name: [d["p50_ms"], d["p99_ms"]]
                    for name, d in bd["stages"].items()}
+        # the ISSUE 10 acceptance metric: combined queueing/delivery
+        # share of e2e (dep_wait + queue_wait + deliver + ack_delivery)
+        qshare = sum(bd["stages"].get(s, {}).get("sum_s", 0.0)
+                     for s in ("dep_wait", "queue_wait", "deliver",
+                               "ack_delivery"))
+        qshare = qshare / bd["measured_s"] if bd["measured_s"] else 0.0
         return {
+            "shards": shards,
+            "op_batching": op_batching,
+            "queueing_delivery_share": round(qshare, 3),
+            "shard_counters": shard_c,
+            "objecter_batches": obj_batches,
+            "objecter_batched_ops": obj_batched_ops,
             "stage_p50_p99_ms": stage_p,
             "attributed_s": bd["attributed_s"],
             "unattributed_frac": bd["unattributed_frac"],
@@ -488,6 +519,76 @@ def stage_ec_e2e():
             "msg_encode_bytes": enc["msg_encode_bytes"],
         }
 
+    async def run_reads(n_objs=128):
+        """Read axis (ISSUE 10 satellite): sequential reads through
+        the full pipeline, then DEGRADED reads after an OSD death (EC
+        reconstructs the missing shard on the read path).  The write
+        warm-up runs UNTRACED so the stage histograms carry only
+        read-path samples."""
+        from ceph_tpu.msg import payload as payload_mod
+        payload_mod.reset_counters()
+        cl = Cluster(ctx_factory=ctx_factory("off", 4, True))
+        admin = await cl.start(5)
+        await admin.pool_create("rpool", pg_num=4,
+                                pool_type="erasure", k=2, m=2)
+        io = admin.open_ioctx("rpool")
+        data = bytes(range(256)) * (OBJ_SIZE // 256)
+        ctxs = [o.ctx for o in cl.osds.values()] \
+            + [m.ctx for m in cl.mons] + [c.ctx for c in cl.clients]
+        for c in ctxs:
+            c.tracer.enabled = False
+        sem = asyncio.Semaphore(CONC)
+
+        async def w(i):
+            async with sem:
+                await io.write_full(f"r{i:05d}", data)
+
+        await asyncio.gather(*[w(i) for i in range(n_objs)])
+        for c in ctxs:
+            c.tracer.enabled = True
+
+        async def read_all(lats):
+            async def r(i):
+                async with sem:
+                    t0 = time.perf_counter()
+                    got = await io.read(f"r{i:05d}")
+                    lats.append(time.perf_counter() - t0)
+                    assert len(got) == OBJ_SIZE
+            t0 = time.perf_counter()
+            await asyncio.gather(*[r(i) for i in range(n_objs)])
+            return time.perf_counter() - t0
+
+        seq_lats = []
+        seq_wall = await read_all(seq_lats)
+        bd = cl.stage_breakdown(measured_e2e_s=sum(seq_lats))
+        stage_p = {name: [d["p50_ms"], d["p99_ms"]]
+                   for name, d in bd["stages"].items()}
+        seq_lats.sort()
+
+        # degrade: kill one OSD and mark it down — reads on its PGs
+        # re-target and EC-reconstruct from the survivors
+        victim = max(cl.osds)
+        await cl.kill_osd(victim)
+        await admin.mon_command({"prefix": "osd down", "id": victim})
+        while admin.monc.osdmap.is_up(victim):
+            await asyncio.sleep(0.05)
+        deg_lats = []
+        deg_wall = await read_all(deg_lats)
+        deg_lats.sort()
+        await cl.stop()
+
+        def pack(lats, wall):
+            return {"mb_s": round(n_objs * OBJ_SIZE / wall / 1e6, 1),
+                    "p50_ms": round(lats[len(lats) // 2] * 1e3, 2),
+                    "p99_ms": round(
+                        lats[int(len(lats) * 0.99) - 1] * 1e3, 2)}
+
+        return {"n_objs": n_objs, "iodepth": CONC,
+                "sequential": pack(seq_lats, seq_wall),
+                "degraded": pack(deg_lats, deg_wall),
+                "stage_p50_p99_ms": stage_p,
+                "unattributed_frac": bd["unattributed_frac"]}
+
     on = asyncio.run(run_once("on"))
     log(f"ec_e2e batch=on:  {on}")
     off = asyncio.run(run_once("off"))
@@ -500,8 +601,21 @@ def stage_ec_e2e():
     log(f"ec_e2e window axis iodepth=16 pg=4: {win16}")
     win1 = asyncio.run(run_once("off", iodepth=1, pg_num=4))
     log(f"ec_e2e window axis iodepth=1  pg=4: {win1}")
+    # sharded-plane axis (ISSUE 10): the new data plane (4 shards,
+    # corked client batching, ack-on-apply commits) vs the pre-shard
+    # plane ("1 = today's behavior": single loop, unbatched client,
+    # threaded commit handoff), same geometry and iodepth, measured
+    # in the same process run.  win16 already IS the new plane at
+    # this exact shape — reuse it as the shards=4 arm.
+    sh4 = win16
+    sh1 = asyncio.run(run_once("off", iodepth=16, pg_num=4, shards=1,
+                               op_batching=False))
+    log(f"ec_e2e shards=1 (legacy plane): {sh1}")
+    reads = asyncio.run(run_reads())
+    log(f"ec_e2e read axis: {reads}")
     return {"on": on, "off": off,
-            "window_iodepth16": win16, "window_iodepth1": win1}
+            "window_iodepth16": win16, "window_iodepth1": win1,
+            "shards4": sh4, "shards1": sh1, "reads": reads}
 
 
 STAGES = {"cpu": stage_cpu, "probe": stage_probe,
@@ -513,11 +627,19 @@ STAGES = {"cpu": stage_cpu, "probe": stage_probe,
 
 CACHE_PATH = pathlib.Path(__file__).parent / "BENCH_TPU_CACHE.json"
 
+#: bench-schema version of cached TPU rows (VERDICT item 3: the
+#: headline must never quietly report a measurement from an older
+#: code's bench).  Bump whenever the measured kernels / workload shape
+#: change in a way that makes old cached rows incomparable; cache_load
+#: then REFUSES the stale blob and the round re-measures instead.
+BENCH_SCHEMA = 2
+
 
 def cache_store(tpu, crush):
     """Persist the last SUCCESSFUL TPU measurement so a wedged runtime
     in a later round degrades to 'stale, labeled' instead of 'absent'
-    (VERDICT r4 ask #1)."""
+    (VERDICT r4 ask #1).  Rows carry a captured_round stamp (git head
+    + timestamp + bench schema) so staleness is decidable."""
     try:
         head = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
@@ -525,8 +647,12 @@ def cache_store(tpu, crush):
         ).stdout.decode().strip()
     except Exception:
         head = "unknown"
-    blob = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-            "git": head, "tpu_ec": tpu,
+    ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    blob = {"ts": ts, "git": head,
+            "bench_schema": BENCH_SCHEMA,
+            "captured_round": {"git": head, "ts": ts,
+                               "bench_schema": BENCH_SCHEMA},
+            "tpu_ec": tpu,
             "crush_tpu": crush if crush else None}
     try:
         CACHE_PATH.write_text(json.dumps(blob, indent=1))
@@ -536,10 +662,22 @@ def cache_store(tpu, crush):
 
 
 def cache_load():
+    """The cached TPU rows, or None when absent OR when the blob
+    predates the current bench schema — a stale-schema cache is
+    REFUSED (never reported as the headline), forcing a fresh
+    measurement attempt instead (VERDICT item 3)."""
     try:
         blob = json.loads(CACHE_PATH.read_text())
-        if blob.get("tpu_ec", {}).get("encode"):
-            return blob
+        if not blob.get("tpu_ec", {}).get("encode"):
+            return None
+        if blob.get("bench_schema") != BENCH_SCHEMA:
+            log(f"TPU cache REFUSED: captured_round "
+                f"{blob.get('captured_round') or blob.get('ts')} "
+                f"predates bench schema {BENCH_SCHEMA} "
+                f"(blob schema {blob.get('bench_schema')}) — "
+                f"re-measure instead of reporting stale rows")
+            return None
+        return blob
     except Exception:
         pass
     return None
@@ -699,7 +837,12 @@ def main():
         cached = cache_load()
         if cached:
             notes.append(f"tpu_ec: STALE cache from {cached['ts']} "
-                         f"(git {cached['git']})")
+                         f"(git {cached['git']}, schema-compatible)")
+        elif CACHE_PATH.exists():
+            notes.append(
+                f"tpu_ec: cached rows REFUSED (captured_round older "
+                f"than bench schema {BENCH_SCHEMA}); reporting the "
+                f"fresh CPU measurement instead of a stale headline")
 
     # end-to-end EC pool under load (device-queue proof); runs on the
     # TPU when up, CPU otherwise — the counter split is the point
@@ -811,6 +954,56 @@ def main():
                 "iodepth1_mb_s": win1["mb_s"],
                 "iodepth1_p50_ms": win1["p50_ms"],
                 "iodepth1_p99_ms": win1["p99_ms"],
+            })
+        sh4, sh1 = e2e.get("shards4"), e2e.get("shards1")
+        if sh4 and sh1:
+            # ISSUE 10 shards axis: new data plane (shards=4 inline
+            # lanes + corked client batching + ack-on-apply) vs the
+            # pre-shard plane (shards=1, unbatched, threaded commit),
+            # same shape (k2m2, pg4, iodepth 16), same process run.
+            # queueing_delivery_share = (dep_wait + queue_wait +
+            # deliver + ack_delivery) / e2e, per arm.
+            extra.append({
+                "metric": "ec_e2e_rados_write_shards_k2m2",
+                "value": sh4["mb_s"], "unit": "MB/s",
+                "vs_baseline": round(sh4["mb_s"] / sh1["mb_s"], 2)
+                if sh1["mb_s"] else 1.0,
+                "backend": "cluster+sharded_plane",
+                "iodepth": 16,
+                "num_shards": sh4.get("shards", 4),
+                "p50_ms": sh4["p50_ms"], "p99_ms": sh4["p99_ms"],
+                "queueing_delivery_share": sh4.get(
+                    "queueing_delivery_share", 0.0),
+                "shards1_mb_s": sh1["mb_s"],
+                "shards1_p50_ms": sh1["p50_ms"],
+                "shards1_p99_ms": sh1["p99_ms"],
+                "shards1_queueing_delivery_share": sh1.get(
+                    "queueing_delivery_share", 0.0),
+                "shard_counters": sh4.get("shard_counters", {}),
+                "objecter_batched_ops": sh4.get(
+                    "objecter_batched_ops", 0),
+            })
+        reads = e2e.get("reads")
+        if reads:
+            # ISSUE 10 read axis: reads had NO captured number before
+            # this round (ROADMAP open item).  value = sequential
+            # read throughput; vs_baseline = degraded/sequential (the
+            # EC-reconstruct cost of one dead OSD on the read path)
+            seq, deg = reads["sequential"], reads["degraded"]
+            extra.append({
+                "metric": "ec_e2e_rados_read_k2m2",
+                "value": seq["mb_s"], "unit": "MB/s",
+                "vs_baseline": round(deg["mb_s"] / seq["mb_s"], 2)
+                if seq["mb_s"] else 1.0,
+                "backend": "cluster+sharded_plane",
+                "iodepth": reads.get("iodepth", 16),
+                "p50_ms": seq["p50_ms"], "p99_ms": seq["p99_ms"],
+                "degraded_mb_s": deg["mb_s"],
+                "degraded_p50_ms": deg["p50_ms"],
+                "degraded_p99_ms": deg["p99_ms"],
+                "stage_p50_p99_ms": reads.get("stage_p50_p99_ms", {}),
+                "unattributed_frac": reads.get("unattributed_frac",
+                                               0.0),
             })
 
     line = {

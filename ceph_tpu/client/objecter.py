@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Tuple
 from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Dispatcher, Messenger
 from ceph_tpu.mon.client import MonClient
-from ceph_tpu.osd.messages import MOSDOp, MOSDOpReply, OSDOp
+from ceph_tpu.osd.messages import MOSDOp, MOSDOpBatch, MOSDOpReply, OSDOp
 from ceph_tpu.osd.osdmap import OSDMap
 from ceph_tpu.osd.types import ObjectLocator, PGId
 
@@ -29,7 +29,7 @@ class ObjectOperationError(Exception):
 
 class _InFlight:
     __slots__ = ("tid", "oid", "loc", "ops", "fut", "attempts", "snapid",
-                 "snapc", "span", "span_sent")
+                 "snapc", "span", "span_sent", "sent", "corked")
 
     def __init__(self, tid, oid, loc, ops, fut, snapid=0, snapc=None):
         self.tid = tid
@@ -42,6 +42,8 @@ class _InFlight:
         self.snapc = snapc      # (seq, [snapids]) selfmanaged override
         self.span = None        # tracer span (op_tracing only)
         self.span_sent = False  # first-send cut taken (resends skip)
+        self.sent = False       # first send left — resends skip the cork
+        self.corked = False     # parked in a pending cork (no re-entry)
 
 
 class Objecter(Dispatcher):
@@ -54,6 +56,15 @@ class Objecter(Dispatcher):
         monc.on_osdmap(self._on_osdmap)
         self._tid = 0
         self._inflight: Dict[int, _InFlight] = {}
+        # corked op batching (sharded-data-plane client half): ops
+        # submitted within one loop pass to the SAME target OSD
+        # coalesce into one MOSDOpBatch — one wire frame, one
+        # local-delivery handoff — instead of N per-message hops.
+        # key = target addr (nonce-less); flush armed per key per pass
+        self._batching = bool(ctx.config["objecter_op_batching"])
+        self._cork: Dict[Tuple[str, int], list] = {}
+        self.batches_sent = 0       # introspection (bench/tests)
+        self.ops_batched = 0
 
     @property
     def osdmap(self) -> Optional[OSDMap]:
@@ -122,14 +133,16 @@ class Objecter(Dispatcher):
                                  loc.hash_pos)
         return loc
 
-    def _send(self, op: _InFlight) -> None:
+    def _build_msg(self, op: _InFlight):
+        """Target + wire message for one in-flight op against the
+        current map; None while the op has no reachable primary."""
         loc = self._effective_loc(op.loc, op.ops)
         pg, primary = self._calc_target(op.oid, loc)
         if primary < 0:
-            return   # no primary yet: next map triggers a resend
+            return None   # no primary yet: next map triggers a resend
         addr = self.osdmap.get_addr(primary)
         if addr is None:
-            return
+            return None
         reqid = f"{self.messenger.nonce:x}.{op.tid}"
         # snap context rides every write from the CURRENT map's pool
         # snap state (Objecter::_op_submit snapc handling); reads carry
@@ -156,10 +169,61 @@ class Objecter(Dispatcher):
             # client_submit cut (the chain cursor is mid-path by then).
             m.trace_id, m.span_id = span.trace_id, span.span_id
             m._span = span
+        return m, addr
+
+    def _send(self, op: _InFlight) -> None:
+        if op.corked and not op.sent:
+            # a resend (map change racing the cork flush) must not
+            # double-enter the pending cork: the already-corked frame
+            # will ship; a stale target self-corrects via EAGAIN
+            return
+        built = self._build_msg(op)
+        if built is None:
+            return
+        m, addr = built
+        if self._batching and not op.sent:
+            # cork: ops for the same OSD within one loop pass ship as
+            # ONE MOSDOpBatch (one frame / one local handoff).  The
+            # first op for a target arms the flush; flushing happens
+            # before any awaited reply can exist, so latency cost is
+            # one call_soon hop.  RESENDS (map change / EAGAIN) bypass
+            # the cork — they are latency-critical singletons and must
+            # not wait out a flush or double-enter a pending cork
+            key = addr.without_nonce()
+            pend = self._cork.setdefault(key, [])
+            pend.append((m, addr, op))
+            op.corked = True
+            if len(pend) == 1:
+                asyncio.get_running_loop().call_soon(
+                    self._flush_cork, key)
+            return
         self.messenger.send_message(m, addr, peer_type="osd")
-        if span is not None and not op.span_sent:
+        self._note_sent(op)
+
+    def _flush_cork(self, key) -> None:
+        pend = self._cork.pop(key, None)
+        if not pend:
+            return
+        if len(pend) == 1:
+            m, addr, op = pend[0]
+            self.messenger.send_message(m, addr, peer_type="osd")
+            self._note_sent(op)
+            return
+        addr = pend[0][1]
+        self.messenger.send_message(
+            MOSDOpBatch([m for m, _a, _o in pend]), addr,
+            peer_type="osd")
+        self.batches_sent += 1
+        self.ops_batched += len(pend)
+        for _m, _a, op in pend:
+            self._note_sent(op)
+
+    def _note_sent(self, op: _InFlight) -> None:
+        op.sent = True
+        op.corked = False
+        if op.span is not None and not op.span_sent:
             op.span_sent = True
-            span.cut("client_submit", self.ctx.tracer.hist)
+            op.span.cut("client_submit", self.ctx.tracer.hist)
 
     async def op_submit(self, oid: str, loc: ObjectLocator,
                         ops: List[OSDOp], timeout: float = 120.0,

@@ -273,8 +273,22 @@ DEFAULT_OPTIONS: List[Option] = [
            "concurrently up to this depth, dependency-tracked by "
            "object id (ShardedOpWQ + ObjectContext rw-state role); "
            "1 = the old serial worker"),
-    Option("osd_op_num_shards", "int", 5, "sharded op queue shards"),
+    Option("osd_op_num_shards", "int", 0,
+           "sharded data plane (osd/shards.py; ShardedOpWQ + "
+           "msgr-worker role): PGs hash to this many shards, each "
+           "with its own work ring + pump (own event-loop thread "
+           "with osd_shard_threads).  0 = auto (one per core, max "
+           "8); 1 = the single-loop plane (today's behavior, "
+           "bit-for-bit)"),
     Option("osd_op_num_threads_per_shard", "int", 2, ""),
+    Option("osd_shard_threads", "bool", True,
+           "run each shard's event loop on its own thread "
+           "(msgr-worker split).  Forced off under the deterministic "
+           "sim loop, where shard pumps are ordinary tasks the "
+           "schedule explorer permutes; with this off the shards "
+           "are cooperatively scheduled lanes on the host loop — "
+           "the right choice on GIL-bound few-core hosts, where "
+           "thread switches cost more than they parallelize"),
     Option("osd_recovery_max_active", "int", 3, "parallel recovery ops"),
     Option("osd_max_object_size", "size", "128m", ""),
     Option("osd_client_message_size_cap", "size", "500m",
@@ -322,6 +336,12 @@ DEFAULT_OPTIONS: List[Option] = [
            "(config_opts.h:1171)"),
     Option("objecter_inflight_ops", "int", 1024, "client op throttle"),
     Option("objecter_inflight_op_bytes", "size", "100m", ""),
+    Option("objecter_op_batching", "bool", True,
+           "cork client ops per target OSD within one loop pass: N "
+           "MOSDOps coalesce into ONE wire frame / ONE local-delivery "
+           "handoff (MOSDOpBatch), amortizing the per-message "
+           "deliver/ack hops the op tracer attributes ~40% of local "
+           "e2e to.  Replies stay per-op; resends bypass the cork"),
     Option("ec_batch_window_us", "int", 200,
            "TPU EC batch-collector window (ShardedOpWQ analog)"),
     Option("ec_batch_max_stripes", "int", 64, "max stripes per TPU launch"),

@@ -104,6 +104,25 @@ class AsyncThrottle:
         self.cur += c
         return True
 
+    def get_later(self, c: int = 1) -> "asyncio.Future":
+        """SYNCHRONOUSLY join the queue: the returned future resolves
+        once the budget is granted (FIFO with get()).  Lets a caller
+        that must park work reserve its place in line before yielding
+        the loop — otherwise a later get_or_fail could overtake it
+        (the batch-unpack ordering hazard).  The budget is already
+        charged when the future resolves; a caller abandoning the
+        wait must put() it back if the future completed."""
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        if self.max <= 0 or (not self._waiters and self._room(c)):
+            if self.max > 0:
+                self.cur += c
+            fut.set_result(None)
+            return fut
+        self.waited += 1
+        self._waiters.append((fut, c))
+        return fut
+
     def put(self, c: int = 1) -> None:
         if self.max <= 0:
             return

@@ -66,6 +66,17 @@ Each rule mechanically enforces one PR-landed write-path invariant
                            ``map_epoch``) before the first mutation —
                            applying a stale-interval message is the
                            classic split-brain write race.
+  SHARD11 home-shard     — PG-state mutation is only legal from the
+                           PG's home shard (osd/shards.py): functions
+                           on the intake/heartbeat path (ms_dispatch,
+                           ``_handle_*``, the heartbeat/scrub/tier
+                           loops, the messenger reader/worker) must
+                           not call PG-mutating methods or assign PG
+                           fields directly — they route through the
+                           shard handoff seam
+                           (``self.shards.route(pgid, fn, ...)``;
+                           passing the bound method through the seam
+                           is the sanctioned pattern).
 
 Waivers: a site that is allowed to break a rule for a documented reason
 carries ``# lint: allow[RULE] reason`` on the same line or the line
@@ -231,7 +242,7 @@ _SANCTION_METHODS = {"mutable", "mutable_copy", "result_copy", "copy",
 #: receiver owns the envelope, only the payload graph is frozen
 _ENVELOPE_FIELDS = {"seq", "src_name", "src_addr", "recv_stamp",
                     "connection", "transport_id", "_span", "_wire",
-                    "_tracked", "_windowed"}
+                    "_tracked", "_windowed", "throttle_cost"}
 _MUTATOR_CALLS = {"append", "extend", "insert", "add", "update",
                   "clear", "remove", "pop", "popitem", "setdefault",
                   "sort", "reverse"}
@@ -744,6 +755,82 @@ def check_epoch10(fi: FileInfo) -> Iterator[Violation]:
             f"(compare m.epoch against same_interval_since first)")
 
 
+# ------------------------------------------------------------------ SHARD11
+
+#: intake/heartbeat-path function names: these run on the OSD's intake
+#: loop (or the messenger's reader/worker), NEVER on a PG's home shard
+_S11_FUNC_RE = re.compile(
+    r"^(ms_dispatch|_handle_\w+|_heartbeat\w*|_scrub_scheduler|"
+    r"_tier_agent_loop|_report_stats|_boot_loop|_on_osdmap|"
+    r"_advance_pgs|_local_worker|_serve_peer)$")
+#: PG methods that mutate PG state or enqueue PG work — calling one
+#: from an intake-path function races the home shard.  Passing the
+#: bound method THROUGH the seam (`self.shards.route(pgid,
+#: pg.queue_op, m)`) is the sanctioned pattern and does not match
+#: (only direct calls and attribute stores do).
+_S11_MUT_METHODS = {
+    "queue_op", "stop", "start", "advance_map", "ensure_peering",
+    "on_query", "on_notify", "on_log_request", "on_pg_log", "on_push",
+    "on_push_reply", "on_object_list", "on_notify_ack", "handle_notify",
+    "handle_watch", "maybe_trim_snaps", "generate_past_intervals",
+    "load_meta", "create_onstore", "save_meta", "complete_to",
+    "append_log", "note_reqid", "try_fast_sub_write"}
+#: calls whose result is a PG object
+_S11_PG_SOURCES = {"_pg_for", "_load_stray_pg"}
+
+
+def check_shard11(fi: FileInfo) -> Iterator[Violation]:
+    if not fi.rel.startswith(("osd/", "msg/")):
+        return
+    for fn in ast.walk(fi.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _S11_FUNC_RE.match(fn.name):
+            continue
+        # names bound to PG objects in this function: the literal
+        # name `pg` plus anything assigned from _pg_for()-family calls
+        pg_names = {"pg"}
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Call) \
+                    and isinstance(sub.value.func, ast.Attribute) \
+                    and sub.value.func.attr in _S11_PG_SOURCES:
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        pg_names.add(t.id)
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _S11_MUT_METHODS:
+                root, _attrs = _chain_names(sub.func.value)
+                if root in pg_names and \
+                        not fi.waived("SHARD11", sub.lineno):
+                    yield Violation(
+                        "SHARD11", fi.rel, sub.lineno,
+                        f"{fn.name}() calls {root}.{sub.func.attr}() "
+                        f"from an intake/heartbeat-path function: "
+                        f"PG-state mutation is only legal on the PG's "
+                        f"home shard — route through the shard "
+                        f"handoff seam (self.shards.route(pgid, "
+                        f"{root}.{sub.func.attr}, ...))")
+            elif isinstance(sub, (ast.Assign, ast.AugAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                for t in targets:
+                    if not isinstance(t, (ast.Attribute, ast.Subscript)):
+                        continue
+                    root, attrs = _chain_names(t)
+                    if root in pg_names and attrs and \
+                            not fi.waived("SHARD11", sub.lineno):
+                        yield Violation(
+                            "SHARD11", fi.rel, sub.lineno,
+                            f"{fn.name}() assigns {root}.{attrs[-1]} "
+                            f"from an intake/heartbeat-path function: "
+                            f"PG fields belong to the home shard — "
+                            f"route the mutation through the shard "
+                            f"handoff seam (osd/shards.py)")
+
+
 # ------------------------------------------------------------------ PROTO08
 
 #: daemon role -> the modules whose isinstance-dispatch handles that
@@ -887,6 +974,8 @@ RULES: Dict[str, Tuple[str, Callable[[FileInfo], Iterator[Violation]]]] = {
     "REPLY09": ("handlers reply or requeue on every path", check_reply09),
     "EPOCH10": ("epoch/interval guard before PG-state mutation",
                 check_epoch10),
+    "SHARD11": ("PG state is touched only from its home shard",
+                check_shard11),
 }
 
 #: project-wide rules: run over the WHOLE linted file set at once

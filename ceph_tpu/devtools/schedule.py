@@ -441,6 +441,11 @@ def check_cluster_invariants(cl, *, encode_base: int,
             findings.append(
                 f"dispatch-throttle leak on osd.{osd.whoami}: "
                 f"cur={thr.cur} after quiesce")
+        for s in osd.shards.shards:
+            if s.ring:
+                findings.append(
+                    f"shard ring not drained on osd.{osd.whoami} "
+                    f"shard {s.idx}: {len(s.ring)} items after quiesce")
     encodes = payload_mod.counters()["msg_encode_calls"] - encode_base
     if encodes:
         findings.append(
@@ -486,21 +491,42 @@ async def _quiesce(cl, timeout: float = 120.0) -> None:
                    for pg in osd.pgs.values())
         busy = busy or any(osd.op_tracker._inflight
                            for osd in cl.osds.values())
+        # sharded plane: work still parked on a shard ring counts
+        busy = busy or any(s.ring or s._busy
+                           for osd in cl.osds.values()
+                           for s in osd.shards.shards)
         if not busy:
             return
         await asyncio.sleep(0.5)
+
+
+def _sim_ctx_factory(num_shards: int):
+    """make_sim_ctx, optionally with the sharded data plane enabled:
+    under the deterministic loop shard threads are forced off, so each
+    shard's pump is an ordinary task the seeded scheduler permutes —
+    shard interleavings become explored schedules."""
+    from ceph_tpu.qa.cluster import make_sim_ctx
+    if num_shards <= 1:
+        return make_sim_ctx
+
+    def f(name):
+        ctx = make_sim_ctx(name)
+        ctx.config.set("osd_op_num_shards", num_shards)
+        return ctx
+    return f
 
 
 async def _ec_mini_body(report: ScheduleReport, *,
                         n_objects: int, iodepth: int,
                         pool_type: str, k: int, m: int, n_osds: int,
                         crash: Optional[Tuple[int, str, int]],
-                        inject_probe: Optional[Callable] = None) -> None:
+                        inject_probe: Optional[Callable] = None,
+                        num_shards: int = 1) -> None:
     from ceph_tpu.msg import payload as payload_mod
-    from ceph_tpu.qa.cluster import Cluster, make_sim_ctx
+    from ceph_tpu.qa.cluster import Cluster
     findings = report.findings
     encode_base = payload_mod.counters()["msg_encode_calls"]
-    cl = Cluster(ctx_factory=make_sim_ctx)
+    cl = Cluster(ctx_factory=_sim_ctx_factory(num_shards))
     admin = await cl.start(n_osds)
     if pool_type == "erasure":
         await admin.pool_create("sim", pg_num=1, pool_type="erasure",
@@ -573,13 +599,16 @@ def run_ec_mini(seed: int = 0, *,
                 pool_type: str = "erasure", k: int = 2, m: int = 2,
                 n_osds: int = 4,
                 crash: Optional[Tuple[int, str, int]] = None,
-                inject_probe: Optional[Callable] = None
+                inject_probe: Optional[Callable] = None,
+                num_shards: int = 1
                 ) -> ScheduleReport:
     """One schedule of the ec_e2e mini-workload under the deterministic
     loop: boot a FAST_CFG sim cluster, burst writes through the per-PG
     window, quiesce, check every machine-checked invariant, tear down.
     ``crash`` = (osd_id, injection_point, occurrence) arms the PR-1
-    commit-thread fault hook on that OSD's store."""
+    commit-thread fault hook on that OSD's store.  ``num_shards`` > 1
+    runs the sharded data plane (osd/shards.py) with its shard pumps
+    driven — and permuted — by this seeded scheduler."""
     report = ScheduleReport(seed=seed, crash=crash)
 
     async def main():
@@ -588,7 +617,8 @@ def run_ec_mini(seed: int = 0, *,
             await _ec_mini_body(
                 report, n_objects=n_objects, iodepth=iodepth,
                 pool_type=pool_type, k=k, m=m, n_osds=n_osds,
-                crash=crash, inject_probe=inject_probe)
+                crash=crash, inject_probe=inject_probe,
+                num_shards=num_shards)
             report.findings.extend(obs.findings)
 
     try:

@@ -65,7 +65,11 @@ class MonClient(Dispatcher):
         if isinstance(m, MMonCommandAck):
             fut = self._pending.pop(m.tid, None)
             if fut is not None and not fut.done():
-                fut.set_result(m)
+                # loop-safe: an OSD's peering (ensure_map_history) may
+                # await a mon command from a PG's home shard while this
+                # reply dispatches on the intake loop (osd/shards.py)
+                from ceph_tpu.osd.shards import resolve_future
+                resolve_future(fut, m)
             return True
         if isinstance(m, MOSDMap):
             self._handle_osdmap(m)

@@ -52,6 +52,12 @@ class Message(Encodable):
     # (client data ops); control-plane messages stay unthrottled so
     # backpressure can't deadlock maps/acks/heartbeats
     THROTTLE_DISPATCH = False
+    # True = this type is a transport ENVELOPE whose throttle
+    # accounting happens per inner op at unpack (MOSDOpBatch): the
+    # messenger must NOT take frame-level budget, or a large cork
+    # would ride the single-message escape hatch straight past the
+    # cap (the budget's whole point)
+    THROTTLE_SPLIT = False
 
     def __init__(self):
         # stamped on send / receive by the messenger

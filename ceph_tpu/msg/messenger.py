@@ -29,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import random
 import struct
+import threading
 import time
 import zlib
 from collections import deque
@@ -394,6 +395,8 @@ class LocalConnection:
             if peer is None:
                 self._reset()
                 return
+            if self._try_shard_fast(peer, msg):
+                return      # handed straight to the owning shard
             cost = msg.local_cost()
             if peer._local_intake_gate(self.conn_id).get_or_fail(cost):
                 self._deliver(peer, msg, cost)   # uncongested fast path
@@ -404,6 +407,49 @@ class LocalConnection:
         if self._task is None:
             self._task = asyncio.get_running_loop().create_task(
                 self._pump_local())
+
+    def _try_shard_fast(self, peer: "Messenger", msg: Message) -> bool:
+        """Sharded-intake classify (osd/shards.py): when the peer runs
+        a sharded data plane and this message class belongs to a PG,
+        hand the local view STRAIGHT to the owning shard's ring — no
+        per-sender intake queue, no worker task, no per-message
+        wakeup.  Engages only while no legacy delivery from this
+        connection is still in flight (``_local_pending``), so per-PG
+        FIFO order can never be overtaken; op-class messages still
+        pass the dispatch throttle (non-blocking probe — on a full
+        budget the message takes the legacy path, which parks and
+        preserves the backpressure contract)."""
+        router = peer.shard_router
+        if router is None or not router.wants(msg):
+            return False
+        if peer._local_pending.get(self.conn_id):
+            return False
+        throttled = 0
+        if msg.THROTTLE_DISPATCH and not msg.THROTTLE_SPLIT \
+                and peer.dispatch_throttle is not None:
+            throttled = msg.local_cost()
+            if not peer.dispatch_throttle.get_or_fail(throttled):
+                return False
+        self.out_seq += 1
+        view = msg.local_view()
+        view.seq = self.out_seq
+        view.src_name = self.msgr.name
+        view.src_addr = self.msgr.addr
+        view.transport_id = -self.conn_id
+        view.recv_stamp = time.monotonic()
+        view.throttle_cost = throttled
+        # stage cuts mirror the legacy intake worker exactly: only
+        # throttled (client-op) classes consume chain stages here — a
+        # sub-op shares the client's LIVE span and must not cut it
+        if msg.THROTTLE_DISPATCH and peer.ctx.tracer.enabled \
+                and view._span is not None:
+            view._span.cut("deliver", peer.ctx.tracer.hist)
+            view._span.cut("throttle_wait", peer.ctx.tracer.hist)
+        self.msgr._local_msgs += 1
+        payload_mod.note_local()
+        peer._msgs_received += 1
+        router.deliver(view)
+        return True
 
     def _deliver(self, peer: "Messenger", msg: Message,
                  cost: int) -> None:
@@ -521,6 +567,10 @@ class Messenger:
         self._local_msgs = 0
         self._local_in: Dict[
             int, Tuple[asyncio.Queue, asyncio.Task, AsyncThrottle]] = {}
+        # per-sender count of legacy local deliveries not yet fully
+        # dispatched: the shard fast path stays OFF while any are in
+        # flight so it can never overtake the queued stream (FIFO)
+        self._local_pending: Dict[int, int] = {}
         # cephx hooks (msg/Messenger.h ms_get_authorizer /
         # ms_verify_authorizer dispatcher hooks, collapsed onto the
         # messenger since auth state lives with the owning stack):
@@ -538,9 +588,45 @@ class Messenger:
         # message class sets THROTTLE_DISPATCH block the reader while
         # over budget; the handling daemon releases at op completion
         self.dispatch_throttle = None
+        # sharded data plane seam (osd/shards.py): when the owning OSD
+        # runs >1 shard it installs a classifier here; intake then
+        # hands op-class messages straight to the owning shard's ring
+        # instead of dispatching on this loop (ms_fast_dispatch ->
+        # ShardedOpWQ role).  None = classic dispatch, unchanged.
+        self.shard_router = None
+        # home event loop: the loop this messenger's asyncio state
+        # (connections, throttles, intake queues) belongs to.  Sends
+        # from a FOREIGN thread (a PG's shard loop) are marshalled
+        # back here through a batched courier — one wakeup per burst
+        # — so shard threads never touch loop-affine state directly.
+        self._home_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._home_thread: Optional[int] = None
+        self._out_courier = None
+        self._xthread_msgs = 0
+        self._xthread_flushes = 0
+        try:
+            self._capture_home_loop()
+        except RuntimeError:
+            pass        # bound later (bind/add_dispatcher re-capture)
 
     # --- setup ---
+    def _capture_home_loop(self) -> None:
+        self._home_loop = asyncio.get_running_loop()
+        self._home_thread = threading.get_ident()
+
+    def _on_home_thread(self) -> bool:
+        """True when the caller may touch this messenger's asyncio
+        state directly.  A messenger never bound to a loop yet behaves
+        classically (single-threaded by construction)."""
+        return self._home_thread is None \
+            or self._home_thread == threading.get_ident()
+
     def add_dispatcher(self, d: Dispatcher) -> None:
+        if self._home_loop is None:
+            try:
+                self._capture_home_loop()
+            except RuntimeError:
+                pass
         self.dispatchers.append(d)
 
     def set_policy(self, entity_type: str, policy: Policy) -> None:
@@ -554,6 +640,7 @@ class Messenger:
         return self.default_policy
 
     async def bind(self, host: str = "127.0.0.1", port: int = 0) -> EntityAddr:
+        self._capture_home_loop()
         self._server = await asyncio.start_server(
             self._handle_incoming, host, port)
         sock = self._server.sockets[0]
@@ -569,7 +656,15 @@ class Messenger:
         """Queue msg for addr; never blocks (Messenger.h:466 contract).
         peer_type selects the delivery policy for a NEW connection (e.g.
         "client" when replying to a lossy client); existing connections
-        keep the policy they were created with."""
+        keep the policy they were created with.
+
+        Thread-safe: a call from a foreign thread (a PG's shard loop,
+        osd/shards.py) is marshalled to the home loop through a
+        batched courier — the send itself, and therefore every
+        connection/queue touch, always runs on the home loop."""
+        if not self._on_home_thread():
+            self._post_home(self.send_message, msg, addr, peer_type)
+            return
         key = addr.without_nonce()
         conn = self.conns.get(key)
         if conn is None or conn.closed:
@@ -583,6 +678,26 @@ class Messenger:
             self.conns[key] = conn
         self._msgs_sent += 1
         conn.send(msg)
+
+    def _post_home(self, fn, *args) -> None:
+        """Batched cross-thread marshalling onto the home loop (one
+        call_soon_threadsafe wakeup per burst, not per message)."""
+        from ceph_tpu.osd.shards import Courier
+        courier = self._out_courier
+        if courier is None:
+            # constructed lazily FROM a shard thread: the home thread
+            # must be passed explicitly or the courier would treat the
+            # constructing shard as "same thread" and skip the
+            # cross-thread wakeup
+            courier = self._out_courier = Courier(
+                self._home_loop, f"{self.name}-out",
+                thread_ident=self._home_thread)
+            courier.on_flush = self._note_xthread_flush
+        self._xthread_msgs += 1
+        courier.post(fn, *args)
+
+    def _note_xthread_flush(self, n: int) -> None:
+        self._xthread_flushes += 1
 
     def _local_peer(self, addr: EntityAddr) -> Optional["Messenger"]:
         """The co-located messenger at addr, when BOTH ends opted into
@@ -643,6 +758,8 @@ class Messenger:
                        conn_id: int, msg: Message, cost: int) -> None:
         """Zero-encode intake: `msg` is already the receiver-safe
         local_view; the caller holds `cost` of this queue's gate."""
+        self._local_pending[conn_id] = \
+            self._local_pending.get(conn_id, 0) + 1
         self._local_entry(conn_id)[0].put_nowait(
             (peer_name, peer_addr, msg, cost))
 
@@ -687,7 +804,8 @@ class Messenger:
             msg.transport_id = -conn_id   # local ids: distinct namespace
             msg.recv_stamp = time.monotonic()
             if (self.dispatch_throttle is not None
-                    and msg.THROTTLE_DISPATCH):
+                    and msg.THROTTLE_DISPATCH
+                    and not msg.THROTTLE_SPLIT):
                 # op tracing: the live span rode local_view — attribute
                 # transit-so-far as `deliver` and the budget wait as
                 # `throttle_wait` into THIS daemon's stage histograms
@@ -699,7 +817,11 @@ class Messenger:
                 if span is not None:
                     span.cut("throttle_wait", self.ctx.tracer.hist)
             gate.put(cost)   # message left the intake queue
-            self._dispatch(msg)
+            try:
+                self._dispatch(msg)
+            finally:
+                left = self._local_pending.get(conn_id, 1) - 1
+                self._local_pending[conn_id] = max(0, left)
 
     # --- receive path ---
     async def _handle_incoming(self, reader: asyncio.StreamReader,
@@ -810,7 +932,8 @@ class Messenger:
                         # the backpressure to the sender.  Only message
                         # types that opt in (client data ops) count.
                         if (self.dispatch_throttle is not None
-                                and msg.THROTTLE_DISPATCH):
+                                and msg.THROTTLE_DISPATCH
+                                and not msg.THROTTLE_SPLIT):
                             cost = len(payload)
                             span = msg._span
                             if span is not None:
@@ -820,7 +943,15 @@ class Messenger:
                             if span is not None:
                                 span.cut("throttle_wait",
                                          self.ctx.tracer.hist)
-                        self._dispatch(msg)
+                        # sharded data plane: PG-bound wire messages
+                        # enqueue onto the owning shard instead of
+                        # dispatching on the reader (already
+                        # throttled above)
+                        if self.shard_router is not None \
+                                and self.shard_router.wants(msg):
+                            self.shard_router.deliver(msg)
+                        else:
+                            self._dispatch(msg)
                 elif tag == TAG_KEEPALIVE:
                     pass
         except (OSError, asyncio.IncompleteReadError, ConnectionError):
@@ -901,11 +1032,16 @@ class Messenger:
     def put_dispatch_throttle(self, msg: Message) -> None:
         """Release a throttled message's budget; owners (the OSD op
         path) call this when the op COMPLETES, unhandled messages
-        release immediately."""
+        release immediately.  Thread-safe: a release from a shard
+        thread is marshalled to the home loop (the throttle's waiter
+        futures belong there), batched one wakeup per burst."""
         cost = getattr(msg, "throttle_cost", 0)
         if cost and self.dispatch_throttle is not None:
             msg.throttle_cost = 0       # idempotent
-            self.dispatch_throttle.put(cost)
+            if self._on_home_thread():
+                self.dispatch_throttle.put(cost)
+            else:
+                self._post_home(self.dispatch_throttle.put, cost)
 
     # --- teardown ---
     async def shutdown(self) -> None:

@@ -290,6 +290,13 @@ class PGBackend:
     async def do_reads(self, m: MOSDOp) -> int: ...
     async def handle_sub_message(self, m) -> None: ...
 
+    def sub_write_fast(self, m) -> bool:
+        """Synchronous replica write-sub-op apply, for the sharded
+        plane's inline classify path (osd/shards.py): True when the
+        message was fully handled with no suspension point.  False =
+        hand it to the PG worker as usual."""
+        return False
+
     def handle_reply(self, m) -> None:
         """Ack-type messages resolve futures the PG worker is awaiting —
         they MUST bypass the op queue (the worker is blocked on them)."""
@@ -615,53 +622,66 @@ class ReplicatedBackend(PGBackend):
         op.rval = len(names)
 
     async def handle_sub_message(self, m) -> None:
-        pg = self.pg
         if isinstance(m, MOSDRepOp):
-            if m.map_epoch < pg.info.same_interval_since:
-                # stale-interval sub-op (found by the schedule
-                # explorer / rule EPOCH10): a primary of a CLOSED
-                # interval fanned this out before it learned the new
-                # map.  Applying it would graft a divergent entry onto
-                # a log the new interval's peering has already judged;
-                # drop it — the old primary's in-flight ack wait aborts
-                # on its own interval change and the client resends
-                return
-            rt = self._repl_trace(m)
-            # copy discipline: txn() is OUR mutable copy (save_meta
-            # appends below must never reach the sender or a sibling
-            # replica); the log entry is immutable and shared as-is
-            txn = m.txn()
-            entry = m.log_entry()
-            advance = None
-            if pg.log.head < entry.version:
-                pg.log.append(entry)
-                pg.note_reqid(entry)
-                pg.info.last_update = entry.version
-                if not pg.missing:
-                    # a copy still owed recovery pushes must keep its
-                    # honest last_complete cursor, or the gap hides
-                    advance = entry.version
-            pg.save_meta(txn)
-            src = int(m.src_name.id)
-            reply = MOSDRepOpReply(pg.pgid, m.tid, 0, True,
-                                   self.osd.whoami)
+            self._apply_rep_write(m)
+
+    def sub_write_fast(self, m) -> bool:
+        if isinstance(m, MOSDRepOp):
+            self._apply_rep_write(m)
+            return True
+        return False
+
+    def _apply_rep_write(self, m) -> None:
+        """Replica write sub-op apply: SYNCHRONOUS by contract (no
+        suspension point), so the sharded plane's classify seam may
+        run it inline off the shard ring (sub_write_fast) without a
+        queue/worker hop when nothing is queued ahead."""
+        pg = self.pg
+        if m.map_epoch < pg.info.same_interval_since:
+            # stale-interval sub-op (found by the schedule
+            # explorer / rule EPOCH10): a primary of a CLOSED
+            # interval fanned this out before it learned the new
+            # map.  Applying it would graft a divergent entry onto
+            # a log the new interval's peering has already judged;
+            # drop it — the old primary's in-flight ack wait aborts
+            # on its own interval change and the client resends
+            return
+        rt = self._repl_trace(m)
+        # copy discipline: txn() is OUR mutable copy (save_meta
+        # appends below must never reach the sender or a sibling
+        # replica); the log entry is immutable and shared as-is
+        txn = m.txn()
+        entry = m.log_entry()
+        advance = None
+        if pg.log.head < entry.version:
+            pg.log.append(entry)
+            pg.note_reqid(entry)
+            pg.info.last_update = entry.version
+            if not pg.missing:
+                # a copy still owed recovery pushes must keep its
+                # honest last_complete cursor, or the gap hides
+                advance = entry.version
+        pg.save_meta(txn)
+        src = int(m.src_name.id)
+        reply = MOSDRepOpReply(pg.pgid, m.tid, 0, True,
+                               self.osd.whoami)
+        if rt is not None:
+            rt.applied()
+
+        def _committed():
+            # last_complete and the repop ack advance TOGETHER from
+            # the commit callback — the ack can never outrun the
+            # durability of the pglog entry it vouches for, and the
+            # PG worker is already applying the next sub-op while
+            # this one's group commits (commit pipelining)
+            if advance is not None:
+                pg.complete_to(advance)
             if rt is not None:
-                rt.applied()
+                rt.committed()
+            self.osd.send_osd(src, reply)
 
-            def _committed():
-                # last_complete and the repop ack advance TOGETHER from
-                # the commit callback — the ack can never outrun the
-                # durability of the pglog entry it vouches for, and the
-                # PG worker is already applying the next sub-op while
-                # this one's group commits (commit pipelining)
-                if advance is not None:
-                    pg.complete_to(advance)
-                if rt is not None:
-                    rt.committed()
-                self.osd.send_osd(src, reply)
-
-            self.osd.store.queue_transactions([txn],
-                                              on_commit=_committed)
+        self.osd.store.queue_transactions([txn],
+                                          on_commit=_committed)
 
 
 # ================================================================= erasure
@@ -718,7 +738,11 @@ class ECBackend(PGBackend):
             except Exception as e:
                 self.log_.warning(f"mesh encode failed ({e}); "
                                   f"falling back to batch queue")
-        q = getattr(self.osd, "ec_queue", None)
+        # per-loop collector: under threaded shards the daemon-wide
+        # queue's wake event belongs to another loop (osd/shards.py)
+        q = self.osd.ec_batch_queue() \
+            if hasattr(self.osd, "ec_batch_queue") \
+            else getattr(self.osd, "ec_queue", None)
         if gen is None or q is None:
             return self.codec.encode(set(range(self.n)), data)
         chunks = self.codec.split_data(data)
@@ -1467,73 +1491,89 @@ class ECBackend(PGBackend):
 
     # ------------------------------------------------------------ sub-ops
     async def handle_sub_message(self, m) -> None:
-        pg = self.pg
         if isinstance(m, MOSDECSubOpWrite):
-            if m.map_epoch < pg.info.same_interval_since:
-                # stale-interval shard write: same drop rule as the
-                # replicated sub-op path (see ReplicatedBackend) — a
-                # closed interval's fan-out must not append to a log
-                # the new interval already peered over
-                return
-            rt = self._repl_trace(m)
-            # copy discipline: mutable txn copy, shared immutable entry
-            # (see ReplicatedBackend.handle_sub_message)
-            txn = m.txn()
-            entry = m.log_entry()
-            advance = None
-            if pg.log.head < entry.version:
-                pg.log.append(entry)
-                pg.note_reqid(entry)
-                pg.info.last_update = entry.version
-                if not pg.missing:
-                    # a copy still owed recovery pushes must keep its
-                    # honest last_complete cursor, or the gap hides
-                    advance = entry.version
-            pg.save_meta(txn)
-            src = int(m.src_name.id)
-            reply = MOSDECSubOpWriteReply(pg.pgid, m.tid, 0,
-                                          self.my_shard, self.osd.whoami)
-            if rt is not None:
-                rt.applied()
-
-            def _committed():
-                # EC sub-op ack + last_complete ride the commit callback
-                # in submission order (see MOSDRepOp above)
-                if advance is not None:
-                    pg.complete_to(advance)
-                if rt is not None:
-                    rt.committed()
-                self.osd.send_osd(src, reply)
-
-            self.osd.store.queue_transactions([txn],
-                                              on_commit=_committed)
+            self._apply_ec_sub_write(m)
         elif isinstance(m, MOSDECSubOpRead):
-            data, attrs = [], {}
-            result = 0
-            for oid, off, ln in m.reads:
-                soid = pg.object_id(oid)
-                if m.snap:
-                    soid = soid.with_snap(m.snap)
-                try:
-                    data.append(self.osd.store.read(
-                        pg.cid, soid, off, ln if ln >= 0 else -1))
-                    attrs = self.osd.store.getattrs(pg.cid, soid)
-                except (NoSuchObject, NoSuchCollection):
-                    result = -errno.ENOENT
-                    data.append(b"")
-            reply = MOSDECSubOpReadReply(
-                pg.pgid, m.tid, self.my_shard, result, data, attrs)
-            if m.want_ss and m.reads:
-                # attach OUR SnapSet row: the primary may have adopted
-                # the pg without it and needs the acting set's truth
-                # to resolve reads-at-snap.  A shard mid-adoption may
-                # lack the meta object entirely — that's "no row", not
-                # a dropped reply (the survey would eat a timeout)
-                from ceph_tpu.osd.snaps import ss_key
-                try:
-                    raw = self.osd.store.omap_get_values(
-                        pg.cid, pg.meta_oid, [ss_key(m.reads[0][0])])
-                    reply.ss = next(iter(raw.values()), b"")
-                except (NoSuchObject, NoSuchCollection):
-                    pass
-            self.osd.send_osd(int(m.src_name.id), reply)
+            self._handle_ec_sub_read(m)
+
+    def sub_write_fast(self, m) -> bool:
+        if isinstance(m, MOSDECSubOpWrite):
+            self._apply_ec_sub_write(m)
+            return True
+        return False
+
+    def _apply_ec_sub_write(self, m) -> None:
+        """Shard write sub-op apply: SYNCHRONOUS by contract (no
+        suspension point), so the sharded plane's classify seam may
+        run it inline off the shard ring (sub_write_fast) without a
+        queue/worker hop when nothing is queued ahead."""
+        pg = self.pg
+        if m.map_epoch < pg.info.same_interval_since:
+        # stale-interval shard write: same drop rule as the
+            # replicated sub-op path (see ReplicatedBackend) — a
+            # closed interval's fan-out must not append to a log
+            # the new interval already peered over
+            return
+        rt = self._repl_trace(m)
+        # copy discipline: mutable txn copy, shared immutable entry
+        # (see ReplicatedBackend.handle_sub_message)
+        txn = m.txn()
+        entry = m.log_entry()
+        advance = None
+        if pg.log.head < entry.version:
+            pg.log.append(entry)
+            pg.note_reqid(entry)
+            pg.info.last_update = entry.version
+            if not pg.missing:
+                # a copy still owed recovery pushes must keep its
+                # honest last_complete cursor, or the gap hides
+                advance = entry.version
+        pg.save_meta(txn)
+        src = int(m.src_name.id)
+        reply = MOSDECSubOpWriteReply(pg.pgid, m.tid, 0,
+                                      self.my_shard, self.osd.whoami)
+        if rt is not None:
+            rt.applied()
+
+        def _committed():
+            # EC sub-op ack + last_complete ride the commit callback
+            # in submission order (see MOSDRepOp above)
+            if advance is not None:
+                pg.complete_to(advance)
+            if rt is not None:
+                rt.committed()
+            self.osd.send_osd(src, reply)
+
+        self.osd.store.queue_transactions([txn],
+                                          on_commit=_committed)
+    def _handle_ec_sub_read(self, m) -> None:
+        pg = self.pg
+        data, attrs = [], {}
+        result = 0
+        for oid, off, ln in m.reads:
+            soid = pg.object_id(oid)
+            if m.snap:
+                soid = soid.with_snap(m.snap)
+            try:
+                data.append(self.osd.store.read(
+                    pg.cid, soid, off, ln if ln >= 0 else -1))
+                attrs = self.osd.store.getattrs(pg.cid, soid)
+            except (NoSuchObject, NoSuchCollection):
+                result = -errno.ENOENT
+                data.append(b"")
+        reply = MOSDECSubOpReadReply(
+            pg.pgid, m.tid, self.my_shard, result, data, attrs)
+        if m.want_ss and m.reads:
+            # attach OUR SnapSet row: the primary may have adopted
+            # the pg without it and needs the acting set's truth
+            # to resolve reads-at-snap.  A shard mid-adoption may
+            # lack the meta object entirely — that's "no row", not
+            # a dropped reply (the survey would eat a timeout)
+            from ceph_tpu.osd.snaps import ss_key
+            try:
+                raw = self.osd.store.omap_get_values(
+                    pg.cid, pg.meta_oid, [ss_key(m.reads[0][0])])
+                reply.ss = next(iter(raw.values()), b"")
+            except (NoSuchObject, NoSuchCollection):
+                pass
+        self.osd.send_osd(int(m.src_name.id), reply)

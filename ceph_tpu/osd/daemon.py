@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import errno
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -25,16 +26,59 @@ from ceph_tpu.mon.messages import MOSDAlive, MOSDBoot, MOSDFailure
 from ceph_tpu.mon.monmap import MonMap
 from ceph_tpu.osd.messages import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
-    MOSDECSubOpWriteReply, MOSDOp, MOSDOpReply, MOSDPing, MOSDRepOp,
-    MOSDRepOpReply, MPGLog, MPGLogRequest, MPGNotify, MPGObjectList,
-    MPGPush, MPGPushReply, MPGQuery, MPGRemove, MPGScrub, MPGScrubMap,
-    MPGScrubScan, MWatchNotifyAck,
+    MOSDECSubOpWriteReply, MOSDOp, MOSDOpBatch, MOSDOpReply, MOSDPing,
+    MOSDRepOp, MOSDRepOpReply, MPGLog, MPGLogRequest, MPGNotify,
+    MPGObjectList, MPGPush, MPGPushReply, MPGQuery, MPGRemove, MPGScrub,
+    MPGScrubMap, MPGScrubScan, MWatchNotifyAck,
 )
 from ceph_tpu.osd.osdmap import OSDMap
 from ceph_tpu.osd.pg import PG
 from ceph_tpu.osd.types import NO_SHARD, PGId
 from ceph_tpu.crush.constants import CRUSH_ITEM_NONE
 from ceph_tpu.store.objectstore import ObjectStore, Transaction
+
+
+#: message classes whose handling touches PG state — classified to the
+#: PG's home shard by the sharded data plane (ms_fast_dispatch ->
+#: ShardedOpWQ seam); everything else is daemon-scope and stays on the
+#: intake loop
+_PG_BOUND = (MOSDOp, MOSDRepOp, MOSDECSubOpWrite, MOSDECSubOpRead,
+             MOSDRepOpReply, MOSDECSubOpWriteReply, MOSDECSubOpReadReply,
+             MPGQuery, MPGRemove, MPGNotify, MPGLogRequest, MPGLog,
+             MPGPush, MPGPushReply, MPGObjectList, MWatchNotifyAck,
+             MPGScrub, MPGScrubScan, MPGScrubMap)
+
+
+class _ShardIntake:
+    """messenger.shard_router: the intake-side classify seam.  The
+    messenger calls wants()/deliver() for each inbound message; a
+    PG-bound message lands on its home shard's ring WITHOUT touching
+    the per-sender intake queue machinery (one batched wakeup per
+    burst instead of one queue round-trip per message)."""
+
+    __slots__ = ("osd",)
+
+    def __init__(self, osd: "OSD"):
+        self.osd = osd
+
+    def wants(self, m: Message) -> bool:
+        return isinstance(m, _PG_BOUND) or isinstance(m, MOSDOpBatch)
+
+    def deliver(self, m: Message) -> None:
+        osd = self.osd
+        if osd.shards.perf is not None:
+            osd.shards.perf.inc("direct_local_ops")
+        if isinstance(m, MOSDOpBatch):
+            osd._dispatch_op_batch(m)
+        else:
+            # post(), never inline: deliver() runs on the SENDER's
+            # call stack (LocalConnection.send / the TCP reader) — an
+            # inline dispatch would execute the receiver's apply
+            # depth-first inside the sender's fan-out, serializing
+            # the very pipeline the shards exist to widen.  The ring
+            # pump is the execution context (where the sub-op inline
+            # fast path then legally skips the PG queue hop).
+            osd.shards.shard_for(m.pgid).post(osd._dispatch_pg_msg, m)
 
 
 class OSD(Dispatcher):
@@ -56,6 +100,11 @@ class OSD(Dispatcher):
         self._hb_task: Optional[asyncio.Task] = None
         self._boot_task: Optional[asyncio.Task] = None
         self._waiting_maps: List[Message] = []
+        # appends land from shard pumps (threaded mode) while the
+        # intake loop swaps the list per map epoch: lock the pair
+        # so a racing append can never strand a message on the
+        # captured old list (a dropped sub-op has no resender)
+        self._wm_lock = threading.Lock()
         self.running = False
         from ceph_tpu.osd.ec_queue import ECBatchQueue
         self.ec_queue = ECBatchQueue(
@@ -87,13 +136,50 @@ class OSD(Dispatcher):
         self.admin_socket = None
         self._stats_task: Optional[asyncio.Task] = None
         self.mesh_exec = None    # set when osd_mesh_mode=on (start())
+        # sharded data plane (osd/shards.py): PGs hash to shards, all
+        # PG-touching work routes through it.  num_shards=1 keeps the
+        # plane disabled — every route() is an inline call, today's
+        # single-loop behavior bit-for-bit
+        from ceph_tpu.osd.shards import ShardedDataPlane
+        self.shards = ShardedDataPlane(self)
+        # per-shard EC batch collectors (threaded mode only: the
+        # daemon-wide collector's wake event is loop-affine)
+        self._shard_ec_queues: Dict[int, object] = {}
 
     def next_tid(self) -> int:
         self._tid += 1
         return self._tid
 
+    def ec_batch_queue(self):
+        """The cross-PG EC batch collector for the CURRENT loop.  The
+        daemon-wide collector serves the single-loop plane; under
+        THREADED shards each shard lazily gets its own (the
+        collector's wake event and task are loop-affine) — it still
+        batches across every PG of that shard."""
+        if not (self.shards.enabled and self.shards.threaded):
+            return self.ec_queue
+        for shard in self.shards.shards:
+            if shard.on_shard():
+                q = self._shard_ec_queues.get(shard.idx)
+                if q is None:
+                    from ceph_tpu.osd.ec_queue import ECBatchQueue
+                    q = ECBatchQueue(
+                        self.ctx, mode=self.cfg["osd_ec_batch_device"],
+                        window_ms=self.cfg["osd_ec_batch_window_ms"],
+                        min_device_bytes=self.cfg["osd_ec_batch_min_bytes"],
+                        flush_bytes=self.cfg["osd_ec_batch_flush_bytes"])
+                    self._shard_ec_queues[shard.idx] = q
+                return q
+        return self.ec_queue
+
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> None:
+        # sharded-plane commit semantics: barrier-less (RAM) stores
+        # ack-on-apply — the commit thread's GIL handoff is the
+        # tracer's repl_commit cost, and there is no durability point
+        # it buys.  shards=1 keeps today's threaded handoff.
+        if self.shards.enabled:
+            self.store.ack_on_apply = True
         self.store.mount()
         if self.messenger.addr.is_blank():
             await self.messenger.bind()
@@ -103,6 +189,12 @@ class OSD(Dispatcher):
         from ceph_tpu.common.throttle import AsyncThrottle
         self.messenger.dispatch_throttle = AsyncThrottle(
             "osd_client_bytes", self.cfg["osd_client_message_size_cap"])
+        # sharded data plane: start the shard pumps (threads when
+        # configured and not under the deterministic sim loop) and
+        # install the intake classifier on the messenger
+        self.shards.start()
+        if self.shards.enabled:
+            self.messenger.shard_router = _ShardIntake(self)
         if self.cfg["osd_mesh_mode"] == "on":
             # device-mesh execution mode: co-located shard OSDs share
             # one mesh; EC bulk bytes move by sharded device program +
@@ -182,10 +274,25 @@ class OSD(Dispatcher):
             self._tier_task.cancel()
         if self.admin_socket is not None:
             await self.admin_socket.stop()
-        for pg in self.pgs.values():
-            pg.stop()
+        # PG teardown runs on each PG's home shard (its tasks live
+        # there); post (never inline) and wait for the rings to drain
+        for pg in list(self.pgs.values()):
+            self.shards.post(pg.pgid, pg.stop)
+        await self.shards.drain()
         self.monc.stop()
         await self.ec_queue.stop()
+        for idx, q in list(self._shard_ec_queues.items()):
+            shard = self.shards.shards[idx]
+            if self.shards.threaded and shard.loop is not None:
+                try:
+                    fut = asyncio.run_coroutine_threadsafe(
+                        q.stop(), shard.loop)
+                    await asyncio.wrap_future(fut)
+                except RuntimeError:
+                    pass     # shard loop already gone
+            else:
+                await q.stop()
+        self._shard_ec_queues.clear()
         # drain the commit pipeline while the messenger still lives so
         # pending ack callbacks send (or no-op) instead of erroring;
         # a dead commit thread raises from sync() — teardown proceeds,
@@ -196,6 +303,7 @@ class OSD(Dispatcher):
             self.logger.exception("store sync failed during stop")
         await asyncio.sleep(0)
         await self.messenger.shutdown()
+        await self.shards.stop()
         self.store.umount()
 
     # ----------------------------------------------------------------- maps
@@ -278,7 +386,8 @@ class OSD(Dispatcher):
                 self.monc.monmap.addr_of_rank(self.monc.cur_mon),
                 peer_type="mon")
         self._advance_pgs()
-        waiting, self._waiting_maps = self._waiting_maps, []
+        with self._wm_lock:
+            waiting, self._waiting_maps = self._waiting_maps, []
         for m in waiting:
             self.ms_dispatch(m)
 
@@ -308,30 +417,47 @@ class OSD(Dispatcher):
         # their copy may be the only survivor of a past interval, so they
         # must keep answering peering queries and serving log/object
         # pulls until the new primary confirms clean and sends MPGRemove
-        # (PG stray role).  Empty copies are dropped immediately
-        for pgid in [p for p in self.pgs if p not in wanted]:
-            pg = self.pgs[pgid]
-            if pg.info.is_empty():
-                self.pgs.pop(pgid).stop()
-            else:
-                if pgid.pool in m.pools:
-                    pg.pool = m.pools[pgid.pool]
-                pg.advance_map(m)
+        # (PG stray role).  Empty copies are dropped immediately.
+        # All per-PG work routes to the PG's home shard (SHARD11 seam);
+        # shard rings are FIFO, so successive map epochs advance each
+        # PG in order
+        for pgid in [p for p in list(self.pgs) if p not in wanted]:
+            self.shards.route(pgid, self._advance_stray, pgid, m)
         for pgid, pool_id in wanted.items():
-            pg = self.pgs.get(pgid)
-            fresh = pg is None
-            if fresh:
-                pg = PG(self, pgid, pool_id, m.pools[pool_id])
-                pg.create_onstore()
-                pg.load_meta()
-                pg.generate_past_intervals()
-                self.pgs[pgid] = pg
-                pg.start()
-            pg.pool = m.pools[pool_id]
+            self.shards.route(pgid, self._advance_one, pgid, pool_id, m)
+
+    def _advance_stray(self, pgid: PGId, m) -> None:
+        """Home-shard half of _advance_pgs for a PG we no longer host."""
+        pg = self.pgs.get(pgid)
+        if pg is None:
+            return
+        if pg.info.is_empty():
+            self.pgs.pop(pgid).stop()
+        else:
+            if pgid.pool in m.pools:
+                pg.pool = m.pools[pgid.pool]
             pg.advance_map(m)
-            if fresh:
-                pg.ensure_peering()
-            pg.maybe_trim_snaps()
+
+    def _advance_one(self, pgid: PGId, pool_id: int, m) -> None:
+        """Home-shard half of _advance_pgs for a hosted PG: creation
+        happens HERE so the PG's tasks, futures and events all live on
+        its home shard's loop."""
+        if pool_id not in m.pools:
+            return      # pool deleted while the advance was in flight
+        pg = self.pgs.get(pgid)
+        fresh = pg is None
+        if fresh:
+            pg = PG(self, pgid, pool_id, m.pools[pool_id])
+            pg.create_onstore()
+            pg.load_meta()
+            pg.generate_past_intervals()
+            self.pgs[pgid] = pg
+            pg.start()
+        pg.pool = m.pools[pool_id]
+        pg.advance_map(m)
+        if fresh:
+            pg.ensure_peering()
+        pg.maybe_trim_snaps()
 
     def note_pg_active(self, pg: PG) -> None:
         """Primary finished peering: assert up_thru (MOSDAlive), once per
@@ -367,12 +493,14 @@ class OSD(Dispatcher):
                          f"(lu {pg.info.last_update})")
         return pg
 
-    def _handle_pg_remove(self, m) -> None:
-        """MPGRemove: the clean primary says our stray copy is garbage."""
+    def _pg_remove(self, m) -> None:
+        """MPGRemove: the clean primary says our stray copy is garbage.
+        Runs on the PG's home shard (routed by _dispatch_pg_msg)."""
         if m.epoch > self.osdmap.epoch:
             # we haven't seen the map the primary decided under: decide
             # after catching up, not against a stale mapping
-            self._waiting_maps.append(m)
+            with self._wm_lock:
+                self._waiting_maps.append(m)
             return
         pg = self._pg_for(m.pgid)
         if pg is None:
@@ -410,95 +538,22 @@ class OSD(Dispatcher):
             pg = self.pgs.get(pgid.without_shard())
         if pg is None:
             # shard-agnostic lookup (EC peers address us by shard)
-            for p, inst in self.pgs.items():
+            for p, inst in list(self.pgs.items()):
                 if p.without_shard() == pgid.without_shard():
                     return inst
         return pg
 
     # ------------------------------------------------------------- dispatch
     def ms_dispatch(self, m: Message) -> bool:
-        if isinstance(m, MOSDOp):
-            self._handle_client_op(m)
+        """Intake classify (ms_fast_dispatch role): PG-bound messages
+        route to the PG's home shard (SHARD11 seam — this function
+        must not touch PG state itself); daemon-scope messages are
+        handled inline on the intake loop."""
+        if isinstance(m, MOSDOpBatch):
+            self._dispatch_op_batch(m)
             return True
-        if isinstance(m, (MOSDRepOp, MOSDECSubOpWrite, MOSDECSubOpRead)):
-            pg = self._pg_for(m.pgid)
-            if pg is None:
-                self._waiting_maps.append(m)
-                return True
-            pg.queue_op(m)
-            return True
-        if isinstance(m, (MOSDRepOpReply, MOSDECSubOpWriteReply,
-                          MOSDECSubOpReadReply)):
-            # acks resolve futures the PG worker awaits: handle inline,
-            # never through the op queue the worker is blocked on
-            pg = self._pg_for(m.pgid)
-            if pg is not None:
-                pg.backend.handle_reply(m)
-            return True
-        if isinstance(m, MPGQuery):
-            pg = self._pg_for(m.pgid) or self._load_stray_pg(m.pgid)
-            if pg is not None:
-                pg.on_query(m)
-            else:
-                # we host nothing for this pg (yet): answer with an empty
-                # info rather than stalling the querier's peering — our
-                # own map advance will instantiate the PG if we belong
-                from ceph_tpu.osd.pglog import PGInfo
-                self.send_osd(m.from_osd, MPGNotify(
-                    m.pgid, m.epoch, PGInfo(m.pgid), self.whoami))
-            return True
-        if isinstance(m, MPGRemove):
-            self._handle_pg_remove(m)
-            return True
-        if isinstance(m, MPGNotify):
-            pg = self._pg_for(m.pgid)
-            if pg is not None:
-                pg.on_notify(m)
-            return True
-        if isinstance(m, MPGLogRequest):
-            pg = self._pg_for(m.pgid)
-            if pg is not None:
-                pg.on_log_request(m)
-            return True
-        if isinstance(m, MPGLog):
-            pg = self._pg_for(m.pgid)
-            if pg is not None:
-                pg.on_pg_log(m)
-            else:
-                self._waiting_maps.append(m)
-            return True
-        if isinstance(m, MPGPush):
-            pg = self._pg_for(m.pgid)
-            if pg is not None:
-                pg.on_push(m)
-            return True
-        if isinstance(m, MPGPushReply):
-            pg = self._pg_for(m.pgid)
-            if pg is not None:
-                pg.on_push_reply(m)
-            return True
-        if isinstance(m, MPGObjectList):
-            pg = self._pg_for(m.pgid)
-            if pg is not None:
-                pg.on_object_list(m)
-            return True
-        if isinstance(m, MWatchNotifyAck):
-            pg = self._pg_for(m.pgid)
-            if pg is not None:
-                pg.on_notify_ack(m)     # primary awaits: bypass op queue
-            return True
-        if isinstance(m, (MPGScrub, MPGScrubScan)):
-            pg = self._pg_for(m.pgid)
-            if pg is not None:
-                pg.queue_op(m)        # serialize with writes
-            return True
-        if isinstance(m, MPGScrubMap):
-            pg = self._pg_for(m.pgid)
-            if pg is not None:
-                # the primary's scrub awaits this — bypass the op queue
-                fut = pg._scrub_map_waiters.get(m.tid)
-                if fut is not None and not fut.done():
-                    fut.set_result(m)
+        if isinstance(m, _PG_BOUND):
+            self.shards.route(m.pgid, self._dispatch_pg_msg, m)
             return True
         if isinstance(m, MOSDPing):
             self._handle_ping(m)
@@ -511,7 +566,188 @@ class OSD(Dispatcher):
             return False
         return False
 
-    def _handle_client_op(self, m: MOSDOp) -> None:
+    def _dispatch_op_batch(self, m: MOSDOpBatch) -> None:
+        """Unpack a corked client batch: one wire frame / one local
+        handoff carried N MOSDOps.  The batch is a transport ENVELOPE
+        (THROTTLE_SPLIT): the dispatch throttle is taken PER INNER OP
+        here — never per frame, which would let an arbitrarily large
+        cork ride the single-message escape hatch past the intake cap.
+        Ops that fit the budget route synchronously; once the budget
+        fills, the REMAINDER parks on an ordered async drain (FIFO
+        with later senders via the throttle's waiter queue), so the
+        byte bound and per-object order both hold."""
+        ops = m.ops_list()
+        if not ops:
+            self.messenger.put_dispatch_throttle(m)
+            return
+        m.throttle_cost = 0           # per-op shares own the budget
+        for op in ops:
+            # the messenger stamped the ENVELOPE (the batch): each
+            # inner op inherits it so replies/auth work unbatched
+            op.src_name = m.src_name
+            op.src_addr = m.src_addr
+            op.transport_id = m.transport_id
+            op.recv_stamp = m.recv_stamp
+            if getattr(m, "auth_entity", None) is not None:
+                op.auth_entity = m.auth_entity
+                op.auth_caps = m.auth_caps
+        thr = self.messenger.dispatch_throttle
+        for i, op in enumerate(ops):
+            cost = op.local_cost()
+            if thr is None:
+                self._route_batched_op(op, 0)
+            elif thr.get_or_fail(cost):
+                self._route_batched_op(op, cost)
+            else:
+                # budget full: register EVERY remaining op's waiter
+                # SYNCHRONOUSLY (get_later) before yielding — a later
+                # send's get_or_fail can then never overtake the
+                # parked remainder, so same-object order holds across
+                # batches; the drain task just awaits the grants in
+                # order
+                rest = [(op2, op2.local_cost()) for op2 in ops[i:]]
+                grants = [(op2, c2, thr.get_later(c2))
+                          for op2, c2 in rest]
+                asyncio.get_running_loop().create_task(
+                    self._drain_batch_rest(grants))
+                return
+
+    async def _drain_batch_rest(self, grants) -> None:
+        thr = self.messenger.dispatch_throttle
+        routed = 0
+        try:
+            for op, cost, fut in grants:
+                await fut
+                self._route_batched_op(op, cost)
+                routed += 1
+        except asyncio.CancelledError:
+            # teardown: return budget that was granted to ops we
+            # never routed (their futures resolved but the op died
+            # with this task); un-granted waiters die with the loop
+            for _op2, c2, f2 in grants[routed:]:
+                if f2.done() and not f2.cancelled():
+                    thr.put(c2)
+            raise
+
+    def _route_batched_op(self, op: MOSDOp, cost: int) -> None:
+        op.throttle_cost = cost
+        tracer = self.ctx.tracer
+        # wire hop: adopt the inner op's propagated span context
+        # (local delivery already carried the live spans)
+        if op._span is None and tracer.enabled \
+                and getattr(op, "trace_id", 0):
+            op._span = tracer.adopt(op.trace_id, op.span_id,
+                                    t0=op.recv_stamp)
+        if op._span is not None and tracer.enabled:
+            # batched delivery: transit-so-far + budget wait tile into
+            # the same chain stages an unbatched op would have cut at
+            # intake (_client_op drops foreign spans if tracing is off)
+            op._span.cut("deliver", tracer.hist)
+            op._span.cut("throttle_wait", tracer.hist)
+        self.shards.route(op.pgid, self._dispatch_pg_msg, op)
+
+    def _dispatch_pg_msg(self, m: Message) -> None:
+        """Per-type PG message handling; ALWAYS runs on the PG's home
+        shard (routed by ms_dispatch / the messenger's shard
+        classifier), so everything it touches stays shard-local."""
+        if isinstance(m, MOSDOp):
+            self._client_op(m)
+            return
+        if isinstance(m, (MOSDRepOp, MOSDECSubOpWrite, MOSDECSubOpRead)):
+            pg = self._pg_for(m.pgid)
+            if pg is None:
+                with self._wm_lock:
+                    self._waiting_maps.append(m)
+                return
+            # sharded plane: write sub-ops apply INLINE off the ring
+            # when nothing is queued ahead — the queue+wakeup hop is
+            # the per-sub-op cost the tracer's replica_rtt carries.
+            # shards=1 keeps the classic queue path bit-for-bit.
+            if self.shards.enabled \
+                    and isinstance(m, (MOSDRepOp, MOSDECSubOpWrite)) \
+                    and pg.try_fast_sub_write(m):
+                if self.shards.perf is not None:
+                    self.shards.perf.inc("subop_inline")
+                return
+            pg.queue_op(m)
+            return
+        if isinstance(m, (MOSDRepOpReply, MOSDECSubOpWriteReply,
+                          MOSDECSubOpReadReply)):
+            # acks resolve futures the PG worker awaits: handle off
+            # the op queue the worker is blocked on (the shard pump is
+            # a separate task, so delivery stays prompt)
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.backend.handle_reply(m)
+            return
+        if isinstance(m, MPGQuery):
+            pg = self._pg_for(m.pgid) or self._load_stray_pg(m.pgid)
+            if pg is not None:
+                pg.on_query(m)
+            else:
+                # we host nothing for this pg (yet): answer with an empty
+                # info rather than stalling the querier's peering — our
+                # own map advance will instantiate the PG if we belong
+                from ceph_tpu.osd.pglog import PGInfo
+                self.send_osd(m.from_osd, MPGNotify(
+                    m.pgid, m.epoch, PGInfo(m.pgid), self.whoami))
+            return
+        if isinstance(m, MPGRemove):
+            self._pg_remove(m)
+            return
+        if isinstance(m, MPGNotify):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_notify(m)
+            return
+        if isinstance(m, MPGLogRequest):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_log_request(m)
+            return
+        if isinstance(m, MPGLog):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_pg_log(m)
+            else:
+                with self._wm_lock:
+                    self._waiting_maps.append(m)
+            return
+        if isinstance(m, MPGPush):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_push(m)
+            return
+        if isinstance(m, MPGPushReply):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_push_reply(m)
+            return
+        if isinstance(m, MPGObjectList):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_object_list(m)
+            return
+        if isinstance(m, MWatchNotifyAck):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.on_notify_ack(m)     # primary awaits: bypass op queue
+            return
+        if isinstance(m, (MPGScrub, MPGScrubScan)):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                pg.queue_op(m)        # serialize with writes
+            return
+        if isinstance(m, MPGScrubMap):
+            pg = self._pg_for(m.pgid)
+            if pg is not None:
+                # the primary's scrub awaits this — bypass the op queue
+                fut = pg._scrub_map_waiters.get(m.tid)
+                if fut is not None and not fut.done():
+                    fut.set_result(m)
+            return
+
+    def _client_op(self, m: MOSDOp) -> None:
         pg = self._pg_for(m.pgid)
         if pg is None:
             self.messenger.put_dispatch_throttle(m)
@@ -599,7 +835,7 @@ class OSD(Dispatcher):
                 "osdmap_epoch": self.osdmap.epoch,
                 "num_pgs": len(self.pgs),
                 "pgs": {str(pg.pgid): pg.state
-                        for pg in self.pgs.values()},
+                        for pg in list(self.pgs.values())},
             }, "daemon status")
         def _bench_cmd(cmd):
             # accept both k=v fields and the text protocol's
@@ -791,23 +1027,30 @@ class OSD(Dispatcher):
             for pg in list(self.pgs.values()):
                 if not pg.is_primary() or pg.state != STATE_ACTIVE:
                     continue
-                info = pg.info
-                if info.last_scrub_stamp == 0:
-                    # fresh PG: activation counts as scrubbed (no boot
-                    # storm of deep scrubs on an empty cluster)
-                    info.last_scrub_stamp = now
-                    info.last_deep_scrub_stamp = now
-                    continue
-                if pg._scrub_queued:
-                    continue       # one in flight; stamp moves on completion
-                if not no_deep \
-                        and now - info.last_deep_scrub_stamp > deep * 1000:
-                    pg._scrub_queued = True
-                    pg.queue_op(MPGScrub(pg.pgid, deep=True))
-                elif not no_light \
-                        and now - info.last_scrub_stamp > light * 1000:
-                    pg._scrub_queued = True
-                    pg.queue_op(MPGScrub(pg.pgid, deep=False))
+                # stamp/queue decisions mutate PG state: home shard
+                self.shards.route(pg.pgid, self._sched_scrub_pg, pg,
+                                  now, no_light, no_deep,
+                                  light * 1000, deep * 1000)
+
+    def _sched_scrub_pg(self, pg: PG, now: int, no_light: bool,
+                        no_deep: bool, light_ms: float,
+                        deep_ms: float) -> None:
+        """Home-shard half of the scrub scheduler for one PG."""
+        info = pg.info
+        if info.last_scrub_stamp == 0:
+            # fresh PG: activation counts as scrubbed (no boot
+            # storm of deep scrubs on an empty cluster)
+            info.last_scrub_stamp = now
+            info.last_deep_scrub_stamp = now
+            return
+        if pg._scrub_queued:
+            return        # one in flight; stamp moves on completion
+        if not no_deep and now - info.last_deep_scrub_stamp > deep_ms:
+            pg._scrub_queued = True
+            pg.queue_op(MPGScrub(pg.pgid, deep=True))
+        elif not no_light and now - info.last_scrub_stamp > light_ms:
+            pg._scrub_queued = True
+            pg.queue_op(MPGScrub(pg.pgid, deep=False))
 
     # ----------------------------------------------------------- heartbeats
     async def _tier_agent_loop(self) -> None:
@@ -824,11 +1067,12 @@ class OSD(Dispatcher):
                         and pg.state == STATE_ACTIVE):
                     def make(p):
                         return lambda: tiering.agent_work(p)
-                    pg.queue_op(make(pg))
+                    # enqueue on the PG's home shard (SHARD11 seam)
+                    self.shards.route(pg.pgid, pg.queue_op, make(pg))
 
     def _hb_peers(self) -> List[int]:
         peers = set()
-        for pg in self.pgs.values():
+        for pg in list(self.pgs.values()):
             for o in pg.acting + pg.up:
                 if o != self.whoami and o != CRUSH_ITEM_NONE \
                         and self.osdmap.is_up(o):

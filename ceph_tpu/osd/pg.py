@@ -99,6 +99,7 @@ class PG:
         else:
             self._op_queue = _FifoQueue()
         self._worker_task: Optional[asyncio.Task] = None
+        self._worker_busy = False    # worker mid-item (fast-path gate)
         # per-PG op pipelining (osd/sequencer.py): up to
         # osd_pg_max_inflight_ops client ops run concurrently as their
         # own tasks, dependency-tracked by object id; barrier-class
@@ -1307,6 +1308,7 @@ class PG:
         seq = self.op_window
         while True:
             m = await self._op_queue.get()
+            self._worker_busy = True
             try:
                 if callable(m):
                     # internal work item (tier agent pass): iterates
@@ -1371,6 +1373,20 @@ class PG:
                 raise
             except Exception:
                 self.log_.exception(f"{self.pgid} op failed: {m}")
+            finally:
+                self._worker_busy = False
+
+    def try_fast_sub_write(self, m) -> bool:
+        """Sharded-plane inline path for replica WRITE sub-ops: apply
+        straight from the classify seam, skipping the op-queue put +
+        worker wakeup.  Legal only while nothing could be ordered
+        ahead of this message — the op queue is empty and the worker
+        is idle (not mid-item, e.g. a scrub scan that must serialize
+        against sub-op application); the backend apply itself is
+        synchronous by contract (backend.sub_write_fast)."""
+        if self._worker_busy or not self._op_queue.empty():
+            return False
+        return self.backend.sub_write_fast(m)
 
     async def _run_windowed(self, m: MOSDOp, slot) -> None:
         """One admitted client op: wait out its object-dependency

@@ -61,7 +61,10 @@ class TierClient:
     def on_reply(self, m: MOSDOpReply) -> bool:
         fut = self._pending.pop(m.tid, None)
         if fut is not None and not fut.done():
-            fut.set_result(m)
+            # loop-safe: tier ops are awaited on the PG's home shard
+            # while replies dispatch on the intake loop (osd/shards.py)
+            from ceph_tpu.osd.shards import resolve_future
+            resolve_future(fut, m)
             return True
         return False
 

@@ -42,6 +42,12 @@ FAST_CFG = {
     # (3x run-to-run throughput variance); stall-focused tests opt in
     # via lockdep_stall_budget.
     "lockdep": True,
+    # backward-compat pin: the bulk of tier-1 runs the single-loop
+    # data plane (osd/shards.py disabled — today's dispatch path,
+    # bit-for-bit).  Sharded coverage is explicit: test_shards.py,
+    # the perf-smoke shard guards, and the 2-shard schedule-explorer
+    # run override this per test.
+    "osd_op_num_shards": 1,
 }
 
 

@@ -90,7 +90,8 @@ class KVSyncThread:
                  kv_sync: Optional[Callable[[int], None]] = None,
                  queue_max: int = QUEUE_MAX,
                  gather_window: float = 0.0,
-                 auto_tune: bool = True):
+                 auto_tune: bool = True,
+                 ack_on_apply: bool = False):
         # unique per instance: co-located stores of the same backend
         # (a 4-OSD in-process cluster = four "memstore_commit"s) must
         # be distinguishable in the schedule explorer's commit-order
@@ -109,6 +110,13 @@ class KVSyncThread:
         #: buys nothing, and a static guess on a device whose fsync is
         #: 4x slower under-batches by the same factor.
         self.gather_window = gather_window
+        #: sharded-data-plane opt-in (the OSD sets it for stores it
+        #: mounts while the plane is enabled): a store with NO
+        #: durability hooks may then commit groups inline at the
+        #: cork-flush point instead of paying the thread handoff —
+        #: see start().  Off = today's threaded behavior, bit-for-bit
+        #: (osd_op_num_shards=1 and standalone stores keep it off).
+        self.ack_on_apply = ack_on_apply
         #: adapt the window to the measured barrier latency (EWMA),
         #: clamped to [0, 4x the static value].  Only engages on stores
         #: with a REAL barrier hook — a RAM store has no fsync signal
@@ -138,9 +146,13 @@ class KVSyncThread:
         # event-loop-side cork: submissions staged within one loop pass
         # ship to the thread as ONE queue put (one lock round + one GIL
         # handoff per pass instead of per transaction — the handoffs,
-        # not the queue, are what tax a busy event loop)
-        self._staged: List[_Item] = []
-        self._flush_scheduled = False
+        # not the queue, are what tax a busy event loop).  Staging is
+        # keyed PER LOOP: under the sharded data plane (osd/shards.py)
+        # several shard loops submit to one store concurrently, and a
+        # shared list would lose wakeups across threads.  Per-loop FIFO
+        # is the order that matters (a PG lives on exactly one shard).
+        self._staged: dict = {}          # id(loop) -> List[_Item]
+        self._flush_scheduled: dict = {}  # id(loop) -> bool
         self.dead = False           # crashed (fault injection) or error
         # --- test hooks ---
         self.trace: Optional[Callable[[str, int], None]] = None
@@ -157,6 +169,19 @@ class KVSyncThread:
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
         if SIM_INLINE:
+            self._inline = True
+            return
+        if self.ack_on_apply and self.data_sync is None \
+                and self.kv_sync is None:
+            # ack-on-apply (ROADMAP: "tighter gather window or
+            # ack-on-apply semantics where safe"): a RAM-backed store
+            # has NO durability point beyond the apply — no data
+            # barrier, no kv submit — so the commit thread would add
+            # only a GIL handoff (5-15ms p50 on a busy event loop,
+            # the tracer's repl_commit cost) between apply and ack.
+            # Commit groups run inline at the cork-flush point
+            # instead: the exact SIM_INLINE code path, so ordering,
+            # observer hooks and crash injection are unchanged.
             self._inline = True
             return
         if self._thread is not None and self._thread.is_alive():
@@ -192,22 +217,45 @@ class KVSyncThread:
             else:
                 self._q.put([item])
             return
-        self._staged.append(item)
-        if not self._flush_scheduled:
-            self._flush_scheduled = True
-            loop.call_soon(self._flush_staged)
+        key = id(loop)
+        self._staged.setdefault(key, []).append(item)
+        if not self._flush_scheduled.get(key):
+            self._flush_scheduled[key] = True
+            loop.call_soon(self._flush_one, key)
 
-    def _flush_staged(self) -> None:
-        self._flush_scheduled = False
-        if not self._staged:
+    def _flush_one(self, key: int) -> None:
+        """Ship one loop's corked items (runs ON that loop)."""
+        self._flush_scheduled[key] = False
+        items = self._staged.pop(key, None)
+        if not items:
             return
-        items, self._staged = self._staged, []
         if self._inline:
             # sim mode: the loop-pass cork IS the commit group; no
             # thread handoff, no gather linger — deterministic
             self._run_group(items)
         else:
             self._q.put(items)
+
+    def _flush_staged(self) -> None:
+        """Ship the CALLING loop's corked items now (flush()/stop()
+        path).  With no running loop — tools, teardown — OR in inline
+        (ack-on-apply / sim) mode, ship EVERY loop's residue: inline
+        groups commit synchronously wherever they run, and a
+        teardown-time flush from the intake thread must not leave a
+        shard loop's staged group behind (its scheduled cork flush
+        may never run once the daemon stops).  The per-key pop is
+        GIL-atomic, so racing the owning loop's own flush is safe —
+        exactly one side ships each list."""
+        try:
+            import asyncio
+            key = id(asyncio.get_running_loop())
+        except RuntimeError:
+            key = None
+        if key is not None and not self._inline:
+            self._flush_one(key)
+            return
+        for k in list(self._staged):
+            self._flush_one(k)
 
     def _run_group(self, group: List[_Item]) -> None:
         """One group through the commit path, on the calling thread
@@ -402,21 +450,32 @@ class KVSyncThread:
 
     def _complete(self, group: List[_Item]) -> None:
         self._notify("callbacks", group)
+        # completions post PER SHARD LOOP, batched: one
+        # call_soon_threadsafe wakeup per (loop, group) carrying every
+        # callback for that loop in submission order — under the
+        # sharded data plane the kv-sync thread would otherwise pay
+        # one cross-thread wakeup per transaction
+        by_loop: dict = {}
         for it in group:
             fns = [f for f in (it.on_commit, it.post) if f is not None]
             if not fns:
                 continue
             if it.loop is not None and not it.loop.is_closed():
-                # submission order is preserved: call_soon_threadsafe
-                # enqueues callbacks FIFO on the loop
-                for f in fns:
-                    try:
-                        it.loop.call_soon_threadsafe(self._guard, f)
-                    except RuntimeError:
-                        self._guard(f)   # loop closed mid-flight
+                by_loop.setdefault(id(it.loop), (it.loop, []))[1] \
+                    .extend(fns)
             else:
                 for f in fns:
                     self._guard(f)
+        for loop, fns in by_loop.values():
+            try:
+                loop.call_soon_threadsafe(self._run_callbacks, fns)
+            except RuntimeError:
+                for f in fns:
+                    self._guard(f)   # loop closed mid-flight
+
+    def _run_callbacks(self, fns: List[Callable[[], None]]) -> None:
+        for f in fns:
+            self._guard(f)
 
     @staticmethod
     def _guard(fn: Callable[[], None]) -> None:

@@ -65,8 +65,13 @@ class MemStore(ObjectStore):
 
     def mount(self) -> None:
         from ceph_tpu.store.commit import KVSyncThread
-        self._committer = KVSyncThread("memstore_commit",
-                                       gather_window=self.GATHER_WINDOW)
+        self._committer = KVSyncThread(
+            "memstore_commit", gather_window=self.GATHER_WINDOW,
+            # set by the mounting OSD when its sharded data plane is
+            # enabled: RAM stores then ack-on-apply (inline commit
+            # groups — no barrier exists to wait for); default off =
+            # today's threaded handoff, bit-for-bit
+            ack_on_apply=getattr(self, "ack_on_apply", False))
         self._committer.start()
         self.mounted = True
 

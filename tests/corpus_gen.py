@@ -134,6 +134,9 @@ def samples():
     mosdop = osdm.MOSDOp(pgid, "obj1", oloc, [osd_op], tid=9,
                          map_epoch=7, reqid="abc.9", snap_seq=4,
                          snaps=[4, 2], snapid=0)
+    mosdop2 = osdm.MOSDOp(pgid, "obj2", oloc, [osd_op], tid=10,
+                          map_epoch=7, reqid="abc.10")
+    op_batch = osdm.MOSDOpBatch([mosdop, mosdop2])
 
     out = {
         "ceph_tpu.crush.types.Bucket": bucket,
@@ -180,6 +183,7 @@ def samples():
         "ceph_tpu.osd.messages.MOSDECSubOpWriteReply":
             osdm.MOSDECSubOpWriteReply(),
         "ceph_tpu.osd.messages.MOSDOp": mosdop,
+        "ceph_tpu.osd.messages.MOSDOpBatch": op_batch,
         "ceph_tpu.osd.messages.MOSDOpReply": osdm.MOSDOpReply(
             9, 0, [osd_op], 7),
         "ceph_tpu.osd.messages.MOSDPing": osdm.MOSDPing(),

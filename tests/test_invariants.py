@@ -48,7 +48,8 @@ def test_cli_entry_point_runs_standalone():
         capture_output=True, text=True, timeout=60)
     assert out.returncode == 0, out.stderr
     for rid in ("AF01", "FP02", "SEND03", "BLK04", "MONO05",
-                "LOCK06", "FIN07", "PROTO08", "REPLY09", "EPOCH10"):
+                "LOCK06", "FIN07", "PROTO08", "REPLY09", "EPOCH10",
+                "SHARD11"):
         assert rid in out.stdout
 
 
@@ -407,6 +408,50 @@ def test_epoch10_guard_before_mutation_passes():
         "# lint: allow[EPOCH10] staleness arbitrated per object\n"
         "def on_push(self, m):\n"
         "    self.backend.apply_push(m)\n"
+    )
+    assert _rules_of(waived, "osd/fixture.py") == []
+
+
+def test_shard11_pg_mutation_from_intake_path():
+    """ISSUE 10: PG-state mutation from an intake/heartbeat-path
+    function must go through the shard handoff seam."""
+    src = (
+        "def ms_dispatch(self, m):\n"
+        "    pg = self._pg_for(m.pgid)\n"
+        "    pg.queue_op(m)\n"
+    )
+    assert _rules_of(src, "osd/fixture.py") == ["SHARD11"]
+    # PG-field assignment from a heartbeat-path function trips too
+    src2 = (
+        "def _scrub_scheduler(self, m):\n"
+        "    pg = self._load_stray_pg(m.pgid)\n"
+        "    pg.info.last_scrub_stamp = 0\n"
+    )
+    assert _rules_of(src2, "osd/fixture.py") == ["SHARD11"]
+    # out of intake-module scope (a PG method itself is fine)
+    assert _rules_of(src, "common/fixture.py") == []
+
+
+def test_shard11_seam_routing_and_waiver_pass():
+    good = (
+        "def ms_dispatch(self, m):\n"
+        "    pg = self._pg_for(m.pgid)\n"
+        "    self.shards.route(m.pgid, pg.queue_op, m)\n"
+    )
+    assert _rules_of(good, "osd/fixture.py") == []
+    # reads stay legal from intake (status/describe/is_primary)
+    good2 = (
+        "def _report_stats(self):\n"
+        "    pg = self._pg_for(self.pgid)\n"
+        "    if pg.is_primary():\n"
+        "        x = pg.describe()\n"
+    )
+    assert _rules_of(good2, "osd/fixture.py") == []
+    waived = (
+        "def ms_dispatch(self, m):\n"
+        "    pg = self._pg_for(m.pgid)\n"
+        "    # lint: allow[SHARD11] single-loop teardown sweep\n"
+        "    pg.stop()\n"
     )
     assert _rules_of(waived, "osd/fixture.py") == []
 

@@ -234,6 +234,72 @@ def test_cluster_rw_over_local_delivery(tmp_path):
     asyncio.run(run())
 
 
+def test_sharded_plane_perf_guards():
+    """ISSUE 10 regression guards for the sharded data plane, with a
+    shards=1 run in the same test pinning backward compatibility:
+
+      * shards=4 on the local path keeps ``msg_encode_calls`` at 0
+        (the classify seam hands over live object graphs, never
+        bytes);
+      * per-PG window depth still engages (> 1) through the shard
+        rings;
+      * the ``osd_shard_handoff`` counters prove cross-shard handoffs
+        are BATCHED: pump wakeups < handed-off ops under burst, and
+        replica write sub-ops apply inline off the ring;
+      * shards=1 (the FAST_CFG default the whole suite runs under)
+        leaves the plane disabled — no shard router, no handoff
+        group, the commit thread intact — i.e. today's path."""
+    from ceph_tpu.msg import payload as payload_mod
+    from ceph_tpu.qa.cluster import Cluster, make_ctx
+
+    def ctx_f(shards):
+        def f(name):
+            c = make_ctx(name)
+            c.config.set("osd_op_num_shards", shards)
+            c.config.set("osd_shard_threads", False)
+            c.config.set("ms_local_delivery", True)
+            return c
+        return f
+
+    async def run(shards):
+        cl = Cluster(ctx_factory=ctx_f(shards))
+        admin = await cl.start(4)
+        await admin.pool_create("shsm", pg_num=2,
+                                pool_type="erasure", k=2, m=2)
+        io = admin.open_ioctx("shsm")
+        payload_mod.reset_counters()
+        blobs = {f"g{i:03d}": bytes([i]) * 8192 for i in range(32)}
+        await cl.write_burst(io, blobs, iodepth=16)
+        win = cl.window_counters()
+        enc = payload_mod.counters()
+        sc = {}
+        for osd in cl.osds.values():
+            for k, v in osd.shards.counters().items():
+                if isinstance(v, (int, float)):
+                    sc[k] = sc.get(k, 0) + v
+        routers = [osd.messenger.shard_router
+                   for osd in cl.osds.values()]
+        for k, v in blobs.items():
+            assert await io.read(k) == v
+        await cl.stop()
+        return win, enc, sc, routers
+
+    win, enc, sc, routers = asyncio.run(run(4))
+    assert enc["msg_encode_calls"] == 0, enc
+    assert win["mean_inflight_depth"] > 1.0, win
+    assert sc["handoff_ops"] > 0, sc
+    assert sc["handoff_wakeups"] < sc["handoff_ops"], sc
+    assert sc["subop_inline"] > 0, sc
+    assert all(r is not None for r in routers)
+
+    # shards=1 compat pin: plane fully off, zero-encode still holds
+    win1, enc1, sc1, routers1 = asyncio.run(run(1))
+    assert enc1["msg_encode_calls"] == 0, enc1
+    assert win1["mean_inflight_depth"] > 1.0, win1
+    assert sc1["handoff_ops"] == 0, sc1
+    assert all(r is None for r in routers1)
+
+
 def test_sanitizer_fully_off_path_when_disabled():
     """ISSUE 7 off-path guard: with lockdep=false the invariant
     sanitizer must leave ZERO footprint on the write path — the
