@@ -4,15 +4,24 @@ Usage:
     python -m ceph_tpu.devtools.lint              # lint the live package
     python -m ceph_tpu.devtools.lint --json       # machine-readable
     python -m ceph_tpu.devtools.lint --rule AF01  # one rule only
+    python -m ceph_tpu.devtools.lint --changed    # git-diff-touched only
+    python -m ceph_tpu.devtools.lint --seam-report  # seam inventory JSON
     python -m ceph_tpu.devtools.lint path.py ...  # explicit targets
 
 Exit status is STABLE (CI keys on it): 0 = clean, 1 = violations,
 2 = usage/parse error.  The ``--json`` document carries a ``schema``
-version, the exit code it implies, and a per-rule summary (violation +
-waiver counts) so CI can diff rule regressions without parsing render
-strings.  The tier-1 suite (tests/test_invariants.py) runs the same
-engine in-process over the live tree and fails on any violation, so an
-invariant regression is a test failure — not a separate pipeline.
+version, the exit code it implies, a per-rule summary (violation +
+waiver counts + analysis wall time), the unused-waiver audit, and —
+when the whole package is linted — the shard-seam inventory block
+(``seam``) the GIL-escape refactor consumes.  The tier-1 suite
+(tests/test_invariants.py) runs the same engine in-process over the
+live tree and fails on any violation, so an invariant regression is a
+test failure — not a separate pipeline.
+
+Performance: every module is parsed ONCE into a process-wide FileInfo
+cache (AST + comment/waiver side table) shared by all rules and all
+subsequent lint calls in the process; ``--changed`` restricts the
+target set to git-diff-touched package files for pre-commit use.
 """
 
 from __future__ import annotations
@@ -20,14 +29,26 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
+import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from ceph_tpu.devtools.rules import (PROJECT_RULES, RULE_IDS, RULES,
                                      FileInfo, Violation)
 
 #: bumped whenever the --json document shape changes incompatibly
-JSON_SCHEMA = 1
+#: (v2: seam-report block, per-rule analysis timings, unused-waiver
+#: audit, ESC12/PORT13/ATOM14 in the rule summary)
+JSON_SCHEMA = 2
+
+#: process-wide parse cache: abspath -> (mtime_ns, size, FileInfo).
+#: One parse feeds every rule and every lint call in the process —
+#: the tier-1 suite lints the live tree several times (full run,
+#: per-rule fixtures, seam report) and used to pay the ~190-file
+#: parse+tokenize cost each time.
+_FILE_CACHE: Dict[str, Tuple[int, int, FileInfo]] = {}
+CACHE_STATS = {"hits": 0, "misses": 0}
 
 
 def package_root() -> str:
@@ -49,33 +70,93 @@ def _iter_py(paths: Iterable[str]) -> Iterable[str]:
             yield p
 
 
-def _file_rules(fi: FileInfo, rule: Optional[str]) -> List[Violation]:
+def _load_file(path: str, rel: str) -> FileInfo:
+    """Parse-once cache keyed on (mtime, size): a re-lint in the same
+    process reuses the AST + waiver side table for every rule."""
+    ap = os.path.abspath(path)
+    st = os.stat(ap)
+    key = (st.st_mtime_ns, st.st_size)
+    got = _FILE_CACHE.get(ap)
+    if got is not None and (got[0], got[1]) == key:
+        CACHE_STATS["hits"] += 1
+        return got[2]
+    CACHE_STATS["misses"] += 1
+    with open(ap, "r", encoding="utf-8") as f:
+        fi = FileInfo(rel, f.read())
+    _FILE_CACHE[ap] = (key[0], key[1], fi)
+    return fi
+
+
+def changed_paths(root: Optional[str] = None) -> List[str]:
+    """Package .py files touched per git (worktree + index vs HEAD,
+    plus untracked) — the --changed pre-commit target set."""
+    root = root or package_root()
+    repo = os.path.dirname(root)
+    try:
+        # -z: NUL-separated, never C-quoted — a path with spaces or
+        # non-ASCII must not be silently dropped from a pre-commit lint
+        out = subprocess.run(
+            ["git", "-C", repo, "status", "--porcelain", "-z",
+             "--untracked-files=all"],
+            capture_output=True, text=True, timeout=30, check=True)
+    except Exception:
+        return [root]       # no git: fall back to the full package
+    paths = []
+    tokens = [t for t in out.stdout.split("\0") if t]
+    i = 0
+    while i < len(tokens):
+        entry = tokens[i]
+        i += 1
+        status, rel = entry[:2], entry[3:]
+        if status and status[0] in "RC":
+            i += 1          # rename/copy: next token is the OLD path
+        if not rel.endswith(".py"):
+            continue
+        ap = os.path.join(repo, rel)
+        if os.path.abspath(ap).startswith(root + os.sep) \
+                and os.path.exists(ap):
+            paths.append(ap)
+    return paths
+
+
+def _file_rules(fi: FileInfo, rule: Optional[str],
+                timings: Optional[Dict[str, float]] = None
+                ) -> List[Violation]:
     out: List[Violation] = []
     for rid, (_desc, fn) in RULES.items():
         if rule is not None and rid != rule \
                 and not (rid == "FP02" and rule == "SEND03"):
             continue
+        t0 = time.perf_counter()
         for v in fn(fi):
             if rule is not None and v.rule != rule:
                 continue
             if fi.waived(v.rule, v.line):
                 continue
             out.append(v)
+        if timings is not None:
+            timings[rid] = timings.get(rid, 0.0) \
+                + (time.perf_counter() - t0)
     return out
 
 
-def _project_rules(files: List[FileInfo],
-                   rule: Optional[str]) -> List[Violation]:
+def _project_rules(files: List[FileInfo], rule: Optional[str],
+                   timings: Optional[Dict[str, float]] = None
+                   ) -> List[Violation]:
     out: List[Violation] = []
     by_rel = {fi.rel: fi for fi in files}
     for rid, (_desc, fn) in PROJECT_RULES.items():
         if rule is not None and rid != rule:
             continue
+        t0 = time.perf_counter()
         for v in fn(files):
             fi = by_rel.get(v.rel)
             if fi is not None and fi.waived(v.rule, v.line):
                 continue
             out.append(v)
+        if timings is not None:
+            timings[rid] = timings.get(rid, 0.0) \
+                + (time.perf_counter() - t0)
     return out
 
 
@@ -95,7 +176,8 @@ def lint_source(source: str, rel: str,
     this).  ``rel`` drives the module-scoped rules (MONO05 op-path set,
     BLK04 exemptions, REPLY09/EPOCH10 osd scope), so fixtures pick
     their rule context via a fake relative path.  Project rules
-    (PROTO08) need a file SET — see lint_project_sources."""
+    (PROTO08, ESC12/PORT13/ATOM14) need a file SET — see
+    lint_project_sources."""
     fi = FileInfo(rel, source)
     out = _file_rules(fi, rule)
     out.sort(key=lambda v: (v.rel, v.line, v.rule))
@@ -104,18 +186,23 @@ def lint_source(source: str, rel: str,
 
 def lint_project_sources(sources: List[Tuple[str, str]],
                          rule: Optional[str] = None) -> List[Violation]:
-    """Run the PROJECT rules (PROTO08) over an in-memory file set of
-    (rel, source) pairs — the fixture entry point."""
+    """Run the PROJECT rules (PROTO08, the seam rules) over an
+    in-memory file set of (rel, source) pairs — the fixture entry
+    point."""
     files = [FileInfo(rel, src) for rel, src in sources]
     out = _project_rules(files, rule)
     out.sort(key=lambda v: (v.rel, v.line, v.rule))
     return out
 
 
-def _collect(paths: Optional[Iterable[str]], rule: Optional[str]
+def _collect(paths: Optional[Iterable[str]], rule: Optional[str],
+             timings: Optional[Dict[str, float]] = None,
+             run_rules: bool = True
              ) -> Tuple[List[Violation], List[str], List[FileInfo]]:
     root = package_root()
-    targets = list(paths) if paths else [root]
+    # an explicit EMPTY path list means "no targets" (--changed with a
+    # pristine worktree), not "the whole package"
+    targets = [root] if paths is None else list(paths)
     violations: List[Violation] = []
     errors: List[str] = []
     files: List[FileInfo] = []
@@ -123,17 +210,35 @@ def _collect(paths: Optional[Iterable[str]], rule: Optional[str]
         rel = os.path.relpath(os.path.abspath(path), root).replace(
             os.sep, "/")
         try:
-            with open(path, "r", encoding="utf-8") as f:
-                fi = FileInfo(rel, f.read())
+            fi = _load_file(path, rel)
         except SyntaxError as e:
             errors.append(f"{path}: parse error: {e}")
             continue
         except OSError as e:
             errors.append(f"{path}: {e}")
             continue
+        # waiver USAGE is per lint run, but FileInfo objects persist
+        # in the parse cache: reset so the unused-waiver audit reports
+        # this run's suppressions, not a stale union of past runs
+        fi.waiver_used.clear()
         files.append(fi)
-        violations.extend(_file_rules(fi, rule))
-    violations.extend(_project_rules(files, rule))
+        if run_rules:
+            violations.extend(_file_rules(fi, rule, timings))
+    if not run_rules:
+        return violations, errors, files
+    # the three seam rules share ONE interprocedural analysis: build
+    # it up front under its own timing key so the per-rule ms report
+    # shows each rule's filter cost, not the whole analysis charged to
+    # whichever seam rule happens to run first (memo effect)
+    if files and (rule is None or rule in ("ESC12", "PORT13",
+                                           "ATOM14")):
+        from ceph_tpu.devtools.seam import analyze
+        t0 = time.perf_counter()
+        analyze(files)
+        if timings is not None:
+            timings["SEAM"] = timings.get("SEAM", 0.0) \
+                + (time.perf_counter() - t0)
+    violations.extend(_project_rules(files, rule, timings))
     violations.sort(key=lambda v: (v.rel, v.line, v.rule))
     return violations, errors, files
 
@@ -150,23 +255,62 @@ def lint_paths(paths: Optional[Iterable[str]] = None,
 
 
 def _waiver_counts(files: List[FileInfo]) -> Dict[str, int]:
-    """Waiver COMMENTS per rule id (each waiver registers two covered
-    lines in fi.waivers; count the comment lines themselves)."""
+    """Waiver COMMENTS per rule id."""
     out: Dict[str, int] = {}
     for fi in files:
-        for ln, text in fi.comments.items():
-            m = FileInfo.WAIVER_RE.search(text)
-            if m:
-                out[m.group(1)] = out.get(m.group(1), 0) + 1
+        for _ln, rid in fi.waiver_comments:
+            out[rid] = out.get(rid, 0) + 1
     return out
 
 
+def _unused_waivers(files: List[FileInfo],
+                    rule: Optional[str]) -> List[dict]:
+    """Waiver comments that suppressed nothing this run.  Only
+    meaningful on an all-rules run — a single-rule lint leaves every
+    other rule's waivers unqueried by construction."""
+    if rule is not None:
+        return []
+    out = []
+    for fi in files:
+        for ln, rid in fi.unused_waivers():
+            out.append({"rel": fi.rel, "line": ln, "rule": rid})
+    out.sort(key=lambda e: (e["rel"], e["line"]))
+    return out
+
+
+def seam_report(paths: Optional[Iterable[str]] = None) -> dict:
+    """The machine-readable shard-seam inventory
+    (``--seam-report``): every seam-crossing value classified, every
+    gil-atomic region, every cross-side shared structure — the
+    work-list the process-lane refactor consumes."""
+    from ceph_tpu.devtools.seam import analyze
+    _violations, _errors, files = _collect(paths, None,
+                                           run_rules=False)
+    report = analyze(files).report()
+    # a subset inventory (explicit paths / --changed) must be
+    # distinguishable from the whole-package work-list a CI consumer
+    # commits as SEAM_INVENTORY.json
+    report["partial"] = paths is not None
+    return report
+
+
 def lint_report(paths: Optional[Iterable[str]] = None,
-                rule: Optional[str] = None) -> dict:
+                rule: Optional[str] = None,
+                strict_waivers: bool = False) -> dict:
     """Full machine-readable report: the --json document.  Everything
     in it is JSON-native (round-trips through json.dumps/loads)."""
-    violations, errors, files = _collect(paths, rule)
+    timings: Dict[str, float] = {}
+    violations, errors, files = _collect(paths, rule, timings)
     waived = _waiver_counts(files)
+    unused = _unused_waivers(files, rule)
+    if strict_waivers:
+        for e in unused:
+            violations.append(Violation(
+                "WAIVER", e["rel"], e["line"],
+                f"stale waiver: # lint: allow[{e['rule']}] no longer "
+                f"suppresses anything — remove it (or fix whatever "
+                f"made it dead)"))
+        violations.sort(key=lambda v: (v.rel, v.line, v.rule))
     descs = {rid: desc for rid, (desc, _fn) in RULES.items()}
     descs.update({rid: desc for rid, (desc, _fn) in PROJECT_RULES.items()})
     descs["SEND03"] = "no message mutation after first send"
@@ -175,26 +319,43 @@ def lint_report(paths: Optional[Iterable[str]] = None,
             "description": descs[rid],
             "violations": sum(1 for v in violations if v.rule == rid),
             "waived": waived.get(rid, 0),
+            # SEND03 rides FP02's shared scan (its own cost is 0);
+            # the seam rules report filter cost only — the shared
+            # interprocedural analysis is the top-level
+            # seam_analysis_ms field
+            "ms": 0.0 if rid == "SEND03"
+            else round(timings.get(rid, 0.0) * 1e3, 3),
         }
         for rid in sorted(RULE_IDS)
     }
     exit_code = 2 if errors else (1 if violations else 0)
-    return {
+    doc = {
         "schema": JSON_SCHEMA,
         "clean": not violations and not errors,
         "exit": exit_code,
         "files": len(files),
         "rules": rules_summary,
+        "seam_analysis_ms": round(timings.get("SEAM", 0.0) * 1e3, 3),
         "violations": [dict(v.__dict__) for v in violations],
+        "unused_waivers": unused,
+        "strict_waivers": bool(strict_waivers),
         "errors": list(errors),
     }
+    if rule is None and paths is None and files:
+        # whole-package runs only: a partial (explicit-path /
+        # --changed) lint must not emit a subset inventory under the
+        # same schema key a CI consumer might store as the work-list
+        from ceph_tpu.devtools.seam import analyze
+        doc["seam"] = analyze(files).report()
+    return doc
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="ceph_tpu.devtools.lint",
         description="invariant sanitizer: static rules over the "
-                    "ceph_tpu package (see devtools/rules.py)")
+                    "ceph_tpu package (see devtools/rules.py + "
+                    "devtools/seam.py)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the package)")
     ap.add_argument("--rule", choices=sorted(RULE_IDS),
@@ -202,6 +363,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output (schema-versioned; "
                          "exit code mirrors the 'exit' field)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only git-diff-touched package files "
+                         "(pre-commit mode; project rules still see "
+                         "the touched set only)")
+    ap.add_argument("--strict-waivers", action="store_true",
+                    help="promote unused '# lint: allow[ID]' comments "
+                         "from warnings to violations")
+    ap.add_argument("--seam-report", action="store_true",
+                    help="emit the shard-seam inventory JSON "
+                         "(schema-versioned; see devtools/seam.py) "
+                         "and exit 0")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -214,12 +386,30 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"(runs with FP02)")
         return 0
 
-    report = lint_report(args.paths or None, rule=args.rule)
+    paths = args.paths or None
+    if args.changed and paths is None:
+        paths = changed_paths()
+        if not paths and not args.json and not args.seam_report:
+            # --json consumers always get the schema document (an
+            # empty-target one), never a bare text line
+            print("lint --changed: no touched package files")
+            return 0
+
+    if args.seam_report:
+        print(json.dumps(seam_report(paths), indent=1))
+        return 0
+
+    report = lint_report(paths, rule=args.rule,
+                         strict_waivers=args.strict_waivers)
     if args.json:
         print(json.dumps(report, indent=1))
     else:
         for v in report["violations"]:
             print(f"{v['rel']}:{v['line']}: {v['rule']} {v['msg']}")
+        for e in report["unused_waivers"]:
+            if not args.strict_waivers:
+                print(f"{e['rel']}:{e['line']}: warning: unused "
+                      f"waiver allow[{e['rule']}]", file=sys.stderr)
         for e in report["errors"]:
             print(e, file=sys.stderr)
         if report["clean"]:

@@ -129,18 +129,41 @@ class FileInfo:
                     self.comments[tok.start[0]] = tok.string
         except (tokenize.TokenError, IndentationError):
             pass
-        #: lineno -> waived rule ids (a waiver covers its own line and
-        #: the line directly below, so it can sit above a long call)
-        self.waivers: Dict[int, Set[str]] = {}
+        #: lineno -> {rule id: waiver COMMENT line} (a waiver covers its
+        #: own line and the line directly below, so it can sit above a
+        #: long call).  The comment line rides along so waiver USAGE can
+        #: be attributed back to the comment that did the suppressing —
+        #: the unused-waiver audit keys on it.
+        self.waivers: Dict[int, Dict[str, int]] = {}
+        #: every waiver comment in the file: (comment line, rule id)
+        self.waiver_comments: List[Tuple[int, str]] = []
+        #: (rule, comment line) pairs that actually suppressed something
+        #: this run — a waiver never queried by a would-be violation is
+        #: stale and reported by the unused-waiver audit
+        self.waiver_used: Set[Tuple[str, int]] = set()
         for ln, c in self.comments.items():
             m = self.WAIVER_RE.search(c)
             if m:
-                self.waivers.setdefault(ln, set()).add(m.group(1))
-                self.waivers.setdefault(ln + 1, set()).add(m.group(1))
+                rid = m.group(1)
+                self.waiver_comments.append((ln, rid))
+                self.waivers.setdefault(ln, {})[rid] = ln
+                self.waivers.setdefault(ln + 1, {})[rid] = ln
         self.aliases = _import_aliases(self.tree)
 
     def waived(self, rule: str, line: int) -> bool:
-        return rule in self.waivers.get(line, ())
+        cover = self.waivers.get(line)
+        if cover is None or rule not in cover:
+            return False
+        self.waiver_used.add((rule, cover[rule]))
+        return True
+
+    def unused_waivers(self) -> List[Tuple[int, str]]:
+        """Waiver comments that suppressed nothing: (comment line,
+        rule).  Only meaningful after every rule has run over the
+        file (a single-rule lint leaves other rules' waivers unused
+        by construction — callers gate on that)."""
+        return sorted((ln, rid) for ln, rid in self.waiver_comments
+                      if (rid, ln) not in self.waiver_used)
 
 
 def _import_aliases(tree: ast.AST) -> Dict[str, str]:
@@ -857,6 +880,51 @@ def _registered_messages(files: List[FileInfo]) -> Set[str]:
     return out
 
 
+def _envelope_inner(files: List[FileInfo],
+                    registered: Set[str]) -> Dict[str, Set[str]]:
+    """Container frames: a registered message that is a pure transport
+    ENVELOPE (marked ``THROTTLE_SPLIT = True`` — per-inner-op throttle
+    accounting is the envelope contract) carries other registered
+    messages inside.  The inner types are read mechanically off the
+    class body (the decode path must name them: ``MOSDOp.from_bytes``
+    inside ``MOSDOpBatch.decode_payload``), so a batched send
+    contributes its INNER (type, role) edges — the receiver dispatches
+    the unpacked inner ops, and an unhandled inner type is the same
+    silent drop an unhandled top-level type is."""
+    out: Dict[str, Set[str]] = {}
+    for fi in files:
+        for node in ast.walk(fi.tree):
+            if not (isinstance(node, ast.ClassDef)
+                    and node.name in registered):
+                continue
+            is_env = any(
+                isinstance(st, ast.Assign)
+                and any(isinstance(t, ast.Name)
+                        and t.id == "THROTTLE_SPLIT"
+                        for t in st.targets)
+                and isinstance(st.value, ast.Constant)
+                and st.value.value is True
+                for st in node.body)
+            if not is_env:
+                continue
+            # only the DECODE path names carried types (the docstring
+            # contract): a registered class mentioned in an unrelated
+            # helper must not fabricate inner edges
+            inner: Set[str] = set()
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and item.name == "decode_payload":
+                    inner |= {
+                        sub.id for sub in ast.walk(item)
+                        if isinstance(sub, ast.Name)
+                        and sub.id in registered
+                        and sub.id != node.name}
+            if inner:
+                out[node.name] = inner
+    return out
+
+
 def _handled_names(fi: FileInfo) -> Set[str]:
     """Every class name this module dispatches on via isinstance()."""
     out: Set[str] = set()
@@ -932,6 +1000,7 @@ def check_proto08(files: List[FileInfo]) -> Iterator[Violation]:
     file must not fabricate missing-handler noise)."""
     by_rel = {fi.rel: fi for fi in files}
     registered = _registered_messages(files)
+    containers = _envelope_inner(files, registered)
     handled: Dict[str, Set[str]] = {}
     for role, mods in ROLE_MODULES.items():
         present = [by_rel[m] for m in mods if m in by_rel]
@@ -945,21 +1014,27 @@ def check_proto08(files: List[FileInfo]) -> Iterator[Violation]:
         if fi.rel.startswith(("tools/", "devtools/")):
             continue
         for cls, role, line in _send_edges(fi, registered):
-            if role not in handled:
-                continue
-            if cls in handled[role]:
-                continue
-            if fi.waived("PROTO08", line):
-                continue
-            if (cls, role) in seen:
-                continue        # one report per (type, role) pair
-            seen.add((cls, role))
-            yield Violation(
-                "PROTO08", fi.rel, line,
-                f"{cls} is sent to role {role!r} but no dispatcher in "
-                f"{list(ROLE_MODULES[role])} handles it "
-                f"(isinstance check missing): the send is a silent "
-                f"drop on the receiver")
+            # a container frame contributes its inner types' edges too:
+            # the envelope is transport, the inner ops are the protocol
+            expanded = [cls] + sorted(containers.get(cls, ()))
+            for ecls in expanded:
+                if role not in handled:
+                    continue
+                if ecls in handled[role]:
+                    continue
+                if fi.waived("PROTO08", line):
+                    continue
+                if (ecls, role) in seen:
+                    continue        # one report per (type, role) pair
+                seen.add((ecls, role))
+                suffix = "" if ecls == cls else \
+                    f" (inner op of container frame {cls})"
+                yield Violation(
+                    "PROTO08", fi.rel, line,
+                    f"{ecls} is sent to role {role!r}{suffix} but no "
+                    f"dispatcher in {list(ROLE_MODULES[role])} handles "
+                    f"it (isinstance check missing): the send is a "
+                    f"silent drop on the receiver")
 
 
 # --------------------------------------------------------------- registry
@@ -978,12 +1053,29 @@ RULES: Dict[str, Tuple[str, Callable[[FileInfo], Iterator[Violation]]]] = {
                 check_shard11),
 }
 
+def _seam_rule(rule_id: str):
+    """Late-bound adapter: the seam analysis (devtools/seam.py) builds
+    on this module, so the project-rule entries import it lazily."""
+    def check(files: List[FileInfo]) -> Iterator[Violation]:
+        from ceph_tpu.devtools.seam import analyze
+        for v in analyze(files).violations:
+            if v.rule == rule_id:
+                yield v
+    return check
+
+
 #: project-wide rules: run over the WHOLE linted file set at once
 PROJECT_RULES: Dict[str, Tuple[str,
                                Callable[[List[FileInfo]],
                                         Iterator[Violation]]]] = {
     "PROTO08": ("cross-daemon message graph is exhaustive",
                 check_proto08),
+    "ESC12": ("no shared-mutable state escapes the shard seam "
+              "undeclared", _seam_rule("ESC12")),
+    "PORT13": ("every seam-crossing value is process-portable",
+               _seam_rule("PORT13")),
+    "ATOM14": ("GIL-atomicity reliance sits in declared regions",
+               _seam_rule("ATOM14")),
 }
 
 #: SEND03 is produced by the FP02 scanner (shared dataflow pass) but is

@@ -683,6 +683,12 @@ class Messenger:
         """Batched cross-thread marshalling onto the home loop (one
         call_soon_threadsafe wakeup per burst, not per message)."""
         from ceph_tpu.osd.shards import Courier
+        # gil-atomic:begin _out_courier,_xthread_msgs runs on the
+        # POSTING shard thread by construction: the lazy courier init
+        # races benignly (two shards can each build one; the second
+        # store wins and the loser's courier drains its own posts —
+        # both target the same home loop), and the counter is a
+        # stats-only RMW whose drift under contention is accepted
         courier = self._out_courier
         if courier is None:
             # constructed lazily FROM a shard thread: the home thread
@@ -694,6 +700,7 @@ class Messenger:
                 thread_ident=self._home_thread)
             courier.on_flush = self._note_xthread_flush
         self._xthread_msgs += 1
+        # gil-atomic:end
         courier.post(fn, *args)
 
     def _note_xthread_flush(self, n: int) -> None:

@@ -40,10 +40,13 @@ class _Counters:
         self.reset()
 
     def reset(self) -> None:
+        # gil-atomic:begin encode_calls,encode_bytes,decode_calls,local_msgs
+        # test-scoped reset; plain stores are single GIL steps
         self.encode_calls = 0
         self.encode_bytes = 0
         self.decode_calls = 0
         self.local_msgs = 0
+        # gil-atomic:end
 
 
 _C = _Counters()
@@ -51,16 +54,26 @@ _C = _Counters()
 
 def note_encode(nbytes: int) -> None:
     """One full message body hit a real socket boundary."""
+    # gil-atomic:begin encode_calls,encode_bytes,decode_calls,local_msgs
+    # process-wide stats counters bumped from every loop and shard
+    # thread: the RMW can drop increments under true parallelism —
+    # accepted for stats, but the ZERO-encode guard is exact either
+    # way (a counter that should be 0 gets no increments to lose)
     _C.encode_calls += 1
     _C.encode_bytes += nbytes
+    # gil-atomic:end
 
 
 def note_decode() -> None:
+    # gil-atomic:begin decode_calls same stats-counter discipline
     _C.decode_calls += 1
+    # gil-atomic:end
 
 
 def note_local() -> None:
+    # gil-atomic:begin local_msgs same stats-counter discipline
     _C.local_msgs += 1
+    # gil-atomic:end
 
 
 def counters() -> dict:

@@ -94,7 +94,12 @@ class OSD(Dispatcher):
         self.monc = MonClient(ctx, messenger, monmap)
         self.osdmap = OSDMap()
         self.pgs: Dict[PGId, PG] = {}
-        self._tid = 0
+        # ESC12 fix: `self._tid += 1` was a read-modify-write shared
+        # across shard lanes — two threaded shards could mint the SAME
+        # tid (duplicate sub-op/scrub ids).  itertools.count.__next__
+        # runs in C, so next() is one GIL-atomic step per caller
+        import itertools
+        self._tid = itertools.count(1)
         self._hb_last: Dict[int, float] = {}     # peer osd -> last reply
         self._map_cache: Dict[int, OSDMap] = {}
         self._hb_task: Optional[asyncio.Task] = None
@@ -147,8 +152,7 @@ class OSD(Dispatcher):
         self._shard_ec_queues: Dict[int, object] = {}
 
     def next_tid(self) -> int:
-        self._tid += 1
-        return self._tid
+        return next(self._tid)
 
     def ec_batch_queue(self):
         """The cross-PG EC batch collector for the CURRENT loop.  The
@@ -168,7 +172,13 @@ class OSD(Dispatcher):
                         window_ms=self.cfg["osd_ec_batch_window_ms"],
                         min_device_bytes=self.cfg["osd_ec_batch_min_bytes"],
                         flush_bytes=self.cfg["osd_ec_batch_flush_bytes"])
+                    # gil-atomic:begin _shard_ec_queues per-shard
+                    # lazy init: each shard only ever stores ITS OWN
+                    # key, so concurrent stores from two shard
+                    # threads never collide on a slot; the dict
+                    # insert itself is one GIL-atomic step
                     self._shard_ec_queues[shard.idx] = q
+                    # gil-atomic:end
                 return q
         return self.ec_queue
 
@@ -281,6 +291,9 @@ class OSD(Dispatcher):
         await self.shards.drain()
         self.monc.stop()
         await self.ec_queue.stop()
+        # gil-atomic:begin _shard_ec_queues teardown sweep: shard
+        # pumps are stopped (rings drained above), so no lazy init
+        # races this; the snapshot + clear are single GIL steps
         for idx, q in list(self._shard_ec_queues.items()):
             shard = self.shards.shards[idx]
             if self.shards.threaded and shard.loop is not None:
@@ -293,6 +306,7 @@ class OSD(Dispatcher):
             else:
                 await q.stop()
         self._shard_ec_queues.clear()
+        # gil-atomic:end
         # drain the commit pipeline while the messenger still lives so
         # pending ack callbacks send (or no-op) instead of erroring;
         # a dead commit thread raises from sync() — teardown proceeds,
@@ -342,9 +356,16 @@ class OSD(Dispatcher):
             m = OSDMap.from_bytes(bytes(data))
         except Exception:
             return None
+        # gil-atomic:begin _map_cache memoized decode shared across
+        # shard lanes: a racing store of the same epoch is idempotent
+        # (both decoded the same committed bytes) and a racing evict
+        # at worst double-decodes later; each dict op is one GIL step
         self._map_cache[epoch] = m
         while len(self._map_cache) > 128:
-            self._map_cache.pop(next(iter(self._map_cache)))
+            # default=None: two lanes racing the same oldest key must
+            # both succeed (the read+pop pair is two GIL steps)
+            self._map_cache.pop(next(iter(self._map_cache)), None)
+        # gil-atomic:end
         return m
 
     async def ensure_map_history(self, from_e: int, to_e: int) -> None:
@@ -432,7 +453,12 @@ class OSD(Dispatcher):
         if pg is None:
             return
         if pg.info.is_empty():
+            # gil-atomic:begin pgs registry drop on the PG's home
+            # shard; intake-side readers iterate list() snapshots,
+            # so a concurrent pop only changes WHICH snapshot they
+            # got — one GIL step either way
             self.pgs.pop(pgid).stop()
+            # gil-atomic:end
         else:
             if pgid.pool in m.pools:
                 pg.pool = m.pools[pgid.pool]
@@ -451,7 +477,11 @@ class OSD(Dispatcher):
             pg.create_onstore()
             pg.load_meta()
             pg.generate_past_intervals()
+            # gil-atomic:begin pgs registry insert on the PG's home
+            # shard (fully constructed first); snapshot readers on
+            # other lanes see it atomically or not at all
             self.pgs[pgid] = pg
+            # gil-atomic:end
             pg.start()
         pg.pool = m.pools[pool_id]
         pg.advance_map(m)
@@ -486,7 +516,10 @@ class OSD(Dispatcher):
         pg.load_meta()
         if pg.info.is_empty():
             return None
+        # gil-atomic:begin pgs stray resurrection on the home shard
+        # (peering queries route here), same snapshot discipline
         self.pgs[pgid] = pg
+        # gil-atomic:end
         pg.start()
         pg.advance_map(self.osdmap)
         self.logger.info(f"resurrected stray {pgid} "
@@ -513,7 +546,10 @@ class OSD(Dispatcher):
             self.logger.warning(
                 f"ignoring pg remove for {m.pgid}: we are in up/acting")
             return
+        # gil-atomic:begin pgs registry drop (MPGRemove on the home
+        # shard); one GIL step, snapshot readers unaffected
         self.pgs.pop(pg.pgid, None)
+        # gil-atomic:end
         pg.stop()
         txn = Transaction().remove_collection(pg.cid)
         self.store.apply_transaction(txn)
@@ -1027,15 +1063,22 @@ class OSD(Dispatcher):
             for pg in list(self.pgs.values()):
                 if not pg.is_primary() or pg.state != STATE_ACTIVE:
                     continue
-                # stamp/queue decisions mutate PG state: home shard
-                self.shards.route(pg.pgid, self._sched_scrub_pg, pg,
-                                  now, no_light, no_deep,
+                # stamp/queue decisions mutate PG state: home shard.
+                # PORT13: only the ROUTING KEY crosses the seam — the
+                # home lane re-resolves its own PG (a live reference
+                # cannot exist in the sending process once lanes
+                # split)
+                self.shards.route(pg.pgid, self._sched_scrub_pg,
+                                  pg.pgid, now, no_light, no_deep,
                                   light * 1000, deep * 1000)
 
-    def _sched_scrub_pg(self, pg: PG, now: int, no_light: bool,
+    def _sched_scrub_pg(self, pgid: PGId, now: int, no_light: bool,
                         no_deep: bool, light_ms: float,
                         deep_ms: float) -> None:
         """Home-shard half of the scrub scheduler for one PG."""
+        pg = self.pgs.get(pgid)
+        if pg is None or not pg.is_primary():
+            return      # remapped/removed while the route was in flight
         info = pg.info
         if info.last_scrub_stamp == 0:
             # fresh PG: activation counts as scrubbed (no boot
@@ -1056,7 +1099,6 @@ class OSD(Dispatcher):
     async def _tier_agent_loop(self) -> None:
         """Periodic cache-tier agent: enqueue an agent pass on every
         primary cache-pool PG's worker (serializes with client ops)."""
-        from ceph_tpu.osd import tiering
         from ceph_tpu.osd.pg import STATE_ACTIVE
         interval = self.cfg["osd_tier_agent_interval"]
         while self.running:
@@ -1065,10 +1107,21 @@ class OSD(Dispatcher):
                 if (pg.is_primary() and pg.pool.is_tier()
                         and pg.pool.cache_mode == "writeback"
                         and pg.state == STATE_ACTIVE):
-                    def make(p):
-                        return lambda: tiering.agent_work(p)
-                    # enqueue on the PG's home shard (SHARD11 seam)
-                    self.shards.route(pg.pgid, pg.queue_op, make(pg))
+                    # enqueue on the PG's home shard (SHARD11 seam).
+                    # PORT13: the agent-pass closure is built ON the
+                    # home lane (_queue_agent_pass) — shipping a
+                    # lambda over the seam would capture the live PG
+                    self.shards.route(pg.pgid, self._queue_agent_pass,
+                                      pg.pgid)
+
+    def _queue_agent_pass(self, pgid: PGId) -> None:
+        """Home-shard half of the tier-agent tick: re-resolve the PG
+        and park the agent pass on its worker queue."""
+        from ceph_tpu.osd import tiering
+        pg = self.pgs.get(pgid)
+        if pg is None or not pg.is_primary():
+            return
+        pg.queue_op(lambda: tiering.agent_work(pg))
 
     def _hb_peers(self) -> List[int]:
         peers = set()

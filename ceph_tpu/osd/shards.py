@@ -92,6 +92,10 @@ def resolve_future(fut: asyncio.Future, value=None,
     if running is loop:
         _set()
     else:
+        # the closure captures only the TARGET loop's own future plus
+        # the (value, exc) pair; the process-lane form is an id-keyed
+        # completion record resolved by the owning lane (seam report)
+        # lint: allow[PORT13] target-loop future resolve, id-keyed under process lanes
         loop.call_soon_threadsafe(_set)
 
 
@@ -126,6 +130,10 @@ class Courier:
         self.on_flush: Optional[Callable[[int], None]] = None
 
     def post(self, fn: Callable, *args) -> None:
+        # gil-atomic:begin _ring,_armed lock-free producer: deque
+        # append is one bytecode-visible C op, and the armed
+        # test-and-set races only benignly (at most one spurious
+        # extra wakeup, never a lost item — _drain clears first)
         self._ring.append((fn, args))
         if not self._armed:
             self._armed = True
@@ -133,9 +141,13 @@ class Courier:
                 self.loop.call_soon(self._drain)
             else:
                 self.loop.call_soon_threadsafe(self._drain)
+        # gil-atomic:end
 
     def _drain(self) -> None:
-        self._armed = False      # before draining: no lost wakeups
+        # gil-atomic:begin _ring,_armed consumer half: clear-armed
+        # strictly before draining (no lost wakeups); popleft is
+        # GIL-atomic against concurrent producer appends
+        self._armed = False
         ring = self._ring
         n = 0
         while ring:
@@ -151,6 +163,7 @@ class Courier:
                 import logging
                 logging.getLogger("ceph-tpu.shards").exception(
                     f"courier {self.name}: posted call failed: {fn}")
+        # gil-atomic:end
         if self.on_flush is not None and n:
             self.on_flush(n)
 
@@ -227,6 +240,10 @@ class Shard:
                 loop.call_soon(loop.stop)
 
             try:
+                # teardown control posted to the shard's own loop;
+                # process lanes replace this with a STOP token on the
+                # lane's control queue (seam report)
+                # lint: allow[PORT13] teardown STOP, a control token under process lanes
                 loop.call_soon_threadsafe(finish)
             except RuntimeError:
                 pass
@@ -246,6 +263,12 @@ class Shard:
         """Enqueue one unit of work for this shard, from any thread.
         Lock-free (deque append) + batched wakeup: only the first post
         of a burst schedules the pump."""
+        # gil-atomic:begin ring,_wake_armed lock-free handoff: the
+        # append is GIL-atomic and the wake flag's test-and-set races
+        # only benignly (at most one spurious wakeup; the pump's
+        # clear-before-drain means none is ever lost).  The handoff
+        # perf counters ride the same region (benign count drift is
+        # accepted; exactness would cost a lock on the hot path)
         self.ring.append((fn, args))
         perf = self.plane.perf
         if perf is not None:
@@ -258,11 +281,16 @@ class Shard:
                 self.loop.call_soon(self._wake)
             else:
                 self.loop.call_soon_threadsafe(self._wake)
+        # gil-atomic:end
 
     def _wake(self) -> None:
+        # gil-atomic:begin ring,_wake_armed pump-side flag clear:
+        # strictly before the event set, so a producer racing this
+        # callback re-arms rather than losing its wakeup
         self._wake_armed = False
         if self._evt is not None:
             self._evt.set()
+        # gil-atomic:end
 
     async def _pump(self) -> None:
         """The shard's worker: drains the ring in FIFO order.  Work
@@ -277,13 +305,16 @@ class Shard:
         log = osd.logger
         while not self._stopping:
             if ring:
+                # gil-atomic:begin ring,_wake_armed single consumer:
+                # the ring cannot empty between the check and the pop
+                # (producers only ever append), so popleft against
+                # concurrent GIL-atomic appends is safe.
                 # _busy BEFORE the pop: drain() polls (ring or _busy)
                 # from the intake thread, and a pop-then-set window
-                # would let teardown proceed mid-item.  Single
-                # consumer, so the ring cannot empty between the
-                # check and the pop.
+                # would let teardown proceed mid-item.
                 self._busy = True
                 fn, args = ring.popleft()
+                # gil-atomic:end
                 try:
                     fn(*args)
                 except asyncio.CancelledError:
@@ -403,6 +434,10 @@ class ShardedDataPlane:
             except BaseException as e:   # must cross the thread edge
                 cf.set_exception(e)
 
+        # admin/teardown RPC: the closure captures a concurrent
+        # .futures handle; the process-lane form is a control-queue
+        # call with an id-keyed reply (seam report)
+        # lint: allow[PORT13] admin RPC closure, id-keyed control call under process lanes
         shard.post(run)
         return await asyncio.wrap_future(cf)
 

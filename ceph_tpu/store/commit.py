@@ -215,18 +215,32 @@ class KVSyncThread:
             if self._inline:
                 self._run_group([item])
             else:
+                # commit items carry loop-bound on_commit/post
+                # callbacks; the process-lane form is a completion-
+                # record queue keyed by item idx (seam report)
+                # lint: allow[PORT13] loop-bound commit callbacks, idx-keyed records under process lanes
                 self._q.put([item])
             return
         key = id(loop)
+        # gil-atomic:begin _staged,_flush_scheduled per-loop staging
+        # keyed by id(loop): each loop only ever touches ITS OWN key
+        # from its own thread; the dict inserts themselves are single
+        # GIL steps, so foreign-key traffic (teardown's _flush_staged
+        # sweep) can race only per-key pops, never corrupt the dict
         self._staged.setdefault(key, []).append(item)
         if not self._flush_scheduled.get(key):
             self._flush_scheduled[key] = True
             loop.call_soon(self._flush_one, key)
+        # gil-atomic:end
 
     def _flush_one(self, key: int) -> None:
         """Ship one loop's corked items (runs ON that loop)."""
+        # gil-atomic:begin _staged,_flush_scheduled the per-key pop is
+        # one GIL step: racing the owning loop's own flush is safe —
+        # exactly one side ships each staged list
         self._flush_scheduled[key] = False
         items = self._staged.pop(key, None)
+        # gil-atomic:end
         if not items:
             return
         if self._inline:
@@ -234,6 +248,9 @@ class KVSyncThread:
             # thread handoff, no gather linger — deterministic
             self._run_group(items)
         else:
+            # same loop-bound callback payload as the loop-less
+            # submit path above (seam report)
+            # lint: allow[PORT13] loop-bound commit callbacks, idx-keyed records under process lanes
             self._q.put(items)
 
     def _flush_staged(self) -> None:
@@ -369,6 +386,9 @@ class KVSyncThread:
             self.trace(point, len(group))
         if self.crash_at == point:
             if self.crash_skip > 0:
+                # fault-injection hook: the schedule explorer arms it
+                # on exactly one commit context at a time
+                # lint: allow[ESC12] test hook, single armed commit context by construction
                 self.crash_skip -= 1
             else:
                 raise InjectedCrash(point)
@@ -468,6 +488,11 @@ class KVSyncThread:
                     self._guard(f)
         for loop, fns in by_loop.values():
             try:
+                # completion callbacks are loop-bound closures by
+                # design; process lanes turn this into per-lane
+                # completion records (item idx + status) resolved by
+                # the owning lane (seam report)
+                # lint: allow[PORT13] loop-bound completion callbacks, per-lane records under process lanes
                 loop.call_soon_threadsafe(self._run_callbacks, fns)
             except RuntimeError:
                 for f in fns:

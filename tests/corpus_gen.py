@@ -232,9 +232,49 @@ def samples():
     return out
 
 
+def _import_package():
+    """Import every ceph_tpu module so @register_message side effects
+    populate the codec registry."""
+    import importlib
+    import pkgutil
+    import ceph_tpu
+    for m in pkgutil.walk_packages(ceph_tpu.__path__, "ceph_tpu."):
+        try:
+            importlib.import_module(m.name)
+        except Exception:
+            pass
+
+
+def registry_samples():
+    """samples() plus a default-constructed instance for every wire
+    type registered with the message codec that samples() forgot —
+    MOSDOpBatch needed a hand-written sample in PR 10; this makes
+    forgetting impossible: a new @register_message type either
+    default-constructs into the corpus here or regenerate() fails
+    loudly asking for a hand sample."""
+    _import_package()
+    from ceph_tpu.msg.message import _REGISTRY
+    out = samples()
+    for code in sorted(_REGISTRY):
+        cls = _REGISTRY[code]
+        name = f"{cls.__module__}.{cls.__name__}"
+        if name in out or name in EXCLUDED \
+                or cls.__module__.split(".")[-1].startswith(("test", "conftest")):
+            continue
+        try:
+            out[name] = cls()
+        except Exception as e:
+            raise RuntimeError(
+                f"registered wire type {name} (code {code}) has no "
+                f"corpus sample and is not default-constructible "
+                f"({e!r}): add a hand-written sample to "
+                f"tests/corpus_gen.py samples()") from None
+    return out
+
+
 def regenerate():
     CORPUS_DIR.mkdir(exist_ok=True)
-    for name, obj in sorted(samples().items()):
+    for name, obj in sorted(registry_samples().items()):
         blob = obj.to_bytes()
         (CORPUS_DIR / f"{name}.bin").write_bytes(blob)
         print(f"{name}: {len(blob)} bytes (v{obj.STRUCT_V})")

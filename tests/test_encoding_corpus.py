@@ -66,6 +66,41 @@ def test_corpus_exists_and_covers_every_encodable():
         f"add samples to tests/corpus_gen.py and regenerate")
 
 
+def test_codec_registry_types_all_have_corpus_files():
+    """The corpus-coverage satellite: every wire type registered with
+    the message codec (msg.message._REGISTRY — what the messenger can
+    actually put on a socket) has a committed tests/corpus/*.bin
+    round-trip file, and corpus_gen.registry_samples() can emit a
+    sample for each, so a new @register_message type cannot ship
+    uncovered (MOSDOpBatch got its sample by hand in PR 10 — this
+    makes forgetting impossible)."""
+    corpus_gen._import_package()
+    from ceph_tpu.msg.message import _REGISTRY
+    have = {p.stem for p in CORPUS}
+    missing = []
+    for code, cls in sorted(_REGISTRY.items()):
+        name = f"{cls.__module__}.{cls.__name__}"
+        if name in corpus_gen.EXCLUDED \
+                or cls.__module__.split(".")[-1].startswith(("test", "conftest")):
+            continue
+        if name not in have:
+            missing.append(f"{name} (type {code})")
+    assert not missing, (
+        f"registered wire types without corpus coverage: {missing} — "
+        f"run `python tests/corpus_gen.py` (registry_samples() emits "
+        f"default-constructed samples; hand-write one if construction "
+        f"needs arguments)")
+    # and the generator covers the whole registry, so regenerating
+    # emits every registered type
+    emitted = set(corpus_gen.registry_samples())
+    for code, cls in sorted(_REGISTRY.items()):
+        name = f"{cls.__module__}.{cls.__name__}"
+        if name in corpus_gen.EXCLUDED \
+                or cls.__module__.split(".")[-1].startswith(("test", "conftest")):
+            continue
+        assert name in emitted, name
+
+
 @pytest.mark.parametrize("path", CORPUS, ids=[p.stem for p in CORPUS])
 def test_committed_corpus_round_trips(path):
     cls = _load_type(path.stem)

@@ -49,7 +49,7 @@ def test_cli_entry_point_runs_standalone():
     assert out.returncode == 0, out.stderr
     for rid in ("AF01", "FP02", "SEND03", "BLK04", "MONO05",
                 "LOCK06", "FIN07", "PROTO08", "REPLY09", "EPOCH10",
-                "SHARD11"):
+                "SHARD11", "ESC12", "PORT13", "ATOM14"):
         assert rid in out.stdout
 
 
@@ -75,6 +75,17 @@ def test_cli_json_smoke_schema_roundtrips():
         assert summary["description"]
     # the documented waivers exist (MONO05 persisted stamps etc)
     assert doc["rules"]["MONO05"]["waived"] >= 1
+    # schema v2: per-rule analysis wall time rides the summary
+    for rid, summary in doc["rules"].items():
+        assert "ms" in summary and summary["ms"] >= 0.0, rid
+    # schema v2: the unused-waiver audit ran and every in-source
+    # waiver (the four documented MONO05/EPOCH10 ones included) still
+    # suppresses something — a stale allow is at least a warning
+    assert doc["unused_waivers"] == [], doc["unused_waivers"]
+    assert doc["strict_waivers"] is False
+    # schema v2: the full-package run carries the seam inventory
+    assert doc["seam"]["seam_schema"] >= 1
+    assert doc["seam"]["summary"]["unprotected_structures"] == 0
     # byte-true JSON round trip (CI stores and diffs these)
     assert json.loads(json.dumps(doc)) == doc
 
@@ -521,6 +532,440 @@ def test_proto08_send_osd_and_local_variable_resolution():
         ("osd/daemon.py", osd_missing),
     ])
     assert [v.rule for v in vio] == ["PROTO08"], vio
+
+
+def test_proto08_container_frame_contributes_inner_edges():
+    """The MOSDOpBatch satellite: a THROTTLE_SPLIT envelope's send
+    contributes its INNER (type, role) edges — a receiver that handles
+    only the envelope but not the unpacked inner type is still a
+    silent drop."""
+    messages = (
+        "from ceph_tpu.msg.message import Message, register_message\n"
+        "@register_message\n"
+        "class MFixInner(Message):\n"
+        "    TYPE = 9996\n"
+        "@register_message\n"
+        "class MFixBatch(Message):\n"
+        "    TYPE = 9997\n"
+        "    THROTTLE_SPLIT = True\n"
+        "    @classmethod\n"
+        "    def decode_payload(cls, dec, struct_v):\n"
+        "        return cls([MFixInner.from_bytes(b) "
+        "for b in dec.list_(lambda d: d.bytes_())])\n"
+    )
+    sender = (
+        "class PG:\n"
+        "    def fan_out(self, peer):\n"
+        "        self.osd.send_osd(peer, MFixBatch())\n"
+    )
+    envelope_only = (
+        "class OSD:\n"
+        "    def ms_dispatch(self, m):\n"
+        "        if isinstance(m, MFixBatch):\n"
+        "            return True\n"
+        "        return False\n"
+    )
+    vio = lint_project_sources([
+        ("osd/fixture_messages.py", messages),
+        ("osd/fixture_pg.py", sender),
+        ("osd/daemon.py", envelope_only),
+    ])
+    assert [v.rule for v in vio] == ["PROTO08"], vio
+    assert "MFixInner" in vio[0].msg
+    assert "container frame MFixBatch" in vio[0].msg
+    both = envelope_only.replace("isinstance(m, MFixBatch)",
+                                 "isinstance(m, (MFixBatch, MFixInner))")
+    assert lint_project_sources([
+        ("osd/fixture_messages.py", messages),
+        ("osd/fixture_pg.py", sender),
+        ("osd/daemon.py", both),
+    ]) == []
+
+
+# ===================================== 2b. seam rules (ESC12/PORT13/ATOM14)
+
+
+def test_esc12_cross_side_mutation_without_declaration():
+    """ISSUE 12 tentpole: a structure written from a shard-lane
+    function while the intake side reads it — with no lock, region or
+    waiver — escapes the seam."""
+    src = (
+        "class OSD:\n"
+        "    def __init__(self):\n"
+        "        self.pgs = {}\n"
+        "    def ms_dispatch(self, m):\n"          # intake side reads
+        "        return self.pgs.get(m.pgid)\n"
+        "    def _run_pg(self, m):\n"              # shard side writes
+        "        self.pgs.pop(m.pgid, None)\n"
+        "    def kick(self, m):\n"
+        "        self.shards.route(m.pgid, self._run_pg, m)\n"
+    )
+    vio = lint_project_sources([("osd/daemon.py", src)])
+    assert [v.rule for v in vio] == ["ESC12"], vio
+    assert "pgs" in vio[0].msg
+
+
+def test_esc12_gil_atomic_region_and_lock_pass():
+    declared = (
+        "class OSD:\n"
+        "    def __init__(self):\n"
+        "        self.pgs = {}\n"
+        "    def ms_dispatch(self, m):\n"
+        "        return self.pgs.get(m.pgid)\n"
+        "    def _run_pg(self, m):\n"
+        "        # gil-atomic:begin pgs single GIL-step pop\n"
+        "        self.pgs.pop(m.pgid, None)\n"
+        "        # gil-atomic:end\n"
+        "    def kick(self, m):\n"
+        "        self.shards.route(m.pgid, self._run_pg, m)\n"
+    )
+    assert lint_project_sources([("osd/daemon.py", declared)]) == []
+    locked = declared.replace(
+        "        # gil-atomic:begin pgs single GIL-step pop\n"
+        "        self.pgs.pop(m.pgid, None)\n"
+        "        # gil-atomic:end\n",
+        "        with self._pg_lock:\n"
+        "            self.pgs.pop(m.pgid, None)\n")
+    assert lint_project_sources([("osd/daemon.py", locked)]) == []
+
+
+def test_esc12_rmw_scalar_counter():
+    """An augassign is never atomic whatever the type: a counter
+    bumped from a seam-crossing function is flagged too (the live-tree
+    catch: OSD.next_tid could mint duplicate tids across shards)."""
+    src = (
+        "class OSD:\n"
+        "    def _mint(self, m):\n"
+        "        self._tid += 1\n"
+        "    def ms_dispatch(self, m):\n"
+        "        self.shards.route(m.pgid, self._mint, m)\n"
+    )
+    vio = lint_project_sources([("osd/daemon.py", src)])
+    assert [v.rule for v in vio] == ["ESC12"], vio
+    assert "_tid" in vio[0].msg
+
+
+def test_port13_live_object_reference_crossing_the_seam():
+    """The live-tree catch: a PG object passed as DATA through
+    shards.route cannot exist in the sending process once lanes
+    split — pass the routing key and re-resolve."""
+    src = (
+        "class OSD:\n"
+        "    def ms_dispatch(self, m):\n"
+        "        pg = self._pg_for(m.pgid)\n"
+        "        self.shards.route(m.pgid, self._run_pg, pg)\n"
+        "    def _run_pg(self, pg):\n"
+        "        pass\n"
+    )
+    vio = lint_project_sources([("osd/daemon.py", src)])
+    assert [v.rule for v in vio] == ["PORT13"], vio
+    assert "live shared-object reference" in vio[0].msg
+
+
+def test_port13_closure_and_clean_handoff():
+    closure = (
+        "class OSD:\n"
+        "    def ms_dispatch(self, m):\n"
+        "        self.shards.route(m.pgid, lambda: self.apply(m))\n"
+    )
+    vio = lint_project_sources([("osd/daemon.py", closure)])
+    assert [v.rule for v in vio] == ["PORT13"], vio
+    assert "lambda/closure" in vio[0].msg
+    # the sanctioned shapes: bound method + wire message + routing key
+    clean = (
+        "class OSD:\n"
+        "    def ms_dispatch(self, m):\n"
+        "        self.shards.route(m.pgid, self._run_pg, m)\n"
+        "    def _run_pg(self, m):\n"
+        "        pass\n"
+    )
+    assert lint_project_sources([("osd/daemon.py", clean)]) == []
+    waived = closure.replace(
+        "        self.shards.route",
+        "        # lint: allow[PORT13] fixture waiver\n"
+        "        self.shards.route")
+    assert lint_project_sources([("osd/daemon.py", waived)]) == []
+
+
+def test_port13_keyword_arguments_cannot_evade():
+    """A kwarg-passed live ref or closure crosses the seam exactly
+    like a positional one and must classify the same way."""
+    live_kw = (
+        "class OSD:\n"
+        "    def ms_dispatch(self, m):\n"
+        "        pg = self._pg_for(m.pgid)\n"
+        "        self.shards.route(m.pgid, self._run_pg, pg=pg)\n"
+        "    def _run_pg(self, pg=None):\n"
+        "        pass\n"
+    )
+    vio = lint_project_sources([("osd/daemon.py", live_kw)])
+    assert [v.rule for v in vio] == ["PORT13"], vio
+    closure_kw = (
+        "class OSD:\n"
+        "    def ms_dispatch(self, m):\n"
+        "        self.shards.route(m.pgid, fn=lambda: self.apply(m))\n"
+    )
+    vio = lint_project_sources([("osd/daemon.py", closure_kw)])
+    assert [v.rule for v in vio] == ["PORT13"], vio
+    assert "lambda/closure" in vio[0].msg
+
+
+def test_atom14_write_outside_declared_region():
+    """Once a structure is declared gil-atomic, EVERY write in the
+    module must sit inside a region — the region set stays exhaustive,
+    so the seam inventory it compiles into can be trusted."""
+    src = (
+        "class Shard:\n"
+        "    def __init__(self):\n"          # construction is exempt
+        "        self.ring = []\n"
+        "    def post(self, item):\n"
+        "        # gil-atomic:begin ring single-producer append\n"
+        "        self.ring.append(item)\n"
+        "        # gil-atomic:end\n"
+        "    def sneak(self, item):\n"
+        "        self.ring.append(item)\n"   # outside any region
+    )
+    vio = lint_project_sources([("osd/shards.py", src)])
+    assert [v.rule for v in vio] == ["ATOM14"], vio
+    assert "'ring'" in vio[0].msg
+
+
+def test_atom14_region_hygiene():
+    unbalanced = (
+        "class Shard:\n"
+        "    def post(self, item):\n"
+        "        # gil-atomic:begin ring never closed\n"
+        "        self.ring.append(item)\n"
+    )
+    vio = lint_project_sources([("osd/shards.py", unbalanced)])
+    assert [v.rule for v in vio] == ["ATOM14"], vio
+    missing_reason = (
+        "class Shard:\n"
+        "    def post(self, item):\n"
+        "        # gil-atomic:begin ring\n"
+        "        self.ring.append(item)\n"
+        "        # gil-atomic:end\n"
+    )
+    vio = lint_project_sources([("osd/shards.py", missing_reason)])
+    assert [v.rule for v in vio] == ["ATOM14"], vio
+    assert "reason" in vio[0].msg
+
+
+def test_seam_report_fixture_inventory():
+    """The seam inventory classifies every crossing value and every
+    declared region with source locations (fixture-scale check; the
+    live-tree inventory is covered by the subprocess smoke)."""
+    from ceph_tpu.devtools.rules import FileInfo
+    from ceph_tpu.devtools.seam import SeamAnalysis
+    src = (
+        "class OSD:\n"
+        "    def __init__(self):\n"
+        "        self.pgs = {}\n"
+        "    def ms_dispatch(self, m):\n"
+        "        self.shards.route(m.pgid, self._run_pg, m)\n"
+        "    def _run_pg(self, m):\n"
+        "        # gil-atomic:begin pgs one-GIL-step insert\n"
+        "        self.pgs[m.pgid] = m\n"
+        "        # gil-atomic:end\n"
+    )
+    an = SeamAnalysis([FileInfo("osd/daemon.py", src)])
+    assert an.violations == []
+    rep = an.report()
+    assert rep["seam_schema"] >= 1
+    assert rep["summary"]["sites"] == 1
+    site = rep["sites"][0]
+    assert site["kind"] == "shard-route" and site["line"] == 5
+    classes = {v["class"] for v in site["values"]}
+    assert classes == {"primitive", "home-bound", "wire"}
+    assert rep["gil_atomic_regions"][0]["attrs"] == ["pgs"]
+    (entry,) = rep["shared_state"]
+    assert entry["attr"] == "pgs"
+    assert entry["classification"] == "gil-atomic"
+    assert json.loads(json.dumps(rep)) == rep
+
+
+# ================================ 2c. waiver audit + lint performance
+
+
+def test_unused_waiver_detection_and_strict_promotion():
+    import os
+    import tempfile
+    from ceph_tpu.devtools.lint import lint_report
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "fixture.py")
+        with open(path, "w") as f:
+            f.write("def f():\n"
+                    "    # lint: allow[MONO05] stale: nothing here\n"
+                    "    return 1\n")
+        doc = lint_report([path])
+        assert doc["exit"] == 0      # a stale waiver alone is a warning
+        (uw,) = doc["unused_waivers"]
+        assert uw["rel"].endswith("fixture.py")
+        assert uw["line"] == 2 and uw["rule"] == "MONO05"
+        strict = lint_report([path], strict_waivers=True)
+        assert strict["exit"] == 1 and strict["clean"] is False
+        (vio,) = strict["violations"]
+        assert vio["rule"] == "WAIVER" and "MONO05" in vio["msg"]
+
+
+def test_waiver_usage_is_per_run_despite_parse_cache():
+    """FileInfo objects persist in the parse cache across lint runs;
+    usage recorded by an EARLIER run (or injected) must not mask a
+    waiver that suppresses nothing THIS run."""
+    import os
+    import tempfile
+    from ceph_tpu.devtools import lint as lint_mod
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "fixture.py")
+        with open(path, "w") as f:
+            f.write("def f():\n"
+                    "    # lint: allow[MONO05] stale\n"
+                    "    return 1\n")
+        doc = lint_mod.lint_report([path], strict_waivers=True)
+        assert doc["exit"] == 1          # stale, flagged
+        # simulate a prior run having consumed the waiver: the cached
+        # FileInfo carries stale usage into the next run
+        ap = os.path.abspath(path)
+        fi = lint_mod._FILE_CACHE[ap][2]
+        fi.waiver_used.add(("MONO05", 2))
+        doc = lint_mod.lint_report([path], strict_waivers=True)
+        assert doc["exit"] == 1, \
+            "stale waiver masked by usage leaked from a previous run"
+
+
+def test_live_waiver_is_counted_used_not_stale():
+    import os
+    import tempfile
+    from ceph_tpu.devtools.lint import lint_report
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "fixture.py")
+        with open(path, "w") as f:
+            # FIN07 is not module-scoped, so it fires on any rel path
+            f.write("async def run(self, m, slot):\n"
+                    "    await self.do_op(m)\n"
+                    "    # lint: allow[FIN07] fixture: failure handled upstream\n"
+                    "    self.op_window.release(slot)\n")
+        doc = lint_report([path], strict_waivers=True)
+        assert doc["exit"] == 0, doc["violations"]
+        assert doc["unused_waivers"] == []
+        assert doc["rules"]["FIN07"]["waived"] == 1
+
+
+def test_cli_strict_waivers_live_tree_clean():
+    """The audit satellite's acceptance: every in-source waiver in the
+    live package — the documented MONO05/EPOCH10 set included — still
+    suppresses a real would-be violation even under --strict-waivers."""
+    out = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.devtools.lint",
+         "--strict-waivers", "--json"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    doc = json.loads(out.stdout)
+    assert doc["strict_waivers"] is True
+    assert doc["unused_waivers"] == []
+    # the four pre-seam documented waivers are all live
+    assert doc["rules"]["MONO05"]["waived"] == 3
+    assert doc["rules"]["EPOCH10"]["waived"] == 1
+
+
+def test_lint_parse_cache_cuts_full_tree_wall_time():
+    """The performance satellite: each module parses ONCE into a
+    shared FileInfo cache used by all rules; a second full-tree lint
+    in the same process re-parses nothing and must be faster."""
+    from ceph_tpu.devtools import lint as lint_mod
+    lint_mod._FILE_CACHE.clear()
+    lint_mod.CACHE_STATS.update(hits=0, misses=0)
+    t0 = time.perf_counter()
+    lint_paths()
+    cold = time.perf_counter() - t0
+    misses = lint_mod.CACHE_STATS["misses"]
+    assert misses > 100          # the whole package really parsed
+    # best-of-two warm runs: the drop is structural (no parse, no
+    # seam re-analysis), but a single run can eat a CI scheduler
+    # stall — requiring BOTH to stall before flaking
+    warms = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        lint_paths()
+        warms.append(time.perf_counter() - t0)
+    warm = min(warms)
+    assert lint_mod.CACHE_STATS["misses"] == misses, \
+        "warm lints re-parsed files the cache should have served"
+    assert lint_mod.CACHE_STATS["hits"] >= misses
+    assert warm < cold, (warm, cold)
+
+
+def test_cli_changed_mode_smoke():
+    """--changed lints only git-touched package files (pre-commit
+    mode): exit must be clean whether the worktree is dirty (touched
+    files are part of the clean live tree) or pristine."""
+    out = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.devtools.lint", "--changed"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ==================================== 2d. seam inventory (committed)
+
+
+def test_cli_seam_report_roundtrips_and_matches_committed():
+    """Acceptance: `ceph-tpu-lint --seam-report` emits a
+    schema-versioned JSON inventory of every seam-crossing value,
+    region and shared structure; the committed SEAM_INVENTORY.json is
+    the same inventory structurally (line numbers aside), so the
+    GIL-escape work-list cannot silently rot."""
+    import pathlib
+    from ceph_tpu.devtools.seam import SEAM_SCHEMA
+    out = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.devtools.lint",
+         "--seam-report"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["seam_schema"] == SEAM_SCHEMA
+    assert doc["partial"] is False   # whole-package work-list
+    assert json.loads(json.dumps(doc)) == doc
+    # the structures the ISSUE names are inventoried
+    shared = {(e["module"], e["attr"]): e["classification"]
+              for e in doc["shared_state"]}
+    assert shared[("osd/shards.py", "ring")] == "gil-atomic"
+    assert shared[("osd/shards.py", "_ring")] == "gil-atomic"
+    assert shared[("store/commit.py", "_staged")] == "gil-atomic"
+    assert shared[("osd/daemon.py", "pgs")] == "gil-atomic"
+    assert shared[("msg/payload.py", "encode_calls")] == "gil-atomic"
+    assert shared[("osd/daemon.py", "_waiting_maps")] == "lock"
+    assert doc["summary"]["unprotected_structures"] == 0
+    assert doc["summary"]["sites"] >= 20
+    # every value at every site is classified
+    for site in doc["sites"]:
+        for v in site["values"]:
+            assert v["class"] and v["role"]
+    # committed work-list stays structurally in sync (regenerate with
+    # `python -m ceph_tpu.devtools.lint --seam-report` when it drifts)
+    committed_path = pathlib.Path(__file__).parent.parent \
+        / "SEAM_INVENTORY.json"
+    committed = json.loads(committed_path.read_text())
+    assert committed["seam_schema"] == doc["seam_schema"]
+    assert committed["partial"] is False, \
+        "a partial (--changed / explicit-path) inventory was " \
+        "committed over the whole-package work-list"
+
+    def shape(d):
+        return {
+            "shared": sorted((e["module"], e["class"] or "", e["attr"],
+                              e["classification"])
+                             for e in d["shared_state"]),
+            "regions": sorted((r["rel"], ",".join(r["attrs"]))
+                              for r in d["gil_atomic_regions"]),
+            "sites": sorted((s["rel"], s["kind"],
+                             tuple(sorted(v["class"]
+                                          for v in s["values"])))
+                            for s in d["sites"]),
+        }
+    assert shape(committed) == shape(doc), \
+        "SEAM_INVENTORY.json drifted from the live tree — regenerate " \
+        "with: python -m ceph_tpu.devtools.lint --seam-report > " \
+        "SEAM_INVENTORY.json"
 
 
 # ============================================= 3. runtime lockdep layer
