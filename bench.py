@@ -385,10 +385,15 @@ def stage_ec_e2e():
 
     N_OBJS, OBJ_SIZE, CONC = 192, 64 * 1024, 16
 
-    def ctx_factory(batch_mode, shards=4, op_batching=True):
+    def ctx_factory(batch_mode, shards=4, op_batching=True,
+                    lanes=None):
         def f(name):
             c = make_ctx(name)
             c.config.set("osd_ec_batch_device", batch_mode)
+            if lanes is not None:
+                # lane-backend axis (ISSUE 13): inline | thread |
+                # process shard lanes, same run, same workload
+                c.config.set("osd_shard_lanes", lanes)
             # co-located daemons skip TCP framing/crc/acks entirely
             # (messenger local fast path) — the bench cluster is one
             # process, so per-message socket round trips are pure
@@ -411,11 +416,11 @@ def stage_ec_e2e():
         return f
 
     async def run_once(batch_mode, iodepth=CONC, pg_num=8, shards=4,
-                       op_batching=True):
+                       op_batching=True, lanes=None):
         from ceph_tpu.msg import payload as payload_mod
         payload_mod.reset_counters()
         cl = Cluster(ctx_factory=ctx_factory(batch_mode, shards,
-                                             op_batching))
+                                             op_batching, lanes))
         admin = await cl.start(5)
         # pg_num 8 for the HEADLINE on/off runs (comparable with the
         # r1-r5 recorded series); the op-window axis runs pg_num 4 so
@@ -486,6 +491,7 @@ def stage_ec_e2e():
         qshare = qshare / bd["measured_s"] if bd["measured_s"] else 0.0
         return {
             "shards": shards,
+            "lane_backend": lanes or "auto",
             "op_batching": op_batching,
             "queueing_delivery_share": round(qshare, 3),
             "shard_counters": shard_c,
@@ -613,9 +619,33 @@ def stage_ec_e2e():
     log(f"ec_e2e shards=1 (legacy plane): {sh1}")
     reads = asyncio.run(run_reads())
     log(f"ec_e2e read axis: {reads}")
+    # lane-backend axis (ISSUE 13, ec_e2e_rados_write_lanes_k2m2):
+    # process vs thread vs inline shard lanes at shards=4, same run.
+    # Client-side MB/s + p50/p99 are the comparable numbers on every
+    # arm; the tracer/window/shard counters live inside the lane
+    # WORKERS under the process backend, so those fields honestly
+    # read ~0 there (the parent hosts no PGs).  Thread lanes measured
+    # ~0.6x of inline on this GIL-bound container in the PR-10 run —
+    # the process arm is the escape that axis exists to judge.
+    lane_axis = {}
+    for lane_backend in ("inline", "thread", "process"):
+        if remaining() < 60:
+            log(f"ec_e2e lane axis: skipping {lane_backend} "
+                f"(budget)")
+            break
+        r = asyncio.run(run_once("off", iodepth=16, pg_num=4,
+                                 shards=4, lanes=lane_backend))
+        lane_axis[lane_backend] = r
+        log(f"ec_e2e lanes={lane_backend}: {r['mb_s']} MB/s "
+            f"p50={r['p50_ms']} p99={r['p99_ms']}")
+    if "inline" in lane_axis:
+        base = lane_axis["inline"]["mb_s"] or 1.0
+        for k, r in lane_axis.items():
+            r["vs_inline"] = round(r["mb_s"] / base, 3)
     return {"on": on, "off": off,
             "window_iodepth16": win16, "window_iodepth1": win1,
-            "shards4": sh4, "shards1": sh1, "reads": reads}
+            "shards4": sh4, "shards1": sh1, "reads": reads,
+            "ec_e2e_rados_write_lanes_k2m2": lane_axis}
 
 
 STAGES = {"cpu": stage_cpu, "probe": stage_probe,

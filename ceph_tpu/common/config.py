@@ -281,6 +281,18 @@ DEFAULT_OPTIONS: List[Option] = [
            "8); 1 = the single-loop plane (today's behavior, "
            "bit-for-bit)"),
     Option("osd_op_num_threads_per_shard", "int", 2, ""),
+    Option("osd_shard_lanes", "str", "auto",
+           "shard lane backend: inline (pumps as tasks on the host "
+           "loop), thread (one event-loop thread per shard — the "
+           "msgr-worker split), process (one multiprocessing worker "
+           "per shard fed by shared-memory ring frames: real "
+           "parallelism outside the GIL; osd/lanes.py).  auto = "
+           "thread/inline per osd_shard_threads (the pre-lane knob). "
+           "Forced to inline under the deterministic sim loop."),
+    Option("osd_lane_ring_bytes", "size", "4m",
+           "per-direction shared-memory ring capacity for process "
+           "lanes (osd/laneipc.py); the ring bound IS the handoff "
+           "backpressure"),
     Option("osd_shard_threads", "bool", True,
            "run each shard's event loop on its own thread "
            "(msgr-worker split).  Forced off under the deterministic "

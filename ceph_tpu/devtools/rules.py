@@ -688,8 +688,8 @@ def check_reply09(fi: FileInfo) -> Iterator[Violation]:
 # ------------------------------------------------------------------ EPOCH10
 
 #: method calls that PERSIST or mutate PG/daemon replicated state
-_E10_MUT_CALLS = {"save_meta", "apply_transaction", "queue_transactions",
-                  "apply_push"}
+_E10_MUT_CALLS = {"save_meta", "save_meta_log", "apply_transaction",
+                  "queue_transactions", "apply_push"}
 #: state attributes off self/pg whose assignment (or container
 #: mutation) is a replicated-state write
 _E10_MUT_ATTRS = {"info", "log", "state", "missing", "reqids",
@@ -796,7 +796,8 @@ _S11_MUT_METHODS = {
     "on_query", "on_notify", "on_log_request", "on_pg_log", "on_push",
     "on_push_reply", "on_object_list", "on_notify_ack", "handle_notify",
     "handle_watch", "maybe_trim_snaps", "generate_past_intervals",
-    "load_meta", "create_onstore", "save_meta", "complete_to",
+    "load_meta", "create_onstore", "save_meta", "save_meta_log",
+    "complete_to",
     "append_log", "note_reqid", "try_fast_sub_write"}
 #: calls whose result is a PG object
 _S11_PG_SOURCES = {"_pg_for", "_load_stray_pg"}

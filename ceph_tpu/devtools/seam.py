@@ -79,7 +79,8 @@ SEAM_SCHEMA = 1
 #: the modules whose shared state IS the seam (candidate scope): the
 #: handoff ring, the daemon intake surface, the messenger marshalling
 #: layer, the lazy-payload counters, the commit-thread staging
-SEAM_MODULES = ("osd/shards.py", "osd/daemon.py", "msg/messenger.py",
+SEAM_MODULES = ("osd/shards.py", "osd/daemon.py", "osd/lanes.py",
+                "osd/laneipc.py", "msg/messenger.py",
                 "msg/payload.py", "store/commit.py")
 
 #: call-graph / reachability scope (PROTO08-grade name resolution is
@@ -380,6 +381,14 @@ _PRIMITIVE_NAMES = {
     "no_deep", "light_ms", "deep_ms", "peer_type", "whoami", "nbytes",
     "exc", "code", "rank", "name", "note", "cfg", "config", "light",
     "deep",
+    # idx-keyed completion/commit RECORDS (store/commit.py _Item,
+    # osd/laneipc frame ids): plain-scalar tuples/int lists by
+    # construction — the process-portable replacement for the old
+    # closure-list handoffs the PR-12 waivers marked.  PORT13 extends
+    # its allowlist to the naming convention; the record types
+    # themselves carry only seq/idx/flag scalars (rule catalog: see
+    # README "Invariant sanitizer" PORT13 notes).
+    "rec", "recs", "records", "record",
 }
 _WIRE_NAMES = {
     "m", "msg", "op", "ops", "reply", "req", "rep", "batch", "view",

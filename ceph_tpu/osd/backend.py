@@ -661,7 +661,7 @@ class ReplicatedBackend(PGBackend):
                 # a copy still owed recovery pushes must keep its
                 # honest last_complete cursor, or the gap hides
                 advance = entry.version
-        pg.save_meta(txn)
+        pg.save_meta_log(txn, entry)
         src = int(m.src_name.id)
         reply = MOSDRepOpReply(pg.pgid, m.tid, 0, True,
                                self.osd.whoami)
@@ -1528,7 +1528,7 @@ class ECBackend(PGBackend):
                 # a copy still owed recovery pushes must keep its
                 # honest last_complete cursor, or the gap hides
                 advance = entry.version
-        pg.save_meta(txn)
+        pg.save_meta_log(txn, entry)
         src = int(m.src_name.id)
         reply = MOSDECSubOpWriteReply(pg.pgid, m.tid, 0,
                                       self.my_shard, self.osd.whoami)

@@ -394,8 +394,6 @@ class OSD(Dispatcher):
                 self.store.apply_transaction(txn)
 
     def _on_osdmap(self, osdmap: OSDMap) -> None:
-        self.osdmap = osdmap
-        self._store_map(osdmap)
         if (self.running and osdmap.exists(self.whoami)
                 and not osdmap.is_up(self.whoami)):
             # falsely marked down (missed heartbeats during a stall):
@@ -406,11 +404,31 @@ class OSD(Dispatcher):
                 MOSDBoot(self.whoami, self.messenger.addr),
                 self.monc.monmap.addr_of_rank(self.monc.cur_mon),
                 peer_type="mon")
+        self._apply_map(osdmap)
+        if self.shards.process_lanes is not None:
+            # process lanes: each lane worker hosts its slice of the
+            # PG registry — ship the map and let the lane-side
+            # _advance_pgs run there (the parent hosts no PGs)
+            self.shards.broadcast_map(osdmap)
+
+    def _apply_map(self, osdmap: OSDMap) -> None:
+        """Adopt one full map: store it, advance hosted PGs, release
+        parked messages.  Shared by the daemon's mon subscription and
+        the lane workers' MAP frames (osd/lanes.py)."""
+        self.osdmap = osdmap
+        self._store_map(osdmap)
         self._advance_pgs()
         with self._wm_lock:
             waiting, self._waiting_maps = self._waiting_maps, []
         for m in waiting:
             self.ms_dispatch(m)
+
+    def _lane_filter(self, pgid: PGId) -> bool:
+        """Which PGs THIS runtime hosts: everything for a daemon with
+        in-process lanes; NOTHING for a daemon whose lanes are worker
+        processes (they own the registry); lane workers override to
+        their shard_index slice."""
+        return self.shards.process_lanes is None
 
     def _advance_pgs(self) -> None:
         """Instantiate/advance PGs this osd hosts (handle_osd_map role)."""
@@ -419,6 +437,8 @@ class OSD(Dispatcher):
         for pool_id, pool in m.pools.items():
             for ps in range(pool.pg_num):
                 pgid = PGId(pool_id, ps)
+                if not self._lane_filter(pgid):
+                    continue
                 up, upp, acting, actp = m.pg_to_up_acting_osds(pgid)
                 if self.whoami in acting or self.whoami in up:
                     # EC shard comes from our acting OR up position: an
@@ -945,99 +965,111 @@ class OSD(Dispatcher):
     async def _report_stats(self) -> None:
         """Periodic PG/OSD stats to the mon (MPGStats -> PGMap)."""
         interval = self.cfg["osd_mon_report_interval"]
+        while self.running:
+            await asyncio.sleep(interval)
+            self._send_pg_stats(self._pg_stat_rows())
+
+    def _pg_stat_rows(self) -> List[dict]:
+        """One stats sweep over the hosted primaries (rows merge
+        per-pgid in the mon's PGMap, so lane workers each reporting
+        their slice compose).  The usage cache persists across sweeps
+        on the bound method's daemon."""
         from ceph_tpu.osd.pg import STATE_ACTIVE
         # pg.last_update version -> (num_objects, num_bytes): unchanged
         # PGs skip the store walk, so steady-state reporting is O(PGs)
-        usage_cache: Dict[PGId, tuple] = {}
-        while self.running:
-            await asyncio.sleep(interval)
-            rows = []
-            for pg in list(self.pgs.values()):
-                if not pg.is_primary():
-                    continue
-                # a clean primary still pinned to pg_temp lost its clear
-                # request (mon down / not leader at the time): re-send
-                # until the map reflects it
-                if (pg.is_fully_clean() and self.osdmap.pg_temp.get(
-                        pg.pgid.without_shard())):
-                    pg.send_pg_temp([])
-                ver = (pg.info.last_update.epoch,
-                       pg.info.last_update.version)
-                cached = usage_cache.get(pg.pgid)
-                if cached is not None and cached[0] == ver:
-                    _, n_objs, nbytes = cached
-                else:
-                    try:
-                        from ceph_tpu.osd.backend import SIZE_XATTR
-                        objs = [o for o in
-                                self.store.collection_list(pg.cid)
-                                if o.name != pg.meta_oid.name
-                                and o.is_head()]
+        usage_cache: Dict[PGId, tuple] = getattr(
+            self, "_usage_cache", None) or {}
+        self._usage_cache = usage_cache
+        rows = []
+        for pg in list(self.pgs.values()):
+            if not pg.is_primary():
+                continue
+            # a clean primary still pinned to pg_temp lost its clear
+            # request (mon down / not leader at the time): re-send
+            # until the map reflects it
+            if (pg.is_fully_clean() and self.osdmap.pg_temp.get(
+                    pg.pgid.without_shard())):
+                pg.send_pg_temp([])
+            ver = (pg.info.last_update.epoch,
+                   pg.info.last_update.version)
+            cached = usage_cache.get(pg.pgid)
+            if cached is not None and cached[0] == ver:
+                _, n_objs, nbytes = cached
+            else:
+                try:
+                    from ceph_tpu.osd.backend import SIZE_XATTR
+                    objs = [o for o in
+                            self.store.collection_list(pg.cid)
+                            if o.name != pg.meta_oid.name
+                            and o.is_head()]
 
-                        def _obj_bytes(o):
-                            # EC shards store chunk bytes; the LOGICAL
-                            # object length rides SIZE_XATTR (hinfo
-                            # role) so pool stats report what the
-                            # client stored, not the shard residue.
-                            # Replicated pools never carry the xattr —
-                            # plain stat, no probe.
-                            if not pg.pool.is_erasure():
-                                return self.store.stat(pg.cid,
-                                                       o)["size"]
-                            try:
-                                return int(self.store.getattr(
-                                    pg.cid, o, SIZE_XATTR))
-                            except Exception:
-                                return self.store.stat(pg.cid,
-                                                       o)["size"]
-                        nbytes = sum(_obj_bytes(o) for o in objs)
-                        n_objs = len(objs)
-                        # only cache a SUCCESSFUL walk: recovery pushes
-                        # don't bump last_update, so caching a failed or
-                        # mid-recovery count would freeze the undercount
-                        # until the next client write
-                        usage_cache[pg.pgid] = (ver, n_objs, nbytes)
-                    except Exception:
-                        n_objs, nbytes = 0, 0
-                state = pg.state
-                if state != STATE_ACTIVE and pg.peering_blocked_by:
-                    # surfaced in `ceph -s` / pg dump like the reference's
-                    # down+peering with blocked_by
-                    state = "down+peering"
-                if state == STATE_ACTIVE:
-                    state = "active+clean" if not pg.peer_missing or \
-                        not any(pm.items
-                                for pm in pg.peer_missing.values()) \
-                        else "active+recovering"
-                errors = 0
-                if pg.last_scrub_result:
-                    errors = (pg.last_scrub_result.get("errors", 0)
-                              - pg.last_scrub_result.get("repaired", 0))
-                rows.append({
-                    "pgid": str(pg.pgid.without_shard()),
-                    "state": state,
-                    "num_objects": n_objs,
-                    "num_bytes": nbytes,
-                    "scrub_errors": max(errors, 0),
-                    "log_version": pg.info.last_update.version,
-                    "up": list(pg.up),
-                    "acting": list(pg.acting),
-                })
-            osd_stat = {"num_pgs": len(self.pgs)}
-            if hasattr(self.store, "statfs"):
-                # store capacity for `ceph osd df` (osd_stat_t kb/
-                # kb_used role); MemStore-family reports used only.
-                # hasattr (not except AttributeError): a bug INSIDE a
-                # real statfs must surface, not silently zero the df
-                osd_stat["statfs"] = self.store.statfs()
-            try:
-                self.monc.messenger.send_message(
-                    MPGStats(self.whoami, self.osdmap.epoch, rows,
-                             osd_stat),
-                    self.monc.monmap.addr_of_rank(self.monc.cur_mon),
-                    peer_type="mon")
-            except Exception:
-                pass
+                    def _obj_bytes(o):
+                        # EC shards store chunk bytes; the LOGICAL
+                        # object length rides SIZE_XATTR (hinfo
+                        # role) so pool stats report what the
+                        # client stored, not the shard residue.
+                        # Replicated pools never carry the xattr —
+                        # plain stat, no probe.
+                        if not pg.pool.is_erasure():
+                            return self.store.stat(pg.cid,
+                                                   o)["size"]
+                        try:
+                            return int(self.store.getattr(
+                                pg.cid, o, SIZE_XATTR))
+                        except Exception:
+                            return self.store.stat(pg.cid,
+                                                   o)["size"]
+                    nbytes = sum(_obj_bytes(o) for o in objs)
+                    n_objs = len(objs)
+                    # only cache a SUCCESSFUL walk: recovery pushes
+                    # don't bump last_update, so caching a failed or
+                    # mid-recovery count would freeze the undercount
+                    # until the next client write
+                    usage_cache[pg.pgid] = (ver, n_objs, nbytes)
+                except Exception:
+                    n_objs, nbytes = 0, 0
+            state = pg.state
+            if state != STATE_ACTIVE and pg.peering_blocked_by:
+                # surfaced in `ceph -s` / pg dump like the reference's
+                # down+peering with blocked_by
+                state = "down+peering"
+            if state == STATE_ACTIVE:
+                state = "active+clean" if not pg.peer_missing or \
+                    not any(pm.items
+                            for pm in pg.peer_missing.values()) \
+                    else "active+recovering"
+            errors = 0
+            if pg.last_scrub_result:
+                errors = (pg.last_scrub_result.get("errors", 0)
+                          - pg.last_scrub_result.get("repaired", 0))
+            rows.append({
+                "pgid": str(pg.pgid.without_shard()),
+                "state": state,
+                "num_objects": n_objs,
+                "num_bytes": nbytes,
+                "scrub_errors": max(errors, 0),
+                "log_version": pg.info.last_update.version,
+                "up": list(pg.up),
+                "acting": list(pg.acting),
+            })
+        return rows
+
+    def _send_pg_stats(self, rows: List[dict]) -> None:
+        osd_stat = {"num_pgs": len(self.pgs)}
+        if hasattr(self.store, "statfs"):
+            # store capacity for `ceph osd df` (osd_stat_t kb/
+            # kb_used role); MemStore-family reports used only.
+            # hasattr (not except AttributeError): a bug INSIDE a
+            # real statfs must surface, not silently zero the df
+            osd_stat["statfs"] = self.store.statfs()
+        try:
+            self.monc.messenger.send_message(
+                MPGStats(self.whoami, self.osdmap.epoch, rows,
+                         osd_stat),
+                self.monc.monmap.addr_of_rank(self.monc.cur_mon),
+                peer_type="mon")
+        except Exception:
+            pass
 
     # ---------------------------------------------------------------- scrub
     async def _scrub_scheduler(self) -> None:

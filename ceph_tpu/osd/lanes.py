@@ -1,0 +1,764 @@
+"""Process shard lanes: each shard pump in its own interpreter.
+
+PR 10's sharded data plane proved dispatch structure is no longer the
+write path's ceiling — on a GIL-bound host, thread lanes measure BELOW
+inline lanes because every lane contends for one interpreter.  This
+module is the escape the seam inventory (SEAM_INVENTORY.json) was
+built to de-risk: ``osd_shard_lanes=process`` runs each shard's pump
+in a ``multiprocessing`` worker, fed by shared-memory ring frames
+(osd/laneipc.py), with every seam-crossing value in the form the
+inventory prescribed:
+
+  * work items cross as their byte-identical WIRE encoding (the lazy
+    payload discipline's cheap cross-process form) plus a tiny
+    transport envelope — no closure, live ref, or loop-bound object
+    ever rides a ring;
+  * reply futures resolve BY ID: a lane's control calls (mon map
+    backfill) carry a u64 id, and the parent's answer frame resolves
+    the lane-local future registered under it;
+  * courier counters go PER LANE (frames/bytes/wakeups/stalls per
+    ring, aggregated by ``ShardedDataPlane.counters``);
+  * commit completions are idx-keyed records end to end (the lane
+    hosts its own store + kv path; store/commit.py's completion
+    records are already process-shaped).
+
+Topology: the parent keeps the daemon scope — the real messenger (one
+listening address per OSD), mon session, boot/heartbeats, map store —
+and hosts NO PGs.  Each lane worker is a headless sub-OSD (same class)
+restricted to the PGs whose ``shard_index`` equals its lane: it owns
+their store collections (its own MemStore — volatile, like every
+FAST_CFG daemon), runs their peering/op/scrub paths unchanged, and
+reaches the world through a ``RingMessenger`` whose every send is a
+frame the parent re-sends from its real address.  Inbound, the
+parent's intake classifies PG-bound messages straight onto the owning
+lane's ring — the same ``_ShardIntake`` seam, with the deque swapped
+for shared memory.
+
+Worker lifecycle / crash semantics: workers are SPAWNED (a fork would
+inherit dead XLA threadpools and the parent's live event loop); the
+parent watches each worker's sentinel and a death outside shutdown
+marks the lane dead — subsequent posts and pending id-keyed calls
+raise ``LaneDead`` loudly.  A dead lane never phantom-acks: its
+in-flight client ops simply never answer, and clients resend after
+the mon marks the OSD down (or time out) — exactly a crashed OSD's
+contract, scoped to one lane.
+
+Known v1 limits (documented, asserted where cheap): the cache-tier
+agent and cephx-authenticated client caps do not run inside lanes;
+file-backed stores and ``osd_mesh_mode=on`` are incompatible with
+process lanes (the lane store is lane-local by construction).
+Scheduled scrub and PG stats reporting DO run lane-side — the lanes
+host the PGs, so each worker runs its own scheduler over its slice.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import multiprocessing
+import os
+import time
+from typing import Dict, List, Optional
+
+from ceph_tpu.common.encoding import Decoder, Encoder
+from ceph_tpu.osd.laneipc import (
+    FRAME_BYE, FRAME_MAP, FRAME_MSG, FRAME_OUT, FRAME_PING, FRAME_PONG,
+    FRAME_RESP, FRAME_RPC, FRAME_STATS, FRAME_STOP, LaneDead, ShmRing,
+    pack_frame, unpack_frame)
+
+_log = logging.getLogger("ceph-tpu.osd.lanes")
+
+#: retry cadence when a ring is full (the producer's backpressure
+#: spin; the consumer advertises progress through the head cursor)
+_RETRY_S = 0.001
+
+
+# ------------------------------------------------------------- envelopes
+
+def encode_msg_envelope(m) -> bytes:
+    """Transport envelope + wire body for one message crossing a ring.
+    The envelope carries what the messenger stamps out-of-band (source
+    identity/address, receive stamp, transport id) so the lane-side
+    dispatch sees exactly what a socket delivery would have stamped."""
+    from ceph_tpu.msg.types import EntityAddr, EntityName
+    enc = Encoder()
+    enc.u16(m.get_type())
+    enc.opt_struct(m.src_name if isinstance(m.src_name, EntityName)
+                   else None)
+    enc.opt_struct(m.src_addr if isinstance(m.src_addr, EntityAddr)
+                   else None)
+    enc.f64(m.recv_stamp or 0.0)
+    enc.u64(m.transport_id or 0)
+    enc.u64(getattr(m, "throttle_cost", 0) or 0)
+    enc.bytes_(m.wire_bytes())
+    return enc.getvalue()
+
+
+def decode_msg_envelope(body: bytes):
+    from ceph_tpu.msg.message import message_class
+    from ceph_tpu.msg.types import EntityAddr, EntityName
+    dec = Decoder(body)
+    mtype = dec.u16()
+    src_name = dec.opt_struct(EntityName)
+    src_addr = dec.opt_struct(EntityAddr)
+    recv_stamp = dec.f64()
+    transport_id = dec.u64()
+    throttle_cost = dec.u64()
+    cls = message_class(mtype)
+    if cls is None:
+        raise ValueError(f"unregistered message type {mtype} on ring")
+    m = cls.from_bytes(dec.bytes_())
+    from ceph_tpu.msg import payload as payload_mod
+    payload_mod.note_decode()
+    m.src_name = src_name
+    m.src_addr = src_addr
+    m.recv_stamp = recv_stamp
+    m.transport_id = transport_id or None
+    m.throttle_cost = throttle_cost
+    return m
+
+
+def encode_out_frame(m, addr, peer_type: Optional[str]) -> bytes:
+    """Lane -> parent outbound send: (target addr, peer type, wire)."""
+    enc = Encoder()
+    enc.string(peer_type or "")
+    enc.struct(addr)
+    enc.u16(m.get_type())
+    enc.opt_struct(m.src_name)
+    enc.bytes_(m.wire_bytes())
+    return enc.getvalue()
+
+
+def decode_out_frame(body: bytes):
+    from ceph_tpu.msg.message import message_class
+    from ceph_tpu.msg.types import EntityAddr, EntityName
+    dec = Decoder(body)
+    peer_type = dec.string() or None
+    addr = dec.struct(EntityAddr)
+    mtype = dec.u16()
+    src_name = dec.opt_struct(EntityName)
+    cls = message_class(mtype)
+    if cls is None:
+        raise ValueError(f"unregistered message type {mtype} on ring")
+    m = cls.from_bytes(dec.bytes_())
+    from ceph_tpu.msg import payload as payload_mod
+    payload_mod.note_decode()
+    if src_name is not None:
+        m.src_name = src_name
+    return m, addr, peer_type
+
+
+# ------------------------------------------------------------ parent side
+
+class ProcessLane:
+    """Parent-side handle for one lane worker: the rings, the wake
+    channels, the worker process, and the id-keyed control futures.
+    Duck-types the slice of ``Shard`` the routing seam touches
+    (``post``/``on_shard``/``ring``) so ``ShardedDataPlane.route``
+    stays one code path."""
+
+    ring = ()            # route()'s fast-path probe: never "queued work
+    _busy = False        # visible in-parent" — lanes drain via ping()
+
+    def __init__(self, plane, idx: int):
+        self.plane = plane
+        self.idx = idx
+        self.osd = plane.osd
+        cap = int(self.osd.cfg["osd_lane_ring_bytes"])
+        self.to_lane = ShmRing(capacity=cap, create=True)
+        self.from_lane = ShmRing(capacity=cap, create=True)
+        # wake channels (mp.Pipe connections pickle across spawn)
+        self._to_wake_r, self._to_wake_w = multiprocessing.Pipe(False)
+        self._from_wake_r, self._from_wake_w = multiprocessing.Pipe(False)
+        self.proc: Optional[multiprocessing.Process] = None
+        self.dead = False
+        self._stopping = False
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._pending: Dict[int, asyncio.Future] = {}   # id-keyed
+        self._next_id = 1
+        from collections import deque
+        self._overflow = deque()            # frames awaiting ring space
+        self._retry_handle = None
+        self.stat_rows: List[dict] = []     # last lane-reported pg rows
+        self._byed = False
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        osd = self.osd
+        spec = {
+            "whoami": osd.whoami,
+            "lane": self.idx,
+            "num_lanes": self.plane.num_shards,
+            "cfg": osd.cfg.dump(),
+            "monmap": osd.monc.monmap.to_bytes(),
+            "addr": osd.messenger.addr.to_bytes(),
+            "to_lane": self.to_lane.name,
+            "from_lane": self.from_lane.name,
+            "ring_bytes": self.to_lane.capacity,
+        }
+        ctx = multiprocessing.get_context("spawn")
+        self.proc = ctx.Process(
+            target=lane_main,
+            args=(spec, self._to_wake_r, self._from_wake_w),
+            daemon=True,
+            name=f"osd{osd.whoami}-lane{self.idx}")
+        self.proc.start()
+        self._loop = asyncio.get_running_loop()
+        self._loop.add_reader(self._from_wake_r.fileno(), self._on_wake)
+        self._loop.add_reader(self.proc.sentinel, self._on_exit)
+        # consumer half of the no-lost-wakeup handshake (laneipc):
+        # advertise parked; _on_wake clears while draining
+        self.from_lane.advertise_waiting(True)
+
+    async def stop(self, timeout: float = 20.0) -> None:
+        self._stopping = True
+        if self.proc is not None and self.proc.is_alive():
+            self._push(pack_frame(FRAME_STOP))
+            deadline = time.monotonic() + timeout
+            while (self.proc.is_alive()
+                   and time.monotonic() < deadline):
+                self._on_wake()
+                await asyncio.sleep(0.01)
+            if self.proc.is_alive():
+                _log.error("lane %d did not stop in %.0fs; killing",
+                           self.idx, timeout)
+                self.proc.terminate()
+            self.proc.join(timeout=5.0)
+        self._teardown_io()
+
+    def _teardown_io(self) -> None:
+        if self._loop is not None:
+            try:
+                self._loop.remove_reader(self._from_wake_r.fileno())
+            except Exception:
+                pass
+            if self.proc is not None:
+                try:
+                    self._loop.remove_reader(self.proc.sentinel)
+                except Exception:
+                    pass
+        for conn in (self._to_wake_r, self._to_wake_w,
+                     self._from_wake_r, self._from_wake_w):
+            try:
+                conn.close()
+            except Exception:
+                pass
+        self.to_lane.close()
+        self.to_lane.unlink()
+        self.from_lane.close()
+        self.from_lane.unlink()
+
+    def _on_exit(self) -> None:
+        """Worker sentinel fired: clean only during stop().  Anything
+        else is a crash — fail LOUDLY, never phantom-ack."""
+        if self._loop is not None and self.proc is not None:
+            try:
+                self._loop.remove_reader(self.proc.sentinel)
+            except Exception:
+                pass
+        if self._stopping:
+            return
+        self.dead = True
+        _log.error(
+            "osd.%d shard lane %d worker died (exit=%s); its PGs are "
+            "offline until daemon restart — in-flight ops will error, "
+            "not phantom-ack", self.osd.whoami, self.idx,
+            self.proc.exitcode if self.proc else "?")
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(LaneDead(
+                    f"lane {self.idx} worker died"))
+        self._pending.clear()
+
+    # -------------------------------------------------------------- sending
+    def _push(self, frame: bytes) -> None:
+        if self.dead:
+            raise LaneDead(f"lane {self.idx} worker is dead")
+        if self._overflow or not self.to_lane.try_push(frame):
+            # ring full: keep FIFO order through the overflow queue
+            self._overflow.append(frame)
+            self._arm_retry()
+            return
+        self._wake_lane()
+
+    def _wake_lane(self) -> None:
+        if self.to_lane.peer_waiting():
+            try:
+                self._to_wake_w.send_bytes(b"w")
+            except (BrokenPipeError, OSError):
+                pass
+
+    def _arm_retry(self) -> None:
+        if self._retry_handle is None and self._loop is not None:
+            self._retry_handle = self._loop.call_later(
+                _RETRY_S, self._drain_overflow)
+
+    def _drain_overflow(self) -> None:
+        self._retry_handle = None
+        if self.dead:
+            self._overflow.clear()
+            return
+        pushed = False
+        while self._overflow:
+            if not self.to_lane.try_push(self._overflow[0]):
+                self._arm_retry()
+                break
+            self._overflow.popleft()
+            pushed = True
+        if pushed:
+            self._wake_lane()
+
+    # Shard-compatible routing surface -----------------------------------
+    def on_shard(self) -> bool:
+        return False
+
+    def post(self, fn, *args) -> None:
+        """The routing seam's entry: only the classify seam's
+        home-bound dispatch callable has a cross-process form; every
+        other (control-plane) callable runs inline on the parent,
+        where its PG lookups are no-ops — lanes own the PGs."""
+        osd = self.osd
+        if fn == osd._dispatch_pg_msg:
+            m = args[0]
+            try:
+                self._push(pack_frame(FRAME_MSG,
+                                      encode_msg_envelope(m)))
+            except LaneDead:
+                # drop, like a crashed OSD would: the death was
+                # already logged loudly and the client resends/times
+                # out.  Raising here would unwind the messenger
+                # reader (killing the connection for HEALTHY lanes
+                # too) and leak the intake budget below.
+                pass
+            # the ring bound is the backpressure now: release the
+            # intake budget the parent took at classify time
+            osd.messenger.put_dispatch_throttle(m)
+            return
+        fn(*args)
+
+    def post_map(self, osdmap) -> None:
+        self._push(pack_frame(FRAME_MAP, osdmap.to_bytes()))
+
+    async def ping(self, timeout: float = 10.0):
+        """Id-keyed quiesce probe: resolves after the lane has drained
+        every frame posted before it (ring FIFO)."""
+        rid = self._next_id
+        self._next_id += 1
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        self._push(pack_frame(FRAME_PING, Encoder().u64(rid).getvalue()))
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(rid, None)
+
+    # ------------------------------------------------------------ receiving
+    def _on_wake(self) -> None:
+        ring = self.from_lane
+        ring.advertise_waiting(False)
+        try:
+            while self._from_wake_r.poll():
+                self._from_wake_r.recv_bytes()
+        except (EOFError, OSError):
+            pass
+        while True:
+            for frame in ring.drain():
+                try:
+                    self._handle_frame(frame)
+                except Exception:
+                    _log.exception("lane %d frame failed", self.idx)
+            # re-advertise BEFORE the emptiness re-check: a producer
+            # racing the drain either sees waiting=1 (sends a byte)
+            # or we see its data here and loop again
+            ring.advertise_waiting(True)
+            if ring.backlog_bytes == 0:
+                return
+            ring.advertise_waiting(False)
+
+    def _handle_frame(self, frame: bytes) -> None:
+        kind, body = unpack_frame(frame)
+        osd = self.osd
+        if kind == FRAME_OUT:
+            m, addr, peer_type = decode_out_frame(body)
+            osd.messenger.send_message(m, addr, peer_type=peer_type)
+        elif kind == FRAME_RPC:
+            dec = Decoder(body)
+            rid = dec.u64()
+            cmd = json.loads(dec.bytes_().decode())
+            asyncio.get_running_loop().create_task(
+                self._serve_rpc(rid, cmd))
+        elif kind == FRAME_PONG:
+            rid = Decoder(body).u64()
+            fut = self._pending.get(rid)
+            if fut is not None and not fut.done():
+                fut.set_result(True)
+        elif kind == FRAME_STATS:
+            self.stat_rows = json.loads(body.decode())
+        elif kind == FRAME_BYE:
+            self._byed = True
+
+    async def _serve_rpc(self, rid: int, cmd: dict) -> None:
+        """Mon control calls on the lane's behalf (the lane has no mon
+        session of its own); the reply resolves the lane-local future
+        registered under ``rid``."""
+        status, outbl = 0, b""
+        try:
+            ack = await self.osd.monc.command(cmd, timeout=15.0)
+            outbl = ack.outbl or b""
+        except Exception as e:
+            status = -1
+            outbl = str(e).encode()
+        enc = Encoder().u64(rid).s32(status)
+        enc.bytes_(outbl)
+        try:
+            self._push(pack_frame(FRAME_RESP, enc.getvalue()))
+        except LaneDead:
+            pass
+
+    # ---------------------------------------------------------- inspection
+    def counters(self) -> dict:
+        return {
+            "to_lane_frames": self.to_lane.pushed,
+            "to_lane_bytes": self.to_lane.push_bytes,
+            "to_lane_stalls": self.to_lane.full_stalls,
+            "from_lane_backlog": self.from_lane.backlog_bytes,
+            "overflow_pending": len(self._overflow),
+            "dead": self.dead,
+        }
+
+
+# ------------------------------------------------------------ worker side
+
+class RingMessenger:
+    """The lane's messenger-shaped endpoint: every outbound send
+    becomes a FRAME_OUT the parent re-sends from the OSD's real
+    address; inbound messages arrive pre-classified from the parent's
+    intake, so no listening socket, reader task, or throttle exists
+    here.  Implements exactly the surface the OSD/PG/monc code
+    touches."""
+
+    def __init__(self, runtime: "LaneRuntime", addr):
+        self.runtime = runtime
+        self.addr = addr            # the PARENT's bound address
+        self.dispatchers: List = []
+        self.dispatch_throttle = None
+        self.shard_router = None
+        self.verify_authorizer_cb = None
+        self.require_authorizer = False
+        # ShardedDataPlane.counters reads these on any backend
+        self._xthread_msgs = 0
+        self._xthread_flushes = 0
+
+    def add_dispatcher(self, d) -> None:
+        self.dispatchers.append(d)
+
+    def set_policy(self, *a, **kw) -> None:
+        pass
+
+    def send_message(self, msg, addr, peer_type: Optional[str] = None
+                     ) -> None:
+        if addr is None:
+            return
+        if msg.src_name is None:
+            msg.src_name = self.runtime.entity_name
+        self.runtime.push(pack_frame(
+            FRAME_OUT, encode_out_frame(msg, addr, peer_type)))
+
+    def put_dispatch_throttle(self, msg) -> None:
+        # intake budget lives (and was already released) parent-side
+        if getattr(msg, "throttle_cost", 0):
+            msg.throttle_cost = 0
+
+    def get_connection(self, addr):
+        return None
+
+    def mark_down(self, addr) -> None:
+        pass
+
+    async def shutdown(self) -> None:
+        pass
+
+    def dispatch_inbound(self, m) -> None:
+        for d in self.dispatchers:
+            try:
+                if d.ms_dispatch(m):
+                    return
+            except Exception:
+                _log.exception("lane dispatch failed: %r", m)
+        _log.warning("lane: no dispatcher took %r", m)
+
+
+class LaneOSD:
+    """Constructed in the worker via :func:`_make_lane_osd` — a real
+    ``OSD`` instance with lane overrides bound post-construction (the
+    OSD class is not imported at module scope to keep spawn cost off
+    the parent's import path)."""
+
+
+def _make_lane_osd(ctx, runtime: "LaneRuntime", store, monmap):
+    from ceph_tpu.osd.daemon import OSD
+    from ceph_tpu.osd.shards import shard_index
+
+    class _LaneOSD(OSD):
+        def _lane_filter(self, pgid) -> bool:
+            return shard_index(pgid, runtime.num_lanes) == runtime.lane
+
+        async def ensure_map_history(self, from_e: int,
+                                     to_e: int) -> None:
+            """Map-history holes are filled by an id-keyed control
+            call to the parent (the lane has no mon session): the
+            reply frame resolves the future registered under the
+            call id — the seam inventory's prescribed form for the
+            reply-future seam."""
+            from ceph_tpu.store.types import CollectionId, ObjectId
+            from ceph_tpu.osd.osdmap import OSDMap
+            from ceph_tpu.store.objectstore import Transaction
+            cid = CollectionId.meta()
+            for e in range(max(1, from_e), to_e):
+                if self.store.exists(cid, ObjectId(f"osdmap.{e}")):
+                    continue
+                try:
+                    outbl = await runtime.rpc(
+                        {"prefix": "osd getmap", "epoch": e})
+                except Exception as ex:
+                    self.logger.warning(
+                        f"lane could not backfill osdmap e{e}: {ex}")
+                    continue
+                if outbl:
+                    txn = Transaction()
+                    if not self.store.collection_exists(cid):
+                        txn.create_collection(cid)
+                    txn.write(cid, ObjectId(f"osdmap.{e}"), 0, outbl)
+                    self.store.apply_transaction(txn)
+                    OSDMap.from_bytes(outbl)   # validate before trust
+
+    osd = _LaneOSD(ctx, runtime.whoami, store, runtime.messenger,
+                   monmap)
+    return osd
+
+
+class LaneRuntime:
+    """Worker-process runtime: rings, wake handshake, the headless
+    sub-OSD, and the pump that turns inbound frames into dispatches."""
+
+    def __init__(self, spec: dict, to_wake_r, from_wake_w):
+        import threading
+        self.whoami = spec["whoami"]
+        #: guards the id-keyed future table + overflow queue.  The
+        #: whole runtime lives on one loop in its own process, but the
+        #: seam tiling cannot see process boundaries — a real lock
+        #: documents (and future-proofs) the affinity at ~zero cost
+        self._mu = threading.Lock()
+        self.lane = spec["lane"]
+        self.num_lanes = spec["num_lanes"]
+        self.spec = spec
+        cap = int(spec.get("ring_bytes", 0))
+        self.to_lane = ShmRing(name=spec["to_lane"],
+                               capacity=cap)              # we consume
+        self.from_lane = ShmRing(name=spec["from_lane"],
+                                 capacity=cap)            # we produce
+        self._wake_r = to_wake_r
+        self._wake_w = from_wake_w
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.osd = None
+        self.messenger: Optional[RingMessenger] = None
+        self.entity_name = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_id = 1
+        self._stopping = False
+        from collections import deque
+        self._overflow = deque()
+        self._retry_handle = None
+
+    # ------------------------------------------------------------- outbound
+    def push(self, frame: bytes) -> None:
+        with self._mu:
+            if self._overflow or not self.from_lane.try_push(frame):
+                self._overflow.append(frame)
+                if self._retry_handle is None \
+                        and self.loop is not None:
+                    self._retry_handle = self.loop.call_later(
+                        _RETRY_S, self._drain_overflow)
+                return
+        self._wake_parent()
+
+    def _drain_overflow(self) -> None:
+        pushed = False
+        with self._mu:
+            self._retry_handle = None
+            while self._overflow:
+                if not self.from_lane.try_push(self._overflow[0]):
+                    self._retry_handle = self.loop.call_later(
+                        _RETRY_S, self._drain_overflow)
+                    break
+                self._overflow.popleft()
+                pushed = True
+        if pushed:
+            self._wake_parent()
+
+    def _wake_parent(self) -> None:
+        if self.from_lane.peer_waiting():
+            try:
+                self._wake_w.send_bytes(b"w")
+            except (BrokenPipeError, OSError):
+                pass
+
+    async def rpc(self, cmd: dict, timeout: float = 15.0) -> bytes:
+        fut = asyncio.get_running_loop().create_future()
+        with self._mu:
+            rid = self._next_id
+            self._next_id += 1
+            self._pending[rid] = fut
+        enc = Encoder().u64(rid)
+        enc.bytes_(json.dumps(cmd).encode())
+        self.push(pack_frame(FRAME_RPC, enc.getvalue()))
+        try:
+            status, outbl = await asyncio.wait_for(fut, timeout)
+        finally:
+            with self._mu:
+                self._pending.pop(rid, None)
+        if status != 0:
+            raise RuntimeError(outbl.decode(errors="replace"))
+        return outbl
+
+    # -------------------------------------------------------------- inbound
+    def _on_wake(self) -> None:
+        try:
+            while self._wake_r.poll():
+                self._wake_r.recv_bytes()
+        except (EOFError, OSError):
+            pass
+        self._pump()
+
+    def _pump(self) -> None:
+        ring = self.to_lane
+        ring.advertise_waiting(False)
+        while True:
+            for frame in ring.drain():
+                try:
+                    self._handle_frame(frame)
+                except Exception:
+                    _log.exception("lane %d: inbound frame failed",
+                                   self.lane)
+            # same handshake as the parent side: re-advertise before
+            # the emptiness re-check so no producer push is lost
+            ring.advertise_waiting(True)
+            if ring.backlog_bytes == 0:
+                return
+            ring.advertise_waiting(False)
+
+    def _handle_frame(self, frame: bytes) -> None:
+        kind, body = unpack_frame(frame)
+        if kind == FRAME_MSG:
+            self.messenger.dispatch_inbound(decode_msg_envelope(body))
+        elif kind == FRAME_MAP:
+            from ceph_tpu.osd.osdmap import OSDMap
+            self.osd._apply_map(OSDMap.from_bytes(body))
+        elif kind == FRAME_RESP:
+            dec = Decoder(body)
+            rid = dec.u64()
+            status = dec.s32()
+            outbl = dec.bytes_()
+            fut = self._pending.get(rid)
+            if fut is not None and not fut.done():
+                fut.set_result((status, outbl))
+        elif kind == FRAME_PING:
+            rid = Decoder(body).u64()
+            self.push(pack_frame(FRAME_PONG,
+                                 Encoder().u64(rid).getvalue()))
+        elif kind == FRAME_STOP:
+            self._stopping = True
+
+    # ------------------------------------------------------------ lifecycle
+    async def run(self) -> None:
+        from ceph_tpu.common.context import Context
+        from ceph_tpu.mon.monmap import MonMap
+        from ceph_tpu.msg.types import EntityAddr, EntityName
+        from ceph_tpu.store.memstore import MemStore
+        self.loop = asyncio.get_running_loop()
+        spec = self.spec
+        ctx = Context(f"osd.{self.whoami}")
+        ctx.config.set_many(spec["cfg"])
+        # the lane is single-loop inside: its own plane stays disabled
+        ctx.config.set("osd_op_num_shards", 1)
+        ctx.config.set("osd_shard_lanes", "inline")
+        self.entity_name = EntityName("osd", str(self.whoami))
+        addr = EntityAddr.from_bytes(spec["addr"])
+        monmap = MonMap.from_bytes(spec["monmap"])
+        self.messenger = RingMessenger(self, addr)
+        store = MemStore()
+        store.mkfs()
+        store.ack_on_apply = True
+        self.osd = _make_lane_osd(ctx, self, store, monmap)
+        osd = self.osd
+        store.mount()
+        osd.shards.start()        # disabled plane: inline route()
+        osd.running = True
+        # stats reporting: compute rows like the daemon would and ship
+        # them BOTH to the mon (via the ring messenger, rows merge
+        # per-pgid in the PGMap) and to the parent (FRAME_STATS, for
+        # local introspection)
+        stats_task = self.loop.create_task(self._stats_loop())
+        # scheduled scrub runs WHERE the PGs live: the parent's
+        # scheduler iterates an empty registry under process lanes
+        osd._scrub_task = self.loop.create_task(
+            osd._scrub_scheduler())
+        self.loop.add_reader(self._wake_r.fileno(), self._on_wake)
+        self.to_lane.advertise_waiting(True)
+        self._pump()              # anything posted before we armed
+        ppid = os.getppid()
+        try:
+            while not self._stopping:
+                await asyncio.sleep(0.2)
+                self._pump()      # belt: poll alongside wakeups
+                if os.getppid() != ppid:
+                    _log.error("lane %d: parent died; exiting",
+                               self.lane)
+                    return
+        finally:
+            stats_task.cancel()
+            if osd._scrub_task is not None:
+                osd._scrub_task.cancel()
+            self.to_lane.advertise_waiting(False)
+            try:
+                self.loop.remove_reader(self._wake_r.fileno())
+            except Exception:
+                pass
+            # graceful: stop PGs, flush the lane store, say BYE
+            osd.running = False
+            for pg in list(osd.pgs.values()):
+                pg.stop()
+            try:
+                store.sync()
+            except Exception:
+                pass
+            try:
+                self.push(pack_frame(FRAME_BYE))
+            except Exception:
+                pass
+            self._drain_overflow()
+
+    async def _stats_loop(self) -> None:
+        interval = float(self.osd.cfg["osd_mon_report_interval"])
+        while not self._stopping:
+            await asyncio.sleep(interval)
+            try:
+                rows = self.osd._pg_stat_rows()
+                self.push(pack_frame(
+                    FRAME_STATS, json.dumps(rows).encode()))
+                self.osd._send_pg_stats(rows)
+            except Exception:
+                _log.exception("lane %d stats tick failed", self.lane)
+
+
+def lane_main(spec: dict, to_wake_r, from_wake_w) -> None:
+    """Worker entry point (spawned).  Builds a fresh event loop and
+    runs the lane runtime until STOP or parent death."""
+    logging.basicConfig(level=logging.WARNING)
+    runtime = LaneRuntime(spec, to_wake_r, from_wake_w)
+    try:
+        asyncio.run(runtime.run())
+    finally:
+        runtime.to_lane.close()
+        runtime.from_lane.close()

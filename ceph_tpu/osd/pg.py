@@ -47,6 +47,18 @@ from ceph_tpu.store.objectstore import Transaction
 from ceph_tpu.store.types import CollectionId, ObjectId
 
 STATE_RESET = "reset"
+
+
+def _check_unfrozen(txn: Transaction) -> None:
+    # copy discipline (msg/payload.py): a txn received over
+    # ms_local_delivery is the SENDER'S sealed object — appending our
+    # meta ops to it would leak into the primary and every sibling
+    # replica.  Receivers must use m.txn() (mutable copy); a real
+    # raise (not an -O-strippable assert) turns a violation into a
+    # loud failure instead of silent cross-daemon corruption.
+    if getattr(txn, "frozen", False):
+        raise ValueError(
+            "save_meta on a frozen payload-shared txn — use m.txn()")
 STATE_PEERING = "peering"
 STATE_ACTIVE = "active"
 
@@ -142,6 +154,11 @@ class PG:
         from ceph_tpu.osd.backend import ECBackend, ReplicatedBackend
         self.backend = (ECBackend(self) if pool.is_erasure()
                         else ReplicatedBackend(self))
+        # incremental pglog persistence (osd/PGLog.cc omap-write role):
+        # appends since the last full `log` blob snapshot, compacted
+        # back into the blob every META_COMPACT_EVERY appends so the
+        # per-entry key range stays bounded (see save_meta_log)
+        self._meta_log_appends = 0
 
     # ----------------------------------------------------------- utilities
     def is_primary(self) -> bool:
@@ -192,21 +209,41 @@ class PG:
                 f"lu {self.info.last_update}")
 
     # --------------------------------------------------------- persistence
+    #: appends between full-blob compactions: the per-entry key range
+    #: holds at most this many entries beyond the `log` blob snapshot,
+    #: and the O(len(log)) re-encode is amortized to O(1) per write
+    META_COMPACT_EVERY = 2 * PGLog.MAX_ENTRIES
+
+    @staticmethod
+    def _log_entry_key(version: EVersion) -> bytes:
+        """Sortable per-entry omap key (fixed-width hex: byte order ==
+        version order, so load_meta's overlay and the compaction
+        rmkeyrange both work on plain key ranges)."""
+        return b"loge.%08x.%016x" % (version.epoch, version.version)
+
+    def _loghead_bytes(self) -> bytes:
+        """The small head record written on EVERY incremental append:
+        authoritative (tail, head) bounds, so load_meta can trim
+        entries the in-memory log dropped without the full blob ever
+        being rewritten."""
+        from ceph_tpu.common.encoding import Encoder
+        return Encoder().struct(self.log.tail).struct(
+            self.log.head).getvalue()
+
     def save_meta(self, txn: Transaction) -> None:
         from ceph_tpu.common.encoding import Encoder
-        # copy discipline (msg/payload.py): a txn received over
-        # ms_local_delivery is the SENDER'S sealed object — appending
-        # our meta ops to it would leak into the primary and every
-        # sibling replica.  Receivers must use m.txn() (mutable copy);
-        # a real raise (not an -O-strippable assert) turns a violation
-        # into a loud failure instead of silent cross-daemon corruption.
-        if getattr(txn, "frozen", False):
-            raise ValueError(
-                "save_meta on a frozen payload-shared txn — use m.txn()")
+        _check_unfrozen(txn)
         txn.touch(self.cid, self.meta_oid)
+        # full snapshot: the per-entry append keys are superseded by
+        # the fresh blob — drop the whole range so a later load can't
+        # overlay stale entries a rewind/merge just removed
+        txn.omap_rmkeyrange(self.cid, self.meta_oid,
+                            b"loge.", b"loge.\xff")
+        self._meta_log_appends = 0
         txn.omap_setkeys(self.cid, self.meta_oid, {
             b"info": self.info.to_bytes(),
             b"log": self.log.to_bytes(),
+            b"loghead": self._loghead_bytes(),
             b"past_intervals": Encoder().list_(
                 self.past_intervals,
                 lambda e, v: e.struct(v)).getvalue(),
@@ -219,6 +256,33 @@ class PG:
                 lambda e, v: e.struct(v)).getvalue(),
         })
 
+    def save_meta_log(self, txn: Transaction,
+                      entry: Optional[LogEntry] = None) -> None:
+        """Incremental meta persistence for the WRITE path (osd/
+        PGLog.cc incremental omap writes): one per-entry key (its
+        framed bytes are already cached on the entry) + the O(1)
+        info/loghead head — instead of re-encoding the whole
+        `log`/`missing` blobs on every write, which profiled as the
+        single biggest per-op CPU slice at shards=4.  Non-log state
+        (missing, past_intervals) only changes on peering/recovery
+        paths, which still go through the full save_meta().
+
+        Every META_COMPACT_EVERY appends the full snapshot is
+        rewritten and the append range cleared, bounding both the
+        omap key count and load_meta's overlay work."""
+        if entry is None or \
+                self._meta_log_appends >= self.META_COMPACT_EVERY:
+            self.save_meta(txn)
+            return
+        _check_unfrozen(txn)
+        self._meta_log_appends += 1
+        txn.touch(self.cid, self.meta_oid)
+        txn.omap_setkeys(self.cid, self.meta_oid, {
+            self._log_entry_key(entry.version): entry.framed_bytes(),
+            b"info": self.info.to_bytes(),
+            b"loghead": self._loghead_bytes(),
+        })
+
     def load_meta(self) -> None:
         try:
             _, omap = self.osd.store.omap_get(self.cid, self.meta_oid)
@@ -228,6 +292,25 @@ class PG:
             self.info = PGInfo.from_bytes(omap[b"info"])
         if b"log" in omap:
             self.log = PGLog.from_bytes(omap[b"log"])
+        # overlay the incremental append keys (newer than the blob
+        # snapshot; fixed-width keys sort in version order) — a store
+        # written by the legacy layout simply has none
+        for k in sorted(k for k in omap if k.startswith(b"loge.")):
+            e = LogEntry.from_bytes(omap[k])
+            if self.log.head < e.version:
+                self.log.append(e)
+        if b"loghead" in omap:
+            from ceph_tpu.common.encoding import Decoder
+            d = Decoder(omap[b"loghead"])
+            tail = d.struct(EVersion)
+            if self.log.tail < tail:
+                # the in-memory log trimmed past the blob's tail while
+                # only incremental heads were written: honor the
+                # recorded bound (entries <= tail are no longer owed)
+                self.log.entries = [e for e in self.log.entries
+                                    if tail < e.version]
+                self.log.tail = tail
+        if self.log.entries or b"log" in omap:
             self.reqids = self.log.reqids()
         if b"past_intervals" in omap:
             from ceph_tpu.common.encoding import Decoder
@@ -1609,7 +1692,7 @@ class PG:
         self.log.append(entry)
         self.note_reqid(entry)
         self.info.last_update = entry.version
-        self.save_meta(txn)
+        self.save_meta_log(txn, entry)
 
     def complete_to(self, version: EVersion) -> None:
         """Store commit callback: the txn carrying this log entry is

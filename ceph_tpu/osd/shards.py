@@ -67,36 +67,36 @@ def shard_index(pgid, n: int) -> int:
     return zlib.crc32(b"%d.%d" % (base.pool, base.seed)) % n
 
 
+def _set_future(fut: asyncio.Future, value, exc) -> None:
+    """The target-loop half of resolve_future: runs ON the loop that
+    owns ``fut`` (the done re-check closes the cancel race).  A plain
+    module-level function — what crosses the loop seam is (function,
+    future, value, exc), the id-keyed record shape process lanes use
+    (osd/lanes.py resolves its control futures the same way)."""
+    if fut.done():
+        return
+    if exc is not None:
+        fut.set_exception(exc)
+    else:
+        fut.set_result(value)
+
+
 def resolve_future(fut: asyncio.Future, value=None,
                    exc: Optional[BaseException] = None) -> None:
     """Resolve a future that may belong to ANOTHER shard's loop.
     Daemon-level reply handlers (mon client, tier client) run on the
     intake loop while the awaiting coroutine lives on a PG's home
     shard; setting a foreign loop's future directly is not
-    thread-safe, so the set is posted to the owning loop (the done
-    re-check runs there too, closing the cancel race)."""
+    thread-safe, so the set is posted to the owning loop."""
     loop = fut.get_loop()
     try:
         running = asyncio.get_running_loop()
     except RuntimeError:
         running = None
-
-    def _set() -> None:
-        if fut.done():
-            return
-        if exc is not None:
-            fut.set_exception(exc)
-        else:
-            fut.set_result(value)
-
     if running is loop:
-        _set()
+        _set_future(fut, value, exc)
     else:
-        # the closure captures only the TARGET loop's own future plus
-        # the (value, exc) pair; the process-lane form is an id-keyed
-        # completion record resolved by the owning lane (seam report)
-        # lint: allow[PORT13] target-loop future resolve, id-keyed under process lanes
-        loop.call_soon_threadsafe(_set)
+        loop.call_soon_threadsafe(_set_future, fut, value, exc)
 
 
 class Courier:
@@ -168,6 +168,16 @@ class Courier:
             self.on_flush(n)
 
 
+def _call_and_resolve(fut, fn: Callable, *args) -> None:
+    """Target-lane half of ShardedDataPlane.call: run the forwarded
+    callable and resolve the concurrent.futures handle (exceptions
+    cross the thread edge through it)."""
+    try:
+        fut.set_result(fn(*args))
+    except BaseException as e:
+        fut.set_exception(e)
+
+
 class Shard:
     """One shard: a FIFO work ring + the pump that drains it, on the
     shard's own event loop (its own thread when the plane is
@@ -227,24 +237,21 @@ class Shard:
             self._evt = asyncio.Event()
             self._pump_task = host_loop.create_task(self._pump())
 
+    def _finish_stop(self) -> None:
+        """Teardown control, run ON the shard's own loop (the bound
+        method IS the portable form: routing key + method name — the
+        process-lane analogue is the STOP control frame)."""
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+        self.loop.call_soon(self.loop.stop)
+
     async def stop(self) -> None:
         """Stop the pump (and the shard thread).  Posted work already
         in the ring drains first; the caller has stopped the PGs."""
         self._stopping = True
         if self._thread is not None:
-            loop = self.loop
-
-            def finish() -> None:
-                if self._pump_task is not None:
-                    self._pump_task.cancel()
-                loop.call_soon(loop.stop)
-
             try:
-                # teardown control posted to the shard's own loop;
-                # process lanes replace this with a STOP token on the
-                # lane's control queue (seam report)
-                # lint: allow[PORT13] teardown STOP, a control token under process lanes
-                loop.call_soon_threadsafe(finish)
+                self.loop.call_soon_threadsafe(self._finish_stop)
             except RuntimeError:
                 pass
             self._thread.join(timeout=30.0)
@@ -361,6 +368,15 @@ class ShardedDataPlane:
         self.num_shards = max(1, n)
         self.enabled = self.num_shards > 1
         self.threaded = False
+        # lane backend (osd_shard_lanes = inline | thread | process):
+        # "auto" preserves the pre-lane knob (osd_shard_threads)
+        lanes = str(cfg["osd_shard_lanes"] or "auto")
+        if lanes == "auto":
+            lanes = "thread" if cfg["osd_shard_threads"] else "inline"
+        self.lane_backend = lanes
+        #: the backend actually running (sim forces inline; see start)
+        self.active_backend = "inline"
+        self.process_lanes: Optional[List] = None
         self.shards: List[Shard] = [Shard(self, i)
                                     for i in range(self.num_shards)]
         self.perf = None
@@ -377,23 +393,53 @@ class ShardedDataPlane:
         self._host_loop = loop
         if not self.enabled:
             return
-        # threads are forced OFF under the deterministic sim loop: the
-        # schedule explorer owns every interleaving, and a real thread
-        # would be the one wakeup source it cannot permute or replay
-        self.threaded = bool(self.osd.cfg["osd_shard_threads"]) \
-            and not getattr(loop, "deterministic", False)
+        backend = self.lane_backend
+        # thread AND process lanes are forced OFF under the
+        # deterministic sim loop: the schedule explorer owns every
+        # interleaving, and a real thread or worker process would be
+        # the one wakeup source it cannot permute or replay — under
+        # SIM every lane backend degrades to inline pumps the seeded
+        # scheduler interleaves like any other task
+        if getattr(loop, "deterministic", False):
+            backend = "inline"
+        self.active_backend = backend
+        if backend == "process":
+            from ceph_tpu.osd.lanes import ProcessLane
+            self.process_lanes = [ProcessLane(self, i)
+                                  for i in range(self.num_shards)]
+            for lane in self.process_lanes:
+                lane.start()
+            self.threaded = False
+            return
+        self.threaded = backend == "thread"
         for s in self.shards:
             s.start(loop, self.threaded)
 
     async def stop(self) -> None:
         if not self.enabled:
             return
+        if self.process_lanes is not None:
+            for lane in self.process_lanes:
+                await lane.stop()
+            self.process_lanes = None
+            return
         for s in self.shards:
             await s.stop()
 
     # -------------------------------------------------------------- routing
-    def shard_for(self, pgid) -> Shard:
-        return self.shards[shard_index(pgid, self.num_shards)]
+    def shard_for(self, pgid):
+        idx = shard_index(pgid, self.num_shards)
+        if self.process_lanes is not None:
+            return self.process_lanes[idx]
+        return self.shards[idx]
+
+    def broadcast_map(self, osdmap) -> None:
+        """Process lanes: ship each new full map to every lane worker
+        (the per-lane _advance_pgs runs THERE, against the lane's own
+        PG registry and store)."""
+        if self.process_lanes is not None:
+            for lane in self.process_lanes:
+                lane.post_map(osdmap)
 
     def route(self, pgid, fn: Callable, *args) -> None:
         """Run fn(*args) on pgid's home shard.  Inline when the plane
@@ -422,29 +468,33 @@ class ShardedDataPlane:
     async def call(self, shard: Shard, fn: Callable, *args):
         """Run fn on a shard and await its result from a foreign
         loop (used by teardown and admin introspection)."""
-        if not self.enabled or (shard.loop is self._host_loop
+        if not self.enabled or (getattr(shard, "loop", None)
+                                is self._host_loop
                                 and shard.on_shard()):
             return fn(*args)
         import concurrent.futures
-        cf: "concurrent.futures.Future" = concurrent.futures.Future()
-
-        def run() -> None:
-            try:
-                cf.set_result(fn(*args))
-            except BaseException as e:   # must cross the thread edge
-                cf.set_exception(e)
-
-        # admin/teardown RPC: the closure captures a concurrent
-        # .futures handle; the process-lane form is a control-queue
-        # call with an id-keyed reply (seam report)
-        # lint: allow[PORT13] admin RPC closure, id-keyed control call under process lanes
-        shard.post(run)
-        return await asyncio.wrap_future(cf)
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+        # id-keyed admin RPC shape: what crosses the seam is (module
+        # function, future handle, forwarded callable+args) — the
+        # target lane runs fn and resolves the handle (process lanes
+        # use the FRAME_RPC/FRAME_RESP pair for the same contract)
+        shard.post(_call_and_resolve, fut, fn, *args)
+        return await asyncio.wrap_future(fut)
 
     async def drain(self) -> None:
         """Wait until every shard's ring is empty (quiesce aid for
-        tests and the schedule explorer)."""
+        tests and the schedule explorer).  Process lanes quiesce via
+        the id-keyed ping: the pong proves every frame posted before
+        it was consumed (ring FIFO)."""
         if not self.enabled:
+            return
+        if self.process_lanes is not None:
+            for lane in self.process_lanes:
+                if not lane.dead:
+                    try:
+                        await lane.ping()
+                    except Exception:
+                        pass     # dead/stopping lane: nothing to drain
             return
         while any(s.ring or s._busy for s in self.shards):
             # inline lanes: yield so the pumps (same loop) can run;
@@ -460,9 +510,14 @@ class ShardedDataPlane:
             d = self.perf.dump()
         d["num_shards"] = self.num_shards
         d["threaded"] = self.threaded
+        d["lane_backend"] = self.active_backend
         # shard->messenger marshalling (sends + throttle releases
         # posted back to the intake loop, corked per burst)
         msgr = self.osd.messenger
         d["outbound_msgs"] = msgr._xthread_msgs
         d["outbound_flushes"] = msgr._xthread_flushes
+        if self.process_lanes is not None:
+            # courier counters go PER LANE (frames/bytes/stalls each)
+            d["lanes"] = {lane.idx: lane.counters()
+                          for lane in self.process_lanes}
         return d

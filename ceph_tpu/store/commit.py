@@ -54,19 +54,23 @@ OBSERVER: Optional[Callable[[str, str, List[int]], None]] = None
 
 
 class _Item:
-    __slots__ = ("seq", "wrote_data", "on_commit", "post", "loop", "t0",
-                 "idx")
+    """One staged transaction's PORTABLE commit record: plain scalars
+    only.  The loop-bound on_commit/post closures never ride the
+    kv-sync queue — they stay in the submitter-side ``_cbs`` table
+    keyed by ``idx``, and completion crosses back as an idx-keyed
+    record the owning lane resolves (the process-lane form the seam
+    inventory prescribed)."""
 
-    def __init__(self, seq, wrote_data, on_commit, post, loop, idx=0):
+    __slots__ = ("seq", "wrote_data", "t0", "idx")
+
+    def __init__(self, seq, wrote_data, idx=0):
         self.seq = seq
         self.wrote_data = wrote_data
-        self.on_commit = on_commit
-        self.post = post
-        self.loop = loop
         self.t0 = time.perf_counter()
         #: process-unique submission index (the seq field is
         #: store-assigned and 0 for RAM stores): the explorer's
-        #: phantom-ack check keys on this
+        #: phantom-ack check keys on this, and the callback table
+        #: (_cbs) is keyed by it
         self.idx = idx
 
 
@@ -135,6 +139,12 @@ class KVSyncThread:
         # needs to be checked against
         self.perf.add_hist("commit_lat_hist")
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_max)
+        #: idx -> (on_commit, post, loop): the submitter-side half of
+        #: the idx-keyed completion records.  Closures never cross the
+        #: kv-sync seam — _complete ships idx lists back to each loop
+        #: and _run_completion_records resolves them HERE, under the
+        #: same lock every side already takes for _submitted
+        self._cbs: dict = {}
         self._thread: Optional[threading.Thread] = None
         # lockdep-wrapped when the sanitizer is on: the commit thread
         # holds this while the event loop submits, so an ordering slip
@@ -210,16 +220,16 @@ class KVSyncThread:
         with self._lock:
             self._submitted += 1
             idx = self._submitted
-        item = _Item(seq, wrote_data, on_commit, post, loop, idx=idx)
+            if on_commit is not None or post is not None:
+                self._cbs[idx] = (on_commit, post, loop)
+        rec = _Item(seq, wrote_data, idx=idx)
         if loop is None:
             if self._inline:
-                self._run_group([item])
+                self._run_group([rec])
             else:
-                # commit items carry loop-bound on_commit/post
-                # callbacks; the process-lane form is a completion-
-                # record queue keyed by item idx (seam report)
-                # lint: allow[PORT13] loop-bound commit callbacks, idx-keyed records under process lanes
-                self._q.put([item])
+                # the record is plain scalars (seq/wrote_data/idx/t0):
+                # the loop-bound callbacks stayed in _cbs on this side
+                self._q.put([rec])
             return
         key = id(loop)
         # gil-atomic:begin _staged,_flush_scheduled per-loop staging
@@ -227,7 +237,7 @@ class KVSyncThread:
         # from its own thread; the dict inserts themselves are single
         # GIL steps, so foreign-key traffic (teardown's _flush_staged
         # sweep) can race only per-key pops, never corrupt the dict
-        self._staged.setdefault(key, []).append(item)
+        self._staged.setdefault(key, []).append(rec)
         if not self._flush_scheduled.get(key):
             self._flush_scheduled[key] = True
             loop.call_soon(self._flush_one, key)
@@ -239,19 +249,16 @@ class KVSyncThread:
         # one GIL step: racing the owning loop's own flush is safe —
         # exactly one side ships each staged list
         self._flush_scheduled[key] = False
-        items = self._staged.pop(key, None)
+        recs = self._staged.pop(key, None)
         # gil-atomic:end
-        if not items:
+        if not recs:
             return
         if self._inline:
             # sim mode: the loop-pass cork IS the commit group; no
             # thread handoff, no gather linger — deterministic
-            self._run_group(items)
+            self._run_group(recs)
         else:
-            # same loop-bound callback payload as the loop-less
-            # submit path above (seam report)
-            # lint: allow[PORT13] loop-bound commit callbacks, idx-keyed records under process lanes
-            self._q.put(items)
+            self._q.put(recs)
 
     def _flush_staged(self) -> None:
         """Ship the CALLING loop's corked items now (flush()/stop()
@@ -462,45 +469,60 @@ class KVSyncThread:
 
     def _finish(self, group: List[_Item]) -> None:
         """Crashed path: account the items so flush() can't hang, but
-        run NO callbacks — these transactions never committed."""
+        run NO callbacks — these transactions never committed.  Their
+        completion records are PURGED (not delivered): a dead commit
+        thread must never phantom-ack."""
         self._notify("crashed", group)
         with self._cv:
+            for it in group:
+                self._cbs.pop(it.idx, None)
             self._completed += len(group)
             self._cv.notify_all()
 
     def _complete(self, group: List[_Item]) -> None:
         self._notify("callbacks", group)
         # completions post PER SHARD LOOP, batched: one
-        # call_soon_threadsafe wakeup per (loop, group) carrying every
-        # callback for that loop in submission order — under the
-        # sharded data plane the kv-sync thread would otherwise pay
-        # one cross-thread wakeup per transaction
+        # call_soon_threadsafe wakeup per (loop, group) carrying the
+        # idx-keyed completion RECORDS for that loop in submission
+        # order — plain ints; the owning lane resolves them against
+        # its _cbs half (the process-portable form of the old
+        # closure-list handoff).  One wakeup per (loop, group), never
+        # one per transaction.
         by_loop: dict = {}
-        for it in group:
-            fns = [f for f in (it.on_commit, it.post) if f is not None]
-            if not fns:
+        direct: List[int] = []
+        with self._lock:
+            metas = [(it.idx, self._cbs.get(it.idx)) for it in group]
+        for idx, meta in metas:
+            if meta is None:
                 continue
-            if it.loop is not None and not it.loop.is_closed():
-                by_loop.setdefault(id(it.loop), (it.loop, []))[1] \
-                    .extend(fns)
+            loop = meta[2]
+            if loop is not None and not loop.is_closed():
+                by_loop.setdefault(id(loop), (loop, []))[1].append(idx)
             else:
-                for f in fns:
-                    self._guard(f)
-        for loop, fns in by_loop.values():
+                direct.append(idx)
+        if direct:
+            # no submitting loop (tools, teardown): resolve on the
+            # commit thread itself, still in order
+            self._run_completion_records(direct)
+        for loop, records in by_loop.values():
             try:
-                # completion callbacks are loop-bound closures by
-                # design; process lanes turn this into per-lane
-                # completion records (item idx + status) resolved by
-                # the owning lane (seam report)
-                # lint: allow[PORT13] loop-bound completion callbacks, per-lane records under process lanes
-                loop.call_soon_threadsafe(self._run_callbacks, fns)
+                loop.call_soon_threadsafe(
+                    self._run_completion_records, records)
             except RuntimeError:
-                for f in fns:
-                    self._guard(f)   # loop closed mid-flight
+                self._run_completion_records(records)  # loop closed
 
-    def _run_callbacks(self, fns: List[Callable[[], None]]) -> None:
-        for f in fns:
-            self._guard(f)
+    def _run_completion_records(self, records: List[int]) -> None:
+        """Resolve idx-keyed completion records on the owning lane:
+        pop each idx's callbacks from the submitter-side table and run
+        them in record (== submission) order."""
+        for idx in records:
+            with self._lock:
+                meta = self._cbs.pop(idx, None)
+            if meta is None:
+                continue
+            for f in meta[:2]:
+                if f is not None:
+                    self._guard(f)
 
     @staticmethod
     def _guard(fn: Callable[[], None]) -> None:

@@ -1,0 +1,239 @@
+"""Shared-memory ring + process-lane seam (ISSUE 13): osd/laneipc.py.
+
+Coverage map:
+  * frame round-trip — FIFO order, wrap-around at the capacity
+    boundary, byte-exact payloads across sizes;
+  * backpressure — a full ring refuses frames (no overwrite, no drop)
+    and drains make room again; an over-capacity frame is a hard
+    error;
+  * wakeup handshake — the waiting flag halves compose so a producer
+    burst against a parked consumer yields a wake signal and a burst
+    against a busy one yields none;
+  * envelope codecs — a message crossing a ring keeps its transport
+    stamps and wire-identical payload;
+  * worker crash — a dead lane turns posts into LOUD LaneDead
+    failures and in-flight ops error instead of phantom-acking.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from ceph_tpu.osd.laneipc import (FRAME_MSG, LaneDead, ShmRing,
+                                  pack_frame, unpack_frame)
+
+
+# ---------------------------------------------------------- ring basics
+
+def test_ring_fifo_roundtrip_and_wraparound():
+    ring = ShmRing(capacity=256, create=True)
+    peer = ShmRing(name=ring.name)
+    try:
+        # many pushes of varying size force several wraps of a 256B
+        # ring; every frame must come out byte-exact, in order
+        sent = []
+        i = 0
+        for round_ in range(40):
+            payload = bytes([i & 0xFF]) * (1 + (i * 7) % 90)
+            assert ring.try_push(payload)
+            sent.append(payload)
+            i += 1
+            if i % 3 == 0:
+                for exp in sent:
+                    assert peer.try_pop() == exp
+                sent = []
+        for exp in sent:
+            assert peer.try_pop() == exp
+        assert peer.try_pop() is None
+    finally:
+        peer.close()
+        ring.close()
+        ring.unlink()
+
+
+def test_ring_backpressure_refuses_and_recovers():
+    ring = ShmRing(capacity=64, create=True)
+    peer = ShmRing(name=ring.name)
+    try:
+        assert ring.try_push(b"x" * 40)
+        # 40+4 used of 64: a 30B frame (34 with header) cannot fit
+        assert not ring.try_push(b"y" * 30)
+        assert ring.full_stalls == 1
+        assert peer.try_pop() == b"x" * 40
+        assert ring.try_push(b"y" * 30)         # room again
+        assert peer.try_pop() == b"y" * 30
+        # an over-capacity frame could NEVER fit: hard error, not spin
+        with pytest.raises(ValueError):
+            ring.try_push(b"z" * 100)
+    finally:
+        peer.close()
+        ring.close()
+        ring.unlink()
+
+
+def test_ring_wakeup_handshake_flag_halves():
+    ring = ShmRing(capacity=256, create=True)
+    peer = ShmRing(name=ring.name)
+    try:
+        # consumer not parked: producer burst sees waiting=0
+        assert not ring.peer_waiting()
+        ring.try_push(b"a")
+        assert not ring.peer_waiting()
+        # consumer parks: advertise, then re-check (the drain)
+        peer.advertise_waiting(True)
+        assert peer.try_pop() == b"a"
+        assert ring.peer_waiting()          # producer now sends a byte
+        peer.advertise_waiting(False)
+        assert not ring.peer_waiting()
+    finally:
+        peer.close()
+        ring.close()
+        ring.unlink()
+
+
+def test_frame_kind_tagging():
+    f = pack_frame(FRAME_MSG, b"body")
+    kind, body = unpack_frame(f)
+    assert kind == FRAME_MSG and body == b"body"
+
+
+# ----------------------------------------------------- envelope codecs
+
+def test_msg_envelope_roundtrip_keeps_stamps_and_payload():
+    from ceph_tpu.msg.types import EntityAddr, EntityName
+    from ceph_tpu.osd.lanes import (decode_msg_envelope,
+                                    encode_msg_envelope)
+    from ceph_tpu.osd.messages import MOSDOp, OSDOp, OP_WRITEFULL
+    from ceph_tpu.osd.types import PGId
+    m = MOSDOp(pgid=PGId(3, 2), oid="obj-a", tid=7,
+               ops=[OSDOp(OP_WRITEFULL, data=b"payload-bytes")])
+    m.src_name = EntityName("client", "4711")
+    m.src_addr = EntityAddr("127.0.0.1", 6801, nonce=99)
+    m.recv_stamp = 123.5
+    m.transport_id = 17
+    m.throttle_cost = 256
+    got = decode_msg_envelope(encode_msg_envelope(m))
+    assert type(got) is MOSDOp
+    assert got.tid == 7 and got.oid == "obj-a"
+    assert got.pgid.without_shard() == PGId(3, 2)
+    assert str(got.src_name) == str(m.src_name)
+    assert got.src_addr.port == 6801 and got.src_addr.nonce == 99
+    assert got.recv_stamp == 123.5 and got.transport_id == 17
+    assert got.throttle_cost == 256
+    assert got.ops[0].data == b"payload-bytes"
+
+
+def test_out_frame_roundtrip():
+    from ceph_tpu.msg.types import EntityAddr
+    from ceph_tpu.osd.lanes import decode_out_frame, encode_out_frame
+    from ceph_tpu.osd.messages import MOSDOpReply
+    reply = MOSDOpReply(9, 0, map_epoch=5)
+    addr = EntityAddr("127.0.0.1", 6805, nonce=3)
+    m, got_addr, peer_type = decode_out_frame(
+        encode_out_frame(reply, addr, "client"))
+    assert type(m) is MOSDOpReply and m.tid == 9
+    assert got_addr.port == 6805 and peer_type == "client"
+
+
+# ------------------------------------------------------- crash = LOUD
+
+def test_dead_lane_posts_raise_loudly_no_phantom_acks():
+    """A ProcessLane whose worker died must raise LaneDead on post and
+    fail its pending id-keyed calls — never quietly accept work."""
+    from ceph_tpu.osd.lanes import ProcessLane
+
+    class _Plane:
+        num_shards = 2
+
+        class osd:      # the slice ProcessLane.__init__ touches
+            class cfg:
+                @staticmethod
+                def __getitem__(k):
+                    raise KeyError
+
+    async def run():
+        plane = _Plane()
+        plane.osd = type("O", (), {})()
+        plane.osd.cfg = {"osd_lane_ring_bytes": 1 << 16}
+        plane.osd.whoami = 0
+        lane = ProcessLane.__new__(ProcessLane)
+        lane.plane = plane
+        lane.idx = 0
+        lane.osd = plane.osd
+        lane.to_lane = ShmRing(capacity=1 << 16, create=True)
+        lane.from_lane = ShmRing(capacity=1 << 16, create=True)
+        lane.proc = None
+        lane.dead = False
+        lane._stopping = False
+        lane._loop = asyncio.get_running_loop()
+        lane._pending = {}
+        lane._next_id = 1
+        lane._overflow = []
+        lane._retry_handle = None
+        # a pending id-keyed call, then the worker "dies"
+        fut = asyncio.get_running_loop().create_future()
+        lane._pending[1] = fut
+        lane._on_exit()
+        assert lane.dead
+        with pytest.raises(LaneDead):
+            lane._push(b"\x01frame")
+        with pytest.raises(LaneDead):
+            await fut                      # pending call failed LOUDLY
+        lane.to_lane.close()
+        lane.to_lane.unlink()
+        lane.from_lane.close()
+        lane.from_lane.unlink()
+
+    asyncio.run(run())
+
+
+def test_cross_process_ring_smoke():
+    """One real child process echoes frames back: proves the shm
+    segment + cursors work across a process boundary (not just across
+    two attachments in one process)."""
+    import multiprocessing
+
+    ring_in = ShmRing(capacity=1 << 14, create=True)
+    ring_out = ShmRing(capacity=1 << 14, create=True)
+    ctx = multiprocessing.get_context("spawn")
+    p = ctx.Process(target=_ring_echo_child,
+                    args=(ring_in.name, ring_out.name))
+    p.start()
+    try:
+        import time
+        for i in range(5):
+            assert ring_in.try_push(b"frame-%d" % i)
+        got = []
+        deadline = time.monotonic() + 20
+        while len(got) < 5 and time.monotonic() < deadline:
+            f = ring_out.try_pop()
+            if f is None:
+                time.sleep(0.002)
+                continue
+            got.append(f)
+        assert got == [(b"frame-%d" % i)[::-1] for i in range(5)]
+    finally:
+        p.join(timeout=10)
+        assert not p.is_alive()
+        ring_in.close()
+        ring_in.unlink()
+        ring_out.close()
+        ring_out.unlink()
+
+
+def _ring_echo_child(a: str, b: str) -> None:
+    import time
+    rin = ShmRing(name=a)
+    rout = ShmRing(name=b)
+    deadline = time.monotonic() + 20
+    echoed = 0
+    while echoed < 5 and time.monotonic() < deadline:
+        got = rin.try_pop()
+        if got is None:
+            time.sleep(0.002)
+            continue
+        rout.try_push(got[::-1])
+        echoed += 1
+    rin.close()
+    rout.close()

@@ -346,3 +346,59 @@ def test_sanitizer_fully_off_path_when_disabled():
     # pipelining engages and the local path encodes nothing
     assert win["mean_inflight_depth"] > 1.0, win
     assert enc["msg_encode_calls"] == 0, enc
+
+
+def test_save_meta_bytes_per_write_are_o1_in_log_length():
+    """ISSUE 13 guard: the write path's meta persistence must stay
+    O(1) in log length.  save_meta_log at a ~100-entry log and at a
+    ~1200-entry log must encode about the same number of omap bytes
+    (one cached entry frame + info + loghead) — the old full-blob
+    save grew linearly and profiled as the biggest per-op CPU slice.
+    The full snapshot (peering-time save_meta) is the contrast: it
+    MUST still grow with the log."""
+    from ceph_tpu.osd.messages import EVersion
+    from ceph_tpu.osd.pglog import LogEntry
+    from ceph_tpu.store.objectstore import Transaction
+
+    async def run():
+        cl = Cluster()
+        admin = await cl.start(2)
+        await admin.pool_create("o1", pg_num=1, size=2)
+        io = admin.open_ioctx("o1")
+        await io.write_full("seed", b"x")
+        pg = next(pg for osd in cl.osds.values()
+                  for pg in osd.pgs.values() if pg.is_primary())
+
+        def one_append_bytes():
+            v = EVersion(pg.info.last_update.epoch or 1,
+                         pg.info.last_update.version + 1)
+            e = LogEntry(oid="guard", version=v,
+                         prior_version=pg.info.last_update)
+            txn = Transaction()
+            pg.append_log(txn, e)
+            return sum(len(k) + len(val)
+                       for op in txn.ops
+                       if getattr(op, "kv", None)
+                       for k, val in op.kv.items())
+
+        def grow_to(n):
+            while len(pg.log.entries) < n:
+                one_append_bytes()
+
+        grow_to(100)
+        small = one_append_bytes()
+        grow_to(1200)
+        large = one_append_bytes()
+        assert large <= small * 1.5, (small, large)
+
+        # contrast: the full snapshot is O(len(log)) by design
+        txn = Transaction()
+        pg.save_meta(txn)
+        full = sum(len(k) + len(val)
+                   for op in txn.ops
+                   if getattr(op, "kv", None)
+                   for k, val in op.kv.items())
+        assert full > 10 * large, (full, large)
+        await cl.stop()
+
+    asyncio.run(run())
