@@ -210,6 +210,11 @@ class Objecter(Dispatcher):
             self._note_sent(op)
             return
         addr = pend[0][1]
+        # device-candidate:crush-placement batch-compute every corked
+        # op's placement in ONE ops/crush_kernel.py call (CHUNK_SIZES-
+        # bucketed, warm-engine gated) instead of per-op _calc_target
+        # scalar descents — the corked MOSDOpBatch is already the
+        # N-ops-per-pass shape the batched kernel wants
         self.messenger.send_message(
             MOSDOpBatch([m for m, _a, _o in pend]), addr,
             peer_type="osd")

@@ -1,0 +1,63 @@
+"""Process-wide device-kernel compile/launch accounting — the runtime
+half of the device-seam pass (devtools/device.py), the way runtime
+lockdep is the static lint's runtime half.
+
+JIT16 can prove statically that no jit object is constructed per call,
+but "hashable static args" and "shape-bucketed signatures" are runtime
+properties: a caller that feeds a fresh shape every op retraces every
+op, and no AST pass can see that.  So every kernel entry the repo owns
+(ec/kernel.py MatrixApply, ops/crush_kernel.py JaxEngine, the mesh
+executor) notes each launch here under a SIGNATURE key — everything a
+jit cache keys on: kernel identity, operand shapes, static config.  A
+new signature is a compile (a retrace); a seen one is a cache hit.
+The perf-smoke guard asserts a steady-state EC workload PLATEAUS:
+compile count fixed at the bucket count while launches keep growing —
+a per-op retrace regression fails tier-1, not a bench review.
+
+Counters are process-global and touched from executor threads; all
+mutation sits under one lock (this module is NOT in the shard-seam
+module set — it is diagnostics, never consulted on the op path
+itself).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Hashable, Set
+
+_lock = threading.Lock()
+_launches: Dict[str, int] = {}
+_compiles: Dict[str, int] = {}
+_seen: Dict[str, Set[Hashable]] = {}
+
+
+def note_launch(domain: str, signature: Hashable) -> bool:
+    """Record one kernel launch in `domain` under a jit-cache-grade
+    signature.  Returns True when the signature is NEW (a compile /
+    retrace), False on a cache hit."""
+    with _lock:
+        _launches[domain] = _launches.get(domain, 0) + 1
+        seen = _seen.setdefault(domain, set())
+        if signature in seen:
+            return False
+        seen.add(signature)
+        _compiles[domain] = _compiles.get(domain, 0) + 1
+        return True
+
+
+def counters() -> dict:
+    """Snapshot: per-domain launches/compiles + process totals."""
+    with _lock:
+        return {
+            "launches": dict(_launches),
+            "compiles": dict(_compiles),
+            "total_launches": sum(_launches.values()),
+            "total_compiles": sum(_compiles.values()),
+        }
+
+
+def reset() -> None:
+    with _lock:
+        _launches.clear()
+        _compiles.clear()
+        _seen.clear()

@@ -1,0 +1,1008 @@
+"""Device-seam analysis: prove the op path kernel-callable before the
+batched-CRUSH / EC device escape.
+
+The ROADMAP's two biggest open bars — batched CRUSH serving real
+consumers and a device data plane that ever reports
+``device_byte_fraction > 0`` — both require calling jitted kernels
+(``ops/crush_kernel.py``, ``ec/kernel.py``) from inside the async op
+path, where one hidden ``block_until_ready``/``np.asarray`` sync
+stalls a shard loop and one retrace per shape burns milliseconds per
+op.  This pass is the host↔device sibling of the shard-seam pass
+(devtools/seam.py): it reuses the same project-wide call graph to tile
+functions onto host-op-path vs device-dispatch sides and carries three
+machine-checked rules:
+
+  SYNC15 no implicit device→host synchronization — ``.item()``,
+         ``float()``/``int()``/``bool()`` on device values,
+         ``np.asarray`` on device arrays, ``block_until_ready`` —
+         inside async op-path functions or AF01 await-free regions.
+         A legitimate sync (fetching kernel output) must sit inside a
+         declared ``# device-sync:begin <reason>`` /
+         ``# device-sync:end`` region, and a region may only live in a
+         SYNC function (the shape the ec_queue executor runs — an
+         ``async def`` body runs on the event loop, where the sync
+         would stall every in-flight op), or carry a waiver.
+  JIT16  every jit entry point reachable from the op path is
+         retrace-stable: no ``jax.jit(lambda ...)`` constructed inside
+         a function body (a fresh jit object per call is a fresh
+         compile cache per call — the ec/kernel.py autotuner did
+         exactly this), and no construct-then-invoke of a jit object
+         within one function body.  Builder functions that RETURN the
+         jitted callable (the caller owns the cache: ``JaxEngine._fn``
+         memoizes into ``self._fns``, ``_mesh_encode_fn`` is
+         lru_cached) are the sanctioned shape and are inventoried
+         with their cache kind.  Hashable static args and
+         shape-bucketed signatures cannot be proven statically — the
+         runtime half (common/devstats.py signature counters +
+         the perf-smoke compile-plateau guard) covers them.
+  XFER17 every host↔device transfer on the op path is a declared
+         staging ``jax.device_put`` (class ``staged``) or a
+         classified wire-fallback (class ``wire``: a buffer whose
+         byte layout is defined — chunk arrays, generator matrices,
+         weight vectors — mirroring PORT13's value taxonomy).  A
+         ``jnp.asarray`` of an unclassifiable value is an implicit
+         transfer of unknown cost and layout: violation.
+
+``ceph-tpu-lint --device-report`` emits the schema-versioned device
+inventory (committed as DEVICE_INVENTORY.json): every candidate
+kernel call site — declared in-source as ``# device-candidate:<kind>
+<note>`` comments (Objecter placement compute for a corked
+MOSDOpBatch, ECBackend encode via osd/ec_queue.py, decode / recovery
+rebuild) — with its sync / retrace / transfer classification.  That
+inventory is the committed work-list the batched-CRUSH-in-the-data-
+path PR consumes, exactly as SEAM_INVENTORY.json was for the process-
+lane escape.
+
+Waivers use the standard ``# lint: allow[ID] reason`` channel.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ceph_tpu.devtools.rules import (FileInfo, Violation, _attr_text,
+                                     _dotted)
+from ceph_tpu.devtools.seam import (FnInfo, _INTAKE_RE, _Resolver,
+                                    _callee_name, _collect_functions)
+
+#: device-inventory schema version (bumped on incompatible shape change)
+DEVICE_SCHEMA = 1
+
+#: the modules whose code IS the device-dispatch side: jit kernels,
+#: engines, the mesh executor
+DEVICE_MODULES = ("ec/kernel.py", "ops/crush_kernel.py",
+                  "parallel/mesh_exec.py", "parallel/layout.py")
+
+#: host-op-path module scope (the async data plane the kernels must be
+#: callable from) — MONO05's op-path set plus the client stack
+HOST_PREFIXES = ("osd/", "msg/", "store/", "client/", "ec/")
+
+#: call-graph scope: host op path + device modules + mon (map sweeps
+#: are a named batched-CRUSH consumer)
+SCOPE_PREFIXES = HOST_PREFIXES + ("ops/", "parallel/", "mon/")
+
+# -------------------------------------------------- device-sync regions
+
+_SYNC_BEGIN_RE = re.compile(r"#\s*device-sync:begin\b\s*(.*)$")
+_SYNC_END_RE = re.compile(r"#\s*device-sync:end\b")
+
+#: candidate kernel call-site annotation:
+#:   # device-candidate:<kind> <free-form note>
+_CANDIDATE_RE = re.compile(r"#\s*device-candidate:([\w-]+)\s*(.*)$")
+
+
+class SyncRegion:
+    __slots__ = ("rel", "begin", "end", "reason")
+
+    def __init__(self, rel: str, begin: int, end: int, reason: str):
+        self.rel = rel
+        self.begin = begin
+        self.end = end
+        self.reason = reason
+
+    def covers(self, line: int) -> bool:
+        return self.begin < line < self.end
+
+    def to_json(self) -> dict:
+        return {"rel": self.rel, "begin": self.begin, "end": self.end,
+                "reason": self.reason}
+
+
+def parse_sync_regions(fi: FileInfo) -> Tuple[List[SyncRegion],
+                                              List[Violation]]:
+    """Balanced ``# device-sync:begin reason`` / ``:end`` regions +
+    region-hygiene violations (SYNC15's bookkeeping half)."""
+    regions: List[SyncRegion] = []
+    vios: List[Violation] = []
+    open_at: Optional[Tuple[int, str]] = None
+    for ln in sorted(fi.comments):
+        c = fi.comments[ln]
+        m = _SYNC_BEGIN_RE.search(c)
+        if m:
+            if open_at is not None:
+                vios.append(Violation(
+                    "SYNC15", fi.rel, ln,
+                    f"nested device-sync:begin (previous at line "
+                    f"{open_at[0]} not closed)"))
+            reason = m.group(1).strip()
+            if not reason:
+                vios.append(Violation(
+                    "SYNC15", fi.rel, ln,
+                    "device-sync:begin must carry a reason: "
+                    "`# device-sync:begin why this fetch is "
+                    "executor-side / off the op path`"))
+            open_at = (ln, reason)
+        elif _SYNC_END_RE.search(c):
+            if open_at is None:
+                vios.append(Violation(
+                    "SYNC15", fi.rel, ln,
+                    "device-sync:end without begin"))
+            else:
+                regions.append(SyncRegion(fi.rel, open_at[0], ln,
+                                          open_at[1]))
+                open_at = None
+    if open_at is not None:
+        vios.append(Violation(
+            "SYNC15", fi.rel, open_at[0],
+            "device-sync:begin never closed"))
+    return regions, vios
+
+
+# --------------------------------------------- device value classification
+
+#: callee names whose result lives ON the device
+_DEVICE_PRODUCER_CALLS = {"device_call", "device_put", "pallas_call"}
+#: callee names whose result is a JITTED CALLABLE (calling it yields a
+#: device value): the repo's builder/cache conventions
+_JIT_PRODUCER_CALLS = {"_fn", "_mesh_encode_fn", "_get_winners_fn",
+                       "ec_cluster_step", "ec_recover_step", "jit",
+                       "shard_map"}
+#: names conventionally bound to jitted callables
+_JIT_NAMES = {"fast", "full", "fetch", "fn", "jitfn"}
+#: producers whose result is a HOST buffer with a defined byte layout
+#: (the wire-fallback class of XFER17 — mirrors PORT13's taxonomy)
+_HOST_PRODUCER_CALLS = {
+    "expand_to_bitmatrix", "ln_u16_table", "rh_lh_tables", "ll_table",
+    "_bit_planes", "ascontiguousarray", "zeros", "ones", "full", "pad",
+    "frombuffer", "arange", "integers", "concatenate", "stack",
+    "tobytes", "reshape", "split_data",
+}
+#: names conventionally holding host buffers with a wire-defined layout
+_WIRE_BUFFER_NAMES = {
+    "chunks", "data", "folded", "seg", "mat", "bm", "bitmat", "gen",
+    "weights", "weights_vec", "wv", "wvj", "items", "rows", "xs", "rs",
+    "surv", "table", "blocks", "planes", "dec", "inp", "parity",
+}
+
+CLS_DEVICE = "device"
+CLS_JITFN = "jitfn"
+CLS_HOST = "host"
+CLS_UNKNOWN = "unknown"
+
+
+class _DevEnv:
+    """Shallow per-function dataflow: name -> device/jitfn/host class.
+    Conservative on purpose: a sync / transfer is only flagged when the
+    operand is PROVABLY device-side (or provably unclassifiable at an
+    explicit transfer API) — same convention-driven approach as
+    PORT13's value taxonomy."""
+
+    def __init__(self, fn_node, fi: FileInfo,
+                 module_jit: Optional[Set[str]] = None):
+        self.fi = fi
+        self.env: Dict[str, str] = {}
+        #: module-level jit entry names (decorated defs / assignments):
+        #: calling one yields a device value
+        self.module_jit = module_jit or set()
+        for st in ast.walk(fn_node):
+            if isinstance(st, ast.Assign):
+                targets = []
+                for t in st.targets:
+                    if isinstance(t, ast.Name):
+                        targets.append(t.id)
+                    elif isinstance(t, ast.Tuple):
+                        targets.extend(e.id for e in t.elts
+                                       if isinstance(e, ast.Name))
+                if not targets:
+                    continue
+                got = self.classify(st.value)
+                if got == CLS_UNKNOWN and isinstance(st.value, ast.Call):
+                    callee = _callee_name(st.value)
+                    if callee in _JIT_PRODUCER_CALLS:
+                        got = CLS_JITFN
+                for name in targets:
+                    if got != CLS_UNKNOWN:
+                        self.env[name] = got
+                    elif name in _JIT_NAMES:
+                        self.env[name] = CLS_JITFN
+
+    def _by_name(self, name: str) -> str:
+        got = self.env.get(name)
+        if got is not None:
+            return got
+        if name in _WIRE_BUFFER_NAMES:
+            return CLS_HOST
+        return CLS_UNKNOWN
+
+    def classify(self, node: ast.AST) -> str:
+        if isinstance(node, ast.Constant):
+            return CLS_HOST
+        if isinstance(node, ast.Name):
+            return self._by_name(node.id)
+        if isinstance(node, ast.Starred):
+            return self.classify(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.classify(node.value)
+        if isinstance(node, ast.IfExp):
+            got = {self.classify(node.body), self.classify(node.orelse)}
+            if CLS_DEVICE in got:
+                return CLS_DEVICE
+            if got == {CLS_HOST}:
+                return CLS_HOST
+            return CLS_UNKNOWN
+        if isinstance(node, (ast.BinOp, ast.UnaryOp, ast.BoolOp,
+                             ast.Compare)):
+            parts = [self.classify(v) for v in ast.iter_child_nodes(
+                node) if isinstance(v, ast.expr)]
+            if CLS_DEVICE in parts:
+                return CLS_DEVICE
+            if parts and all(p == CLS_HOST for p in parts):
+                return CLS_HOST
+            return CLS_UNKNOWN
+        if isinstance(node, ast.Attribute):
+            leaf = node.attr
+            if leaf in _WIRE_BUFFER_NAMES:
+                return CLS_HOST
+            return CLS_UNKNOWN
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func, self.fi.aliases) or ""
+            callee = _callee_name(node)
+            # a LOCAL binding beats every global name convention: a
+            # variable named `full` holding a jitted callable must not
+            # classify as np.full's host-producer namesake
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in self.env:
+                got = self.env[node.func.id]
+                if got == CLS_JITFN:
+                    return CLS_DEVICE
+            if dotted.startswith(("jax.numpy.", "jnp.")):
+                return CLS_DEVICE
+            if dotted == "jax.jit" or callee == "jit":
+                return CLS_JITFN
+            if dotted.startswith("jax."):
+                return CLS_DEVICE
+            if dotted.startswith(("numpy.", "np.")):
+                return CLS_HOST
+            if callee in _DEVICE_PRODUCER_CALLS:
+                return CLS_DEVICE
+            if callee in _HOST_PRODUCER_CALLS:
+                return CLS_HOST
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in self.module_jit:
+                return CLS_DEVICE
+            # x.astype(...)/x.sum() etc: class follows the receiver
+            if isinstance(node.func, ast.Attribute):
+                base = self.classify(node.func.value)
+                if base in (CLS_DEVICE, CLS_HOST):
+                    return base
+            # jitfn(...) and curried dispatch self._fn()(...): device
+            if isinstance(node.func, ast.Name) \
+                    and self._by_name(node.func.id) == CLS_JITFN:
+                return CLS_DEVICE
+            if isinstance(node.func, ast.Call):
+                inner = _callee_name(node.func)
+                if inner in _JIT_PRODUCER_CALLS:
+                    return CLS_DEVICE
+            return CLS_UNKNOWN
+        return CLS_UNKNOWN
+
+
+# ----------------------------------------------------- sync / xfer scans
+
+#: fetch-class builtins: calling one on a device value synchronizes
+_FETCH_BUILTINS = {"float", "int", "bool"}
+
+
+def _sync_kind(call: ast.Call, env: _DevEnv, fi: FileInfo,
+               in_device_module: bool) -> Optional[str]:
+    """The device→host sync class of this Call, or None."""
+    f = call.func
+    dotted = _dotted(f, fi.aliases) or ""
+    if dotted.endswith("block_until_ready") or (
+            isinstance(f, ast.Attribute)
+            and f.attr == "block_until_ready"):
+        return "block_until_ready"
+    if isinstance(f, ast.Attribute) and f.attr == "item":
+        if in_device_module \
+                or env.classify(f.value) == CLS_DEVICE:
+            return "item"
+        return None
+    if dotted in ("numpy.asarray", "numpy.array", "np.asarray",
+                  "np.array") and call.args:
+        if env.classify(call.args[0]) == CLS_DEVICE:
+            return "np.asarray(device)"
+        return None
+    if isinstance(f, ast.Name) and f.id in _FETCH_BUILTINS \
+            and call.args:
+        if env.classify(call.args[0]) == CLS_DEVICE:
+            return f"{f.id}(device)"
+        return None
+    return None
+
+
+#: XFER17 transfer classes
+XFER_STAGED = "staged"          # explicit jax.device_put staging
+XFER_WIRE = "wire"              # host buffer with defined byte layout
+XFER_DEVICE = "device-noop"     # already on device: no transfer
+XFER_OPAQUE = "OPAQUE"          # unclassifiable: violation
+
+
+def _xfer_at(call: ast.Call, env: _DevEnv,
+             fi: FileInfo) -> Optional[Tuple[str, str]]:
+    """(api, class) when this Call is an explicit host↔device transfer
+    API; None otherwise."""
+    dotted = _dotted(call.func, fi.aliases) or ""
+    if dotted.endswith("device_put"):
+        return ("device_put", XFER_STAGED)
+    if dotted in ("jax.numpy.asarray", "jax.numpy.array") \
+            and call.args:
+        got = env.classify(call.args[0])
+        if got == CLS_DEVICE:
+            return ("jnp.asarray", XFER_DEVICE)
+        if got == CLS_HOST:
+            return ("jnp.asarray", XFER_WIRE)
+        return ("jnp.asarray", XFER_OPAQUE)
+    return None
+
+
+# ------------------------------------------------------------ jit entries
+
+
+def _jit_call(node: ast.Call, fi: FileInfo) -> bool:
+    """True when this Call constructs a jit object: jax.jit(...) or
+    functools.partial(jax.jit, ...)."""
+    dotted = _dotted(node.func, fi.aliases) or ""
+    if dotted == "jax.jit":
+        return True
+    if dotted.endswith("partial") and node.args:
+        inner = _dotted(node.args[0], fi.aliases) or ""
+        return inner == "jax.jit"
+    return False
+
+
+class JitEntry:
+    __slots__ = ("rel", "line", "name", "cache")
+
+    def __init__(self, rel: str, line: int, name: str, cache: str):
+        self.rel = rel
+        self.line = line
+        self.name = name
+        self.cache = cache      # "module" | "builder-return" |
+        #                         "guarded-cache" | "PER-CALL"
+
+    def to_json(self) -> dict:
+        return {"rel": self.rel, "line": self.line, "name": self.name,
+                "cache": self.cache}
+
+
+# --------------------------------------------------------- kernel sites
+
+class KernelSite:
+    """One declared candidate kernel call site (``# device-candidate:``
+    annotation) with its classification — the work-list row the
+    batched-CRUSH / device-EC PR consumes."""
+
+    __slots__ = ("rel", "line", "kind", "note", "fn", "side", "is_async",
+                 "sync", "retrace", "transfer")
+
+    def __init__(self, rel: str, line: int, kind: str, note: str):
+        self.rel = rel
+        self.line = line
+        self.kind = kind
+        self.note = note
+        self.fn: Optional[str] = None
+        self.side = "other"
+        self.is_async = False
+        self.sync = "UNKNOWN"
+        self.retrace = "UNKNOWN"
+        self.transfer = "UNKNOWN"
+
+    @property
+    def classified(self) -> bool:
+        return "UNKNOWN" not in (self.sync, self.retrace, self.transfer)
+
+    def to_json(self) -> dict:
+        return {"rel": self.rel, "line": self.line, "kind": self.kind,
+                "note": self.note, "fn": self.fn, "side": self.side,
+                "async": self.is_async, "sync": self.sync,
+                "retrace": self.retrace, "transfer": self.transfer}
+
+
+#: bucketing helpers: a caller (or its note) naming one is shape-stable
+_BUCKET_HELPERS = {"_bucket", "_pick_chunk", "LANE_BUCKETS",
+                   "CHUNK_SIZES"}
+_BUCKET_NOTE_RE = re.compile(r"\b(\w*bucket\w*|CHUNK_SIZES|"
+                             r"LANE_BUCKETS|static-shape|lru-cached|"
+                             r"warm-engine)\b", re.IGNORECASE)
+
+
+# ---------------------------------------------------------- the analysis
+
+class DeviceAnalysis:
+    """One full device-seam pass over a linted file set.  Violations
+    carry rule ids SYNC15 / JIT16 / XFER17; ``report()`` emits the
+    device inventory."""
+
+    def __init__(self, files: List[FileInfo]):
+        # the FULL input set is retained: the analyze() memo keys on
+        # the ids of ALL handed-in FileInfos (see seam.analyze)
+        self.all_files = list(files)
+        self.files = [fi for fi in files
+                      if fi.rel.startswith(SCOPE_PREFIXES)]
+        self.by_rel = {fi.rel: fi for fi in self.files}
+        self.violations: List[Violation] = []
+        self.regions: Dict[str, List[SyncRegion]] = {}
+        self.sync_sites: List[dict] = []
+        self.transfers: List[dict] = []
+        self.jit_entries: List[JitEntry] = []
+        self.kernel_sites: List[KernelSite] = []
+        self.waiver_hits: List[Tuple[str, str, int]] = []
+        self._run()
+
+    def _waived(self, fi: FileInfo, rule: str, line: int) -> bool:
+        if fi.waived(rule, line):
+            self.waiver_hits.append((fi.rel, rule, line))
+            return True
+        return False
+
+    # ------------------------------------------------------------ phases
+    def _run(self) -> None:
+        for fi in self.files:
+            regions, vios = parse_sync_regions(fi)
+            self.regions[fi.rel] = regions
+            self.violations.extend(vios)
+        self.fns = _collect_functions(self.files, SCOPE_PREFIXES)
+        self._tile_sides()
+        self._check_regions_off_loop()
+        self._scan_sync_and_xfer()
+        self._scan_jit()
+        self._collect_kernel_sites()
+
+    # ---------------------------------------------------------- side tiling
+    def _tile_sides(self) -> None:
+        """Tile functions onto host-op-path vs device-dispatch sides.
+        Module membership gives the static tier; run_in_executor /
+        ThreadPoolExecutor handoffs mark executor entries; reachability
+        from the SHARD11 intake seeds marks the hot op path."""
+        resolver = _Resolver(self.fns)
+        self.executor_fns: Set[str] = set()     # qualnames
+        exec_names: Set[Tuple[str, str]] = set()
+        for fn in self.fns:
+            for sub in ast.walk(fn.node):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "run_in_executor" \
+                        and len(sub.args) >= 2:
+                    tgt = sub.args[1]
+                    if isinstance(tgt, ast.Attribute):
+                        exec_names.add((fn.rel, tgt.attr))
+                    elif isinstance(tgt, ast.Name):
+                        exec_names.add((fn.rel, tgt.id))
+        for fn in self.fns:
+            if (fn.rel, fn.name) in exec_names:
+                self.executor_fns.add(fn.qual)
+        # hot-op-path reachability from the intake seeds
+        self.hot: Set[str] = set()
+        work = [fn for fn in self.fns
+                if _INTAKE_RE.match(fn.name)
+                or (fn.rel, fn.name) == ("osd/shards.py", "_pump")]
+        while work:
+            fn = work.pop()
+            if fn.qual in self.hot:
+                continue
+            self.hot.add(fn.qual)
+            for recv, meth in fn.called:
+                for cand in resolver.resolve(fn, recv, meth):
+                    if cand.qual not in self.hot:
+                        work.append(cand)
+
+    def _side_of(self, fn: FnInfo) -> str:
+        if fn.qual in self.executor_fns:
+            return "executor"
+        if fn.rel.startswith(tuple(DEVICE_MODULES)) \
+                or fn.rel in DEVICE_MODULES:
+            return "device"
+        if fn.rel.startswith(HOST_PREFIXES):
+            return "host-op-path"
+        return "other"
+
+    # ------------------------------------------- region placement hygiene
+    def _check_regions_off_loop(self) -> None:
+        """A device-sync region may only live in a SYNC function: an
+        async def body runs on the event loop, where the declared sync
+        would stall every in-flight op — the sanctioned shape is an
+        executor handoff (osd/ec_queue.py's single-thread pool)."""
+        for fi in self.files:
+            async_spans: List[Tuple[int, int]] = []
+            for node in ast.walk(fi.tree):
+                if isinstance(node, ast.AsyncFunctionDef):
+                    inner_sync = [
+                        sub for sub in ast.walk(node)
+                        if isinstance(sub, ast.FunctionDef)]
+                    end = max((getattr(s, "end_lineno", s.lineno)
+                               for s in ast.walk(node)
+                               if hasattr(s, "lineno")),
+                              default=node.lineno)
+                    spans = [(node.lineno, end)]
+                    # a nested SYNC def inside the async body is its
+                    # own (legal) habitat — punch it out of the span
+                    for s in inner_sync:
+                        s_end = max((getattr(x, "end_lineno", x.lineno)
+                                     for x in ast.walk(s)
+                                     if hasattr(x, "lineno")),
+                                    default=s.lineno)
+                        spans = _punch(spans, (s.lineno, s_end))
+                    async_spans.extend(spans)
+            for rg in self.regions.get(fi.rel, []):
+                if any(lo <= rg.begin <= hi for lo, hi in async_spans):
+                    if not self._waived(fi, "SYNC15", rg.begin):
+                        self.violations.append(Violation(
+                            "SYNC15", fi.rel, rg.begin,
+                            "device-sync region inside an async def: "
+                            "the declared sync would run ON the event "
+                            "loop — move the fetch into a sync "
+                            "function dispatched through the ec_queue "
+                            "executor"))
+
+    # ------------------------------------------------------- SYNC15/XFER17
+    def _scan_sync_and_xfer(self) -> None:
+        af_regions = {fi.rel: _af01_spans(fi) for fi in self.files}
+        mod_jit: Dict[str, Set[str]] = {}
+        for fn in self.fns:
+            in_dev = fn.rel in DEVICE_MODULES
+            is_async = isinstance(fn.node, ast.AsyncFunctionDef)
+            in_host = fn.rel.startswith(HOST_PREFIXES) and not in_dev
+            is_exec = fn.qual in self.executor_fns
+            af = af_regions.get(fn.rel, [])
+            # SYNC15 scope: device modules and executor entries always
+            # (region discipline); host modules for async bodies (the
+            # event loop) and AF01 await-free regions
+            checked = in_dev or is_exec or (in_host and is_async)
+            env: Optional[_DevEnv] = None
+            fi = self.by_rel[fn.rel]
+            if fn.rel not in mod_jit:
+                mod_jit[fn.rel] = _module_jit_names(fi)
+            regions = self.regions.get(fn.rel, [])
+            own = set(_own_stmts(fn.node))
+            for sub in ast.walk(fn.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if id(sub) not in own:
+                    continue            # nested defs scan as their own fn
+                if env is None:
+                    env = _DevEnv(fn.node, fi, mod_jit[fn.rel])
+                x = _xfer_at(sub, env, fi)
+                if x is not None:
+                    api, cls = x
+                    self.transfers.append({
+                        "rel": fn.rel, "line": sub.lineno,
+                        "fn": fn.qual, "api": api, "class": cls})
+                    if cls == XFER_OPAQUE \
+                            and not self._waived(fi, "XFER17",
+                                                 sub.lineno):
+                        src = ast.unparse(sub) \
+                            if hasattr(ast, "unparse") else "<expr>"
+                        self.violations.append(Violation(
+                            "XFER17", fn.rel, sub.lineno,
+                            f"implicit host->device transfer {src!r} "
+                            f"of an unclassifiable value: stage it "
+                            f"with an explicit jax.device_put or pass "
+                            f"a wire-classified buffer (chunk array / "
+                            f"generator matrix / weight vector "
+                            f"convention)"))
+                ln = sub.lineno
+                in_af = any(lo < ln < hi for lo, hi in af)
+                if not checked and not in_af:
+                    continue
+                kind = _sync_kind(sub, env, fi, in_dev)
+                covered = any(rg.covers(ln) for rg in regions)
+                if kind is None:
+                    # an np.asarray the classifier cannot settle but
+                    # that sits inside a DECLARED region is a declared
+                    # fetch: record it so the inventory shows intent
+                    dotted = _dotted(sub.func, fi.aliases) or ""
+                    if covered and dotted in ("numpy.asarray",
+                                              "numpy.array",
+                                              "np.asarray", "np.array"):
+                        self.sync_sites.append({
+                            "rel": fn.rel, "line": ln, "fn": fn.qual,
+                            "api": "np.asarray(declared)",
+                            "sanction": "region"})
+                    continue
+                sanction = "region" if covered else None
+                if covered and (is_async or in_af):
+                    # region hygiene already flagged async placement;
+                    # an AF01 region is await-free BY CONTRACT — a
+                    # device sync inside it blocks the submit section
+                    sanction = None
+                if sanction is None \
+                        and self._waived(fi, "SYNC15", ln):
+                    sanction = "waived"
+                self.sync_sites.append({
+                    "rel": fn.rel, "line": ln, "fn": fn.qual,
+                    "api": kind,
+                    "sanction": sanction or "VIOLATION"})
+                if sanction is None:
+                    where = "an AF01 await-free region" if in_af else (
+                        "an async op-path function" if is_async
+                        else "an executor-side function" if is_exec
+                        and not in_dev else "a device module")
+                    self.violations.append(Violation(
+                        "SYNC15", fn.rel, ln,
+                        f"implicit device->host sync ({kind}) in "
+                        f"{where}: one hidden sync stalls the whole "
+                        f"shard loop — route the fetch through the "
+                        f"ec_queue executor inside a declared "
+                        f"# device-sync:begin/end region"))
+
+    # ------------------------------------------------------------- JIT16
+    def _scan_jit(self) -> None:
+        for fi in self.files:
+            # module-level entries: decorated defs + module assignments
+            for node in fi.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    for d in node.decorator_list:
+                        if isinstance(d, ast.Call) and _jit_call(d, fi):
+                            self.jit_entries.append(JitEntry(
+                                fi.rel, node.lineno, node.name,
+                                "module"))
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and _jit_call(node.value, fi) \
+                        and node.targets \
+                        and isinstance(node.targets[0], ast.Name):
+                    self.jit_entries.append(JitEntry(
+                        fi.rel, node.lineno, node.targets[0].id,
+                        "module"))
+        for fn in self.fns:
+            fi = self.by_rel[fn.rel]
+            own = set(_own_stmts(fn.node))
+            jit_bound: Dict[str, int] = {}
+            returned: Dict[int, Optional[str]] = {}
+            lru, guard_names = _cache_guards(fn.node)
+            flagged: Set[int] = set()
+
+            def flag(line: int, msg: str) -> None:
+                if line in flagged:
+                    return
+                flagged.add(line)
+                if not self._waived(fi, "JIT16", line):
+                    self.violations.append(Violation(
+                        "JIT16", fn.rel, line, msg))
+
+            for sub in ast.walk(fn.node):
+                if id(sub) not in own:
+                    continue
+                # nested def decorated @jax.jit: in-body construction
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) \
+                        and sub is not fn.node:
+                    for d in sub.decorator_list:
+                        is_jit = (isinstance(d, ast.Call)
+                                  and _jit_call(d, fi)) or \
+                            (_dotted(d, fi.aliases) == "jax.jit")
+                        if is_jit:
+                            jit_bound[sub.name] = sub.lineno
+                    continue
+                if isinstance(sub, ast.Assign) \
+                        and isinstance(sub.value, ast.Call) \
+                        and _jit_call(sub.value, fi):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            jit_bound[t.id] = sub.lineno
+                if not isinstance(sub, ast.Call):
+                    continue
+                if _jit_call(sub, fi):
+                    if sub.args and isinstance(sub.args[0], ast.Lambda):
+                        flag(sub.lineno,
+                             "jax.jit(lambda ...) constructed inside "
+                             "a function body: a fresh jit object per "
+                             "call is a fresh compile cache per call "
+                             "(the kernel retraces every time) — jit "
+                             "a named module-level function instead")
+                        continue
+                    if _direct_invoke_parent(fn.node, sub):
+                        flag(sub.lineno,
+                             "jit object constructed AND invoked in "
+                             "the same function body: the compile "
+                             "cache dies with the call — memoize the "
+                             "jitted callable (guarded cache / "
+                             "lru_cache / module scope)")
+            # a jit object bound in-body and invoked in-body (per-call
+            # construct+invoke) — unless the enclosing fn memoizes
+            for sub in ast.walk(fn.node):
+                if id(sub) not in own or not isinstance(sub, ast.Call):
+                    continue
+                callee = sub.func
+                if isinstance(callee, ast.Name) \
+                        and callee.id in jit_bound and not lru \
+                        and callee.id not in guard_names:
+                    flag(sub.lineno,
+                         f"jitted callable {callee.id!r} constructed "
+                         f"at line {jit_bound[callee.id]} and invoked "
+                         f"in the same function body with no cache "
+                         f"guard on it: every call pays a retrace — "
+                         f"hoist the jit to module scope or memoize "
+                         f"it")
+            # returned jit objects are builder entries (caller caches)
+            for sub in ast.walk(fn.node):
+                if id(sub) not in own \
+                        or not isinstance(sub, ast.Return) \
+                        or sub.value is None:
+                    continue
+                vals = sub.value.elts \
+                    if isinstance(sub.value, ast.Tuple) else [sub.value]
+                for v in vals:
+                    if isinstance(v, ast.Call) and _jit_call(v, fi):
+                        returned.setdefault(v.lineno, None)
+                    elif isinstance(v, ast.Name) and v.id in jit_bound:
+                        returned.setdefault(jit_bound[v.id], v.id)
+            for line in sorted(returned):
+                if line not in flagged:
+                    name = returned[line]
+                    cached = lru or (name is not None
+                                     and name in guard_names)
+                    self.jit_entries.append(JitEntry(
+                        fn.rel, line, fn.qual.split(":", 1)[1],
+                        "guarded-cache" if cached
+                        else "builder-return"))
+
+    # ------------------------------------------------------ kernel sites
+    def _collect_kernel_sites(self) -> None:
+        by_fn_sync: Dict[str, List[dict]] = {}
+        for s in self.sync_sites:
+            by_fn_sync.setdefault(s["fn"], []).append(s)
+        by_fn_xfer: Dict[str, List[dict]] = {}
+        for t in self.transfers:
+            by_fn_xfer.setdefault(t["fn"], []).append(t)
+        for fi in self.files:
+            for ln, c in sorted(fi.comments.items()):
+                m = _CANDIDATE_RE.search(c)
+                if not m:
+                    continue
+                # a long annotation wraps onto following comment lines:
+                # they are the note's continuation, not new directives
+                note_parts = [m.group(2).strip()]
+                nxt = ln + 1
+                while nxt in fi.comments:
+                    cont = fi.comments[nxt]
+                    if _CANDIDATE_RE.search(cont) \
+                            or _SYNC_BEGIN_RE.search(cont) \
+                            or _SYNC_END_RE.search(cont):
+                        break
+                    note_parts.append(cont.lstrip("# ").strip())
+                    nxt += 1
+                site = KernelSite(fi.rel, ln, m.group(1),
+                                  " ".join(p for p in note_parts if p))
+                fn = self._enclosing(fi.rel, ln)
+                if fn is not None:
+                    site.fn = fn.qual
+                    site.side = self._side_of(fn)
+                    if fn.qual in self.hot:
+                        site.side += "+hot"
+                    site.is_async = isinstance(fn.node,
+                                               ast.AsyncFunctionDef)
+                    syncs = by_fn_sync.get(fn.qual, [])
+                    bad = [s for s in syncs
+                           if s["sanction"] == "VIOLATION"]
+                    site.sync = ("VIOLATION" if bad else
+                                 "declared-region" if syncs else
+                                 "clean")
+                    xfers = by_fn_xfer.get(fn.qual, [])
+                    opaque = [t for t in xfers
+                              if t["class"] == XFER_OPAQUE]
+                    site.transfer = ("VIOLATION" if opaque else
+                                     "/".join(sorted({t["class"]
+                                                      for t in xfers}))
+                                     if xfers else "none")
+                    site.retrace = self._retrace_of(fn, site.note)
+                self.kernel_sites.append(site)
+
+    def _enclosing(self, rel: str, line: int) -> Optional[FnInfo]:
+        best: Optional[FnInfo] = None
+        best_span = None
+        for fn in self.fns:
+            if fn.rel != rel:
+                continue
+            end = max((getattr(s, "end_lineno", s.lineno)
+                       for s in ast.walk(fn.node)
+                       if hasattr(s, "lineno")), default=fn.node.lineno)
+            # the annotation may sit on the line above its call
+            if fn.node.lineno <= line + 1 and line <= end + 1:
+                span = end - fn.node.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = fn, span
+        return best
+
+    def _retrace_of(self, fn: FnInfo, note: str) -> str:
+        m = _BUCKET_NOTE_RE.search(note)
+        if m:
+            return m.group(1)
+        names = {sub.id for sub in ast.walk(fn.node)
+                 if isinstance(sub, ast.Name)} | {
+            sub.attr for sub in ast.walk(fn.node)
+            if isinstance(sub, ast.Attribute)}
+        hit = sorted(names & _BUCKET_HELPERS)
+        if hit:
+            return f"bucketed({hit[0]})"
+        return "UNKNOWN"
+
+    # ------------------------------------------------------------ report
+    def report(self) -> dict:
+        regions = [rg.to_json() for rel in sorted(self.regions)
+                   for rg in self.regions[rel]]
+        sites = sorted((s.to_json() for s in self.kernel_sites),
+                       key=lambda s: (s["rel"], s["line"]))
+        syncs = sorted(self.sync_sites,
+                       key=lambda s: (s["rel"], s["line"]))
+        xfers = sorted(self.transfers,
+                       key=lambda t: (t["rel"], t["line"]))
+        jits = sorted((j.to_json() for j in self.jit_entries),
+                      key=lambda j: (j["rel"], j["line"]))
+        return {
+            "device_schema": DEVICE_SCHEMA,
+            "kernel_sites": sites,
+            "sync_regions": regions,
+            "sync_sites": syncs,
+            "transfers": xfers,
+            "jit_entries": jits,
+            "summary": {
+                "kernel_sites": len(sites),
+                "unclassified_kernel_sites": sum(
+                    1 for s in self.kernel_sites if not s.classified),
+                "sync_regions": len(regions),
+                "sync_sites": len(syncs),
+                "unsanctioned_syncs": sum(
+                    1 for s in syncs if s["sanction"] == "VIOLATION"),
+                "transfers": len(xfers),
+                "unportable_transfers": sum(
+                    1 for t in xfers if t["class"] == XFER_OPAQUE),
+                "jit_entries": len(jits),
+                "per_call_jit": sum(1 for v in self.violations
+                                    if v.rule == "JIT16"),
+            },
+        }
+
+
+# ------------------------------------------------------------- helpers
+
+def _own_stmts(fn_node) -> List[int]:
+    """ids of nodes in fn's own body, not descending into nested defs
+    (each nested def is collected and scanned as its own FnInfo)."""
+    out: List[int] = []
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        out.append(id(node))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _punch(spans: List[Tuple[int, int]],
+           hole: Tuple[int, int]) -> List[Tuple[int, int]]:
+    out: List[Tuple[int, int]] = []
+    for lo, hi in spans:
+        if hole[1] < lo or hole[0] > hi:
+            out.append((lo, hi))
+            continue
+        if lo < hole[0]:
+            out.append((lo, hole[0] - 1))
+        if hole[1] < hi:
+            out.append((hole[1] + 1, hi))
+    return out
+
+
+def _af01_spans(fi: FileInfo) -> List[Tuple[int, int]]:
+    spans: List[Tuple[int, int]] = []
+    start: Optional[int] = None
+    for ln in sorted(fi.comments):
+        c = fi.comments[ln]
+        if "awaitfree:begin" in c:
+            start = ln
+        elif "awaitfree:end" in c and start is not None:
+            spans.append((start, ln))
+            start = None
+    return spans
+
+
+def _module_jit_names(fi: FileInfo) -> Set[str]:
+    """Module-level jit entry names: jit-decorated top-level defs and
+    module assignments from jax.jit(...) — calling one yields a device
+    value (feeds the _DevEnv classifier)."""
+    out: Set[str] = set()
+    for node in fi.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.decorator_list:
+                if (isinstance(d, ast.Call) and _jit_call(d, fi)) or \
+                        _dotted(d, fi.aliases) == "jax.jit":
+                    out.add(node.name)
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and _jit_call(node.value, fi):
+            out.update(t.id for t in node.targets
+                       if isinstance(t, ast.Name))
+    return out
+
+
+def _cache_guards(fn_node) -> Tuple[bool, Set[str]]:
+    """(lru-decorated?, guarded names): a NAME counts as cache-guarded
+    only when IT is what the membership / is-None test inspects
+    (`if _winners_fn is None`, `if key not in self._fns`) — an
+    unrelated `mode is None` elsewhere in the body must not silence
+    the construct-and-invoke rule for a jit bound to `fn`."""
+    lru = False
+    for d in fn_node.decorator_list:
+        t = _attr_text(d) or (d.id if isinstance(d, ast.Name) else "")
+        if isinstance(d, ast.Call):
+            t = _attr_text(d.func) or t
+        if t and t.rsplit(".", 1)[-1] in ("lru_cache", "cache"):
+            lru = True
+    names: Set[str] = set()
+    for sub in ast.walk(fn_node):
+        if not isinstance(sub, ast.Compare):
+            continue
+        for op, comparator in zip(sub.ops, sub.comparators):
+            if isinstance(op, (ast.Is, ast.IsNot)):
+                # `x is None` guards x (either operand order)
+                for side in (sub.left, comparator):
+                    if isinstance(side, ast.Name):
+                        names.add(side.id)
+            elif isinstance(op, (ast.In, ast.NotIn)):
+                # `key not in cache` guards the CONTAINER's root
+                root = comparator
+                while isinstance(root, (ast.Attribute, ast.Subscript)):
+                    root = root.value
+                if isinstance(root, ast.Name):
+                    names.add(root.id)
+                if isinstance(comparator, ast.Attribute):
+                    names.add(comparator.attr)
+    return lru, names
+
+
+def _direct_invoke_parent(fn_node, call: ast.Call) -> bool:
+    """True when `call` (a jit construction) is itself the func of an
+    outer Call: jax.jit(f)(x) — construct+invoke in one expression."""
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Call) and sub.func is call:
+            return True
+    return False
+
+
+# --------------------------------------------------------- entry point
+
+_MEMO: Dict[Tuple[int, ...], DeviceAnalysis] = {}
+
+
+def analyze(files: List[FileInfo]) -> DeviceAnalysis:
+    """Memoized per file set (the three rule adapters and the report
+    share one pass); waiver queries are replayed on memo hits so the
+    unused-waiver audit stays correct (same contract as
+    seam.analyze)."""
+    key = tuple(id(fi) for fi in files)
+    got = _MEMO.get(key)
+    if got is None:
+        while len(_MEMO) >= 4:
+            _MEMO.pop(next(iter(_MEMO)))
+        got = _MEMO[key] = DeviceAnalysis(files)
+    else:
+        by_rel = {fi.rel: fi for fi in files}
+        for rel, rule, line in got.waiver_hits:
+            fi = by_rel.get(rel)
+            if fi is not None:
+                fi.waived(rule, line)
+    return got

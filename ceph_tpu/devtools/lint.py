@@ -6,6 +6,7 @@ Usage:
     python -m ceph_tpu.devtools.lint --rule AF01  # one rule only
     python -m ceph_tpu.devtools.lint --changed    # git-diff-touched only
     python -m ceph_tpu.devtools.lint --seam-report  # seam inventory JSON
+    python -m ceph_tpu.devtools.lint --device-report  # device inventory
     python -m ceph_tpu.devtools.lint path.py ...  # explicit targets
 
 Exit status is STABLE (CI keys on it): 0 = clean, 1 = violations,
@@ -13,7 +14,9 @@ Exit status is STABLE (CI keys on it): 0 = clean, 1 = violations,
 version, the exit code it implies, a per-rule summary (violation +
 waiver counts + analysis wall time), the unused-waiver audit, and —
 when the whole package is linted — the shard-seam inventory block
-(``seam``) the GIL-escape refactor consumes.  The tier-1 suite
+(``seam``) the GIL-escape refactor consumes plus the device-seam
+inventory block (``device``) the batched-CRUSH / EC device-path
+refactor consumes.  The tier-1 suite
 (tests/test_invariants.py) runs the same engine in-process over the
 live tree and fails on any violation, so an invariant regression is a
 test failure — not a separate pipeline.
@@ -39,8 +42,10 @@ from ceph_tpu.devtools.rules import (PROJECT_RULES, RULE_IDS, RULES,
 
 #: bumped whenever the --json document shape changes incompatibly
 #: (v2: seam-report block, per-rule analysis timings, unused-waiver
-#: audit, ESC12/PORT13/ATOM14 in the rule summary)
-JSON_SCHEMA = 2
+#: audit, ESC12/PORT13/ATOM14 in the rule summary; v3: device-seam
+#: block + device_analysis_ms, SYNC15/JIT16/XFER17 in the rule
+#: summary)
+JSON_SCHEMA = 3
 
 #: process-wide parse cache: abspath -> (mtime_ns, size, FileInfo).
 #: One parse feeds every rule and every lint call in the process —
@@ -226,10 +231,11 @@ def _collect(paths: Optional[Iterable[str]], rule: Optional[str],
             violations.extend(_file_rules(fi, rule, timings))
     if not run_rules:
         return violations, errors, files
-    # the three seam rules share ONE interprocedural analysis: build
-    # it up front under its own timing key so the per-rule ms report
-    # shows each rule's filter cost, not the whole analysis charged to
-    # whichever seam rule happens to run first (memo effect)
+    # the seam rules (and likewise the device rules) each share ONE
+    # interprocedural analysis: build it up front under its own timing
+    # key so the per-rule ms report shows each rule's filter cost, not
+    # the whole analysis charged to whichever rule runs first (memo
+    # effect)
     if files and (rule is None or rule in ("ESC12", "PORT13",
                                            "ATOM14")):
         from ceph_tpu.devtools.seam import analyze
@@ -237,6 +243,14 @@ def _collect(paths: Optional[Iterable[str]], rule: Optional[str],
         analyze(files)
         if timings is not None:
             timings["SEAM"] = timings.get("SEAM", 0.0) \
+                + (time.perf_counter() - t0)
+    if files and (rule is None or rule in ("SYNC15", "JIT16",
+                                           "XFER17")):
+        from ceph_tpu.devtools.device import analyze as dev_analyze
+        t0 = time.perf_counter()
+        dev_analyze(files)
+        if timings is not None:
+            timings["DEVICE"] = timings.get("DEVICE", 0.0) \
                 + (time.perf_counter() - t0)
     violations.extend(_project_rules(files, rule, timings))
     violations.sort(key=lambda v: (v.rel, v.line, v.rule))
@@ -294,6 +308,22 @@ def seam_report(paths: Optional[Iterable[str]] = None) -> dict:
     return report
 
 
+def device_report(paths: Optional[Iterable[str]] = None) -> dict:
+    """The machine-readable device-seam inventory
+    (``--device-report``): every declared candidate kernel call site
+    with its sync/retrace/transfer classification, every device-sync
+    region, transfer and jit entry — the work-list the
+    batched-CRUSH-in-the-data-path PR consumes."""
+    from ceph_tpu.devtools.device import analyze
+    _violations, _errors, files = _collect(paths, None,
+                                           run_rules=False)
+    report = analyze(files).report()
+    # a subset inventory must be distinguishable from the
+    # whole-package work-list CI commits as DEVICE_INVENTORY.json
+    report["partial"] = paths is not None
+    return report
+
+
 def lint_report(paths: Optional[Iterable[str]] = None,
                 rule: Optional[str] = None,
                 strict_waivers: bool = False) -> dict:
@@ -336,6 +366,8 @@ def lint_report(paths: Optional[Iterable[str]] = None,
         "files": len(files),
         "rules": rules_summary,
         "seam_analysis_ms": round(timings.get("SEAM", 0.0) * 1e3, 3),
+        "device_analysis_ms": round(
+            timings.get("DEVICE", 0.0) * 1e3, 3),
         "violations": [dict(v.__dict__) for v in violations],
         "unused_waivers": unused,
         "strict_waivers": bool(strict_waivers),
@@ -347,6 +379,8 @@ def lint_report(paths: Optional[Iterable[str]] = None,
         # same schema key a CI consumer might store as the work-list
         from ceph_tpu.devtools.seam import analyze
         doc["seam"] = analyze(files).report()
+        from ceph_tpu.devtools.device import analyze as dev_analyze
+        doc["device"] = dev_analyze(files).report()
     return doc
 
 
@@ -374,6 +408,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="emit the shard-seam inventory JSON "
                          "(schema-versioned; see devtools/seam.py) "
                          "and exit 0")
+    ap.add_argument("--device-report", action="store_true",
+                    help="emit the device-seam inventory JSON "
+                         "(schema-versioned; see devtools/device.py) "
+                         "and exit 0")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -389,7 +427,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     paths = args.paths or None
     if args.changed and paths is None:
         paths = changed_paths()
-        if not paths and not args.json and not args.seam_report:
+        if not paths and not args.json and not args.seam_report \
+                and not args.device_report:
             # --json consumers always get the schema document (an
             # empty-target one), never a bare text line
             print("lint --changed: no touched package files")
@@ -397,6 +436,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.seam_report:
         print(json.dumps(seam_report(paths), indent=1))
+        return 0
+
+    if args.device_report:
+        print(json.dumps(device_report(paths), indent=1))
         return 0
 
     report = lint_report(paths, rule=args.rule,

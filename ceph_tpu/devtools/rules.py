@@ -1065,6 +1065,17 @@ def _seam_rule(rule_id: str):
     return check
 
 
+def _device_rule(rule_id: str):
+    """Late-bound adapter for the device-seam analysis
+    (devtools/device.py): SYNC15 / JIT16 / XFER17 share one pass."""
+    def check(files: List[FileInfo]) -> Iterator[Violation]:
+        from ceph_tpu.devtools.device import analyze
+        for v in analyze(files).violations:
+            if v.rule == rule_id:
+                yield v
+    return check
+
+
 #: project-wide rules: run over the WHOLE linted file set at once
 PROJECT_RULES: Dict[str, Tuple[str,
                                Callable[[List[FileInfo]],
@@ -1077,6 +1088,12 @@ PROJECT_RULES: Dict[str, Tuple[str,
                _seam_rule("PORT13")),
     "ATOM14": ("GIL-atomicity reliance sits in declared regions",
                _seam_rule("ATOM14")),
+    "SYNC15": ("no implicit device->host sync on the op path",
+               _device_rule("SYNC15")),
+    "JIT16": ("jit entry points on the op path are retrace-stable",
+              _device_rule("JIT16")),
+    "XFER17": ("host<->device transfers are staged or wire-classified",
+               _device_rule("XFER17")),
 }
 
 #: SEND03 is produced by the FP02 scanner (shared dataflow pass) but is

@@ -243,10 +243,15 @@ def _callee_name(call: ast.Call) -> Optional[str]:
     return None
 
 
-def _collect_functions(files: List[FileInfo]) -> List[FnInfo]:
+def _collect_functions(files: List[FileInfo],
+                       prefixes: Tuple[str, ...] = SCOPE_PREFIXES
+                       ) -> List[FnInfo]:
+    """Function summaries for the call graph.  The default scope is the
+    shard-seam set; the device-seam pass (devtools/device.py) reuses
+    the same collector over its wider host+device module set."""
     out: List[FnInfo] = []
     for fi in files:
-        if not fi.rel.startswith(SCOPE_PREFIXES):
+        if not fi.rel.startswith(prefixes):
             continue
 
         def walk(node, cls: Optional[str]) -> None:

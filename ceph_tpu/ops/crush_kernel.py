@@ -41,6 +41,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ceph_tpu.common import devstats
 from ceph_tpu.crush.constants import (
     BUCKET_STRAW2, CRUSH_ITEM_NONE, RULE_CHOOSELEAF_FIRSTN,
     RULE_CHOOSELEAF_INDEP, RULE_CHOOSE_FIRSTN, RULE_CHOOSE_INDEP,
@@ -52,6 +53,17 @@ from ceph_tpu.crush.lntable import ln_u16_table
 from ceph_tpu.crush.types import CrushMap
 
 S64_MIN = -(2**63)
+
+
+def _enable_x64(jax_mod):
+    """x64 context manager across jax versions: ``jax.enable_x64``
+    moved to ``jax.experimental.enable_x64`` (the old attribute now
+    raises via the deprecation shim — the seed's straw2/jit tests all
+    failed on it)."""
+    fn = getattr(jax_mod, "enable_x64", None)
+    if fn is None:
+        from jax.experimental import enable_x64 as fn
+    return fn()
 
 
 class Level:
@@ -714,7 +726,7 @@ def warmup(map_: CrushMap, ruleno: int, result_max: int,
         key = (numrep, out_size, seg.firstn)
         eng = _jax_engine(seg, weights_vec)
         fast, full = eng._fn(numrep, seg.firstn, out_size)
-        with jax.enable_x64():
+        with _enable_x64(jax):
             outer_ws = tuple(jnp.asarray(lv.weights, jnp.int64)
                              for lv in seg.outer)
             leaf_ws = tuple(jnp.asarray(lv.weights, jnp.int64)
@@ -723,14 +735,24 @@ def warmup(map_: CrushMap, ruleno: int, result_max: int,
                               jnp.int64)
             shapes = {_pick_chunk(n) for n in sizes}
             shapes.add(JaxEngine.STRAGGLER_CHUNK)  # full_map's one shape
+            # device-sync:begin eager warmup compile: paid up front,
+            # outside any event loop, precisely so engine="auto" can
+            # route op-path batches without a cold-compile stall
             for n in sorted(shapes):
                 xs = jnp.arange(n, dtype=jnp.int64)
+                devstats.note_launch(
+                    "crush_map", (eng._ekey, numrep, out_size,
+                                  seg.firstn, n))
                 jax.block_until_ready(fast(xs, outer_ws, leaf_ws, wvj))
                 if n == JaxEngine.STRAGGLER_CHUNK:
+                    devstats.note_launch(
+                        "crush_map", (eng._ekey, numrep, out_size,
+                                      seg.firstn, "full"))
                     jax.block_until_ready(full(xs, outer_ws, leaf_ws,
                                                wvj))
                     eng._warm_shapes.add((key, "full"))
                 eng._warm_shapes.add((key, n))
+            # device-sync:end
         did = True
     return did
 
@@ -790,6 +812,10 @@ class JaxEngine:
         self._jax = jax
         self.cr = cr
         self.wv = np.asarray(weights_vec, np.int64)
+        # retrace-counter identity (common/devstats): one per memoized
+        # topology — _jax_engine reuses engines across epochs, so the
+        # signature space IS the compile space
+        self._ekey = hash(_engine_key(cr, weights_vec))
         self._fns = {}
         # (numrep, firstn, chunk) triples whose XLA executables exist;
         # engine_is_warm consults this so "auto" never cold-compiles
@@ -1147,7 +1173,7 @@ class JaxEngine:
         out_size = out_size or numrep
         key = (numrep, out_size, firstn)
         if key not in self._fns:
-            with self._jax.enable_x64():
+            with _enable_x64(self._jax):
                 self._fns[key] = self._build(numrep, firstn, out_size)
         return self._fns[key]
 
@@ -1174,14 +1200,19 @@ class JaxEngine:
         pad = (-X) % chunk
         xs_p = np.pad(xs, (0, pad))
         fast, full = self._fn(numrep, firstn, out_size)
-        with jax.enable_x64():
+        with _enable_x64(jax):
             outer_ws = tuple(jnp.asarray(lv.weights, jnp.int64)
                              for lv in self.cr.outer)
             leaf_ws = tuple(jnp.asarray(lv.weights, jnp.int64)
                             for lv in self.cr.leaf)
             wvj = jnp.asarray(self.wv, jnp.int64)
-            results = [fast(xs_p[i:i + chunk], outer_ws, leaf_ws, wvj)
-                       for i in range(0, len(xs_p), chunk)]
+            results = []
+            for i in range(0, len(xs_p), chunk):
+                devstats.note_launch(
+                    "crush_map", (self._ekey, numrep, out_size,
+                                  firstn, chunk))
+                results.append(fast(xs_p[i:i + chunk], outer_ws,
+                                    leaf_ws, wvj))
             self._warm_shapes.add(((numrep, out_size, firstn),
                                    chunk))
             # NOTE: deliberately NOT marking "full" here — only warmup()
@@ -1197,8 +1228,13 @@ class JaxEngine:
                     [r[1] for r in results])[:, None])
             cols.append(jnp.concatenate(
                 [r[-1] for r in results])[:, None].astype(jnp.int64))
+            # device-sync:begin result fetch: the ONE packed transfer
+            # this entry exists to produce — callers (osdmaptool,
+            # bench, the future Objecter batch) run it off the event
+            # loop / behind warm-engine gating by contract
             packed = np.asarray(
                 jnp.concatenate(cols, axis=1).astype(jnp.int32))[:X]
+            # device-sync:end
             osds = packed[:, :ncols].astype(np.int64)
             cnt = packed[:, ncols].astype(np.int64) if firstn else None
             bad = np.nonzero(packed[:, -1])[0]
@@ -1209,11 +1245,18 @@ class JaxEngine:
                 sc = self.STRAGGLER_CHUNK
                 bxs = np.pad(xs[bad], (0, (-bad.size) % sc))
                 pieces, pcnt = [], []
+                # device-sync:begin straggler fetch: compacted redo of
+                # the flagged lanes, one fixed shape, same off-loop
+                # contract as the main result fetch above
                 for i in range(0, len(bxs), sc):
+                    devstats.note_launch(
+                        "crush_map", (self._ekey, numrep, out_size,
+                                      firstn, "full"))
                     r = full(bxs[i:i + sc], outer_ws, leaf_ws, wvj)
                     pieces.append(np.asarray(r[0]))
                     if firstn:
                         pcnt.append(np.asarray(r[1]))
+                # device-sync:end
                 fixed = np.concatenate(pieces)[:bad.size]
                 osds[bad] = fixed
                 if firstn:
@@ -1233,8 +1276,64 @@ def jax_straw2_winners(items, weights, xs, rs):
     import jax
     import jax.numpy as jnp
 
-    with jax.enable_x64():   # straw2 needs 2^48-scale fixed-point ints
+    with _enable_x64(jax):   # straw2 needs 2^48-scale fixed-point ints
         return _jax_winners_x64(jax, jnp, items, weights, xs, rs)
+
+
+#: process-cached straw2 winner-grid kernel (see _get_winners_fn)
+_winners_fn = None
+
+
+def _get_winners_fn(jax, jnp):
+    """The winner-grid kernel, jitted ONCE per process.  The old shape
+    — ``@jax.jit`` on a def nested in the per-call entry — built a
+    fresh jit object (a fresh, instantly-dead compile cache) on EVERY
+    call, so even a same-shape sweep retraced every time (JIT16's
+    canonical finding).  All bucket/grid arrays are traced arguments:
+    one compile per operand SHAPE, shared across all calls."""
+    global _winners_fn
+    if _winners_fn is None:
+        def mix(a, b, c):
+            # crush_hashmix (hash.c:12-30) in uint32 wraparound math
+            a = (a - b) - c; a = a ^ (c >> 13)
+            b = (b - c) - a; b = b ^ (a << 8)
+            c = (c - a) - b; c = c ^ (b >> 13)
+            a = (a - b) - c; a = a ^ (c >> 12)
+            b = (b - c) - a; b = b ^ (a << 16)
+            c = (c - a) - b; c = c ^ (b >> 5)
+            a = (a - b) - c; a = a ^ (c >> 3)
+            b = (b - c) - a; b = b ^ (a << 10)
+            c = (c - a) - b; c = c ^ (b >> 15)
+            return a, b, c
+
+        def winners(items_i, items_u, w, ln_tab, xs_u, rs_u):
+            # crush_hash32_3(a=x, b=item, c=r): same mix schedule as
+            # hashfn.np_hash32_3 — h = seed^a^b^c, then (a,b,h)
+            # (c,x,h) (y,a,h) (b,x,h) (y,c,h) with x=231232, y=1232
+            a = jnp.broadcast_to(xs_u[:, None, None],
+                                 (xs_u.shape[0], rs_u.shape[0],
+                                  items_u.shape[0])).astype(jnp.uint32)
+            b = jnp.broadcast_to(items_u[None, None, :], a.shape)
+            c = jnp.broadcast_to(rs_u[None, :, None], a.shape)
+            h = jnp.uint32(1315423911) ^ a ^ b ^ c
+            x = jnp.full(a.shape, 231232, jnp.uint32)
+            y = jnp.full(a.shape, 1232, jnp.uint32)
+            a, b, h = mix(a, b, h)
+            c, x, h = mix(c, x, h)
+            y, a, h = mix(y, a, h)
+            b, x, h = mix(b, x, h)
+            y, c, h = mix(y, c, h)
+            u = (h & jnp.uint32(0xFFFF)).astype(jnp.int32)
+            ln = ln_tab[u] - jnp.int64(0x1000000000000)
+            draw = jnp.where(w[None, None, :] > 0,
+                             -((-ln) // jnp.maximum(w[None, None, :],
+                                                    1)),
+                             jnp.int64(S64_MIN))
+            idx = jnp.argmax(draw, axis=-1)
+            return items_i[idx]
+
+        _winners_fn = jax.jit(winners)
+    return _winners_fn
 
 
 def _jax_winners_x64(jax, jnp, items, weights, xs, rs):
@@ -1243,46 +1342,16 @@ def _jax_winners_x64(jax, jnp, items, weights, xs, rs):
                           jnp.uint32)
     items_i = jnp.asarray(items, jnp.int64)
     w = jnp.asarray(weights, jnp.int64)
-    xs = jnp.asarray(np.asarray(xs, np.int64) & 0xFFFFFFFF, jnp.uint32)
-    rs = jnp.asarray(np.asarray(rs, np.int64) & 0xFFFFFFFF, jnp.uint32)
-
-    def mix(a, b, c):
-        # crush_hashmix (hash.c:12-30) in uint32 wraparound arithmetic
-        a = (a - b) - c; a = a ^ (c >> 13)
-        b = (b - c) - a; b = b ^ (a << 8)
-        c = (c - a) - b; c = c ^ (b >> 13)
-        a = (a - b) - c; a = a ^ (c >> 12)
-        b = (b - c) - a; b = b ^ (a << 16)
-        c = (c - a) - b; c = c ^ (b >> 5)
-        a = (a - b) - c; a = a ^ (c >> 3)
-        b = (b - c) - a; b = b ^ (a << 10)
-        c = (c - a) - b; c = c ^ (b >> 15)
-        return a, b, c
-
-    @jax.jit
-    def winners(xs, rs):
-        # crush_hash32_3(a=x, b=item, c=r): same mix schedule as
-        # hashfn.np_hash32_3 — h = seed^a^b^c, then (a,b,h) (c,x,h)
-        # (y,a,h) (b,x,h) (y,c,h) with x=231232, y=1232
-        a = jnp.broadcast_to(xs[:, None, None],
-                             (xs.shape[0], rs.shape[0],
-                              items_u.shape[0])).astype(jnp.uint32)
-        b = jnp.broadcast_to(items_u[None, None, :], a.shape)
-        c = jnp.broadcast_to(rs[None, :, None], a.shape)
-        h = jnp.uint32(1315423911) ^ a ^ b ^ c
-        x = jnp.full(a.shape, 231232, jnp.uint32)
-        y = jnp.full(a.shape, 1232, jnp.uint32)
-        a, b, h = mix(a, b, h)
-        c, x, h = mix(c, x, h)
-        y, a, h = mix(y, a, h)
-        b, x, h = mix(b, x, h)
-        y, c, h = mix(y, c, h)
-        u = (h & jnp.uint32(0xFFFF)).astype(jnp.int32)
-        ln = ln_tab[u] - jnp.int64(0x1000000000000)
-        draw = jnp.where(w[None, None, :] > 0,
-                         -((-ln) // jnp.maximum(w[None, None, :], 1)),
-                         jnp.int64(S64_MIN))
-        idx = jnp.argmax(draw, axis=-1)
-        return items_i[idx]
-
-    return np.asarray(winners(xs, rs))
+    xs_u = jnp.asarray(np.asarray(xs, np.int64) & 0xFFFFFFFF,
+                       jnp.uint32)
+    rs_u = jnp.asarray(np.asarray(rs, np.int64) & 0xFFFFFFFF,
+                       jnp.uint32)
+    winners = _get_winners_fn(jax, jnp)
+    devstats.note_launch(
+        "crush_winners",
+        (items_u.shape[0], len(xs_u), len(rs_u)))
+    # device-sync:begin winner-grid fetch: offline grid entry
+    # (tests/bench sweeps) — never called from an event loop
+    return np.asarray(winners(items_i, items_u, w, ln_tab, xs_u,
+                              rs_u))
+    # device-sync:end

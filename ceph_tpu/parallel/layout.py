@@ -30,6 +30,21 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def shard_map_check_kwargs(shard_map_fn) -> dict:
+    """Version-portable shard_map replication-check kwarg: the flag
+    was renamed check_rep -> check_vma across jax releases (the seed's
+    mesh tests failed on whichever name the installed jax lacked)."""
+    import inspect
+    try:
+        params = inspect.signature(shard_map_fn).parameters
+    except (TypeError, ValueError):
+        return {}
+    for name in ("check_vma", "check_rep"):
+        if name in params:
+            return {name: False}
+    return {}
+
+
 def make_mesh(n_devices: Optional[int] = None,
               axes: Sequence[str] = ("host", "shard")) -> Mesh:
     """Mesh over the first n devices: 'host' x 'shard', shard innermost so
@@ -79,7 +94,7 @@ def ec_cluster_step(mesh: Mesh, bitmat: jnp.ndarray):
         step, mesh=mesh,
         in_specs=(P("host", None, "shard"),),
         out_specs=(P("host", None, "shard"), P()),
-        check_vma=False)
+        **shard_map_check_kwargs(shard_map))
     return jax.jit(sharded)
 
 
@@ -125,7 +140,7 @@ def ec_recover_step(mesh: Mesh, dec_bitmat: jnp.ndarray,
         step, mesh=mesh,
         in_specs=(P("host", "shard", None),),
         out_specs=(P("host", None, None), P()),
-        check_vma=False)
+        **shard_map_check_kwargs(shard_map))
     return jax.jit(sharded)
 
 

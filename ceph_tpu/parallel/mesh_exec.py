@@ -60,6 +60,7 @@ def _mesh_encode_fn(n: int, k: int, mat_bytes: bytes):
     except ImportError:  # pragma: no cover - older jax
         from jax.experimental.shard_map import shard_map
     from ceph_tpu.ec.gf256 import expand_to_bitmatrix
+    from ceph_tpu.parallel.layout import shard_map_check_kwargs
 
     gen = np.frombuffer(mat_bytes, np.uint8).reshape(n, k)
     # per-shard 8-row bit-matrix blocks: blocks[i] computes shard i
@@ -99,7 +100,7 @@ def _mesh_encode_fn(n: int, k: int, mat_bytes: bytes):
     fn = shard_map(step, mesh=mesh,
                    in_specs=(P("shard", None),),
                    out_specs=P("shard", None),
-                   check_vma=False)
+                   **shard_map_check_kwargs(shard_map))
     return jax.jit(fn), mesh
 
 
@@ -142,12 +143,19 @@ class MeshExecutor:
         Lc = len(chunks[0])
 
         def _launch():
-            fn, _mesh = _mesh_encode_fn(
-                n, k, np.ascontiguousarray(gen, np.uint8).tobytes())
+            from ceph_tpu.common import devstats
+            mat_bytes = np.ascontiguousarray(gen, np.uint8).tobytes()
+            fn, _mesh = _mesh_encode_fn(n, k, mat_bytes)
             inp = np.zeros((n, Lc), np.uint8)
             for i in range(k):
                 inp[i] = chunks[i]
+            devstats.note_launch("mesh_encode",
+                                 (n, k, hash(mat_bytes), Lc))
+            # device-sync:begin sharded-encode fetch: this closure runs
+            # on the mesh executor's own thread (run_in_executor above)
+            # — the event loop only awaits the handoff
             return np.asarray(fn(inp))
+            # device-sync:end
 
         out = await asyncio.get_running_loop().run_in_executor(
             self._pool, _launch)

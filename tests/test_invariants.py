@@ -49,7 +49,8 @@ def test_cli_entry_point_runs_standalone():
     assert out.returncode == 0, out.stderr
     for rid in ("AF01", "FP02", "SEND03", "BLK04", "MONO05",
                 "LOCK06", "FIN07", "PROTO08", "REPLY09", "EPOCH10",
-                "SHARD11", "ESC12", "PORT13", "ATOM14"):
+                "SHARD11", "ESC12", "PORT13", "ATOM14", "SYNC15",
+                "JIT16", "XFER17"):
         assert rid in out.stdout
 
 
@@ -86,6 +87,12 @@ def test_cli_json_smoke_schema_roundtrips():
     # schema v2: the full-package run carries the seam inventory
     assert doc["seam"]["seam_schema"] >= 1
     assert doc["seam"]["summary"]["unprotected_structures"] == 0
+    # schema v3: ... and the device inventory, clean on the live tree
+    assert doc["device"]["device_schema"] >= 1
+    assert doc["device"]["summary"]["unclassified_kernel_sites"] == 0
+    assert doc["device"]["summary"]["unsanctioned_syncs"] == 0
+    assert doc["device"]["summary"]["per_call_jit"] == 0
+    assert "device_analysis_ms" in doc
     # byte-true JSON round trip (CI stores and diffs these)
     assert json.loads(json.dumps(doc)) == doc
 
@@ -784,7 +791,199 @@ def test_seam_report_fixture_inventory():
     assert json.loads(json.dumps(rep)) == rep
 
 
-# ================================ 2c. waiver audit + lint performance
+# ============================ 2c. device rules (SYNC15/JIT16/XFER17)
+
+
+def test_sync15_device_sync_in_async_op_path():
+    """ISSUE 14 tentpole: an implicit device->host sync inside an
+    async op-path function stalls the shard loop — violation."""
+    src = (
+        "class ECBackend:\n"
+        "    async def _encode_object(self, data):\n"
+        "        y = self.kernel.device_call(data)\n"
+        "        return float(y)\n"
+    )
+    vio = lint_project_sources([("osd/fixture.py", src)])
+    assert [v.rule for v in vio] == ["SYNC15"], vio
+    assert "device->host sync" in vio[0].msg
+    # the sanctioned shape: await the executor, fetch nothing inline
+    clean = (
+        "class ECBackend:\n"
+        "    async def _encode_object(self, data):\n"
+        "        parity = await self.ec_queue.apply(self.gen, data)\n"
+        "        return parity\n"
+    )
+    assert lint_project_sources([("osd/fixture.py", clean)]) == []
+
+
+def test_sync15_declared_region_in_sync_fn_passes():
+    """A declared device-sync region sanctions the fetch — but only in
+    a SYNC function (the executor shape); the same region inside an
+    async def is itself a violation."""
+    import textwrap
+    region = textwrap.dedent("""\
+        def _run_group(self, chunks):
+            out = self.kernel.device_call(chunks)
+            # device-sync:begin executor-thread group fetch
+            res = np.asarray(out)
+            # device-sync:end
+            return res
+        """)
+    assert lint_project_sources([("ec/kernel.py", region)]) == []
+    bare = region.replace(
+        "    # device-sync:begin executor-thread group fetch\n", "") \
+        .replace("    # device-sync:end\n", "")
+    vio = lint_project_sources([("ec/kernel.py", bare)])
+    assert [v.rule for v in vio] == ["SYNC15"], vio
+    async_region = "async " + region
+    vio = lint_project_sources([("ec/kernel.py", async_region)])
+    assert vio and all(v.rule == "SYNC15" for v in vio), vio
+    assert any("async" in v.msg for v in vio)
+    waived = bare.replace(
+        "    res = np.asarray(out)\n",
+        "    # lint: allow[SYNC15] fixture: measured fetch\n"
+        "    res = np.asarray(out)\n")
+    assert lint_project_sources([("ec/kernel.py", waived)]) == []
+
+
+def test_sync15_region_hygiene():
+    no_reason = (
+        "def fetch(self, out):\n"
+        "    # device-sync:begin\n"
+        "    return np.asarray(out)\n"
+        "    # device-sync:end\n"
+    )
+    vio = lint_project_sources([("ec/kernel.py", no_reason)])
+    assert [v.rule for v in vio] == ["SYNC15"], vio
+    assert "reason" in vio[0].msg
+    unclosed = (
+        "def fetch(self, out):\n"
+        "    # device-sync:begin fixture fetch\n"
+        "    return out\n"
+    )
+    vio = lint_project_sources([("ec/kernel.py", unclosed)])
+    assert [v.rule for v in vio] == ["SYNC15"], vio
+
+
+def test_jit16_per_call_jit_lambda():
+    """The live-tree catch: the ec/kernel.py autotuner built a
+    jax.jit(lambda ...) per variant per sweep — a fresh compile cache
+    every call."""
+    src = (
+        "def _tune(self, d):\n"
+        "    import jax\n"
+        "    fetch = jax.jit(lambda x: x + 1)\n"
+        "    return fetch(d)\n"
+    )
+    vio = lint_project_sources([("ec/fixture.py", src)])
+    assert vio and {v.rule for v in vio} == {"JIT16"}, vio
+    assert any("lambda" in v.msg for v in vio)
+
+
+def test_jit16_builder_return_and_guarded_cache_pass():
+    builder = (
+        "def make_step(step):\n"
+        "    import jax\n"
+        "    return jax.jit(step)\n"
+    )
+    assert lint_project_sources([("ops/fixture.py", builder)]) == []
+    guarded = (
+        "_fn_cache = {}\n"
+        "def get_step(self, key, step):\n"
+        "    import jax\n"
+        "    if key not in _fn_cache:\n"
+        "        _fn_cache[key] = jax.jit(step)\n"
+        "    return _fn_cache[key]\n"
+    )
+    assert lint_project_sources([("ops/fixture.py", guarded)]) == []
+    # the guarded-GLOBAL shape (crush_kernel._get_winners_fn):
+    # construct once behind `x is None`, invoke the cached object
+    global_cache = (
+        "_fn = None\n"
+        "def step_fn(self, step, x):\n"
+        "    import jax\n"
+        "    global _fn\n"
+        "    if _fn is None:\n"
+        "        _fn = jax.jit(step)\n"
+        "    return _fn(x)\n"
+    )
+    assert lint_project_sources([("ops/fixture.py", global_cache)]) == []
+    # construct-and-invoke with NO cache guard: every call retraces
+    unguarded = (
+        "def run_step(self, step, x):\n"
+        "    import jax\n"
+        "    fn = jax.jit(step)\n"
+        "    return fn(x)\n"
+    )
+    vio = lint_project_sources([("ops/fixture.py", unguarded)])
+    assert vio and {v.rule for v in vio} == {"JIT16"}, vio
+    # an UNRELATED is/in comparison in the body must not silence the
+    # rule: only a guard on the jit binding itself sanctions it
+    decoy_guard = (
+        "def run_step(self, step, x, mode=None):\n"
+        "    import jax\n"
+        "    if mode is None:\n"
+        "        mode = 'a'\n"
+        "    fn = jax.jit(step)\n"
+        "    return fn(x)\n"
+    )
+    vio = lint_project_sources([("ops/fixture.py", decoy_guard)])
+    assert vio and {v.rule for v in vio} == {"JIT16"}, vio
+
+
+def test_xfer17_opaque_transfer_trips_staged_and_wire_pass():
+    opaque = (
+        "def _stage(self, blob):\n"
+        "    import jax.numpy as jnp\n"
+        "    return jnp.asarray(blob)\n"
+    )
+    vio = lint_project_sources([("osd/fixture.py", opaque)])
+    assert [v.rule for v in vio] == ["XFER17"], vio
+    assert "stage it" in vio[0].msg
+    clean = (
+        "def _stage(self, chunks, table):\n"
+        "    import jax\n"
+        "    import jax.numpy as jnp\n"
+        "    a = jnp.asarray(chunks)\n"          # wire-classified buffer
+        "    b = jax.device_put(table)\n"        # declared staging
+        "    return a, b\n"
+    )
+    assert lint_project_sources([("osd/fixture.py", clean)]) == []
+    waived = opaque.replace(
+        "    return jnp.asarray(blob)\n",
+        "    # lint: allow[XFER17] fixture: blob layout pinned upstream\n"
+        "    return jnp.asarray(blob)\n")
+    assert lint_project_sources([("osd/fixture.py", waived)]) == []
+
+
+def test_device_report_fixture_inventory():
+    """The device inventory classifies candidate kernel sites with
+    sync/retrace/transfer verdicts (fixture-scale; the live tree is
+    covered by the subprocess smoke)."""
+    from ceph_tpu.devtools.device import DeviceAnalysis
+    from ceph_tpu.devtools.rules import FileInfo
+    src = (
+        "class Objecter:\n"
+        "    def _flush_cork(self, key):\n"
+        "        pend = self._cork.pop(key)\n"
+        "        # device-candidate:crush-placement one batched kernel\n"
+        "        # call per cork (CHUNK_SIZES-bucketed)\n"
+        "        self.messenger.send_message(pend)\n"
+    )
+    an = DeviceAnalysis([FileInfo("client/fixture.py", src)])
+    assert an.violations == []
+    rep = an.report()
+    assert rep["device_schema"] >= 1
+    (site,) = rep["kernel_sites"]
+    assert site["kind"] == "crush-placement"
+    assert site["fn"].endswith("_flush_cork")
+    assert site["sync"] == "clean"
+    assert site["retrace"] == "CHUNK_SIZES"
+    assert rep["summary"]["unclassified_kernel_sites"] == 0
+    assert json.loads(json.dumps(rep)) == rep
+
+
+# ================================ 2d. waiver audit + lint performance
 
 
 def test_unused_waiver_detection_and_strict_promotion():
@@ -905,7 +1104,7 @@ def test_cli_changed_mode_smoke():
     assert out.returncode == 0, out.stdout + out.stderr
 
 
-# ==================================== 2d. seam inventory (committed)
+# ============================= 2e. committed inventories (seam+device)
 
 
 def test_cli_seam_report_roundtrips_and_matches_committed():
@@ -966,6 +1165,77 @@ def test_cli_seam_report_roundtrips_and_matches_committed():
         "SEAM_INVENTORY.json drifted from the live tree — regenerate " \
         "with: python -m ceph_tpu.devtools.lint --seam-report > " \
         "SEAM_INVENTORY.json"
+
+
+def test_cli_device_report_roundtrips_and_matches_committed():
+    """Acceptance (ISSUE 14): `ceph-tpu-lint --device-report` emits a
+    schema-versioned inventory with every candidate kernel call site
+    classified (sync/retrace/transfer), zero unsanctioned syncs, zero
+    unportable transfers, zero per-call jit — and the committed
+    DEVICE_INVENTORY.json stays structurally in sync, so the
+    batched-CRUSH-in-the-data-path work-list cannot silently rot."""
+    import pathlib
+    from ceph_tpu.devtools.device import DEVICE_SCHEMA
+    out = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.devtools.lint",
+         "--device-report"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["device_schema"] == DEVICE_SCHEMA
+    assert doc["partial"] is False    # whole-package work-list
+    assert json.loads(json.dumps(doc)) == doc
+    s = doc["summary"]
+    assert s["unclassified_kernel_sites"] == 0
+    assert s["unsanctioned_syncs"] == 0
+    assert s["unportable_transfers"] == 0
+    assert s["per_call_jit"] == 0
+    # the ISSUE-named candidate sites are all inventoried + classified
+    kinds = {k["kind"]: k for k in doc["kernel_sites"]}
+    assert "crush-placement" in kinds       # Objecter corked batch
+    assert "ec-encode" in kinds             # ECBackend via ec_queue
+    assert "ec-decode" in kinds             # degraded-read rebuild
+    assert "decode-rebuild" in kinds        # recovery rebuild
+    assert "ec-dispatch" in kinds           # the live executor launch
+    assert kinds["crush-placement"]["retrace"] == "CHUNK_SIZES"
+    assert kinds["ec-encode"]["sync"] == "clean"
+    assert kinds["ec-dispatch"]["side"] == "executor"
+    assert kinds["ec-dispatch"]["sync"] == "declared-region"
+    assert kinds["ec-dispatch"]["transfer"] == "staged"
+    # every jit entry carries a cache kind; none are per-call
+    for j in doc["jit_entries"]:
+        assert j["cache"] in ("module", "builder-return",
+                              "guarded-cache"), j
+    # the fixed live-tree findings stay fixed: the autotuner probe is
+    # a module-level jit entry, the winners kernel a guarded cache
+    names = {(j["rel"], j["name"]): j["cache"]
+             for j in doc["jit_entries"]}
+    assert names[("ec/kernel.py", "_pallas_probe_sum")] == "module"
+    assert names[("ops/crush_kernel.py",
+                  "_get_winners_fn")] == "guarded-cache"
+    # committed work-list stays structurally in sync (regenerate with
+    # `python -m ceph_tpu.devtools.lint --device-report` on drift)
+    committed_path = pathlib.Path(__file__).parent.parent \
+        / "DEVICE_INVENTORY.json"
+    committed = json.loads(committed_path.read_text())
+    assert committed["device_schema"] == doc["device_schema"]
+    assert committed["partial"] is False
+
+    def shape(d):
+        return {
+            "sites": sorted((s["rel"], s["kind"], s["side"], s["sync"],
+                             s["retrace"], s["transfer"])
+                            for s in d["kernel_sites"]),
+            "regions": sorted(r["rel"] for r in d["sync_regions"]),
+            "jits": sorted((j["rel"], j["name"], j["cache"])
+                           for j in d["jit_entries"]),
+            "syncs": sorted((s["rel"], s["api"], s["sanction"])
+                            for s in d["sync_sites"]),
+        }
+    assert shape(committed) == shape(doc), \
+        "DEVICE_INVENTORY.json drifted from the live tree — " \
+        "regenerate with: python -m ceph_tpu.devtools.lint " \
+        "--device-report > DEVICE_INVENTORY.json"
 
 
 # ============================================= 3. runtime lockdep layer

@@ -402,3 +402,59 @@ def test_save_meta_bytes_per_write_are_o1_in_log_length():
         await cl.stop()
 
     asyncio.run(run())
+
+
+def test_device_kernel_compile_count_plateaus():
+    """ISSUE 14 guard (runtime half of the device-seam pass): a
+    steady-state EC workload through the cross-PG device queue must
+    PLATEAU at a fixed jit compile count — the lane-bucket padding
+    (osd/ec_queue.py LANE_BUCKETS) means every round after the first
+    replays already-compiled signatures, so kernel launches keep
+    growing while compiles (distinct signatures per common/devstats)
+    stay flat.  A per-op retrace — the regression JIT16 can't see
+    statically (unhashable statics, shape-per-call drift) — fails
+    here, in tier-1, not in a bench review.  msg_encode_calls stays
+    pinned at 0 throughout: the device path must never touch the
+    message codec."""
+    import numpy as np
+
+    from ceph_tpu.common import devstats
+    from ceph_tpu.common.context import Context
+    from ceph_tpu.ec import gf256
+    from ceph_tpu.msg import payload
+    from ceph_tpu.osd.ec_queue import ECBatchQueue
+
+    enc0 = payload.counters()["msg_encode_calls"]
+    devstats.reset()
+
+    async def run():
+        q = ECBatchQueue(Context("osd.0"), mode="force",
+                         window_ms=2.0, min_device_bytes=256)
+        mat = gf256.rs_vandermonde_matrix(4, 2)[4:]
+        rng = np.random.default_rng(7)
+        snaps = []
+        for _round in range(3):
+            # varied per-request lengths, same folded lane bucket:
+            # the steady-state shape of a running cluster
+            ins = [rng.integers(0, 256, (4, 900 + 128 * i),
+                                dtype=np.uint8) for i in range(6)]
+            outs = await asyncio.gather(
+                *[q.apply(mat, c) for c in ins])
+            for c, o in zip(ins, outs):
+                assert np.array_equal(o, gf256.host_apply(mat, c)), \
+                    "device bytes diverged from the host kernel"
+            snaps.append(devstats.counters())
+        await q.stop()
+        return snaps
+
+    snaps = asyncio.run(run())
+    compiles = [s["compiles"].get("ec_apply", 0) for s in snaps]
+    launches = [s["launches"].get("ec_apply", 0) for s in snaps]
+    assert launches[0] >= 1 and launches[2] > launches[1] > \
+        launches[0], launches               # work kept flowing
+    assert compiles[0] >= 1, compiles       # ...through the device
+    assert compiles[2] == compiles[1] == compiles[0], \
+        (f"jit compile count kept growing across steady-state rounds "
+         f"{compiles}: a per-op retrace slipped into the kernel path")
+    assert payload.counters()["msg_encode_calls"] == enc0, \
+        "device-queue workload bumped the message codec"
