@@ -464,7 +464,11 @@ def stage_ec_e2e():
         win = cl.window_counters()
         # per-op tracer: stage breakdown vs the independently measured
         # e2e latencies — the unattributed fraction is the part of the
-        # op path no named stage covers (read BEFORE stop)
+        # op path no named stage covers (read BEFORE stop).  Process
+        # lanes: scrape each worker's stage histograms first (metrics
+        # plane, FRAME_RPC), or the lane-side pipeline would read as
+        # one unattributed hole
+        await cl.refresh_lane_metrics()
         bd = cl.stage_breakdown(measured_e2e_s=sum(lats))
         # lazy-payload guard: with ms_local_delivery on, in-process hops
         # must not serialize message bodies at all (read BEFORE stop)
@@ -484,16 +488,31 @@ def stage_ec_e2e():
         stage_p = {name: [d["p50_ms"], d["p99_ms"]]
                    for name, d in bd["stages"].items()}
         # the ISSUE 10 acceptance metric: combined queueing/delivery
-        # share of e2e (dep_wait + queue_wait + deliver + ack_delivery)
+        # share of e2e.  COMPARABLE with the recorded 0.47-0.49
+        # series: the old monolithic queue_wait is exactly
+        # queue_wait_ring + queue_wait_pump after the ISSUE 15 cause
+        # split (throttle_wait/admit_wait were always separate stages
+        # and stay excluded here; ring_wait is lane-hop time that was
+        # previously UNATTRIBUTED, also excluded from this share).
+        # The by-cause dict below reports the full taxonomy so the
+        # next capture says WHICH seam to attack.
+        from ceph_tpu.common.tracer import QUEUE_WAIT_CAUSES
+        q_stages = ("dep_wait", "deliver", "ack_delivery",
+                    "queue_wait_ring", "queue_wait_pump")
         qshare = sum(bd["stages"].get(s, {}).get("sum_s", 0.0)
-                     for s in ("dep_wait", "queue_wait", "deliver",
-                               "ack_delivery"))
+                     for s in q_stages)
         qshare = qshare / bd["measured_s"] if bd["measured_s"] else 0.0
+        q_by_cause = {
+            s: round(bd["stages"].get(s, {}).get("sum_s", 0.0)
+                     / bd["measured_s"], 3)
+            for s in QUEUE_WAIT_CAUSES + ("admit_wait",)} \
+            if bd["measured_s"] else {}
         return {
             "shards": shards,
             "lane_backend": lanes or "auto",
             "op_batching": op_batching,
             "queueing_delivery_share": round(qshare, 3),
+            "queueing_share_by_cause": q_by_cause,
             "shard_counters": shard_c,
             "objecter_batches": obj_batches,
             "objecter_batched_ops": obj_batched_ops,
@@ -1078,6 +1097,38 @@ def main():
                 "stage_p50_p99_ms": reads.get("stage_p50_p99_ms", {}),
                 "unattributed_frac": reads.get("unattributed_frac",
                                                0.0),
+            })
+        lanes = e2e.get("ec_e2e_rados_write_lanes_k2m2") or {}
+        if lanes:
+            # ISSUE 15 lane axis row: per-MODE stage breakdown +
+            # queueing share BY CAUSE (throttle vs ring vs pump), so
+            # the next multi-core capture explains itself — under
+            # process lanes the stage histograms now include every
+            # lane worker's slice via the metrics plane
+            proc = lanes.get("process") or {}
+            best = proc or lanes.get("inline") or {}
+            extra.append({
+                "metric": "ec_e2e_rados_write_lanes_k2m2",
+                "value": best.get("mb_s", 0.0), "unit": "MB/s",
+                "vs_baseline": best.get("vs_inline", 1.0),
+                "backend": ("cluster+process_lanes" if proc
+                            else "cluster+shard_lanes"),
+                "iodepth": 16,
+                "modes": {
+                    mode: {
+                        "mb_s": r.get("mb_s", 0.0),
+                        "p50_ms": r.get("p50_ms", 0.0),
+                        "p99_ms": r.get("p99_ms", 0.0),
+                        "vs_inline": r.get("vs_inline", 0.0),
+                        "unattributed_frac": r.get(
+                            "unattributed_frac", 0.0),
+                        "queueing_delivery_share": r.get(
+                            "queueing_delivery_share", 0.0),
+                        "queueing_share_by_cause": r.get(
+                            "queueing_share_by_cause", {}),
+                        "stage_p50_p99_ms": r.get(
+                            "stage_p50_p99_ms", {}),
+                    } for mode, r in lanes.items()},
             })
 
     line = {

@@ -89,8 +89,17 @@ class Objecter(Dispatcher):
             if op.span is not None and not op.span.finished:
                 # close the trace: the reply transit back is the last
                 # chain segment, then op_total (t0 -> now) lands as the
-                # aux e2e the coverage guard measures the chain against
+                # aux e2e the coverage guard measures the chain against.
+                # A reply that crossed a process-lane ring carries the
+                # lane's send stamp (converted to this clock by the
+                # parent): rebase the cursor onto it so ack_delivery
+                # covers only the reply leg — the skipped window is the
+                # lane worker's service time, recorded by the lane's
+                # own continuation span (merging would double count)
                 tr = self.ctx.tracer
+                anchor = getattr(m, "_lane_sent_mono", 0.0)
+                if anchor:
+                    op.span.rebase(anchor)
                 op.span.cut("ack_delivery", tr.hist)
                 tr.finish(op.span)
             if not op.fut.done():

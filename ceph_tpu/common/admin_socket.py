@@ -36,6 +36,11 @@ class AdminSocket:
                       lambda cmd: ctx.perf.dump_histograms(),
                       "latency histograms (log2-us buckets, "
                       "p50/p99/p999) per counter group")
+        self.register("perf dump full", self._perf_dump_full,
+                      "mergeable metrics-plane snapshot "
+                      "(common/metrics.py: counters + bucketed "
+                      "histograms + devstats); daemons with process "
+                      "lanes override with a lane-complete version")
         self.register("config show", lambda cmd: ctx.config.dump(),
                       "dump current config values")
         self.register("config set", self._config_set,
@@ -47,6 +52,12 @@ class AdminSocket:
 
     def register(self, command: str, fn: Callable, help_: str = "") -> None:
         self._commands[command] = (fn, help_)
+
+    def _perf_dump_full(self, cmd: dict) -> dict:
+        from ceph_tpu.common import metrics
+        return {"metrics_schema": metrics.METRICS_SCHEMA,
+                "snapshots": [metrics.snapshot(self.ctx)],
+                "lane_dead": []}
 
     def _config_set(self, cmd: dict):
         key, value = cmd["args"][0], cmd["args"][1]

@@ -391,4 +391,8 @@ DEFAULT_OPTIONS: List[Option] = [
            "ops in flight longer than this log one slow-op complaint "
            "and count in the osd.slow_ops counter "
            "(osd_op_complaint_time, osd/OSD.cc check_ops_in_flight)"),
+    Option("osd_flight_recorder_size", "int", 64,
+           "bounded ring of slow-op stage records kept per daemon for "
+           "post-hoc attribution (dump_flight_recorder admin command); "
+           "one record at complaint time + one at finish per slow op"),
 ]

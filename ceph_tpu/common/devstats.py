@@ -29,6 +29,32 @@ _lock = threading.Lock()
 _launches: Dict[str, int] = {}
 _compiles: Dict[str, int] = {}
 _seen: Dict[str, Set[Hashable]] = {}
+# XFER17-classified transfer accounting: bytes that crossed to the
+# device through a declared staging transfer vs bytes the host-kernel
+# fallback processed instead — the LIVE substrate of the metrics
+# plane's device_byte_fraction (until now that number only ever
+# existed inside bench.py's own counter arithmetic).
+_bytes_device: Dict[str, int] = {}
+_bytes_host: Dict[str, int] = {}
+
+
+def note_bytes(domain: str, nbytes: int, device: bool) -> None:
+    """Record ``nbytes`` of payload processed in ``domain`` — on the
+    device (the declared XFER17 staging transfer fed it) or on the
+    host fallback kernel."""
+    with _lock:
+        d = _bytes_device if device else _bytes_host
+        d[domain] = d.get(domain, 0) + int(nbytes)
+
+
+def byte_fraction() -> float:
+    """Live device_byte_fraction: device-processed bytes over all
+    bytes, 0.0 when nothing has flowed yet."""
+    with _lock:
+        dev = sum(_bytes_device.values())
+        host = sum(_bytes_host.values())
+    total = dev + host
+    return round(dev / total, 4) if total else 0.0
 
 
 def note_launch(domain: str, signature: Hashable) -> bool:
@@ -53,6 +79,10 @@ def counters() -> dict:
             "compiles": dict(_compiles),
             "total_launches": sum(_launches.values()),
             "total_compiles": sum(_compiles.values()),
+            "bytes_device": dict(_bytes_device),
+            "bytes_host": dict(_bytes_host),
+            "total_bytes_device": sum(_bytes_device.values()),
+            "total_bytes_host": sum(_bytes_host.values()),
         }
 
 
@@ -61,3 +91,5 @@ def reset() -> None:
         _launches.clear()
         _compiles.clear()
         _seen.clear()
+        _bytes_device.clear()
+        _bytes_host.clear()

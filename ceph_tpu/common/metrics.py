@@ -1,0 +1,184 @@
+"""Cluster-wide mergeable metrics plane.
+
+Reference parity: the mgr's cluster-wide perf scrape + Prometheus
+exposition (pybind/mgr/prometheus) — every daemon (and, since process
+shard lanes, every LANE WORKER) ships one schema-versioned snapshot of
+its full perf state, and any consumer folds N snapshots into one
+cluster view with plain bucket-wise arithmetic.
+
+The unit of exchange is the SNAPSHOT:
+
+    {"metrics_schema": 1,
+     "source": "osd.0" | "osd.0/lane1" | "client.admin" | ...,
+     "groups": {group: {key: int | {"avgcount","sum"}
+                              | {"count","sum_s",...,"buckets":[...]}}},
+     "devstats": {launches, compiles, bytes_device, bytes_host, ...},
+     "device_byte_fraction": 0.0..1.0}
+
+``groups`` is ``PerfCountersCollection.dump_full()`` — histograms keep
+their raw log2 bucket vectors, so a remote consumer reconstructs each
+one bit-for-bit via ``PerfHistogram.from_dump`` (quantile
+interpolation included: count/sum/buckets are integers + one float
+that round-trips exactly through JSON).  That is what makes the plane
+MERGEABLE: lane workers dump over FRAME_STATS/FRAME_RPC ring frames,
+daemons over the admin socket, and ``merge()`` needs no live objects
+from either.
+
+``device_byte_fraction`` is LIVE: computed from the XFER17-classified
+transfer accounting in common/devstats.py (bytes fed to device kernels
+through declared staging transfers vs host-fallback bytes) — until
+this module, that number only existed inside bench.py's private
+counter arithmetic.
+
+Merging never touches message bodies or encoders, so the zero-encode
+invariant (``msg_encode_calls == 0`` on the local path) holds with the
+metrics plane on — perf-smoke guards exactly that.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from ceph_tpu.common import devstats
+from ceph_tpu.common.perf_counters import PerfHistogram
+
+#: bumped whenever the snapshot shape changes incompatibly (same
+#: discipline as the lint/bench schema stamps)
+METRICS_SCHEMA = 1
+
+
+def snapshot(ctx, source: Optional[str] = None) -> dict:
+    """One daemon's (or lane worker's) full mergeable perf state.
+    ``pid`` stamps the owning process: devstats counters are
+    PROCESS-global, so when several daemons of one process each
+    snapshot (an in-process qa cluster), merge() must count that
+    process's devstats once, not once per daemon."""
+    import os
+    return {
+        "metrics_schema": METRICS_SCHEMA,
+        "source": source or ctx.name,
+        "pid": os.getpid(),
+        "groups": ctx.perf.dump_full(),
+        "devstats": devstats.counters(),
+        "device_byte_fraction": devstats.byte_fraction(),
+    }
+
+
+def _merge_value(into: dict, key: str, v) -> None:
+    cur = into.get(key)
+    if isinstance(v, dict) and "buckets" in v:
+        h = PerfHistogram.from_dump(v)
+        if isinstance(cur, PerfHistogram):
+            cur.merge(h)
+        else:
+            into[key] = h
+    elif isinstance(v, dict) and "avgcount" in v:
+        if isinstance(cur, dict) and "avgcount" in cur:
+            cur["avgcount"] += v.get("avgcount", 0)
+            cur["sum"] += v.get("sum", 0.0)
+        else:
+            into[key] = {"avgcount": v.get("avgcount", 0),
+                         "sum": v.get("sum", 0.0)}
+    elif isinstance(v, (int, float)) and not isinstance(v, bool):
+        into[key] = (cur if isinstance(cur, (int, float)) else 0) + v
+    elif cur is None:
+        into[key] = v
+
+
+def merge(snapshots: Iterable[dict],
+          lane_dead: Iterable = ()) -> dict:
+    """Fold N snapshots into ONE cluster-wide view.
+
+    Counters sum, avg pairs sum component-wise, histograms merge
+    bucket-wise (then re-dump with recomputed quantiles), devstats
+    byte/launch counters sum and the cluster ``device_byte_fraction``
+    is recomputed from the summed transfer bytes.  ``lane_dead`` names
+    sources whose snapshot could NOT be fetched — they are carried
+    loudly in the output, never silently dropped."""
+    groups: Dict[str, Dict[str, object]] = {}
+    dev_totals: Dict[str, float] = {}
+    sources: List[str] = []
+    seen_pids = set()
+    schema = METRICS_SCHEMA
+    for snap in snapshots:
+        if not snap:
+            continue
+        schema = max(schema, int(snap.get("metrics_schema", 1)))
+        sources.append(str(snap.get("source", "?")))
+        for gname, g in (snap.get("groups") or {}).items():
+            into = groups.setdefault(gname, {})
+            for key, v in g.items():
+                _merge_value(into, key, v)
+        # devstats are process-global: sum them once per PROCESS, not
+        # once per daemon snapshot (an in-process cluster shares them)
+        pid = snap.get("pid")
+        if pid is not None and pid in seen_pids:
+            continue
+        seen_pids.add(pid)
+        ds = snap.get("devstats") or {}
+        for key in ("total_launches", "total_compiles",
+                    "total_bytes_device", "total_bytes_host"):
+            dev_totals[key] = dev_totals.get(key, 0) + int(ds.get(key, 0))
+    out_groups: Dict[str, Dict[str, object]] = {}
+    for gname, g in groups.items():
+        out_groups[gname] = {
+            key: (v.dump_full() if isinstance(v, PerfHistogram) else v)
+            for key, v in g.items()}
+    byte_total = (dev_totals.get("total_bytes_device", 0)
+                  + dev_totals.get("total_bytes_host", 0))
+    return {
+        "metrics_schema": schema,
+        "sources": sources,
+        "lane_dead": list(lane_dead),
+        "groups": out_groups,
+        "devstats": dev_totals,
+        "device_byte_fraction": round(
+            dev_totals.get("total_bytes_device", 0) / byte_total, 4)
+        if byte_total else 0.0,
+    }
+
+
+def _prom_name(*parts: str) -> str:
+    safe = "_".join(parts)
+    return "ceph_tpu_" + "".join(
+        c if c.isalnum() or c == "_" else "_" for c in safe)
+
+
+def prometheus_text(merged: dict) -> str:
+    """Prometheus-style text exposition of a merged cluster view
+    (counters as untyped samples; histograms as _count/_sum plus
+    interpolated quantile gauges — the shape a scraper graphs without
+    knowing our bucket layout)."""
+    lines: List[str] = [
+        f"# ceph-tpu cluster metrics "
+        f"(metrics_schema {merged.get('metrics_schema', 1)}, "
+        f"{len(merged.get('sources', []))} sources)"]
+    for src in merged.get("lane_dead", []):
+        lines.append(f"# LANE DEAD (snapshot missing): {src}")
+    for gname in sorted(merged.get("groups", {})):
+        g = merged["groups"][gname]
+        for key in sorted(g):
+            v = g[key]
+            if isinstance(v, dict) and "buckets" in v:
+                h = PerfHistogram.from_dump(v)
+                base = _prom_name(gname, key)
+                lines.append(f"{base}_count {h.count}")
+                lines.append(f"{base}_sum {h.sum:.6f}")
+                for q, tag in ((0.5, "0.5"), (0.99, "0.99"),
+                               (0.999, "0.999")):
+                    lines.append(
+                        f"{base}{{quantile=\"{tag}\"}} "
+                        f"{h.quantile(q):.6f}")
+            elif isinstance(v, dict) and "avgcount" in v:
+                base = _prom_name(gname, key)
+                lines.append(f"{base}_count {v['avgcount']}")
+                lines.append(f"{base}_sum {v['sum']:.6f}")
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                lines.append(f"{_prom_name(gname, key)} {v}")
+    ds = merged.get("devstats", {})
+    for key in sorted(ds):
+        lines.append(f"{_prom_name('devstats', key)} {ds[key]}")
+    lines.append(
+        f"ceph_tpu_device_byte_fraction "
+        f"{merged.get('device_byte_fraction', 0.0)}")
+    return "\n".join(lines) + "\n"

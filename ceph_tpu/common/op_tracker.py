@@ -71,7 +71,8 @@ class OpTracker:
     def __init__(self, history_size: int = 20,
                  history_duration: float = 600.0,
                  complaint_time: float = 30.0,
-                 perf=None, logger=None):
+                 perf=None, logger=None,
+                 flight_recorder_size: int = 64):
         self._seq = itertools.count(1)
         self._inflight: Dict[int, TrackedOp] = {}
         self._history: Deque[TrackedOp] = deque(maxlen=history_size)
@@ -81,6 +82,27 @@ class OpTracker:
         self.perf = perf              # group carrying the slow_ops u64
         self.logger = logger
         self.slow_op_count = 0
+        # flight recorder: a bounded ring of slow-op STAGE RECORDS
+        # (everything the span/marks knew, frozen at record time) —
+        # post-hoc attribution for tails that outlive the in-flight
+        # table.  One record at complaint time ("final": False, the op
+        # was still running) and one at finish for complained ops.
+        self.flight: Deque[dict] = deque(
+            maxlen=max(1, flight_recorder_size))
+
+    def _flight_record(self, op: TrackedOp, final: bool) -> None:
+        rec = {
+            "seq": op.seq,
+            "description": op.desc,
+            "initiated_at": op.wall_start,
+            "age": round(op.age(), 6),
+            "final": final,
+            "events": [e for _, e in op.events],
+        }
+        if op.span is not None:
+            rec["stages"] = [{"stage": s, "ms": round(dt * 1e3, 4)}
+                             for s, dt in op.span.stages]
+        self.flight.append(rec)
 
     def create(self, desc: str) -> TrackedOp:
         op = TrackedOp(next(self._seq), desc)
@@ -94,6 +116,7 @@ class OpTracker:
         self._history.append(op)
         if op.complained:
             self._slow_history.append(op)
+            self._flight_record(op, final=True)
 
     def check_slow(self) -> int:
         """Scan in-flight ops for slow ones (OSD::check_ops_in_flight):
@@ -108,6 +131,7 @@ class OpTracker:
             op.mark("slow_op_complaint")
             self.slow_op_count += 1
             raised += 1
+            self._flight_record(op, final=False)
             if self.perf is not None:
                 self.perf.inc("slow_ops")
             if self.logger is not None:
@@ -132,3 +156,10 @@ class OpTracker:
                if now - (o.done_at or now) <= self.history_duration]
         return {"num_ops": len(ops), "complaint_time": self.complaint_time,
                 "total_slow_ops": self.slow_op_count, "ops": ops}
+
+    def dump_flight_recorder(self) -> Dict:
+        """Post-hoc slow-op stage attribution: the bounded ring of
+        records captured at complaint and at finish (newest last)."""
+        return {"size": self.flight.maxlen,
+                "num_records": len(self.flight),
+                "records": list(self.flight)}

@@ -90,9 +90,13 @@ class PerfHistogram:
 
     def dump_full(self) -> Dict[str, object]:
         """Quantiles plus the raw bucket vector (what a remote consumer
-        needs to merge dumps across processes)."""
+        needs to merge dumps across processes).  Unlike the rounded
+        display form, ``sum_s`` is the FULL-precision float here — it
+        round-trips exactly through JSON, so a reconstructed histogram
+        is bit-for-bit the original (buckets, count, sum, quantiles)."""
         d: Dict[str, object] = self.dump()
         d["buckets"] = list(self.buckets)
+        d["sum_s"] = self.sum
         return d
 
     @classmethod
@@ -206,6 +210,25 @@ class PerfCounters:
         with self._lock:
             return {k: h.dump_full() for k, h in self._hists.items()}
 
+    def dump_full(self) -> Dict[str, object]:
+        """Like dump(), but histograms keep their raw bucket vectors —
+        the cross-process form: a remote consumer reconstructs every
+        histogram bit-for-bit via PerfHistogram.from_dump and merges
+        bucket-wise (the metrics plane ships THIS shape)."""
+        with self._lock:
+            out: Dict[str, object] = {}
+            for k, t in self._types.items():
+                if t == TYPE_U64:
+                    out[k] = self._vals.get(k, 0)
+                elif t == TYPE_HIST:
+                    out[k] = self._hists[k].dump_full()
+                else:
+                    out[k] = {"avgcount": self._counts.get(k, 0),
+                              "sum": self._sums.get(k, 0.0)}
+            for k, v in self._vals.items():
+                out.setdefault(k, v)
+            return out
+
 
 class PerfCountersCollection:
     """All counter groups in a process, for `perf dump` (admin socket)."""
@@ -240,3 +263,11 @@ class PerfCountersCollection:
             if h:
                 out[n] = h
         return out
+
+    def dump_full(self) -> Dict[str, Dict]:
+        """Every group's mergeable form (counters + bucketed
+        histograms): the per-daemon body of a metrics-plane snapshot
+        (common/metrics.py)."""
+        with self._lock:
+            groups = list(self._groups.items())
+        return {n: g.dump_full() for n, g in groups}

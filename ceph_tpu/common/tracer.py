@@ -65,7 +65,10 @@ CHAIN_STAGES = (
     "client_submit",    # objecter: op build + target calc + send
     "deliver",          # messenger transit + intake queue (pre-throttle)
     "throttle_wait",    # dispatch-throttle wait (OSD intake budget)
-    "queue_wait",       # PG op queue + sequencer slot admission wait
+    "lane_codec",       # process-lane hop: wire encode + decode cost
+    "ring_wait",        # process-lane hop: parent push -> lane pop
+    "queue_wait_ring",  # shard-ring dwell (handoff backpressure)
+    "queue_wait_pump",  # PG op-queue dwell (pump/worker busy)
     "admit_wait",       # sequencer window-slot wait (window full)
     "dep_wait",         # per-object dependency chain wait
     "prepare",          # guards, recover-before-write, cow, txn build
@@ -76,6 +79,18 @@ CHAIN_STAGES = (
     "commit_wait",      # residual local group-commit wait (post-acks)
     "op_exec",          # read-class execution (reads only)
     "ack_delivery",     # reply transit back to the client dispatch
+)
+
+#: The cause taxonomy that replaced the old monolithic ``queue_wait``
+#: stage: every second an op spends queued before admission now lands
+#: under the stage that NAMES its cause — the attribution the
+#: <20%-queueing-share work keys on.  (``admit_wait`` — a full window —
+#: and ``dep_wait`` — an object-order chain — were already split out.)
+QUEUE_WAIT_CAUSES = (
+    "throttle_wait",    # dispatch-throttle budget full (intake cap)
+    "ring_wait",        # process-lane ring dwell / backpressure
+    "queue_wait_ring",  # shard handoff ring dwell (pump not scheduled)
+    "queue_wait_pump",  # PG worker busy with ops ahead in its queue
 )
 
 #: Auxiliary (non-chain) stages, for dump annotation.
@@ -118,6 +133,36 @@ class Span:
         if hist is not None:
             hist.hinc(stage, dt)
         return dt
+
+    def attribute(self, stage: str, dt: float, now: Optional[float] = None,
+                  hist=None) -> None:
+        """Record an EXPLICIT-duration chain sample and (optionally)
+        advance the cursor to ``now``.  The lane seam uses this where
+        the interval endpoints live on different clocks (parent push /
+        lane pop): the caller computes the duration from the
+        PING/PONG-calibrated offset, and the stage still tiles the
+        chain because the cursor lands exactly at the hop's end."""
+        if self.finished:
+            return
+        self.stages.append((stage, max(0.0, dt)))
+        _last_stage[threading.get_ident()] = stage
+        if now is not None:
+            self._cursor = now
+        if hist is not None:
+            hist.hinc(stage, max(0.0, dt))
+
+    def rebase(self, t: float) -> None:
+        """Advance the cursor to ``t`` without attributing the skipped
+        interval to any local stage.  The reply path of a process-lane
+        op uses this: the skipped window is the lane worker's service
+        time, which the LANE's continuation span recorded into the
+        lane's own histograms — re-attributing it here would double
+        count the merged cluster view.  Clamped to now: a clock-offset
+        estimation error must never park the cursor in the future and
+        make the next cut record a negative interval."""
+        t = min(t, time.monotonic())
+        if t > self._cursor:
+            self._cursor = t
 
     def event(self, name: str) -> None:
         """Point-in-time span event (OpTracker marks land here)."""
@@ -197,10 +242,13 @@ class Tracer:
 
 # ---------------------------------------------------------- aggregation
 
-def merge_stage_histograms(ctxs) -> Dict[str, PerfHistogram]:
+def merge_stage_histograms(ctxs, extra_dumps=()) -> Dict[str, PerfHistogram]:
     """Merge every context's op_stages group into fresh per-stage
     histograms (bench + qa aggregate client and all daemons of an
-    in-process cluster with this)."""
+    in-process cluster with this).  ``extra_dumps`` takes iterable
+    ``{stage: dump_full dict}`` mappings — the cross-PROCESS form a
+    lane worker ships over FRAME_STATS/FRAME_RPC — merged bucket-wise
+    via ``PerfHistogram.from_dump``."""
     merged: Dict[str, PerfHistogram] = {}
     for ctx in ctxs:
         group = ctx.perf._groups.get(STAGE_GROUP) \
@@ -209,23 +257,39 @@ def merge_stage_histograms(ctxs) -> Dict[str, PerfHistogram]:
             continue
         for stage, h in group.histograms().items():
             merged.setdefault(stage, PerfHistogram()).merge(h)
+    for dump in extra_dumps:
+        for stage, d in (dump or {}).items():
+            if isinstance(d, dict) and "buckets" in d:
+                merged.setdefault(stage, PerfHistogram()).merge(
+                    PerfHistogram.from_dump(d))
     return merged
 
 
-def stage_table(perf_collection) -> Dict[str, object]:
+def stage_table(perf_collection, extra_dumps=(),
+                full: bool = False) -> Dict[str, object]:
     """`dump_op_stages` admin-socket body: per-stage quantiles from this
-    daemon's op_stages group, chain stages in path order first."""
+    daemon's op_stages group, chain stages in path order first.
+    ``extra_dumps``: per-lane ``{stage: dump_full}`` mappings merged in
+    (the parent's lane-complete dump); ``full=True`` keeps the raw
+    bucket vectors so the OUTPUT itself stays mergeable upstream."""
     group = perf_collection._groups.get(STAGE_GROUP)
-    if group is None:
-        return {"stages": {}, "chain_s": 0.0}
-    hists = group.histograms()
+    hists: Dict[str, PerfHistogram] = {}
+    if group is not None:
+        for name, h in group.histograms().items():
+            hists[name] = PerfHistogram().merge(h)
+    for dump in extra_dumps:
+        for name, d in (dump or {}).items():
+            if isinstance(d, dict) and "buckets" in d:
+                hists.setdefault(name, PerfHistogram()).merge(
+                    PerfHistogram.from_dump(d))
     stages: Dict[str, Dict] = {}
     for name in CHAIN_STAGES:
         if name in hists:
-            stages[name] = hists[name].dump()
+            stages[name] = (hists[name].dump_full() if full
+                            else hists[name].dump())
     for name, h in sorted(hists.items()):
         if name not in stages:
-            d = h.dump()
+            d = h.dump_full() if full else h.dump()
             d["aux"] = True
             stages[name] = d
     chain_s = sum(hists[n].sum for n in CHAIN_STAGES if n in hists)

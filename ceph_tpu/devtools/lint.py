@@ -44,8 +44,9 @@ from ceph_tpu.devtools.rules import (PROJECT_RULES, RULE_IDS, RULES,
 #: (v2: seam-report block, per-rule analysis timings, unused-waiver
 #: audit, ESC12/PORT13/ATOM14 in the rule summary; v3: device-seam
 #: block + device_analysis_ms, SYNC15/JIT16/XFER17 in the rule
-#: summary)
-JSON_SCHEMA = 3
+#: summary; v4: STAGE18 in the rule summary + the ``stages``
+#: coverage block on whole-package runs)
+JSON_SCHEMA = 4
 
 #: process-wide parse cache: abspath -> (mtime_ns, size, FileInfo).
 #: One parse feeds every rule and every lint call in the process —
@@ -381,6 +382,16 @@ def lint_report(paths: Optional[Iterable[str]] = None,
         doc["seam"] = analyze(files).report()
         from ceph_tpu.devtools.device import analyze as dev_analyze
         doc["device"] = dev_analyze(files).report()
+        # stage-coverage inventory (STAGE18's evidence): per-stage cut
+        # site counts, diffable like the seam/device inventories
+        from ceph_tpu.common.tracer import AUX_STAGES, CHAIN_STAGES
+        from ceph_tpu.devtools.rules import collect_stage_sites
+        doc["stages"] = {
+            "declared_chain": list(CHAIN_STAGES),
+            "declared_aux": list(AUX_STAGES),
+            "sites": {name: len(locs) for name, locs in sorted(
+                collect_stage_sites(files).items())},
+        }
     return doc
 
 

@@ -78,6 +78,20 @@ Each rule mechanically enforces one PR-landed write-path invariant
                            passing the bound method through the seam
                            is the sanctioned pattern).
 
+  STAGE18 stage-coverage — the tracer's cut chain and the code stay
+                           mechanically in sync (PROJECT rule, the
+                           PROTO08 shape applied to observability):
+                           every literal stage name passed to
+                           ``span.cut(...)`` / ``span.attribute(...)``
+                           must be declared in CHAIN_STAGES /
+                           AUX_STAGES (common/tracer.py), and — when
+                           the linted set spans the op-path modules —
+                           every declared CHAIN stage must have at
+                           least one cut site in the tree.  A renamed
+                           stage with a stale cut site (or a declared
+                           stage nothing ever cuts) silently un-names
+                           part of the write path's attribution.
+
 Waivers: a site that is allowed to break a rule for a documented reason
 carries ``# lint: allow[RULE] reason`` on the same line or the line
 directly above.  Waivers are counted and reported; an undocumented
@@ -1038,6 +1052,81 @@ def check_proto08(files: List[FileInfo]) -> Iterator[Violation]:
                     f"silent drop on the receiver")
 
 
+# ------------------------------------------------------------------ STAGE18
+
+#: modules whose presence marks a file set as "whole-op-path": the
+#: coverage half of STAGE18 (every declared chain stage has a cut
+#: site) only runs when ALL of these are in the linted set — a partial
+#: (--changed / explicit-path) lint must not report every stage as
+#: uncovered just because the files that cut them were not handed in.
+_STAGE_COVERAGE_ANCHORS = (
+    "common/tracer.py", "client/objecter.py", "osd/sequencer.py",
+    "osd/pg.py", "osd/daemon.py", "osd/backend.py", "osd/lanes.py",
+    "msg/messenger.py",
+)
+
+#: span-recording call names whose first literal argument is a stage
+_STAGE_CALL_ATTRS = ("cut", "attribute")
+
+
+def collect_stage_sites(files: List["FileInfo"]) -> Dict[str, list]:
+    """stage name -> [(FileInfo, line)] over every ``.cut("x", ...)`` /
+    ``.attribute("x", ...)`` call with a literal first argument.  The
+    lint --json document exposes the per-stage site counts so CI can
+    diff coverage like it diffs the seam/device inventories."""
+    sites: Dict[str, list] = {}
+    for fi in files:
+        for node in ast.walk(fi.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _STAGE_CALL_ATTRS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            sites.setdefault(node.args[0].value, []).append(
+                (fi, node.lineno))
+    return sites
+
+
+def check_stage18(files: List["FileInfo"]) -> Iterator[Violation]:
+    """PROJECT rule: CHAIN_STAGES and the span cut sites stay in sync
+    both ways (see module docstring)."""
+    from ceph_tpu.common.tracer import AUX_STAGES, CHAIN_STAGES
+    declared = set(CHAIN_STAGES) | set(AUX_STAGES)
+    sites = collect_stage_sites(files)
+    for name in sorted(sites):
+        if name in declared:
+            continue
+        for fi, line in sites[name]:
+            if fi.waived("STAGE18", line):
+                continue
+            yield Violation(
+                "STAGE18", fi.rel, line,
+                f"span cut names undeclared stage {name!r} — declare "
+                f"it in CHAIN_STAGES/AUX_STAGES (common/tracer.py) or "
+                f"fix the typo; an undeclared cut silently falls out "
+                f"of the attributed chain sum")
+    rels = {fi.rel for fi in files}
+    if not all(a in rels for a in _STAGE_COVERAGE_ANCHORS):
+        return                    # partial lint: skip the coverage half
+    tracer_fi = next(fi for fi in files
+                     if fi.rel == "common/tracer.py")
+    decl_line = next(
+        (n.lineno for n in ast.walk(tracer_fi.tree)
+         if isinstance(n, ast.Assign)
+         and any(isinstance(t, ast.Name) and t.id == "CHAIN_STAGES"
+                 for t in n.targets)), 1)
+    for name in CHAIN_STAGES:
+        if name not in sites and not tracer_fi.waived("STAGE18",
+                                                      decl_line):
+            yield Violation(
+                "STAGE18", tracer_fi.rel, decl_line,
+                f"declared chain stage {name!r} has no span.cut/"
+                f"attribute site anywhere in the tree — dead stages "
+                f"rot the documented chain (remove it or cut it)")
+
+
 # --------------------------------------------------------------- registry
 
 RULES: Dict[str, Tuple[str, Callable[[FileInfo], Iterator[Violation]]]] = {
@@ -1094,6 +1183,8 @@ PROJECT_RULES: Dict[str, Tuple[str,
               _device_rule("JIT16")),
     "XFER17": ("host<->device transfers are staged or wire-classified",
                _device_rule("XFER17")),
+    "STAGE18": ("tracer chain stages and span cut sites stay in sync",
+                check_stage18),
 }
 
 #: SEND03 is produced by the FP02 scanner (shared dataflow pass) but is

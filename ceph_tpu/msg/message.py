@@ -78,6 +78,12 @@ class Message(Encodable):
         # zero-encode local delivery hands the receiver this object so
         # co-located daemons cut stages under one shared clock
         self._span = None
+        # reply-leg anchor for messages that crossed a process-lane
+        # ring (osd/lanes.py FRAME_OUT): the lane worker's send stamp
+        # converted to the parent/client monotonic clock.  Transport
+        # metadata like recv_stamp — never encoded; rides local_view's
+        # shallow copy so the objecter can rebase its span cursor
+        self._lane_sent_mono = 0.0
 
     # --- lazy wire form (msg/payload.py) ---
     def wire_bytes(self) -> bytes:

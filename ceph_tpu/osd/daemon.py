@@ -137,7 +137,9 @@ class OSD(Dispatcher):
         from ceph_tpu.common.op_tracker import OpTracker
         self.op_tracker = OpTracker(
             complaint_time=self.cfg["osd_op_complaint_time"],
-            perf=self.perf_osd, logger=self.logger)
+            perf=self.perf_osd, logger=self.logger,
+            flight_recorder_size=int(
+                self.cfg["osd_flight_recorder_size"]))
         self.admin_socket = None
         self._stats_task: Optional[asyncio.Task] = None
         self.mesh_exec = None    # set when osd_mesh_mode=on (start())
@@ -826,6 +828,13 @@ class OSD(Dispatcher):
                 m._span = None
             else:
                 m._tracked.span = m._span
+                # cause-split queue_wait: classify -> here is the
+                # shard handoff ring's dwell (pump not yet scheduled /
+                # items ahead in the ring) — ~0 on the inline plane,
+                # the named backpressure signal on thread lanes.
+                # (Process lanes attributed the ipc hop as
+                # ring_wait/lane_codec at envelope decode already.)
+                m._span.cut("queue_wait_ring", self.ctx.tracer.hist)
         from ceph_tpu.osd.messages import OP_NOTIFY
         if m.ops and all(o.op == OP_NOTIFY for o in m.ops):
             # notify gathers remote acks for seconds and touches no
@@ -877,14 +886,27 @@ class OSD(Dispatcher):
             "recently completed client ops")
         sock.register(
             "dump_historic_slow_ops",
-            lambda cmd: self.op_tracker.dump_historic_slow_ops(),
+            lambda cmd: self._dump_historic_slow_ops(),
             "recently completed ops that exceeded "
-            "osd_op_complaint_time (osd/OSD.cc parity)")
+            "osd_op_complaint_time, merged across process-lane "
+            "workers (osd/OSD.cc parity)")
         sock.register(
             "dump_op_stages",
             lambda cmd: self._dump_op_stages(),
             "per-stage write-path latency breakdown "
-            "(op tracer histograms: p50/p99/p999 per stage)")
+            "(op tracer histograms: p50/p99/p999 per stage), merged "
+            "across process-lane workers")
+        sock.register(
+            "dump_flight_recorder",
+            lambda cmd: self._dump_flight_recorder(),
+            "bounded ring of recent slow-op stage records "
+            "(post-hoc tail attribution), merged across lanes")
+        sock.register(
+            "perf dump full",
+            lambda cmd: self._perf_dump_full(),
+            "mergeable metrics-plane snapshots (common/metrics.py): "
+            "this daemon + every process-lane worker, with loud "
+            "lane_dead markers")
         sock.register(
             "status", lambda cmd: {
                 "whoami": self.whoami,
@@ -908,11 +930,93 @@ class OSD(Dispatcher):
         await sock.start()
         self.admin_socket = sock
 
-    def _dump_op_stages(self) -> dict:
+    async def _lane_dump_calls(self, prefix: str):
+        """Fan one dump request out to every process-lane worker over
+        the id-keyed FRAME_RPC path (SEAM_INVENTORY discipline: json
+        command out, json reply resolved by id).  Returns
+        ``([(lane_idx, reply), ...], [dead_lane_idx, ...])`` — a dead
+        lane is reported LOUDLY by every consumer, never folded into
+        an empty reply."""
+        lanes = [lane for lane in self.shards.process_lanes or []]
+        live = [lane for lane in lanes if not lane.dead]
+        dead = [lane.idx for lane in lanes if lane.dead]
+        # fan out CONCURRENTLY: one wedged lane costs one timeout, not
+        # one per lane (an 8-lane serial sweep would outlive the admin
+        # socket client's own timeout)
+        results = await asyncio.gather(
+            *[lane.admin_rpc({"prefix": prefix}) for lane in live],
+            return_exceptions=True)
+        replies = []
+        for lane, r in zip(live, results):
+            if isinstance(r, BaseException):
+                dead.append(lane.idx)
+            else:
+                replies.append((lane.idx, r))
+        dead.sort()
+        if dead:
+            self.logger.warning(
+                f"admin dump '{prefix}': lane(s) {dead} are DEAD — "
+                f"their ops/stages are missing from this dump")
+        return replies, dead
+
+    async def _dump_op_stages(self) -> dict:
         from ceph_tpu.common import tracer as tracer_mod
-        out = tracer_mod.stage_table(self.ctx.perf)
+        extra, dead = [], []
+        if self.shards.process_lanes is not None:
+            replies, dead = await self._lane_dump_calls("stage_dumps")
+            extra = [r for _, r in replies]
+        out = tracer_mod.stage_table(self.ctx.perf, extra_dumps=extra)
         out["op_tracing"] = bool(self.ctx.tracer.enabled)
+        if self.shards.process_lanes is not None:
+            out["lanes_merged"] = len(extra)
+            out["lane_dead"] = dead
         return out
+
+    async def _dump_historic_slow_ops(self) -> dict:
+        out = self.op_tracker.dump_historic_slow_ops()
+        if self.shards.process_lanes is not None:
+            replies, dead = await self._lane_dump_calls(
+                "dump_historic_slow_ops")
+            for idx, r in replies:
+                for o in r.get("ops", []):
+                    o["lane"] = idx
+                out["ops"].extend(r.get("ops", []))
+                out["total_slow_ops"] += int(r.get("total_slow_ops", 0))
+            out["num_ops"] = len(out["ops"])
+            out["lane_dead"] = dead
+        return out
+
+    async def _dump_flight_recorder(self) -> dict:
+        out = self.op_tracker.dump_flight_recorder()
+        if self.shards.process_lanes is not None:
+            replies, dead = await self._lane_dump_calls(
+                "dump_flight_recorder")
+            for idx, r in replies:
+                for rec in r.get("records", []):
+                    rec["lane"] = idx
+                out["records"].extend(r.get("records", []))
+            out["num_records"] = len(out["records"])
+            out["lane_dead"] = dead
+        return out
+
+    async def _perf_dump_full(self) -> dict:
+        """The per-daemon half of ``perf dump --cluster``: this
+        process's mergeable snapshot plus a FRESH one from every live
+        lane worker (on-demand FRAME_RPC scrape), with dead lanes
+        named loudly."""
+        from ceph_tpu.common import metrics
+        snaps = [metrics.snapshot(self.ctx,
+                                  source=f"osd.{self.whoami}")]
+        dead: list = []
+        if self.shards.process_lanes is not None:
+            dead_idx = await self.shards.fetch_lane_metrics()
+            for idx, snap in sorted(
+                    self.shards.lane_metric_snapshots().items()):
+                if snap and idx not in dead_idx:
+                    snaps.append(snap)
+            dead = [f"osd.{self.whoami}/lane{i}" for i in dead_idx]
+        return {"metrics_schema": metrics.METRICS_SCHEMA,
+                "snapshots": snaps, "lane_dead": dead}
 
     async def _store_bench(self, count: int, size: int) -> dict:
         """Timed object writes straight at the ObjectStore — measures

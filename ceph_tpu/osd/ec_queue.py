@@ -179,6 +179,8 @@ class ECBatchQueue:
     def _host_apply(self, mat, chunks, nbytes) -> np.ndarray:
         self.perf.inc("host_requests")
         self.perf.inc("host_bytes", nbytes)
+        from ceph_tpu.common import devstats
+        devstats.note_bytes("ec_apply", nbytes, device=False)
         from ceph_tpu import native
         if native.available():
             return native.gf_matrix_apply(mat, chunks)
@@ -298,6 +300,12 @@ class ECBatchQueue:
         # device-sync:end
         self.perf.inc("device_requests", len(reqs))
         self.perf.inc("device_bytes", k * total)
+        # LIVE device_byte_fraction substrate (metrics plane): booked
+        # only AFTER the fetch proved every launch succeeded — a
+        # device failure falls back to _host_apply, which must not
+        # find these bytes already counted as device work
+        from ceph_tpu.common import devstats
+        devstats.note_bytes("ec_apply", k * total, device=True)
         self.perf.tinc("batch_fill", len(reqs))
         res = []
         off = 0

@@ -52,13 +52,21 @@ __all__ = ["ShmRing", "FRAME_MSG", "FRAME_OUT", "FRAME_MAP",
 FRAME_MSG = 1     # parent -> lane: one PG-bound message (envelope+wire)
 FRAME_OUT = 2     # lane -> parent: one outbound message (addr+wire)
 FRAME_MAP = 3     # parent -> lane: one full osdmap (wire bytes)
-FRAME_RPC = 4     # lane -> parent: id-keyed control call (mon command)
-FRAME_RESP = 5    # parent -> lane: id-keyed reply (resolves a future)
+FRAME_RPC = 4     # id-keyed control call; DIRECTION disambiguates:
+#                   lane->parent = mon command on the lane's behalf,
+#                   parent->lane = dump/metrics request (the lane-
+#                   complete admin plane).  Ids are allocated by the
+#                   sender and scoped to its direction's ring.
+FRAME_RESP = 5    # id-keyed reply, opposite direction of its request
 FRAME_STOP = 6    # parent -> lane: drain + shut down
 FRAME_BYE = 7     # lane -> parent: clean shutdown acknowledged
-FRAME_PING = 8    # parent -> lane: id-keyed quiesce probe
+FRAME_PING = 8    # parent -> lane: id-keyed quiesce probe; carries the
+#                   parent's monotonic send stamp + its current best
+#                   parent->lane clock-offset estimate (span continuity)
 FRAME_PONG = 9    # lane -> parent: probe reply (ring drained to here)
-FRAME_STATS = 10  # lane -> parent: periodic PG stat rows (json)
+#                   + the lane's monotonic receive stamp
+FRAME_STATS = 10  # lane -> parent: periodic PG stat rows + metrics
+#                   snapshot + slow-op count (json)
 
 _HDR = 24                      # head u64 | tail u64 | waiting u32 | pad
 _OFF_HEAD = 0
@@ -109,6 +117,10 @@ class ShmRing:
         self.pushed = 0
         self.push_bytes = 0
         self.full_stalls = 0
+        # consumer-side accounting (same per-lane discipline; ONE
+        # consumer per ring by the SPSC contract)
+        self.popped = 0
+        self.pop_bytes = 0
 
     # ------------------------------------------------------------ cursors
     def _load(self, off: int) -> int:
@@ -182,6 +194,11 @@ class ShmRing:
         ln = struct.unpack("<I", self._copy_out(head, 4))[0]
         payload = self._copy_out(head + 4, ln)
         self._store(_OFF_HEAD, head + 4 + ln)
+        # gil-atomic:begin popped,pop_bytes consumer-side stats: ONE
+        # consumer per ring by the SPSC contract; single GIL steps
+        self.popped += 1
+        self.pop_bytes += 4 + ln
+        # gil-atomic:end
         return payload
 
     def drain(self, limit: int = 0) -> List[bytes]:

@@ -80,7 +80,11 @@ def encode_msg_envelope(m) -> bytes:
     """Transport envelope + wire body for one message crossing a ring.
     The envelope carries what the messenger stamps out-of-band (source
     identity/address, receive stamp, transport id) so the lane-side
-    dispatch sees exactly what a socket delivery would have stamped."""
+    dispatch sees exactly what a socket delivery would have stamped —
+    plus the SPAN CONTEXT (trace/span id, the chain cursor in the
+    parent's monotonic clock, and a push stamp) so the lane hop gets
+    its own chain stages (``lane_codec``/``ring_wait``) instead of an
+    unattributed hole in the op's timeline."""
     from ceph_tpu.msg.types import EntityAddr, EntityName
     enc = Encoder()
     enc.u16(m.get_type())
@@ -91,11 +95,27 @@ def encode_msg_envelope(m) -> bytes:
     enc.f64(m.recv_stamp or 0.0)
     enc.u64(m.transport_id or 0)
     enc.u64(getattr(m, "throttle_cost", 0) or 0)
-    enc.bytes_(m.wire_bytes())
+    sp = getattr(m, "_span", None)
+    if sp is not None and not sp.finished:
+        enc.u64(sp.trace_id)
+        enc.u64(sp.span_id)
+        enc.f64(sp._cursor)
+    else:
+        enc.u64(0)
+        enc.u64(0)
+        enc.f64(0.0)
+    body = m.wire_bytes()
+    # the push stamp is the LAST field written: everything after it on
+    # the parent side is the try_push itself, so lane-side
+    # (t_push - cursor) is an honest wire-encode cost sample
+    enc.f64(time.monotonic() if sp is not None and not sp.finished
+            else 0.0)
+    enc.bytes_(body)
     return enc.getvalue()
 
 
-def decode_msg_envelope(body: bytes):
+def decode_msg_envelope(body: bytes, t_pop: Optional[float] = None,
+                        runtime: Optional["LaneRuntime"] = None):
     from ceph_tpu.msg.message import message_class
     from ceph_tpu.msg.types import EntityAddr, EntityName
     dec = Decoder(body)
@@ -105,6 +125,10 @@ def decode_msg_envelope(body: bytes):
     recv_stamp = dec.f64()
     transport_id = dec.u64()
     throttle_cost = dec.u64()
+    trace_id = dec.u64()
+    span_id = dec.u64()
+    span_cursor = dec.f64()
+    t_push = dec.f64()
     cls = message_class(mtype)
     if cls is None:
         raise ValueError(f"unregistered message type {mtype} on ring")
@@ -116,16 +140,25 @@ def decode_msg_envelope(body: bytes):
     m.recv_stamp = recv_stamp
     m.transport_id = transport_id or None
     m.throttle_cost = throttle_cost
+    if trace_id and runtime is not None:
+        m._span = runtime.adopt_lane_span(trace_id, span_id,
+                                          span_cursor, t_push, t_pop)
     return m
 
 
 def encode_out_frame(m, addr, peer_type: Optional[str]) -> bytes:
-    """Lane -> parent outbound send: (target addr, peer type, wire)."""
+    """Lane -> parent outbound send: (target addr, peer type, send
+    stamp, wire).  The send stamp (lane monotonic clock) is the reply
+    leg's anchor: the parent converts it through the PING/PONG clock
+    offset and the client rebases its span cursor onto it, so
+    ``ack_delivery`` covers only the reply transit — the lane's
+    service time was already recorded by the lane's own span."""
     enc = Encoder()
     enc.string(peer_type or "")
     enc.struct(addr)
     enc.u16(m.get_type())
     enc.opt_struct(m.src_name)
+    enc.f64(time.monotonic())
     enc.bytes_(m.wire_bytes())
     return enc.getvalue()
 
@@ -138,6 +171,7 @@ def decode_out_frame(body: bytes):
     addr = dec.struct(EntityAddr)
     mtype = dec.u16()
     src_name = dec.opt_struct(EntityName)
+    t_send = dec.f64()
     cls = message_class(mtype)
     if cls is None:
         raise ValueError(f"unregistered message type {mtype} on ring")
@@ -146,7 +180,7 @@ def decode_out_frame(body: bytes):
     payload_mod.note_decode()
     if src_name is not None:
         m.src_name = src_name
-    return m, addr, peer_type
+    return m, addr, peer_type, t_send
 
 
 # ------------------------------------------------------------ parent side
@@ -182,6 +216,23 @@ class ProcessLane:
         self._retry_handle = None
         self.stat_rows: List[dict] = []     # last lane-reported pg rows
         self._byed = False
+        self._cal_task: Optional[asyncio.Task] = None
+        #: last metrics-plane snapshot the lane shipped (FRAME_STATS
+        #: period or an on-demand call()); None until the first one
+        self.metrics: Optional[dict] = None
+        #: lane-reported slow-op total (forwarded complaints — the
+        #: lane sweeps its OWN OpTracker; the parent heartbeat cannot
+        #: see lane-hosted ops)
+        self.slow_ops = 0
+        #: monotonic-clock offset estimate: lane_clock ≈ parent_clock
+        #: + clock_offset.  Same-host CLOCK_MONOTONIC is shared on
+        #: Linux so 0.0 is already correct; the PING/PONG handshake
+        #: measures it anyway (and keeps the lane hop attributable on
+        #: platforms where the clocks differ)
+        self.clock_offset = 0.0
+        self._offset_known = False
+        self._best_rtt = float("inf")
+        self._ping_t: Dict[int, float] = {}   # rid -> ping send stamp
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
@@ -210,9 +261,27 @@ class ProcessLane:
         # consumer half of the no-lost-wakeup handshake (laneipc):
         # advertise parked; _on_wake clears while draining
         self.from_lane.advertise_waiting(True)
+        # clock calibration: a short PING/PONG burst measures the
+        # parent->lane monotonic offset (min-RTT estimate) and the
+        # follow-up pings DELIVER it — the lane needs it to attribute
+        # ring dwell (`ring_wait`) across the process edge
+        self._cal_task = self._loop.create_task(self._calibrate_clock())
+
+    async def _calibrate_clock(self) -> None:
+        for _ in range(4):
+            if self.dead or self._stopping:
+                return
+            try:
+                await self.ping(timeout=10.0)
+            except Exception:
+                return            # dying/stopping lane: nothing to do
+            await asyncio.sleep(0.02)
 
     async def stop(self, timeout: float = 20.0) -> None:
         self._stopping = True
+        if getattr(self, "_cal_task", None) is not None \
+                and not self._cal_task.done():
+            self._cal_task.cancel()
         if self.proc is not None and self.proc.is_alive():
             self._push(pack_frame(FRAME_STOP))
             deadline = time.monotonic() + timeout
@@ -342,16 +411,54 @@ class ProcessLane:
 
     async def ping(self, timeout: float = 10.0):
         """Id-keyed quiesce probe: resolves after the lane has drained
-        every frame posted before it (ring FIFO)."""
+        every frame posted before it (ring FIFO).  Doubles as the
+        clock-offset handshake: the PING carries the parent's send
+        stamp + its current best offset estimate (delivered to the
+        lane), the PONG returns the lane's receive stamp and the
+        parent refines ``clock_offset`` from the exchange with the
+        smallest RTT."""
         rid = self._next_id
         self._next_id += 1
         fut = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
-        self._push(pack_frame(FRAME_PING, Encoder().u64(rid).getvalue()))
         try:
+            # the push sits INSIDE the try: a LaneDead raised here must
+            # still run the finally, or the table entry outlives the
+            # lane (the _on_exit sweep already ran and cannot re-clean)
+            t_send = time.monotonic()
+            self._ping_t[rid] = t_send
+            enc = Encoder().u64(rid)
+            enc.f64(t_send)
+            enc.f64(self.clock_offset)
+            enc.u8(1 if self._offset_known else 0)
+            self._push(pack_frame(FRAME_PING, enc.getvalue()))
             return await asyncio.wait_for(fut, timeout)
         finally:
             self._pending.pop(rid, None)
+            self._ping_t.pop(rid, None)
+
+    async def admin_rpc(self, cmd: dict, timeout: float = 10.0) -> dict:
+        """Id-keyed control call INTO the lane (the parent->lane half
+        of the FRAME_RPC plane): dump/metrics requests for the
+        lane-complete admin commands.  Raises ``LaneDead`` loudly on a
+        dead lane — a missing lane must never look like an empty
+        one."""
+        rid = self._next_id
+        self._next_id += 1
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            # push inside the try: see ping() — a dead-lane raise must
+            # not strand the id-keyed entry
+            enc = Encoder().u64(rid)
+            enc.bytes_(json.dumps(cmd).encode())
+            self._push(pack_frame(FRAME_RPC, enc.getvalue()))
+            status, outbl = await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(rid, None)
+        if status != 0:
+            raise RuntimeError(outbl.decode(errors="replace"))
+        return json.loads(outbl.decode() or "{}")
 
     # ------------------------------------------------------------ receiving
     def _on_wake(self) -> None:
@@ -380,7 +487,13 @@ class ProcessLane:
         kind, body = unpack_frame(frame)
         osd = self.osd
         if kind == FRAME_OUT:
-            m, addr, peer_type = decode_out_frame(body)
+            m, addr, peer_type, t_send = decode_out_frame(body)
+            if t_send:
+                # reply-leg anchor in the PARENT/client clock: the
+                # objecter rebases its span cursor onto this so
+                # ack_delivery covers only the reply transit (the
+                # lane's span already recorded the service time)
+                m._lane_sent_mono = t_send - self.clock_offset
             osd.messenger.send_message(m, addr, peer_type=peer_type)
         elif kind == FRAME_RPC:
             dec = Decoder(body)
@@ -388,15 +501,54 @@ class ProcessLane:
             cmd = json.loads(dec.bytes_().decode())
             asyncio.get_running_loop().create_task(
                 self._serve_rpc(rid, cmd))
+        elif kind == FRAME_RESP:
+            dec = Decoder(body)
+            rid = dec.u64()
+            status = dec.s32()
+            outbl = dec.bytes_()
+            fut = self._pending.get(rid)
+            if fut is not None and not fut.done():
+                fut.set_result((status, outbl))
         elif kind == FRAME_PONG:
-            rid = Decoder(body).u64()
+            dec = Decoder(body)
+            rid = dec.u64()
+            t_lane = dec.f64() if dec.remaining() >= 8 else 0.0
+            t_send = self._ping_t.pop(rid, None)
+            if t_send is not None and t_lane:
+                now = time.monotonic()
+                rtt = now - t_send
+                if rtt < self._best_rtt:
+                    # midpoint estimate from the tightest exchange:
+                    # lane_clock - parent_clock at the same instant
+                    self._best_rtt = rtt
+                    self.clock_offset = t_lane - (t_send + now) / 2
+                    self._offset_known = True
             fut = self._pending.get(rid)
             if fut is not None and not fut.done():
                 fut.set_result(True)
         elif kind == FRAME_STATS:
-            self.stat_rows = json.loads(body.decode())
+            self._on_stats(json.loads(body.decode()))
         elif kind == FRAME_BYE:
             self._byed = True
+
+    def _on_stats(self, data) -> None:
+        if isinstance(data, list):          # legacy shape: rows only
+            self.stat_rows = data
+            return
+        self.stat_rows = data.get("pg_rows") or []
+        snap = data.get("metrics")
+        if snap:
+            self.metrics = snap
+        slow = int(data.get("slow_ops", 0))
+        if slow > self.slow_ops:
+            # forwarded complaints: the lane swept its own OpTracker
+            # (the parent heartbeat cannot see lane-hosted ops) —
+            # surface the delta at the parent, where operators look
+            _log.warning(
+                "osd.%d lane %d reports %d new slow op(s) "
+                "(lane total %d)", self.osd.whoami, self.idx,
+                slow - self.slow_ops, slow)
+            self.slow_ops = slow
 
     async def _serve_rpc(self, rid: int, cmd: dict) -> None:
         """Mon control calls on the lane's behalf (the lane has no mon
@@ -422,8 +574,13 @@ class ProcessLane:
             "to_lane_frames": self.to_lane.pushed,
             "to_lane_bytes": self.to_lane.push_bytes,
             "to_lane_stalls": self.to_lane.full_stalls,
+            "from_lane_frames": self.from_lane.popped,
+            "from_lane_bytes": self.from_lane.pop_bytes,
             "from_lane_backlog": self.from_lane.backlog_bytes,
             "overflow_pending": len(self._overflow),
+            "slow_ops": self.slow_ops,
+            "clock_offset_s": round(self.clock_offset, 6),
+            "has_metrics": self.metrics is not None,
             "dead": self.dead,
         }
 
@@ -570,6 +727,37 @@ class LaneRuntime:
         from collections import deque
         self._overflow = deque()
         self._retry_handle = None
+        #: parent->lane monotonic offset (lane ≈ parent + offset),
+        #: delivered by the parent's PING after its PONG-measured
+        #: handshake; 0.0 (correct on same-host Linux) until then
+        self.clock_offset = 0.0
+
+    # ----------------------------------------------------------- tracing
+    def adopt_lane_span(self, trace_id: int, span_id: int,
+                        span_cursor: float, t_push: float,
+                        t_pop: Optional[float]):
+        """Continue a parent-side span across the ring hop: adopt a
+        lane-local handle whose cursor starts where the parent's chain
+        left off (converted through the clock offset), and attribute
+        the hop itself — ``ring_wait`` (push -> pop dwell) and
+        ``lane_codec`` (envelope encode + decode cost) — so
+        process-lane runs tile to the same >=90% attribution inline
+        runs do."""
+        tr = self.osd.ctx.tracer if self.osd is not None else None
+        if tr is None or not tr.enabled:
+            return None
+        off = self.clock_offset
+        t_dec_end = time.monotonic()
+        if t_pop is None:
+            t_pop = t_dec_end
+        span = tr.adopt(trace_id, span_id, t0=span_cursor + off)
+        enc_dur = max(0.0, t_push - span_cursor)      # parent clock
+        dwell = max(0.0, t_pop - (t_push + off))      # cross-clock
+        dec_dur = max(0.0, t_dec_end - t_pop)         # lane clock
+        span.attribute("ring_wait", dwell, hist=tr.hist)
+        span.attribute("lane_codec", enc_dur + dec_dur,
+                       now=t_dec_end, hist=tr.hist)
+        return span
 
     # ------------------------------------------------------------- outbound
     def push(self, frame: bytes) -> None:
@@ -651,7 +839,9 @@ class LaneRuntime:
     def _handle_frame(self, frame: bytes) -> None:
         kind, body = unpack_frame(frame)
         if kind == FRAME_MSG:
-            self.messenger.dispatch_inbound(decode_msg_envelope(body))
+            t_pop = time.monotonic()
+            self.messenger.dispatch_inbound(
+                decode_msg_envelope(body, t_pop=t_pop, runtime=self))
         elif kind == FRAME_MAP:
             from ceph_tpu.osd.osdmap import OSDMap
             self.osd._apply_map(OSDMap.from_bytes(body))
@@ -663,12 +853,61 @@ class LaneRuntime:
             fut = self._pending.get(rid)
             if fut is not None and not fut.done():
                 fut.set_result((status, outbl))
+        elif kind == FRAME_RPC:
+            # parent->lane dump/metrics request (the lane-complete
+            # admin plane): id-keyed, answered with FRAME_RESP
+            dec = Decoder(body)
+            rid = dec.u64()
+            cmd = json.loads(dec.bytes_().decode())
+            self._serve_parent_rpc(rid, cmd)
         elif kind == FRAME_PING:
-            rid = Decoder(body).u64()
-            self.push(pack_frame(FRAME_PONG,
-                                 Encoder().u64(rid).getvalue()))
+            t_recv = time.monotonic()
+            dec = Decoder(body)
+            rid = dec.u64()
+            if dec.remaining() >= 17:
+                dec.f64()                  # parent send stamp (unused)
+                off = dec.f64()
+                if dec.u8():
+                    self.clock_offset = off
+            enc = Encoder().u64(rid)
+            enc.f64(t_recv)
+            self.push(pack_frame(FRAME_PONG, enc.getvalue()))
         elif kind == FRAME_STOP:
             self._stopping = True
+
+    def _serve_parent_rpc(self, rid: int, cmd: dict) -> None:
+        """Serve one parent dump request (everything here is a plain
+        in-memory read — no awaits, no store access, no encodes)."""
+        status, out = 0, {}
+        try:
+            prefix = cmd.get("prefix", "")
+            osd = self.osd
+            if prefix == "metrics":
+                from ceph_tpu.common import metrics
+                out = metrics.snapshot(
+                    osd.ctx,
+                    source=f"osd.{self.whoami}/lane{self.lane}")
+            elif prefix == "stage_dumps":
+                from ceph_tpu.common import tracer as tracer_mod
+                grp = osd.ctx.perf._groups.get(tracer_mod.STAGE_GROUP)
+                out = grp.dump_histograms() if grp is not None else {}
+            elif prefix == "dump_historic_slow_ops":
+                out = osd.op_tracker.dump_historic_slow_ops()
+            elif prefix == "dump_ops_in_flight":
+                out = osd.op_tracker.dump_in_flight()
+            elif prefix == "dump_flight_recorder":
+                out = osd.op_tracker.dump_flight_recorder()
+            elif prefix == "check_slow":
+                out = {"raised": osd.op_tracker.check_slow()}
+            else:
+                status = -1
+                out = {"error": f"unknown lane rpc {prefix!r}"}
+        except Exception as e:
+            status = -1
+            out = {"error": f"{type(e).__name__}: {e}"}
+        enc = Encoder().u64(rid).s32(status)
+        enc.bytes_(json.dumps(out, default=str).encode())
+        self.push(pack_frame(FRAME_RESP, enc.getvalue()))
 
     # ------------------------------------------------------------ lifecycle
     async def run(self) -> None:
@@ -708,10 +947,24 @@ class LaneRuntime:
         self.to_lane.advertise_waiting(True)
         self._pump()              # anything posted before we armed
         ppid = os.getppid()
+        # slow-op sweep cadence: the lane hosts the PGs, so the
+        # parent's heartbeat-tick sweep cannot see these ops — each
+        # worker sweeps its OWN OpTracker and forwards complaint
+        # counts via FRAME_STATS (osd.slow_ops stays lane-complete)
+        sweep_every = max(0.5, float(osd.cfg["osd_heartbeat_interval"]))
+        next_sweep = time.monotonic() + sweep_every
         try:
             while not self._stopping:
                 await asyncio.sleep(0.2)
                 self._pump()      # belt: poll alongside wakeups
+                now = time.monotonic()
+                if now >= next_sweep:
+                    next_sweep = now + sweep_every
+                    try:
+                        osd.op_tracker.check_slow()
+                    except Exception:
+                        _log.exception("lane %d slow-op sweep failed",
+                                       self.lane)
                 if os.getppid() != ppid:
                     _log.error("lane %d: parent died; exiting",
                                self.lane)
@@ -741,12 +994,25 @@ class LaneRuntime:
 
     async def _stats_loop(self) -> None:
         interval = float(self.osd.cfg["osd_mon_report_interval"])
+        from ceph_tpu.common import metrics
         while not self._stopping:
             await asyncio.sleep(interval)
             try:
                 rows = self.osd._pg_stat_rows()
+                # the periodic half of the metrics plane: PG rows +
+                # the lane's FULL mergeable perf snapshot + forwarded
+                # slow-op count ride one frame (on-demand fetches use
+                # the id-keyed FRAME_RPC path instead)
+                body = {
+                    "pg_rows": rows,
+                    "slow_ops": self.osd.op_tracker.slow_op_count,
+                    "metrics": metrics.snapshot(
+                        self.osd.ctx,
+                        source=f"osd.{self.whoami}/lane{self.lane}"),
+                }
                 self.push(pack_frame(
-                    FRAME_STATS, json.dumps(rows).encode()))
+                    FRAME_STATS,
+                    json.dumps(body, default=str).encode()))
                 self.osd._send_pg_stats(rows)
             except Exception:
                 _log.exception("lane %d stats tick failed", self.lane)

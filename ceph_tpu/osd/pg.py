@@ -1407,7 +1407,7 @@ class PG:
                         # queue, never occupy a window slot peering's
                         # drain would then deadlock against
                         if m._span is not None:
-                            m._span.cut("queue_wait",
+                            m._span.cut("queue_wait_pump",
                                         self.osd.ctx.tracer.hist)
                         await seq.drain()
                         await self._do_client_op(m)

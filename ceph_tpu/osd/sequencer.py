@@ -92,10 +92,11 @@ class OpSequencer:
         """Admission backpressure: block the admitter while the window
         is full (the op queue keeps buffering behind it, and the
         messenger dispatch throttle pushes back on clients).  A traced
-        op cuts `queue_wait` (dispatch -> here: PG op-queue time) on
+        op cuts `queue_wait_pump` (dispatch -> here: PG op-queue dwell
+        behind a busy worker — one of the named queue-wait causes) on
         entry and `admit_wait` (a full window's slot wait) on exit."""
         if span is not None and self.tracer is not None:
-            span.cut("queue_wait", self.tracer.hist)
+            span.cut("queue_wait_pump", self.tracer.hist)
         while self.active >= self.max_inflight:
             self._slot_free.clear()
             await self._slot_free.wait()

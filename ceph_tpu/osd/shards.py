@@ -502,6 +502,36 @@ class ShardedDataPlane:
             await asyncio.sleep(0.001 if self.threaded else 0)
 
     # ---------------------------------------------------------- inspection
+    def lane_metric_snapshots(self) -> dict:
+        """Latest metrics-plane snapshot per process lane (periodic
+        FRAME_STATS push or the last on-demand fetch); entries are
+        None until a lane has reported.  Empty at inline/thread lanes
+        — those share the parent's PerfCountersCollection already."""
+        if self.process_lanes is None:
+            return {}
+        return {lane.idx: lane.metrics for lane in self.process_lanes}
+
+    async def fetch_lane_metrics(self) -> list:
+        """On-demand cluster-scrape half of the metrics plane: ask
+        every live lane for a fresh full dump over the id-keyed
+        FRAME_RPC path.  Returns the indices of DEAD/unreachable lanes
+        — the caller must surface them loudly, never as an empty
+        snapshot."""
+        if self.process_lanes is None:
+            return []
+        live = [ln for ln in self.process_lanes if not ln.dead]
+        dead = [ln.idx for ln in self.process_lanes if ln.dead]
+        # concurrent scrape: one wedged lane costs one timeout total
+        results = await asyncio.gather(
+            *[ln.admin_rpc({"prefix": "metrics"}) for ln in live],
+            return_exceptions=True)
+        for ln, r in zip(live, results):
+            if isinstance(r, BaseException):
+                dead.append(ln.idx)
+            else:
+                ln.metrics = r
+        return sorted(dead)
+
     def counters(self) -> dict:
         if self.perf is None:
             d = {"handoff_ops": 0, "handoff_wakeups": 0,

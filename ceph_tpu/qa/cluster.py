@@ -199,15 +199,66 @@ class Cluster:
                 "ops_admitted": admitted,
                 "window_drains": drains}
 
+    async def refresh_lane_metrics(self) -> list:
+        """On-demand metrics scrape of every OSD's process-lane
+        workers (FRAME_RPC); the fetched snapshots feed
+        stage_histograms()/cluster_perf_dump().  No-op (empty list) at
+        inline/thread lanes.  Returns loud per-OSD dead-lane names."""
+        dead = []
+        for i, osd in self.osds.items():
+            for idx in await osd.shards.fetch_lane_metrics():
+                dead.append(f"osd.{i}/lane{idx}")
+        return dead
+
+    def _lane_stage_dumps(self) -> list:
+        """Per-lane {stage: dump_full} mappings from the latest lane
+        metrics snapshots (periodic FRAME_STATS push or an explicit
+        refresh_lane_metrics())."""
+        from ceph_tpu.common import tracer as tracer_mod
+        dumps = []
+        for osd in self.osds.values():
+            for snap in osd.shards.lane_metric_snapshots().values():
+                if snap:
+                    dumps.append((snap.get("groups") or {}).get(
+                        tracer_mod.STAGE_GROUP) or {})
+        return dumps
+
     def stage_histograms(self) -> dict:
         """Merged op-tracer stage histograms across every daemon and
-        client of this in-process cluster: {stage: PerfHistogram}.
-        Empty unless the contexts ran with op_tracing=true."""
+        client of this in-process cluster — and every process-lane
+        worker that has shipped a metrics snapshot (call
+        refresh_lane_metrics() first for fresh lane data):
+        {stage: PerfHistogram}.  Empty unless the contexts ran with
+        op_tracing=true."""
         from ceph_tpu.common import tracer as tracer_mod
         ctxs = [o.ctx for o in self.osds.values()]
         ctxs += [m.ctx for m in self.mons]
         ctxs += [c.ctx for c in self.clients]
-        return tracer_mod.merge_stage_histograms(ctxs)
+        return tracer_mod.merge_stage_histograms(
+            ctxs, extra_dumps=self._lane_stage_dumps())
+
+    def cluster_perf_dump(self) -> dict:
+        """One merged metrics-plane view of the whole in-process
+        cluster (the `ceph perf dump --cluster` shape without admin
+        sockets): every daemon + client context snapshot plus every
+        lane worker's latest shipped snapshot."""
+        from ceph_tpu.common import metrics
+        snaps = []
+        dead = []
+        for i, osd in self.osds.items():
+            snaps.append(metrics.snapshot(osd.ctx, source=f"osd.{i}"))
+            for idx, snap in sorted(
+                    osd.shards.lane_metric_snapshots().items()):
+                lanes = osd.shards.process_lanes or []
+                if snap:
+                    snaps.append(snap)
+                if any(ln.idx == idx and ln.dead for ln in lanes):
+                    dead.append(f"osd.{i}/lane{idx}")
+        for m in self.mons:
+            snaps.append(metrics.snapshot(m.ctx))
+        for c in self.clients:
+            snaps.append(metrics.snapshot(c.ctx))
+        return metrics.merge(snaps, lane_dead=dead)
 
     def stage_breakdown(self, measured_e2e_s=None) -> dict:
         """Per-stage quantiles + attributed/unattributed split (see

@@ -9,6 +9,12 @@ ceph.in:98-145).  Commands map 1:1 onto the monitor's command table:
     ... osd pool delete <name>
     ... osd out|in|down <id>
     ... osd getmap [epoch] --out FILE
+
+Observability (admin-socket plane, no mon round trip):
+
+    ... --admin-daemon DIR/osd.0.asok dump_op_stages
+    ... perf dump --cluster [--prom]    # merged metrics snapshot of
+                                        # every daemon + lane worker
 """
 
 from __future__ import annotations
@@ -124,6 +130,51 @@ def build_command(args, extra) -> dict:
     return cmd
 
 
+def _cluster_perf_dump(cluster_dir: str, prom: bool) -> int:
+    """`ceph perf dump --cluster`: the mgr-style cluster-wide scrape.
+    Every daemon under the cluster dir exposes `perf dump full` on its
+    admin socket — one mergeable metrics-plane snapshot per process
+    PLUS one per live lane worker (the daemon fans the request over
+    FRAME_RPC itself).  The merged view sums counters, merges
+    histogram buckets, and recomputes quantiles + the live
+    device_byte_fraction; dead lanes are carried loudly in
+    ``lane_dead``, never dropped.  ``--prom`` renders a
+    Prometheus-style text exposition instead of JSON."""
+    import glob
+    import json as _json
+
+    from ceph_tpu.common import metrics
+    from ceph_tpu.common.admin_socket import admin_command
+    socks = sorted(glob.glob(os.path.join(cluster_dir, "*.asok")))
+    if not socks:
+        print(f"no admin sockets under {cluster_dir!r} — is the "
+              f"cluster running (vstart) with admin_socket set?",
+              file=sys.stderr)
+        return 1
+    snaps, lane_dead, errors = [], [], []
+    for path in socks:
+        who = os.path.basename(path)[:-len(".asok")]
+        out = admin_command(path, "perf dump full")
+        if not isinstance(out, dict) or "snapshots" not in out:
+            errors.append(who)
+            continue
+        snaps.extend(out["snapshots"])
+        lane_dead.extend(out.get("lane_dead", []))
+    merged = metrics.merge(snaps, lane_dead=lane_dead)
+    if errors:
+        merged["scrape_errors"] = errors
+        print(f"WARNING: no snapshot from: {', '.join(errors)}",
+              file=sys.stderr)
+    if lane_dead:
+        print(f"WARNING: DEAD lane(s), metrics missing: "
+              f"{', '.join(map(str, lane_dead))}", file=sys.stderr)
+    if prom:
+        sys.stdout.write(metrics.prometheus_text(merged))
+    else:
+        print(_json.dumps(merged, indent=2, default=str))
+    return 0
+
+
 def _render_stage_table(stages: dict) -> str:
     """Aligned per-stage latency table (dump_op_stages sugar)."""
     rows = [f"{'stage':<16} {'count':>8} {'avg_ms':>10} {'p50_ms':>10} "
@@ -153,8 +204,18 @@ def main(argv=None) -> int:
     ap.add_argument("--admin-daemon", default="",
                     help="talk to a daemon's admin socket instead of "
                          "the cluster (reference ceph.in)")
+    ap.add_argument("--cluster", action="store_true",
+                    help="with `perf dump`: scrape EVERY daemon's "
+                         "admin socket under --dir (and, through "
+                         "each daemon, every process-lane worker) "
+                         "and print one merged metrics snapshot")
+    ap.add_argument("--prom", action="store_true",
+                    help="with `perf dump --cluster`: Prometheus-"
+                         "style text exposition instead of JSON")
     ap.add_argument("command", nargs="+")
     args, extra = ap.parse_known_args(argv)
+    if args.command[:2] == ["perf", "dump"] and args.cluster:
+        return _cluster_perf_dump(args.dir, args.prom)
     if args.admin_daemon:
         import json as _json
         from ceph_tpu.common.admin_socket import admin_command

@@ -50,7 +50,7 @@ def test_cli_entry_point_runs_standalone():
     for rid in ("AF01", "FP02", "SEND03", "BLK04", "MONO05",
                 "LOCK06", "FIN07", "PROTO08", "REPLY09", "EPOCH10",
                 "SHARD11", "ESC12", "PORT13", "ATOM14", "SYNC15",
-                "JIT16", "XFER17"):
+                "JIT16", "XFER17", "STAGE18"):
         assert rid in out.stdout
 
 
@@ -983,7 +983,76 @@ def test_device_report_fixture_inventory():
     assert json.loads(json.dumps(rep)) == rep
 
 
-# ================================ 2d. waiver audit + lint performance
+# ===================================== 2d. STAGE18 (stage coverage)
+
+
+def test_stage18_undeclared_stage_name_trips():
+    """ISSUE 15 CI satellite: a span cut naming a stage that is not
+    declared in CHAIN_STAGES/AUX_STAGES silently falls out of the
+    attributed chain sum — violation; declared names pass."""
+    src = (
+        "def _admit(self, m):\n"
+        "    m._span.cut(\"que_wait\", self.tracer.hist)\n"
+    )
+    vio = lint_project_sources([("osd/fixture.py", src)])
+    assert [v.rule for v in vio] == ["STAGE18"], vio
+    assert "undeclared stage" in vio[0].msg
+    clean = src.replace("que_wait", "queue_wait_pump")
+    assert lint_project_sources([("osd/fixture.py", clean)]) == []
+    # explicit-duration attribution sites (Span.attribute) are held to
+    # the same declaration discipline as cut()
+    attr = (
+        "def _hop(self, span, dwell):\n"
+        "    span.attribute(\"ringe_wait\", dwell)\n"
+    )
+    vio = lint_project_sources([("osd/fixture.py", attr)])
+    assert [v.rule for v in vio] == ["STAGE18"], vio
+    ok = attr.replace("ringe_wait", "ring_wait")
+    assert lint_project_sources([("osd/fixture.py", ok)]) == []
+    # waiver escape hatch
+    waived = src.replace(
+        "    m._span.cut(",
+        "    # lint: allow[STAGE18] fixture: exotic local stage\n"
+        "    m._span.cut(")
+    assert lint_project_sources([("osd/fixture.py", waived)]) == []
+
+
+def test_stage18_coverage_half_needs_whole_tree():
+    """The every-declared-stage-has-a-cut-site half only runs on a
+    whole-op-path file set (all anchors present): a partial lint must
+    not report every stage as uncovered.  The live tree IS whole and
+    lints clean (test_live_package_lints_clean), which proves every
+    CHAIN stage currently has a site."""
+    from ceph_tpu.devtools.rules import (_STAGE_COVERAGE_ANCHORS,
+                                         check_stage18, FileInfo)
+    # partial set: one file with one legal cut, no anchors -> clean
+    fi = FileInfo("osd/fixture.py",
+                  "def f(s):\n    s.cut(\"prepare\")\n")
+    assert list(check_stage18([fi])) == []
+    # the anchors the gate keys on must all exist in the live package
+    import os
+    pkg = os.path.dirname(os.path.dirname(
+        os.path.abspath(__import__("ceph_tpu").__file__)))
+    for rel in _STAGE_COVERAGE_ANCHORS:
+        assert os.path.exists(os.path.join(pkg, "ceph_tpu", rel)), rel
+
+
+def test_lint_json_carries_stage_coverage_block():
+    """lint --json schema 4: whole-package runs expose the per-stage
+    cut-site inventory (diffable, like the seam/device blocks)."""
+    from ceph_tpu.common.tracer import CHAIN_STAGES
+    from ceph_tpu.devtools.lint import JSON_SCHEMA, lint_report
+    assert JSON_SCHEMA >= 4
+    doc = lint_report()
+    assert doc["stages"]["declared_chain"] == list(CHAIN_STAGES)
+    sites = doc["stages"]["sites"]
+    for name in ("ring_wait", "lane_codec", "queue_wait_ring",
+                 "queue_wait_pump"):
+        assert sites.get(name, 0) >= 1, (name, sites)
+    assert json.loads(json.dumps(doc["stages"])) == doc["stages"]
+
+
+# ================================ 2e. waiver audit + lint performance
 
 
 def test_unused_waiver_detection_and_strict_promotion():

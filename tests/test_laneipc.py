@@ -130,10 +130,13 @@ def test_out_frame_roundtrip():
     from ceph_tpu.osd.messages import MOSDOpReply
     reply = MOSDOpReply(9, 0, map_epoch=5)
     addr = EntityAddr("127.0.0.1", 6805, nonce=3)
-    m, got_addr, peer_type = decode_out_frame(
+    m, got_addr, peer_type, t_send = decode_out_frame(
         encode_out_frame(reply, addr, "client"))
     assert type(m) is MOSDOpReply and m.tid == 9
     assert got_addr.port == 6805 and peer_type == "client"
+    # the reply-leg anchor: stamped at encode, in the lane's
+    # monotonic clock (the parent converts via the PING/PONG offset)
+    assert t_send > 0.0
 
 
 # ------------------------------------------------------- crash = LOUD

@@ -1,0 +1,291 @@
+"""Metrics plane (ISSUE 15): mergeable snapshots, cross-process
+histogram round-trips, lane-seam span continuity, the queue-wait cause
+taxonomy and the slow-op flight recorder.
+
+The load-bearing property is BIT-FOR-BIT mergeability: a lane worker's
+``dump_full`` crosses a ring as JSON bytes, and the parent's
+``from_dump`` reconstruction must preserve bucket counts and quantile
+interpolation exactly — otherwise the cluster-wide view silently
+drifts from the per-process truth.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+import time
+
+import pytest
+
+from ceph_tpu.common import devstats, metrics
+from ceph_tpu.common import tracer as tracer_mod
+from ceph_tpu.common.context import Context
+from ceph_tpu.common.op_tracker import OpTracker
+from ceph_tpu.common.perf_counters import PerfCounters, PerfHistogram
+from ceph_tpu.common.tracer import (AUX_STAGES, CHAIN_STAGES,
+                                    QUEUE_WAIT_CAUSES, Span)
+
+# ===================================== histogram cross-process fidelity
+
+
+def test_histogram_dump_full_frame_from_dump_roundtrip_bitforbit():
+    """dump_full -> json frame -> from_dump preserves buckets, count,
+    sum AND quantile interpolation exactly (ints + one float that
+    round-trips through repr-based json)."""
+    rng = random.Random(15)
+    h = PerfHistogram()
+    for _ in range(500):
+        h.add(rng.uniform(1e-6, 5.0))
+    frame = json.dumps(h.dump_full()).encode()     # what crosses a ring
+    h2 = PerfHistogram.from_dump(json.loads(frame.decode()))
+    assert h2.buckets == h.buckets
+    assert h2.count == h.count
+    assert h2.sum == h.sum                          # exact, not approx
+    for q in (0.5, 0.9, 0.99, 0.999):
+        assert h2.quantile(q) == h.quantile(q)      # bit-for-bit
+
+
+def test_histogram_merge_equals_union():
+    a, b = PerfHistogram(), PerfHistogram()
+    rng = random.Random(7)
+    u = PerfHistogram()
+    for _ in range(200):
+        s = rng.uniform(1e-6, 0.5)
+        (a if rng.random() < 0.5 else b).add(s)
+        u.add(s)
+    m = PerfHistogram()
+    m.merge(PerfHistogram.from_dump(a.dump_full()))
+    m.merge(PerfHistogram.from_dump(b.dump_full()))
+    assert m.buckets == u.buckets and m.count == u.count
+    assert math.isclose(m.sum, u.sum, rel_tol=1e-12)
+
+
+# ================================================== snapshot + merge
+
+
+def _ctx(name="osd.0"):
+    c = Context(name)
+    return c
+
+
+def test_snapshot_and_merge_sums_counters_and_merges_histograms():
+    devstats.reset()
+    ctx_a, ctx_b = _ctx("osd.0"), _ctx("osd.1")
+    for ctx, n in ((ctx_a, 3), (ctx_b, 5)):
+        g = ctx.perf.create("osd")
+        g.add_u64("slow_ops")
+        g.inc("slow_ops", n)
+        g.add_time("commit_lat")
+        g.tinc("commit_lat", 0.25 * n)
+        st = ctx.perf.create("op_stages")
+        for _ in range(n):
+            st.hinc("prepare", 0.004)
+    devstats.note_bytes("ec_apply", 3000, device=True)
+    devstats.note_bytes("ec_apply", 1000, device=False)
+    snap_a = metrics.snapshot(ctx_a)
+    # a snapshot must survive the wire (ring frame / admin socket)
+    snap_b = json.loads(json.dumps(
+        metrics.snapshot(ctx_b, source="osd.1/lane0"), default=str))
+    assert snap_a["metrics_schema"] == metrics.METRICS_SCHEMA
+    assert snap_b["source"] == "osd.1/lane0"
+    merged = metrics.merge([snap_a, snap_b])
+    assert merged["groups"]["osd"]["slow_ops"] == 8
+    assert merged["groups"]["osd"]["commit_lat"]["avgcount"] == 2
+    h = PerfHistogram.from_dump(merged["groups"]["op_stages"]["prepare"])
+    assert h.count == 8
+    # live device_byte_fraction from XFER17-classified byte accounting
+    # (both snapshots read the same process-global devstats here, so
+    # the merged fraction matches the per-process one)
+    assert snap_a["device_byte_fraction"] == 0.75
+    assert merged["device_byte_fraction"] == 0.75
+    assert merged["sources"] == ["osd.0", "osd.1/lane0"]
+    devstats.reset()
+
+
+def test_merge_carries_lane_dead_loudly():
+    merged = metrics.merge([], lane_dead=["osd.0/lane1"])
+    assert merged["lane_dead"] == ["osd.0/lane1"]
+    txt = metrics.prometheus_text(merged)
+    assert "LANE DEAD" in txt and "osd.0/lane1" in txt
+
+
+def test_prometheus_text_exposition():
+    devstats.reset()
+    ctx = _ctx()
+    g = ctx.perf.create("osd_shard_handoff")
+    g.add_u64("handoff_ops")
+    g.inc("handoff_ops", 42)
+    st = ctx.perf.create("op_stages")
+    st.hinc("replica_rtt", 0.010)
+    merged = metrics.merge([metrics.snapshot(ctx)])
+    txt = metrics.prometheus_text(merged)
+    assert "ceph_tpu_osd_shard_handoff_handoff_ops 42" in txt
+    assert "ceph_tpu_op_stages_replica_rtt_count 1" in txt
+    assert 'quantile="0.99"' in txt
+    assert "ceph_tpu_device_byte_fraction" in txt
+
+
+# ======================================= chain taxonomy + span helpers
+
+
+def test_chain_declares_lane_and_cause_split_stages():
+    for name in ("ring_wait", "lane_codec", "queue_wait_ring",
+                 "queue_wait_pump"):
+        assert name in CHAIN_STAGES, name
+    assert "queue_wait" not in CHAIN_STAGES    # replaced by its causes
+    for cause in QUEUE_WAIT_CAUSES:
+        assert cause in CHAIN_STAGES, cause
+    assert not set(AUX_STAGES) & set(CHAIN_STAGES)
+
+
+def test_span_attribute_tiles_and_rebase_skips():
+    sp = Span(1, 2, "op")
+    time.sleep(0.002)
+    sp.cut("prepare")
+    # explicit-duration attribution advances the cursor to `now`
+    t_end = time.monotonic()
+    sp.attribute("ring_wait", 0.003)
+    sp.attribute("lane_codec", 0.001, now=t_end)
+    assert sp._cursor == t_end
+    # rebase skips forward without attributing (the lane recorded it)
+    time.sleep(0.002)
+    anchor = time.monotonic() - 0.0005
+    sp.rebase(anchor)
+    assert sp._cursor == anchor
+    sp.rebase(anchor - 1.0)                     # never moves backward
+    assert sp._cursor == anchor
+    # a future anchor (offset estimation error) clamps to now: the
+    # next cut can never record a negative interval
+    sp.rebase(time.monotonic() + 5.0)
+    assert sp._cursor <= time.monotonic()
+    names = [s for s, _ in sp.stages]
+    assert names == ["prepare", "ring_wait", "lane_codec"]
+    assert dict(sp.stages)["ring_wait"] == 0.003
+
+
+def test_lane_envelope_carries_span_context_and_attributes_hop():
+    """encode_msg_envelope -> decode_msg_envelope continues the chain
+    across the ring: the adopted span starts at the parent's cursor
+    and carries ring_wait + lane_codec samples for the hop itself."""
+    from ceph_tpu.osd.lanes import (decode_msg_envelope,
+                                    encode_msg_envelope)
+    from ceph_tpu.osd.messages import MOSDOp
+    from ceph_tpu.osd.types import PGId
+
+    ctx = _ctx("osd.7")
+    ctx.config.set("op_tracing", True)
+    tr = ctx.tracer
+    assert tr.enabled
+
+    class _Runtime:
+        clock_offset = 0.0
+        osd = type("O", (), {"ctx": ctx})
+
+        adopt_lane_span = (
+            lambda self, *a: __import__(
+                "ceph_tpu.osd.lanes", fromlist=["LaneRuntime"]
+            ).LaneRuntime.adopt_lane_span(self, *a))
+
+    m = MOSDOp(PGId(1, 0), "obj", [], tid=9)
+    m._span = tr.start("osd_op")
+    m._span.cut("deliver")
+    body = encode_msg_envelope(m)
+    time.sleep(0.002)                            # ring dwell
+    got = decode_msg_envelope(body, t_pop=time.monotonic(),
+                              runtime=_Runtime())
+    sp = got._span
+    assert sp is not None
+    assert sp.trace_id == m._span.trace_id
+    assert sp.span_id == m._span.span_id
+    stages = dict(sp.stages)
+    assert "ring_wait" in stages and "lane_codec" in stages
+    assert stages["ring_wait"] >= 0.001          # the slept dwell
+    # the hop tiles: adopted t0 == parent cursor, lane cursor at decode
+    # end, and the recorded samples cover the span between them
+    hist = tr.hist.histograms()
+    assert hist["ring_wait"].count == 1
+    assert hist["lane_codec"].count == 1
+    # untraced messages stay untraced (no span allocation)
+    m2 = MOSDOp(PGId(1, 0), "obj2", [], tid=10)
+    got2 = decode_msg_envelope(encode_msg_envelope(m2),
+                               t_pop=time.monotonic(),
+                               runtime=_Runtime())
+    assert got2._span is None
+
+
+# =========================================== slow-op flight recorder
+
+
+def test_flight_recorder_records_complaint_and_finish_bounded():
+    ot = OpTracker(complaint_time=0.0, flight_recorder_size=4)
+    op = ot.create("osd_op(slow)")
+    op.span = Span(1, 2, "op")
+    op.span.cut("prepare")
+    time.sleep(0.001)
+    assert ot.check_slow() == 1
+    assert ot.check_slow() == 0                  # complains ONCE
+    ot.finish(op)
+    d = ot.dump_flight_recorder()
+    assert d["size"] == 4 and d["num_records"] == 2
+    first, last = d["records"][0], d["records"][-1]
+    assert first["final"] is False and last["final"] is True
+    assert any(s["stage"] == "prepare" for s in last["stages"])
+    assert "slow_op_complaint" in last["events"]
+    # bounded: the ring never grows past its size
+    for i in range(10):
+        o = ot.create(f"op{i}")
+        o.complained = True                      # simulate complaint
+        ot.finish(o)
+    assert ot.dump_flight_recorder()["num_records"] == 4
+
+
+def test_cluster_perf_dump_cli_scrapes_admin_sockets(tmp_path, capsys):
+    """`ceph perf dump --cluster`: glob the cluster dir's admin
+    sockets, fetch each `perf dump full`, merge — JSON and Prometheus
+    forms both carry the summed counters."""
+    import asyncio
+
+    from ceph_tpu.common.admin_socket import AdminSocket
+    from ceph_tpu.tools.ceph import _cluster_perf_dump
+
+    async def run():
+        socks = []
+        for name, n in (("mon.a", 2), ("osd.0", 3)):
+            ctx = _ctx(name)
+            g = ctx.perf.create("osd")
+            g.add_u64("slow_ops")
+            g.inc("slow_ops", n)
+            s = AdminSocket(ctx, str(tmp_path / f"{name}.asok"))
+            await s.start()
+            socks.append(s)
+        loop = asyncio.get_running_loop()
+        rc_json = await loop.run_in_executor(
+            None, _cluster_perf_dump, str(tmp_path), False)
+        rc_prom = await loop.run_in_executor(
+            None, _cluster_perf_dump, str(tmp_path), True)
+        for s in socks:
+            await s.stop()
+        return rc_json, rc_prom
+
+    rc_json, rc_prom = asyncio.run(run())
+    assert rc_json == 0 and rc_prom == 0
+    out = capsys.readouterr().out
+    json_part, prom_part = out.split("# ceph-tpu cluster metrics", 1)
+    doc = json.loads(json_part)
+    assert doc["groups"]["osd"]["slow_ops"] == 5
+    assert len(doc["sources"]) == 2
+    assert "ceph_tpu_osd_slow_ops 5" in prom_part
+    # empty dir: loud failure, not an empty merge
+    assert _cluster_perf_dump(str(tmp_path / "nope"), False) == 1
+
+
+def test_perf_counters_dump_full_groups():
+    pc = PerfCounters("g")
+    pc.add_u64("n")
+    pc.inc("n", 3)
+    pc.hinc("lat", 0.002)
+    full = pc.dump_full()
+    assert full["n"] == 3
+    assert "buckets" in full["lat"]
+    assert PerfHistogram.from_dump(full["lat"]).count == 1

@@ -168,17 +168,23 @@ def test_tracing_stage_coverage_and_zero_encode():
 
         await asyncio.gather(*[one(n, d) for n, d in blobs.items()])
         bd = cl.stage_breakdown(measured_e2e_s=sum(lats))
+        # the metrics plane on the same run (ISSUE 15): a full
+        # cluster-wide scrape must be pure counter arithmetic —
+        # zero message encodes at inline lanes
+        scrape = cl.cluster_perf_dump()
         enc = payload_mod.counters()
         merged = cl.stage_histograms()
         for k, v in blobs.items():
             assert await io.read(k) == v
         await cl.stop()
-        return bd, enc, merged
+        return bd, enc, merged, scrape
 
-    bd, enc, merged = asyncio.run(run())
-    # (b) tracing must not reintroduce encodes on the pure-local path
+    bd, enc, merged, scrape = asyncio.run(run())
+    # (b) tracing AND the metrics-plane scrape must not reintroduce
+    # encodes on the pure-local path
     assert enc["msg_encode_calls"] == 0, enc
     assert enc["msg_encode_bytes"] == 0, enc
+    assert "op_stages" in scrape["groups"] and scrape["sources"]
     # every write produced a finished span
     assert merged["op_total"].count >= 24, merged["op_total"].count
     # the EC write path stages all recorded samples

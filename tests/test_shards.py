@@ -326,6 +326,93 @@ def test_process_lane_minicluster_replicated_rw():
 
 
 @pytest.mark.slow
+def test_process_lane_observability_attribution_and_cluster_scrape():
+    """ISSUE 15 acceptance: a PROCESS-lane cluster run attributes
+    >=90% of measured e2e wall time to named chain stages — including
+    the new lane-hop cuts (ring_wait / lane_codec) and the cause-split
+    queue-wait stages — because each lane worker's stage histograms
+    ship to the parent over the metrics plane and merge bit-for-bit.
+    The same run proves the cluster scrape: one merged perf snapshot
+    covering parent + all lanes with devstats and device_byte_fraction
+    included, lane-merged dump_op_stages, and a LOUD lane_dead marker
+    once a worker is killed."""
+    import time as _time
+
+    def ctx_f(name):
+        c = make_ctx(name)
+        c.config.set("osd_op_num_shards", 2)
+        c.config.set("osd_shard_lanes", "process")
+        c.config.set("ms_local_delivery", True)
+        c.config.set("op_tracing", True)
+        return c
+
+    async def run():
+        cl = Cluster(ctx_factory=ctx_f)
+        admin = await cl.start(3)
+        await admin.pool_create("obspool", pg_num=4)
+        io = admin.open_ioctx("obspool")
+        lats = []
+        sem = asyncio.Semaphore(8)
+
+        async def one(name, data):
+            async with sem:
+                t0 = _time.perf_counter()
+                await io.write_full(name, data)
+                lats.append(_time.perf_counter() - t0)
+
+        blobs = {f"ob{i:03d}": bytes([i]) * 8192 for i in range(24)}
+        await asyncio.gather(*[one(n, d) for n, d in blobs.items()])
+        # fresh lane scrape (FRAME_RPC), then the merged views
+        dead = await cl.refresh_lane_metrics()
+        assert dead == [], dead
+        bd = cl.stage_breakdown(measured_e2e_s=sum(lats))
+        merged = cl.stage_histograms()
+        scrape = cl.cluster_perf_dump()
+        # lane-merged admin dump straight off one OSD
+        osd = next(iter(cl.osds.values()))
+        table = await osd._dump_op_stages()
+        slow = await osd._dump_historic_slow_ops()
+        # kill one worker: the dump must MARK the lane dead, not
+        # silently omit it
+        victim = osd.shards.process_lanes[0]
+        victim.proc.terminate()
+        victim.proc.join(timeout=10.0)
+        for _ in range(100):
+            if victim.dead:
+                break
+            await asyncio.sleep(0.05)
+        table_dead = await osd._dump_op_stages()
+        scrape_dead = await osd._perf_dump_full()
+        await cl.stop()
+        return (bd, merged, scrape, table, slow, victim.idx,
+                table_dead, scrape_dead)
+
+    (bd, merged, scrape, table, slow, victim_idx, table_dead,
+     scrape_dead) = asyncio.run(run())
+    # (a) the acceptance bar: >=90% attribution WITH process lanes
+    assert bd["measured_s"] > 0
+    assert bd["attributed_s"] >= 0.9 * bd["measured_s"], bd
+    assert bd["unattributed_frac"] < 0.10, bd
+    # (b) the lane-hop chain stages recorded real samples
+    for stage in ("ring_wait", "lane_codec", "queue_wait_pump",
+                  "prepare", "store_apply", "replica_rtt",
+                  "ack_delivery"):
+        assert stage in merged and merged[stage].count > 0, stage
+    # (c) lane-merged dump_op_stages saw the lane-side pipeline
+    assert table["lanes_merged"] >= 1 and table["lane_dead"] == []
+    assert "ring_wait" in table["stages"], table["stages"].keys()
+    assert "prepare" in table["stages"]
+    assert slow["lane_dead"] == []
+    # (d) one merged cluster snapshot covers parent + lanes + devstats
+    assert any("/lane" in s for s in scrape["sources"]), scrape["sources"]
+    assert "devstats" in scrape and "device_byte_fraction" in scrape
+    assert "op_stages" in scrape["groups"]
+    # (e) a dead lane is LOUD, never silence
+    assert victim_idx in table_dead["lane_dead"], table_dead
+    assert any(str(victim_idx) in d for d in scrape_dead["lane_dead"])
+
+
+@pytest.mark.slow
 def test_process_lane_minicluster_ec_write_burst():
     """The tier-1 smoke the ISSUE names: a 2-lane process plane
     serving one EC (k=2,m=2) write burst end to end — sub-op fan-out,
