@@ -57,12 +57,14 @@ class Objecter(Dispatcher):
         self._tid = 0
         self._inflight: Dict[int, _InFlight] = {}
         # corked op batching (sharded-data-plane client half): ops
-        # submitted within one loop pass to the SAME target OSD
-        # coalesce into one MOSDOpBatch — one wire frame, one
-        # local-delivery handoff — instead of N per-message hops.
-        # key = target addr (nonce-less); flush armed per key per pass
+        # submitted within one loop pass park UNTARGETED; the flush
+        # batch-computes every corked op's placement in ONE kernel call
+        # (OSDMap.prime_pgs), then groups per target OSD into
+        # MOSDOpBatch frames — one wire frame, one local-delivery
+        # handoff — instead of N per-message hops with N scalar
+        # placement descents
         self._batching = bool(ctx.config["objecter_op_batching"])
-        self._cork: Dict[Tuple[str, int], list] = {}
+        self._cork: List[_InFlight] = []
         self.batches_sent = 0       # introspection (bench/tests)
         self.ops_batched = 0
 
@@ -186,51 +188,70 @@ class Objecter(Dispatcher):
             # double-enter the pending cork: the already-corked frame
             # will ship; a stale target self-corrects via EAGAIN
             return
+        if self._batching and not op.sent:
+            # cork: ops submitted within one loop pass park UNTARGETED
+            # (no per-op placement descent here) and ship as per-OSD
+            # MOSDOpBatch frames from the flush.  The first op arms the
+            # flush; flushing happens before any awaited reply can
+            # exist, so latency cost is one call_soon hop.  RESENDS
+            # (map change / EAGAIN) bypass the cork — they are
+            # latency-critical singletons and must not wait out a
+            # flush or double-enter a pending cork
+            self._cork.append(op)
+            op.corked = True
+            if len(self._cork) == 1:
+                asyncio.get_running_loop().call_soon(self._flush_cork)
+            return
         built = self._build_msg(op)
         if built is None:
             return
         m, addr = built
-        if self._batching and not op.sent:
-            # cork: ops for the same OSD within one loop pass ship as
-            # ONE MOSDOpBatch (one frame / one local handoff).  The
-            # first op for a target arms the flush; flushing happens
-            # before any awaited reply can exist, so latency cost is
-            # one call_soon hop.  RESENDS (map change / EAGAIN) bypass
-            # the cork — they are latency-critical singletons and must
-            # not wait out a flush or double-enter a pending cork
-            key = addr.without_nonce()
-            pend = self._cork.setdefault(key, [])
-            pend.append((m, addr, op))
-            op.corked = True
-            if len(pend) == 1:
-                asyncio.get_running_loop().call_soon(
-                    self._flush_cork, key)
-            return
         self.messenger.send_message(m, addr, peer_type="osd")
         self._note_sent(op)
 
-    def _flush_cork(self, key) -> None:
-        pend = self._cork.pop(key, None)
+    def _flush_cork(self) -> None:
+        pend, self._cork = self._cork, []
         if not pend:
             return
-        if len(pend) == 1:
-            m, addr, op = pend[0]
-            self.messenger.send_message(m, addr, peer_type="osd")
-            self._note_sent(op)
-            return
-        addr = pend[0][1]
-        # device-candidate:crush-placement batch-compute every corked
-        # op's placement in ONE ops/crush_kernel.py call (CHUNK_SIZES-
-        # bucketed, warm-engine gated) instead of per-op _calc_target
-        # scalar descents — the corked MOSDOpBatch is already the
-        # N-ops-per-pass shape the batched kernel wants
-        self.messenger.send_message(
-            MOSDOpBatch([m for m, _a, _o in pend]), addr,
-            peer_type="osd")
-        self.batches_sent += 1
-        self.ops_batched += len(pend)
-        for _m, _a, op in pend:
-            self._note_sent(op)
+        m = self.osdmap
+        if m is not None and len(pend) > 1:
+            # device-candidate:crush-placement@landed batch-compute
+            # every corked op's placement in ONE ops/crush_kernel.py
+            # call (OSDMap.prime_pgs → batch_do_rule, CHUNK_SIZES-
+            # bucketed) instead of per-op _calc_target scalar descents
+            # — the corked pass is already the N-ops shape the batched
+            # kernel wants; _build_msg below then runs on pure
+            # _acting_cache hits
+            pgs = []
+            for op in pend:
+                loc = self._effective_loc(op.loc, op.ops)
+                if loc.pool in m.pools:
+                    pgs.append(m.object_locator_to_pg(op.oid, loc))
+            m.prime_pgs(pgs)
+        by_addr: Dict[Tuple[str, int], list] = {}
+        for op in pend:
+            built = self._build_msg(op)
+            if built is None:
+                # no reachable primary: leave the op for the next map's
+                # resend scan (uncork so it can re-enter)
+                op.corked = False
+                continue
+            msg, addr = built
+            by_addr.setdefault(addr.without_nonce(),
+                               (addr, []))[1].append((msg, op))
+        for addr, group in by_addr.values():
+            if len(group) == 1:
+                msg, op = group[0]
+                self.messenger.send_message(msg, addr, peer_type="osd")
+                self._note_sent(op)
+                continue
+            self.messenger.send_message(
+                MOSDOpBatch([msg for msg, _o in group]), addr,
+                peer_type="osd")
+            self.batches_sent += 1
+            self.ops_batched += len(group)
+            for _msg, op in group:
+                self._note_sent(op)
 
     def _note_sent(self, op: _InFlight) -> None:
         op.sent = True

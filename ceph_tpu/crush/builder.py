@@ -159,6 +159,7 @@ def make_bucket(map_: CrushMap, alg: int, type_: int, items: List[int],
 def reweight_item(map_: CrushMap, b: Bucket, item: int, weight: int) -> None:
     """Adjust one item's weight, recomputing derived state
     (reference: crush_bucket_adjust_item_weight, builder.c:830-1130)."""
+    map_._invalidate_kernel_cache()
     pos = b.items.index(item)
     if b.alg == BUCKET_UNIFORM:
         b.item_weights = [weight] * b.size

@@ -142,7 +142,15 @@ class CrushMap(Encodable):
             return self.buckets[idx]
         return None
 
+    def _invalidate_kernel_cache(self) -> None:
+        """Drop the attached batched-kernel compile cache (see
+        ops/crush_kernel.compile_rule) — in-place topology mutation
+        invalidates compiled level tables."""
+        self.__dict__.pop("_kernel_compile_cache", None)
+        self.__dict__.pop("_kernel_compile_token", None)
+
     def add_bucket(self, b: Bucket) -> int:
+        self._invalidate_kernel_cache()
         if b.id == 0:  # auto-assign
             b.id = -1 - len(self.buckets)
             self.buckets.append(b)
@@ -155,6 +163,7 @@ class CrushMap(Encodable):
         return b.id
 
     def add_rule(self, r: Rule, rule_id: int = -1) -> int:
+        self._invalidate_kernel_cache()
         if rule_id < 0:
             rule_id = len(self.rules)
         while len(self.rules) <= rule_id:
@@ -175,6 +184,7 @@ class CrushMap(Encodable):
             item_id, f"osd.{item_id}" if item_id >= 0 else f"bucket{item_id}")
 
     def set_tunables_profile(self, name: str) -> None:
+        self._invalidate_kernel_cache()
         self.tunables = Tunables.profile(name)
 
     # -- encoding ------------------------------------------------------------

@@ -90,7 +90,10 @@ _SYNC_END_RE = re.compile(r"#\s*device-sync:end\b")
 
 #: candidate kernel call-site annotation:
 #:   # device-candidate:<kind> <free-form note>
-_CANDIDATE_RE = re.compile(r"#\s*device-candidate:([\w-]+)\s*(.*)$")
+#: a consumed work-list row is marked landed in-source:
+#:   # device-candidate:<kind>@landed <free-form note>
+_CANDIDATE_RE = re.compile(
+    r"#\s*device-candidate:([\w-]+)(@landed)?\s*(.*)$")
 
 
 class SyncRegion:
@@ -174,6 +177,7 @@ _WIRE_BUFFER_NAMES = {
     "chunks", "data", "folded", "seg", "mat", "bm", "bitmat", "gen",
     "weights", "weights_vec", "wv", "wvj", "items", "rows", "xs", "rs",
     "surv", "table", "blocks", "planes", "dec", "inp", "parity",
+    "sizes", "ids",   # per-level bucket size / id tables (topology)
 }
 
 CLS_DEVICE = "device"
@@ -395,13 +399,15 @@ class KernelSite:
     batched-CRUSH / device-EC PR consumes."""
 
     __slots__ = ("rel", "line", "kind", "note", "fn", "side", "is_async",
-                 "sync", "retrace", "transfer")
+                 "sync", "retrace", "transfer", "landed")
 
-    def __init__(self, rel: str, line: int, kind: str, note: str):
+    def __init__(self, rel: str, line: int, kind: str, note: str,
+                 landed: bool = False):
         self.rel = rel
         self.line = line
         self.kind = kind
         self.note = note
+        self.landed = landed    # work-list row consumed by a batched PR
         self.fn: Optional[str] = None
         self.side = "other"
         self.is_async = False
@@ -417,7 +423,8 @@ class KernelSite:
         return {"rel": self.rel, "line": self.line, "kind": self.kind,
                 "note": self.note, "fn": self.fn, "side": self.side,
                 "async": self.is_async, "sync": self.sync,
-                "retrace": self.retrace, "transfer": self.transfer}
+                "retrace": self.retrace, "transfer": self.transfer,
+                "landed": self.landed}
 
 
 #: bucketing helpers: a caller (or its note) naming one is shape-stable
@@ -775,7 +782,7 @@ class DeviceAnalysis:
                     continue
                 # a long annotation wraps onto following comment lines:
                 # they are the note's continuation, not new directives
-                note_parts = [m.group(2).strip()]
+                note_parts = [m.group(3).strip()]
                 nxt = ln + 1
                 while nxt in fi.comments:
                     cont = fi.comments[nxt]
@@ -786,7 +793,8 @@ class DeviceAnalysis:
                     note_parts.append(cont.lstrip("# ").strip())
                     nxt += 1
                 site = KernelSite(fi.rel, ln, m.group(1),
-                                  " ".join(p for p in note_parts if p))
+                                  " ".join(p for p in note_parts if p),
+                                  landed=m.group(2) is not None)
                 fn = self._enclosing(fi.rel, ln)
                 if fn is not None:
                     site.fn = fn.qual
@@ -861,6 +869,8 @@ class DeviceAnalysis:
             "jit_entries": jits,
             "summary": {
                 "kernel_sites": len(sites),
+                "landed_kernel_sites": sum(
+                    1 for s in self.kernel_sites if s.landed),
                 "unclassified_kernel_sites": sum(
                     1 for s in self.kernel_sites if not s.classified),
                 "sync_regions": len(regions),

@@ -88,11 +88,28 @@ _EC_TILE = 32768          # default lanes per grid step (mult. of 128)
 _EC_LAYOUT = "bc"
 _EC_PACK = "or"
 
+#: per-bitmatrix-shape overrides, keyed by the [8r, 8k] bitmat shape:
+#: encode (parity rows of the generator) and decode (square-ish
+#: rebuild matrices) present DIFFERENT matmul aspect ratios, and the
+#: winning (tile, layout, pack) differs between them — a decode
+#: autotune pass installs here without clobbering the encode winner
+_EC_SHAPE_CFG: dict = {}
+
 
 def set_fused_config(tile: int = None, layout: str = None,
-                     pack: str = None) -> dict:
-    """Set the process-wide fused-kernel variant (bench autotune)."""
+                     pack: str = None, shape: tuple = None) -> dict:
+    """Set the fused-kernel variant (bench autotune).  With ``shape``
+    (a bitmat [8r, 8k] shape tuple) the config binds to that matrix
+    shape only; without it the process-wide defaults change."""
     global _EC_TILE, _EC_LAYOUT, _EC_PACK
+    if shape is not None:
+        base = _EC_SHAPE_CFG.get(tuple(shape),
+                                 (_EC_TILE, _EC_LAYOUT, _EC_PACK))
+        cfg = (int(tile) if tile else base[0],
+               layout or base[1], pack or base[2])
+        _EC_SHAPE_CFG[tuple(shape)] = cfg
+        return {"tile": cfg[0], "layout": cfg[1], "pack": cfg[2],
+                "shape": tuple(shape)}
     if tile:
         _EC_TILE = int(tile)
     if layout:
@@ -100,6 +117,13 @@ def set_fused_config(tile: int = None, layout: str = None,
     if pack:
         _EC_PACK = pack
     return {"tile": _EC_TILE, "layout": _EC_LAYOUT, "pack": _EC_PACK}
+
+
+def _resolve_fused_config(bitmat_shape: tuple) -> tuple:
+    """(tile, layout, pack) for one launch: shape-bound winner first,
+    process-wide defaults otherwise."""
+    return _EC_SHAPE_CFG.get(tuple(bitmat_shape),
+                             (_EC_TILE, _EC_LAYOUT, _EC_PACK))
 
 
 def _perm_cb_to_bc(n_bytes: int) -> np.ndarray:
@@ -167,14 +191,15 @@ def _apply_bitmatrix_pallas(bitmat: jnp.ndarray, data: jnp.ndarray,
                             tile: Optional[int] = None,
                             layout: Optional[str] = None,
                             pack: Optional[str] = None) -> jnp.ndarray:
-    """Thin unjitted wrapper: the process-wide config globals are
-    resolved HERE, outside jit, so set_fused_config/autotune changes
-    reach every later call — resolving them inside the traced function
-    would bake the values active at first trace into the cached
-    executable forever."""
+    """Thin unjitted wrapper: the config (shape-bound winner, else the
+    process-wide globals) is resolved HERE, outside jit, so
+    set_fused_config/autotune changes reach every later call —
+    resolving it inside the traced function would bake the values
+    active at first trace into the cached executable forever."""
+    ctile, clay, cpack = _resolve_fused_config(bitmat.shape)
     return _apply_bitmatrix_pallas_jit(
-        bitmat, data, interpret, tile or _EC_TILE,
-        layout or _EC_LAYOUT, pack or _EC_PACK)
+        bitmat, data, interpret, tile or ctile,
+        layout or clay, pack or cpack)
 
 
 @partial(jax.jit,
@@ -236,10 +261,16 @@ TUNE_SPACE = [
 
 
 def autotune(mat: np.ndarray, length: int = 1 << 25,
-             trials: int = 3, budget_s: Optional[float] = None) -> dict:
+             trials: int = 3, budget_s: Optional[float] = None,
+             install: str = "global") -> dict:
     """Time every fused variant on the live device and install the
     winner (bench.py tpu_ec runs this before measuring).  Returns
     {config, rate_mb_s} of the winner.
+
+    ``install="global"`` sets the process-wide default (the encode
+    pass); ``install="shape"`` binds the winner to THIS matrix's
+    bitmat shape only (the decode pass — decode matrices have a
+    different aspect ratio and must not clobber the encode winner).
 
     Each variant is timed by the SLOPE between a small and a large
     operand (marginal bytes/second): the tunneled runtime carries a
@@ -297,16 +328,20 @@ def autotune(mat: np.ndarray, length: int = 1 << 25,
         except Exception:
             worst_cost = max(worst_cost, time.monotonic() - t_var)
             continue                      # variant unsupported: skip
+    shape = tuple(bm.shape) if install == "shape" else None
     if best:
-        set_fused_config(best["tile"], best["layout"], best["pack"])
+        set_fused_config(best["tile"], best["layout"], best["pack"],
+                         shape=shape)
     else:
         # every slope drowned in RTT noise: fall back to the measured
         # champion default rather than silently leaving whatever config
         # a previous caller installed
         t, lay, pk = TUNE_SPACE[0]
-        set_fused_config(t, lay, pk)
+        set_fused_config(t, lay, pk, shape=shape)
         best = {"tile": t, "layout": lay, "pack": pk,
                 "rate_mb_s": None, "note": "slope-noise fallback"}
+    if shape is not None:
+        best["shape"] = shape
     return best
 
 
@@ -350,7 +385,8 @@ class MatrixApply:
 
     def device_call(self, chunks: jnp.ndarray) -> jnp.ndarray:
         """On-device variant for fused pipelines (no host round-trip)."""
-        cfg = (_EC_TILE, _EC_LAYOUT, _EC_PACK) if self.fused else ()
+        cfg = (_resolve_fused_config(self._bitmat.shape)
+               if self.fused else ())
         devstats.note_launch(
             "ec_apply", (self._sig, tuple(chunks.shape), self.fused,
                          cfg))

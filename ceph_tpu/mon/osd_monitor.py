@@ -359,16 +359,23 @@ class OSDMonitor(PaxosService):
         elif prefix == "osd reweight-by-utilization":
             # OSDMonitor::reweight_by_utilization: nudge overloaded osds
             # down proportionally to their PG-count excess over the mean
-            # (usage proxy — the reference uses kb_used the same way)
+            # (usage proxy — the reference uses kb_used the same way).
+            # The all-PG census sweeps every pool through the batched
+            # placement kernel (OSDMap.map_pgs_batch: one launch per
+            # pool) instead of waiting on reported pg_stats — the mon
+            # answers from the map it is about to mutate
             oload = int(cmd.get("oload", 120))
             if oload <= 100:
                 ack(-errno.EINVAL, "oload must be > 100")
                 return
             per_osd: Dict[int, int] = {}
-            for row in self.mon.pgmon.pg_stats.values():
-                for o in row.get("acting", []):
-                    if o >= 0:
-                        per_osd[o] = per_osd.get(o, 0) + 1
+            for pool_id in self.osdmap.pools:
+                for _pg, _up, _upp, acting, _actp in \
+                        self.osdmap.map_pgs_batch(pool_id,
+                                                  engine="host"):
+                    for o in acting:
+                        if o >= 0:
+                            per_osd[o] = per_osd.get(o, 0) + 1
             if not per_osd:
                 ack(0, json.dumps({"avg_pgs": 0, "reweighted": {}}))
                 return
@@ -694,6 +701,8 @@ class OSDMonitor(PaxosService):
         # pool quotas (`osd pool set-quota` role): the mon's quota
         # check flips FLAG_FULL_QUOTA off PGMap usage
         "quota_max_bytes": int, "quota_max_objects": int,
+        # pg_num growth (split): validated by a batched all-PG sweep
+        "pg_num": int,
     }
 
     def set_pool_full_quota(self, pid: int, full: bool) -> None:
@@ -738,6 +747,39 @@ class OSDMonitor(PaxosService):
             return
         pool = copy.deepcopy(self.pending_inc.new_pools.get(
             pid, self.osdmap.pools[pid]))
+        if var == "pg_num":
+            if val <= pool.pg_num:
+                self.mon.reply(m, MMonCommandAck(
+                    m.tid, -errno.EINVAL,
+                    f"pg_num may only grow (now {pool.pg_num})"))
+                return
+            # sweep the WHOLE grown pg set through the batched
+            # placement kernel in one launch before the map commits:
+            # unplaceable growth (dead rule / empty topology) is
+            # rejected here instead of surfacing as stuck pgs later
+            from ceph_tpu.ops.crush_kernel import batch_do_rule
+            from ceph_tpu.osd.types import PGId
+            grown = copy.deepcopy(pool)
+            grown.pg_num = val
+            grown.pgp_num = val
+            ruleno = self.osdmap.crush.find_rule(
+                pool.crush_ruleset, pool.type, pool.size)
+            if ruleno < 0:
+                self.mon.reply(m, MMonCommandAck(
+                    m.tid, -errno.EINVAL,
+                    f"pool {name!r} has no usable crush rule"))
+                return
+            pps = [grown.raw_pg_to_pps(PGId(pid, ps))
+                   for ps in range(val)]
+            mapped = batch_do_rule(self.osdmap.crush, ruleno, pps,
+                                   pool.size, self.osdmap.osd_weight,
+                                   engine="host")
+            if not any(any(o >= 0 for o in row) for row in mapped):
+                self.mon.reply(m, MMonCommandAck(
+                    m.tid, -errno.EINVAL,
+                    "pg_num growth would leave every pg unmapped"))
+                return
+            pool.pgp_num = val
         setattr(pool, var, val)
         self.pending_inc.new_pools[pid] = pool
         self._propose_and_ack(m, outs=f"set pool {name} {var} = {val}")

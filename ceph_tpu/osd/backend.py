@@ -746,7 +746,7 @@ class ECBackend(PGBackend):
         if gen is None or q is None:
             return self.codec.encode(set(range(self.n)), data)
         chunks = self.codec.split_data(data)
-        # device-candidate:ec-encode the live kernel call site: awaits
+        # device-candidate:ec-encode@landed the live kernel call site: awaits
         # the cross-PG collector (LANE_BUCKETS-bucketed, executor
         # dispatch) — the loop never blocks on the device
         parity = await q.apply(gen[self.k:], chunks)
@@ -1315,7 +1315,7 @@ class ECBackend(PGBackend):
         streams, gattrs = got
         from ceph_tpu.ec.interface import ErasureCodeError
         try:
-            # device-candidate:ec-decode degraded-read rebuild runs the
+            # device-candidate:ec-decode@landed degraded-read rebuild runs the
             # host codec inline today; route it through the ec_queue
             # collector (LANE_BUCKETS-bucketed) so recovery-window
             # reads batch their decodes like writes batch encodes
@@ -1423,7 +1423,7 @@ class ECBackend(PGBackend):
             raise RuntimeError(f"{pg.pgid}: cannot reconstruct {oid} "
                                f"for shard {target}: insufficient shards")
         streams, _ = got
-        # device-candidate:decode-rebuild recovery rebuild decodes one
+        # device-candidate:decode-rebuild@landed recovery rebuild decodes one
         # object at a time on the host codec; whole-PG rebuild is one
         # embarrassingly parallel decode (LANE_BUCKETS-bucketed fold,
         # or the pjit mesh path parallel/mesh_exec.py proves)

@@ -436,6 +436,13 @@ class OSD(Dispatcher):
         """Instantiate/advance PGs this osd hosts (handle_osd_map role)."""
         m = self.osdmap
         wanted: Dict[PGId, int] = {}
+        # batch-compute the new epoch's placements up front: one kernel
+        # launch per pool primes the acting cache the per-PG loop below
+        # reads (prime_pgs no-ops per pool when the rule doesn't
+        # vectorize — the loop then pays the scalar descent as before)
+        m.prime_pgs([PGId(pool_id, ps)
+                     for pool_id, pool in m.pools.items()
+                     for ps in range(pool.pg_num)])
         for pool_id, pool in m.pools.items():
             for ps in range(pool.pg_num):
                 pgid = PGId(pool_id, ps)

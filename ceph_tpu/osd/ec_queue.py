@@ -277,7 +277,7 @@ class ECBatchQueue:
             off += r.chunks.shape[1]
         ap = matrix_apply(mat)
         cap = LANE_BUCKETS[-1]
-        # device-candidate:ec-dispatch the live executor-side launch:
+        # device-candidate:ec-dispatch@landed the live executor-side launch:
         # LANE_BUCKETS-bucketed windows over the folded group, staged
         # once, fetched once (the shape every candidate above adopts)
         # XFER17 staging transfer: one h2d for the whole folded group

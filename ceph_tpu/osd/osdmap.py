@@ -331,16 +331,8 @@ class OSDMap(Encodable):
             # only prime when the rule actually vectorizes — the
             # batch call's scalar fallback would descend EVERY pg of
             # the pool inline, turning one lookup into a pg_num x 1ms
-            # event-loop stall
-            from ceph_tpu.ops.crush_kernel import compile_rule
-            ruleno = self.crush.find_rule(pool.crush_ruleset, pool.type,
-                                          pool.size)
-            if ruleno >= 0 and compile_rule(self.crush,
-                                            ruleno) is not None:
-                for cpg, up, upp, acting, actp in self.map_pgs_batch(
-                        pg.pool, engine="host"):
-                    self._acting_cache[cpg] = (tuple(up), upp,
-                                               tuple(acting), actp)
+            # event-loop stall (_prime_batch checks compile_rule)
+            if self._prime_batch(pg.pool, self.pg_ids(pg.pool)):
                 hit = self._acting_cache.get(pg)
                 if hit is not None:
                     up, up_primary, acting, acting_primary = hit
@@ -380,16 +372,90 @@ class OSDMap(Encodable):
             else up_primary
         return up, up_primary, acting, acting_primary
 
+    def _prime_batch(self, pool_id: int, pgs: List[PGId],
+                     engine: str = "host") -> bool:
+        """Compute placements for `pgs` (raw pg ids of ONE pool) in a
+        single batched kernel launch and prime _acting_cache.  Returns
+        False — and launches nothing — when the pool's rule doesn't
+        vectorize; callers then fall back to the scalar per-pg path."""
+        from ceph_tpu.ops import crush_kernel
+        from ceph_tpu.common import devstats
+        pool = self.pools.get(pool_id)
+        if pool is None or not pgs:
+            return False
+        ruleno = self.crush.find_rule(pool.crush_ruleset, pool.type,
+                                      pool.size)
+        if ruleno < 0 or crush_kernel.compile_rule(self.crush,
+                                                   ruleno) is None:
+            return False
+        pps = [pool.raw_pg_to_pps(pg) for pg in pgs]
+        # launch signature deliberately excludes the epoch: steady-state
+        # bursts repeat (pool, rule, chunk) so the perf-smoke compile
+        # plateau holds while every batch still counts as one launch
+        devstats.note_launch(
+            "crush_place",
+            (pool_id, ruleno, crush_kernel._pick_chunk(len(pps))))
+        raws = crush_kernel.batch_do_rule(
+            self.crush, ruleno, pps, pool.size, self.osd_weight,
+            engine=engine)
+        for pg, raw in zip(pgs, raws):
+            up, upp, acting, actp = self._finish_mapping(pool, pg, raw)
+            self._acting_cache[pg] = (tuple(up), upp, tuple(acting),
+                                      actp)
+        return True
+
+    def prime_pgs(self, pgs: List[PGId]) -> int:
+        """Placement for a whole work-list in ONE batched kernel launch
+        per pool — the device-seam consumer entry (Objecter cork flush,
+        OSD epoch advance, backfill planning).  Dedupes, skips pgs the
+        cache already holds, groups the rest per pool.  Returns the
+        number of batch launches performed (0 = everything cached or
+        nothing vectorizable)."""
+        by_pool: Dict[int, List[PGId]] = {}
+        for pg in pgs:
+            pool = self.pools.get(pg.pool)
+            if pool is None:
+                continue
+            pg = pool.raw_pg_to_pg(pg)
+            if pg in self._acting_cache:
+                continue
+            by_pool.setdefault(pg.pool, []).append(pg)
+        launches = 0
+        for pool_id, want in by_pool.items():
+            if self._prime_batch(pool_id, list(dict.fromkeys(want))):
+                launches += 1
+        return launches
+
+    def map_objects_batch(self, pool_id: int, names: List[str]
+                          ) -> List[Tuple[PGId, List[int], int]]:
+        """Batched object→placement for a whole object list (backfill
+        planning maps a full listing window per pass): hash every name
+        to its pg, prime all distinct pgs in one kernel launch, then
+        serve from the cache.  Returns [(pg, acting, acting_primary)]
+        aligned with `names`."""
+        loc = ObjectLocator(pool_id)
+        pool = self.pools[pool_id]
+        raw = [self.object_locator_to_pg(n, loc) for n in names]
+        pgs = [pool.raw_pg_to_pg(r) for r in raw]
+        self.prime_pgs(pgs)
+        out = []
+        for pg in pgs:
+            acting, primary = self.pg_to_acting_osds(pg)
+            out.append((pg, acting, primary))
+        return out
+
     def map_pgs_batch(self, pool_id: int, engine: str = "auto"
                       ) -> List[Tuple[PGId, List[int], int, List[int], int]]:
         """Map EVERY pg of a pool in one batched kernel launch
-        (osdmaptool --test-map-pgs hot path; ops/crush_kernel.py).
+        (osdmaptool --test-map-pgs hot path; the mon's reweight and
+        pg_num-growth sweeps; ops/crush_kernel.py).
         Returns [(pg, up, up_primary, acting, acting_primary)].
 
         engine="auto" never pays a cold jit compile; call
         warmup_placement() first (or pass engine="jax") to route large
         pools through the TPU descent."""
-        from ceph_tpu.ops.crush_kernel import batch_do_rule
+        from ceph_tpu.ops import crush_kernel
+        from ceph_tpu.common import devstats
         pool = self.pools[pool_id]
         pgs = self.pg_ids(pool_id)
         pps = [pool.raw_pg_to_pps(pg) for pg in pgs]
@@ -397,8 +463,13 @@ class OSDMap(Encodable):
                                       pool.size)
         if ruleno < 0:
             return [(pg, [], -1, [], -1) for pg in pgs]
-        raws = batch_do_rule(self.crush, ruleno, pps, pool.size,
-                             self.osd_weight, engine=engine)
+        if crush_kernel.compile_rule(self.crush, ruleno) is not None:
+            devstats.note_launch(
+                "crush_place",
+                (pool_id, ruleno, crush_kernel._pick_chunk(len(pps))))
+        raws = crush_kernel.batch_do_rule(
+            self.crush, ruleno, pps, pool.size, self.osd_weight,
+            engine=engine)
         return [(pg,) + self._finish_mapping(pool, pg, raw)
                 for pg, raw in zip(pgs, raws)]
 

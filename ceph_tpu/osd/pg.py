@@ -795,11 +795,25 @@ class PG:
                        if s.name != self.meta_oid.name)
         window = max(8, int(self.osd.cfg["osd_backfill_scan_max"]))
         after = ""
-        pulled = total = 0
+        pulled = total = misplaced = 0
+        my_pg = self.pgid.without_shard()
         while True:
             names, truncated = await self._fetch_list_window(
                 peer, epoch, after, window)
             total += len(names)
+            # backfill planning: map the whole listing window in ONE
+            # batched placement pass (OSDMap.map_objects_batch →
+            # prime_pgs → batch_do_rule) instead of a scalar descent
+            # per object.  Misplaced names (objects whose CURRENT map
+            # places them in another pg — locator-key writes hash
+            # independently of the name) are only counted: they still
+            # get pulled below, never dropped.
+            if names:
+                for _name, (pg, _act, _prim) in zip(
+                        names, self.osd.osdmap.map_objects_batch(
+                            self.pgid.pool, names)):
+                    if pg != my_pg:
+                        misplaced += 1
             # drop local objects inside this window's span the auth
             # peer doesn't have (peer-only objects must not survive);
             # `local` is sorted — bisect the span instead of rescanning
@@ -837,7 +851,9 @@ class PG:
         self.save_meta(txn)
         self.osd.store.apply_transaction(txn)
         self.log_.info(f"{self.pgid}: self-resync complete "
-                       f"({pulled}/{total} objects pulled)")
+                       f"({pulled}/{total} objects pulled"
+                       + (f", {misplaced} misplaced under current map"
+                          if misplaced else "") + ")")
 
     async def _fetch_list_window(self, peer: int, epoch: int,
                                  after: str, limit: int):

@@ -964,10 +964,10 @@ def test_device_report_fixture_inventory():
     from ceph_tpu.devtools.rules import FileInfo
     src = (
         "class Objecter:\n"
-        "    def _flush_cork(self, key):\n"
-        "        pend = self._cork.pop(key)\n"
-        "        # device-candidate:crush-placement one batched kernel\n"
-        "        # call per cork (CHUNK_SIZES-bucketed)\n"
+        "    def _flush_cork(self):\n"
+        "        pend, self._cork = self._cork, []\n"
+        "        # device-candidate:crush-placement@landed one batched\n"
+        "        # kernel call per cork (CHUNK_SIZES-bucketed)\n"
         "        self.messenger.send_message(pend)\n"
     )
     an = DeviceAnalysis([FileInfo("client/fixture.py", src)])
@@ -979,6 +979,8 @@ def test_device_report_fixture_inventory():
     assert site["fn"].endswith("_flush_cork")
     assert site["sync"] == "clean"
     assert site["retrace"] == "CHUNK_SIZES"
+    assert site["landed"] is True
+    assert rep["summary"]["landed_kernel_sites"] == 1
     assert rep["summary"]["unclassified_kernel_sites"] == 0
     assert json.loads(json.dumps(rep)) == rep
 
@@ -1271,6 +1273,10 @@ def test_cli_device_report_roundtrips_and_matches_committed():
     assert kinds["ec-dispatch"]["side"] == "executor"
     assert kinds["ec-dispatch"]["sync"] == "declared-region"
     assert kinds["ec-dispatch"]["transfer"] == "staged"
+    # ISSUE 16: the batched-placement PR consumed the work-list — every
+    # inventoried site is marked landed in-source
+    assert all(k["landed"] for k in kinds.values()), kinds
+    assert s["landed_kernel_sites"] == s["kernel_sites"]
     # every jit entry carries a cache kind; none are per-call
     for j in doc["jit_entries"]:
         assert j["cache"] in ("module", "builder-return",
@@ -1293,7 +1299,7 @@ def test_cli_device_report_roundtrips_and_matches_committed():
     def shape(d):
         return {
             "sites": sorted((s["rel"], s["kind"], s["side"], s["sync"],
-                             s["retrace"], s["transfer"])
+                             s["retrace"], s["transfer"], s["landed"])
                             for s in d["kernel_sites"]),
             "regions": sorted(r["rel"] for r in d["sync_regions"]),
             "jits": sorted((j["rel"], j["name"], j["cache"])

@@ -464,3 +464,129 @@ def test_device_kernel_compile_count_plateaus():
          f"{compiles}: a per-op retrace slipped into the kernel path")
     assert payload.counters()["msg_encode_calls"] == enc0, \
         "device-queue workload bumped the message codec"
+
+
+def test_objecter_cork_is_one_placement_kernel_launch():
+    """ISSUE 16 guard (batched CRUSH in the data path): ONE corked
+    Objecter flush computes placement for the whole burst in exactly
+    ONE batched placement-kernel launch (devstats "crush_place"), not
+    one scalar descent per op; steady-state bursts replay the same
+    launch signature (compile plateau), and map churn recompiles the
+    rule exactly once (guarded per-map compile cache)."""
+    from ceph_tpu.client.objecter import Objecter, _InFlight
+    from ceph_tpu.common import devstats
+    from ceph_tpu.common.context import Context
+    from ceph_tpu.crush.builder import (build_hierarchy,
+                                        make_replicated_rule)
+    from ceph_tpu.crush.types import CrushMap
+    from ceph_tpu.msg.types import EntityAddr
+    from ceph_tpu.osd.messages import (MOSDOp, MOSDOpBatch, OP_WRITEFULL,
+                                       OSDOp)
+    from ceph_tpu.osd.osdmap import Incremental, OSDMap
+    from ceph_tpu.osd.types import (OSD_IN_WEIGHT, ObjectLocator,
+                                    POOL_TYPE_REPLICATED, PGPool)
+
+    def build_map():
+        m = OSDMap()
+        m.fsid = "cork-fsid"
+        crush = CrushMap()
+        crush.max_devices = 8
+        build_hierarchy(crush, 8, 2)
+        rep = make_replicated_rule(crush, "replicated_rule")
+        m.crush = crush
+        m.set_max_osd(8)
+        inc = Incremental(1)
+        for o in range(8):
+            inc.new_up[o] = EntityAddr("127.0.0.1", 6800 + o, o + 1)
+            inc.new_weight[o] = OSD_IN_WEIGHT
+        m.apply_incremental(inc)
+        m.pools[1] = PGPool(POOL_TYPE_REPLICATED, size=3,
+                            crush_ruleset=rep, pg_num=32)
+        m.pool_names[1] = "rbd"
+        return m
+
+    class FakeMessenger:
+        nonce = 1
+
+        def __init__(self):
+            self.sent = []
+
+        def add_dispatcher(self, d):
+            pass
+
+        def send_message(self, msg, addr, peer_type=None):
+            self.sent.append(msg)
+
+    class FakeMonc:
+        def __init__(self, m):
+            self.osdmap = m
+
+        def on_osdmap(self, cb):
+            pass
+
+        def sub_want(self, *a, **k):
+            pass
+
+    async def run():
+        m = build_map()
+        msgr = FakeMessenger()
+        obj = Objecter(Context("client"), msgr, FakeMonc(m))
+        assert obj._batching
+        devstats.reset()
+        loop = asyncio.get_running_loop()
+
+        async def burst(tag, n=16):
+            before = len(msgr.sent)
+            for i in range(n):
+                obj._tid += 1
+                op = _InFlight(obj._tid, f"{tag}-{i:03d}",
+                               ObjectLocator(1),
+                               [OSDOp(OP_WRITEFULL, data=b"x")],
+                               loop.create_future())
+                obj._inflight[op.tid] = op
+                obj._send(op)
+            assert len(msgr.sent) == before, \
+                "corked ops must not ship before the flush"
+            await asyncio.sleep(0)      # run the call_soon flush
+            frames = msgr.sent[before:]
+            shipped = sum(len(f.msgs) if isinstance(f, MOSDOpBatch)
+                          else 1 for f in frames)
+            assert shipped == n, (shipped, n)
+            assert all(isinstance(f, (MOSDOp, MOSDOpBatch))
+                       for f in frames)
+            # grouped per target OSD: far fewer frames than ops
+            assert len(frames) <= 8 < n
+
+        def stats(domain):
+            c = devstats.counters()
+            return (c["launches"].get(domain, 0),
+                    c["compiles"].get(domain, 0))
+
+        await burst("a")
+        # ONE cork = ONE placement-kernel launch for all 16 ops, which
+        # cost exactly one guarded rule compile
+        assert stats("crush_place") == (1, 1), stats("crush_place")
+        assert stats("crush_compile")[1] == 1, stats("crush_compile")
+
+        # steady state: new names, same map — the acting cache and the
+        # repeated (pool, rule, chunk) launch signature keep the
+        # compile counts FLAT (any extra launch replays a seen sig)
+        await burst("b")
+        await burst("c")
+        assert stats("crush_place")[1] == 1, stats("crush_place")
+        assert stats("crush_compile")[1] == 1, stats("crush_compile")
+
+        # map churn: a NEW crush object recompiles the rule exactly
+        # once, and the next cork is again one launch (cache cleared)
+        inc = Incremental(m.epoch + 1)
+        inc.new_crush = CrushMap.from_bytes(m.crush.to_bytes())
+        m.apply_incremental(inc)
+        place_launches = stats("crush_place")[0]
+        await burst("d")
+        assert stats("crush_place") == (place_launches + 1, 1), \
+            stats("crush_place")
+        assert stats("crush_compile")[1] == 2, stats("crush_compile")
+        await burst("e")
+        assert stats("crush_compile")[1] == 2, stats("crush_compile")
+
+    asyncio.run(run())
