@@ -626,6 +626,145 @@ def stage_ec_e2e():
                 "stage_p50_p99_ms": stage_p,
                 "unattributed_frac": bd["unattributed_frac"]}
 
+    async def run_recovery(n_objs=96, throttle=None):
+        """Recovery axis (ISSUE 17/18, ec_e2e_recovery_rebuild_k2m2):
+        kill an OSD while clients keep reading and measure the
+        rebuild — recovery MB/s from the landing-side byte counter
+        (osd.recovery_bytes), plus the client-visible degraded-read
+        MB/s and p50/p99 DURING the rebuild window, with the per-stage
+        degraded-read breakdown.  The PR-10 recorded degraded-read
+        baseline is 14.6 MB/s (serial shard gather, host decode per
+        read); the concurrent gather + batched decode path is what
+        this axis judges.  `throttle` overlays recovery-throttle
+        config (osd_recovery_sleep / osd_recovery_max_active) so the
+        throttle-on and throttle-off arms run the same workload: the
+        graceful-degradation claim is that throttling the rebuild
+        buys back client tail latency."""
+        from ceph_tpu.crush.constants import CRUSH_ITEM_NONE
+        from ceph_tpu.msg import payload as payload_mod
+        from ceph_tpu.osd.pglog import LB_MAX
+        payload_mod.reset_counters()
+        base_f = ctx_factory("on", 4, True)
+
+        def rec_ctx(name):
+            c = base_f(name)
+            for k, v in (throttle or {}).items():
+                c.config.set(k, v)
+            return c
+
+        cl = Cluster(ctx_factory=rec_ctx)
+        admin = await cl.start(5)
+        await admin.pool_create("recpool", pg_num=4,
+                                pool_type="erasure", k=2, m=2)
+        io = admin.open_ioctx("recpool")
+        data = bytes(range(256)) * (OBJ_SIZE // 256)
+        sem = asyncio.Semaphore(CONC)
+
+        async def w(i):
+            async with sem:
+                await io.write_full(f"rc{i:05d}", data)
+
+        await asyncio.gather(*[w(i) for i in range(n_objs)])
+
+        def rec_bytes():
+            return sum(int(o.perf_osd.dump().get("recovery_bytes", 0))
+                       for o in cl.osds.values())
+
+        def recovered():
+            # rebuilt = every surviving pg re-peered AWAY from the
+            # victim with no placement holes, nothing missing, every
+            # backfill (primary bookkeeping included) run to
+            # completion, and a shard replica actually instantiated
+            # for every slot (pg_num x width PG objects).  The remap
+            # check keeps the pre-peering instant (old acting sets,
+            # trivially "clean") from reading as converged; the
+            # presence floor keeps the post-remap instant (new target
+            # has not created its replica yet, so no check can fail
+            # on it) from doing the same; the active-state gate keeps
+            # NEWBORN replicas (instantiated with last_backfill
+            # already at LB_MAX, not yet marked backfill targets by
+            # the primary's activation) from doing the same.
+            pgs = [pg for o in cl.osds.values()
+                   for pg in o.pgs.values()]
+            if len(pgs) < 4 * 4:       # pg_num x (k+m)
+                return False
+            for pg in pgs:
+                if pg.state != "active" \
+                        or victim in pg.acting \
+                        or CRUSH_ITEM_NONE in pg.acting \
+                        or pg.missing.items \
+                        or pg.info.last_backfill != LB_MAX \
+                        or pg._backfilling \
+                        or pg.peer_backfill_cursors:
+                    return False
+            return True
+
+        base_bytes = rec_bytes()
+        victim = max(cl.osds)
+        await cl.kill_osd(victim)
+        await admin.mon_command({"prefix": "osd down", "id": victim})
+        while admin.monc.osdmap.is_up(victim):
+            await asyncio.sleep(0.05)
+
+        # client reads race the rebuild until it converges
+        deg_lats = []
+        stop = asyncio.Event()
+
+        async def reader():
+            i = 0
+            while not stop.is_set():
+                async def r(j):
+                    async with sem:
+                        t0 = time.perf_counter()
+                        got = await io.read(f"rc{j:05d}")
+                        deg_lats.append(time.perf_counter() - t0)
+                        assert len(got) == OBJ_SIZE
+                await asyncio.gather(
+                    *[r((i + j) % n_objs) for j in range(CONC)])
+                i += CONC
+
+        rt = asyncio.get_running_loop().create_task(reader())
+        t0 = time.perf_counter()
+        while not recovered():
+            if time.perf_counter() - t0 > 180:
+                break
+            await asyncio.sleep(0.02)
+        rebuild_wall = time.perf_counter() - t0
+        moved = rec_bytes() - base_bytes
+        converged = recovered()
+        stop.set()
+        await rt
+        read_wall = time.perf_counter() - t0
+        # degraded-read breakdown: where client time went WHILE the
+        # rebuild competed for the same loops/stores (queue_wait vs
+        # device vs net), from the same tracer plane run_once uses
+        bd = cl.stage_breakdown(measured_e2e_s=sum(deg_lats))
+        deg_stage_p = {name: [d["p50_ms"], d["p99_ms"]]
+                       for name, d in bd["stages"].items()}
+        await cl.stop()
+        deg_reads = len(deg_lats)
+        deg_lats.sort()
+        wall = rebuild_wall or 1e-9
+        return {
+            "n_objs": n_objs, "iodepth": CONC,
+            "throttle": dict(throttle) if throttle else None,
+            "converged": converged,
+            "degraded_stage_p50_p99_ms": deg_stage_p,
+            "rebuild_s": round(rebuild_wall, 2),
+            "rebuild_mb_s": round(moved / wall / 1e6, 1),
+            "recovery_bytes": moved,
+            "degraded_reads": deg_reads,
+            "degraded_read_mb_s": round(
+                deg_reads * OBJ_SIZE / read_wall / 1e6, 1)
+            if deg_reads else 0.0,
+            "client_p50_ms": round(
+                deg_lats[deg_reads // 2] * 1e3, 2) if deg_reads else 0,
+            "client_p99_ms": round(
+                deg_lats[int(deg_reads * 0.99) - 1] * 1e3, 2)
+            if deg_reads else 0,
+            "baseline_degraded_mb_s": 14.6,
+        }
+
     on = asyncio.run(run_once("on"))
     log(f"ec_e2e batch=on:  {on}")
     off = asyncio.run(run_once("off"))
@@ -650,6 +789,27 @@ def stage_ec_e2e():
     log(f"ec_e2e shards=1 (legacy plane): {sh1}")
     reads = asyncio.run(run_reads())
     log(f"ec_e2e read axis: {reads}")
+    # recovery axis (ISSUE 17/18, ec_e2e_recovery_rebuild_k2m2):
+    # rebuild MB/s + client latency while the cluster is rebuilding a
+    # killed OSD under read load, throttle-off vs throttle-on — the
+    # osd_recovery_sleep/max_active knobs trade rebuild speed for
+    # client tail latency, and the axis records both sides of that
+    # trade in one run
+    recovery = None
+    recovery_throttled = None
+    if remaining() >= 90:
+        recovery = asyncio.run(run_recovery())
+        log(f"ec_e2e recovery axis (throttle off): {recovery}")
+    else:
+        log("ec_e2e recovery axis: skipped (budget)")
+    if remaining() >= 90:
+        recovery_throttled = asyncio.run(run_recovery(
+            throttle={"osd_recovery_max_active": 1,
+                      "osd_recovery_sleep": 0.002}))
+        log(f"ec_e2e recovery axis (throttle on): "
+            f"{recovery_throttled}")
+    else:
+        log("ec_e2e recovery throttle arm: skipped (budget)")
     # lane-backend axis (ISSUE 13, ec_e2e_rados_write_lanes_k2m2):
     # process vs thread vs inline shard lanes at shards=4, same run.
     # Client-side MB/s + p50/p99 are the comparable numbers on every
@@ -676,6 +836,10 @@ def stage_ec_e2e():
     return {"on": on, "off": off,
             "window_iodepth16": win16, "window_iodepth1": win1,
             "shards4": sh4, "shards1": sh1, "reads": reads,
+            "recovery": recovery,
+            "ec_e2e_recovery_rebuild_k2m2": {
+                "throttle_off": recovery,
+                "throttle_on": recovery_throttled},
             "ec_e2e_rados_write_lanes_k2m2": lane_axis}
 
 

@@ -257,17 +257,24 @@ class ObjectCacher:
                 await self._tx_done.wait()
 
     async def _flusher(self) -> None:
+        from ceph_tpu.common.backoff import Backoff
+        bo = Backoff("cache_writeback", base=0.25, cap=10.0)
         while True:
             try:
                 await asyncio.wait_for(self._flush_wake.wait(),
                                        self.max_dirty_age)
+            # lint: allow[RETRY19] timeout IS the flush trigger (dirty-age cadence)
             except asyncio.TimeoutError:
                 pass
             self._flush_wake.clear()
             try:
                 await self._flush_some(min_age=self.max_dirty_age)
+                bo.reset()
             except Exception:
-                await asyncio.sleep(0.5)   # backend down: retry later
+                # backend down: jittered exponential retry (shared
+                # policy — was a hardcoded 0.5s that hammered a
+                # recovering cluster in lockstep with every client)
+                await bo.sleep()
 
     # ------------------------------------------------------------ trimming
     def _trim(self) -> None:

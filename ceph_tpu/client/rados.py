@@ -105,8 +105,10 @@ class Rados:
         cmd.update(kw)
         await self.mon_command(cmd)
         # wait until the local map shows the pool
+        from ceph_tpu.common.backoff import Backoff
+        bo = Backoff("pool_create_wait", base=0.02, cap=0.5)
         while self.monc.osdmap.lookup_pool(name) < 0:
-            await asyncio.sleep(0.05)
+            await bo.sleep()
 
     async def pool_delete(self, name: str) -> None:
         await self.mon_command({"prefix": "osd pool delete", "pool": name})
@@ -243,7 +245,9 @@ class IoCtx:
         the osdmap subscription — unbounded, a stalled subscription
         (or a pool deleted mid-wait) would hang the caller forever
         (found by qa/rados_model seed 409 wedging a whole run)."""
-        deadline = asyncio.get_running_loop().time() + timeout
+        from ceph_tpu.common.backoff import Backoff, BackoffGiveUp
+        bo = Backoff("snap_propagate_wait", base=0.02, cap=0.5,
+                     timeout=timeout)
         while True:
             pool = self.rados.monc.osdmap.pools.get(self.pool_id)
             if pool is None:
@@ -251,11 +255,12 @@ class IoCtx:
                                            f"pool {self.pool_id}")
             if pred(pool):
                 return
-            if asyncio.get_running_loop().time() >= deadline:
+            try:
+                await bo.sleep()
+            except BackoffGiveUp:
                 raise asyncio.TimeoutError(
                     f"snap state never propagated for pool "
-                    f"{self.pool_name}")
-            await asyncio.sleep(0.05)
+                    f"{self.pool_name}") from None
 
     async def rollback(self, oid: str, snap_name: str) -> None:
         """Restore head from a pool snap (rados rollback)."""

@@ -302,6 +302,16 @@ DEFAULT_OPTIONS: List[Option] = [
            "the right choice on GIL-bound few-core hosts, where "
            "thread switches cost more than they parallelize"),
     Option("osd_recovery_max_active", "int", 3, "parallel recovery ops"),
+    Option("osd_recovery_sleep", "float", 0.0,
+           "pause between recovery windows, yielding the loop to "
+           "client ops (graceful-degradation knob; 0 = no pause)"),
+    Option("osd_recovery_push_timeout", "float", 20.0,
+           "overall monotonic budget awaiting one recovery push ack "
+           "before the cause-tagged give-up (common/backoff.py)"),
+    Option("osd_ack_timeout", "float", 20.0,
+           "overall monotonic budget awaiting replica acks / local "
+           "commit before the cause-tagged give-up fails the peer "
+           "set (was a hardcoded wait_for(fut, 20.0))"),
     Option("osd_max_object_size", "size", "128m", ""),
     Option("osd_client_message_size_cap", "size", "500m",
            "client op bytes in flight before intake blocks (Throttle)"),

@@ -93,8 +93,14 @@ QUEUE_WAIT_CAUSES = (
     "queue_wait_pump",  # PG worker busy with ops ahead in its queue
 )
 
-#: Auxiliary (non-chain) stages, for dump annotation.
-AUX_STAGES = ("op_total", "repl_apply", "repl_commit")
+#: Auxiliary (non-chain) stages, for dump annotation.  recovery_pull
+#: (one recovered object: gather -> decode -> push ack) and
+#: decode_rebuild (the decode slice alone, batched through the EC
+#: queue / mesh plane) overlap client chain stages — recovery runs
+#: CONCURRENTLY with the op path, so they must never join the chain
+#: sum.
+AUX_STAGES = ("op_total", "repl_apply", "repl_commit",
+              "recovery_pull", "decode_rebuild")
 
 STAGE_GROUP = "op_stages"
 

@@ -327,13 +327,25 @@ def device_report(paths: Optional[Iterable[str]] = None) -> dict:
 
 def lint_report(paths: Optional[Iterable[str]] = None,
                 rule: Optional[str] = None,
-                strict_waivers: bool = False) -> dict:
+                strict_waivers: bool = False,
+                restrict: Optional[set] = None) -> dict:
     """Full machine-readable report: the --json document.  Everything
-    in it is JSON-native (round-trips through json.dumps/loads)."""
+    in it is JSON-native (round-trips through json.dumps/loads).
+
+    `restrict` (a set of package-relative paths) reports only findings
+    anchored in those files while still ANALYZING the whole target
+    set: the interprocedural rules (seam/device tiling) are only
+    sound on the full call graph — a subset graph can't see the
+    callers that prove a function single-sided, so pre-commit
+    (--changed) runs would flag phantom cross-side escapes in
+    untouched architecture."""
     timings: Dict[str, float] = {}
     violations, errors, files = _collect(paths, rule, timings)
     waived = _waiver_counts(files)
     unused = _unused_waivers(files, rule)
+    if restrict is not None:
+        violations = [v for v in violations if v.rel in restrict]
+        unused = [e for e in unused if e["rel"] in restrict]
     if strict_waivers:
         for e in unused:
             violations.append(Violation(
@@ -374,10 +386,13 @@ def lint_report(paths: Optional[Iterable[str]] = None,
         "strict_waivers": bool(strict_waivers),
         "errors": list(errors),
     }
-    if rule is None and paths is None and files:
-        # whole-package runs only: a partial (explicit-path /
-        # --changed) lint must not emit a subset inventory under the
-        # same schema key a CI consumer might store as the work-list
+    if rule is None and paths is None and restrict is None and files:
+        # whole-package runs only: a partial (explicit-path) lint must
+        # not emit a subset inventory under the same schema key a CI
+        # consumer might store as the work-list, and a --changed run
+        # (whole-package analysis, filtered findings) skips the
+        # inventory blocks — pre-commit wants the verdict, not the
+        # work-list
         from ceph_tpu.devtools.seam import analyze
         doc["seam"] = analyze(files).report()
         from ceph_tpu.devtools.device import analyze as dev_analyze
@@ -409,9 +424,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="machine-readable output (schema-versioned; "
                          "exit code mirrors the 'exit' field)")
     ap.add_argument("--changed", action="store_true",
-                    help="lint only git-diff-touched package files "
-                         "(pre-commit mode; project rules still see "
-                         "the touched set only)")
+                    help="report only git-diff-touched package files "
+                         "(pre-commit mode; the interprocedural rules "
+                         "still analyze the whole package so partial "
+                         "call graphs can't manufacture phantom "
+                         "cross-side escapes)")
     ap.add_argument("--strict-waivers", action="store_true",
                     help="promote unused '# lint: allow[ID]' comments "
                          "from warnings to violations")
@@ -436,14 +453,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     paths = args.paths or None
+    restrict = None
     if args.changed and paths is None:
-        paths = changed_paths()
-        if not paths and not args.json and not args.seam_report \
+        changed = changed_paths()
+        if not changed and not args.json and not args.seam_report \
                 and not args.device_report:
             # --json consumers always get the schema document (an
             # empty-target one), never a bare text line
             print("lint --changed: no touched package files")
             return 0
+        if args.seam_report or args.device_report:
+            # report modes keep their subset semantics (marked
+            # partial) — they're inventories of the named files
+            paths = changed
+        elif changed:
+            # lint mode: analyze the WHOLE package (sound seam/device
+            # call graph), report only the touched files
+            restrict = set(changed)
+        else:
+            paths = changed    # empty: the no-targets schema document
 
     if args.seam_report:
         print(json.dumps(seam_report(paths), indent=1))
@@ -454,7 +482,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     report = lint_report(paths, rule=args.rule,
-                         strict_waivers=args.strict_waivers)
+                         strict_waivers=args.strict_waivers,
+                         restrict=restrict)
     if args.json:
         print(json.dumps(report, indent=1))
     else:

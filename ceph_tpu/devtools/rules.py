@@ -92,6 +92,24 @@ Each rule mechanically enforces one PR-landed write-path invariant
                            stage nothing ever cuts) silently un-names
                            part of the write path's attribution.
 
+  RETRY19 retry-backoff  — degraded-path retry discipline in osd/ and
+                           client/ modules: (a) an ``await
+                           asyncio.sleep(<numeric literal>)`` inside a
+                           ``while`` loop of an ``async def`` is a
+                           fixed-interval retry/poll — it must ride
+                           the shared policy (common/backoff.py: a
+                           ``Backoff(...)`` whose ``.sleep()`` /
+                           ``.wait_for()`` is awaited in the same
+                           loop) or carry a waiver; fixed intervals
+                           re-synchronize a storm of peers into
+                           thundering herds against whatever they are
+                           all waiting on.  (b) an ``except
+                           [asyncio.]TimeoutError:`` whose handler
+                           body is only ``pass`` swallows a timeout
+                           with no backoff, counter or give-up —
+                           waiver required (``asyncio.sleep(0)`` — a
+                           pure yield — is exempt).
+
 Waivers: a site that is allowed to break a rule for a documented reason
 carries ``# lint: allow[RULE] reason`` on the same line or the line
 directly above.  Waivers are counted and reported; an undocumented
@@ -1127,6 +1145,107 @@ def check_stage18(files: List["FileInfo"]) -> Iterator[Violation]:
                 f"rot the documented chain (remove it or cut it)")
 
 
+# ----------------------------------------------------------------- RETRY19
+
+_RETRY_PREFIXES = ("osd/", "client/")
+
+
+def _is_backoff_ctor(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    """``Backoff(...)`` / ``backoff.Backoff(...)`` under any import
+    alias — the shared-policy constructor (common/backoff.py)."""
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = _dotted(node.func, aliases)
+    if dotted and dotted.split(".")[-1] == "Backoff":
+        return True
+    return isinstance(node.func, ast.Name) and node.func.id == "Backoff"
+
+
+def _retry19_async_fn(fi: FileInfo, fn,
+                      out: List[Violation]) -> None:
+    # names bound to a shared-policy Backoff anywhere in this function
+    bonames: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and \
+                _is_backoff_ctor(node.value, fi.aliases):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    bonames.add(t.id)
+
+    def uses_policy(loop: ast.While) -> bool:
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Await) and \
+                    isinstance(node.value, ast.Call) and \
+                    isinstance(node.value.func, ast.Attribute) and \
+                    node.value.func.attr in ("sleep", "wait_for"):
+                base = node.value.func.value
+                if isinstance(base, ast.Name) and base.id in bonames:
+                    return True
+        return False
+
+    for loop in ast.walk(fn):
+        if not isinstance(loop, ast.While):
+            continue
+        backed = uses_policy(loop)
+        for node in ast.walk(loop):
+            if not (isinstance(node, ast.Await)
+                    and isinstance(node.value, ast.Call)
+                    and _dotted(node.value.func,
+                                fi.aliases) == "asyncio.sleep"):
+                continue
+            args = node.value.args
+            if not (args and isinstance(args[0], ast.Constant)
+                    and isinstance(args[0].value, (int, float))):
+                continue              # config-driven / computed delay
+            if args[0].value == 0:
+                continue              # pure yield-to-loop idiom
+            if backed or fi.waived("RETRY19", node.lineno):
+                continue
+            out.append(Violation(
+                "RETRY19", fi.rel, node.lineno,
+                f"fixed {args[0].value}s retry/poll interval in a "
+                f"while loop: degraded-path retries must use the "
+                f"shared jittered backoff (common/backoff.py "
+                f"Backoff.sleep/wait_for in the same loop) or carry "
+                f"a waiver"))
+
+
+def _retry19_handler_catches_timeout(handler: ast.ExceptHandler,
+                                     aliases: Dict[str, str]) -> bool:
+    t = handler.type
+    types = t.elts if isinstance(t, ast.Tuple) else ([t] if t else [])
+    for ty in types:
+        if isinstance(ty, ast.Name) and ty.id == "TimeoutError":
+            return True
+        if _dotted(ty, aliases) in ("asyncio.TimeoutError",
+                                    "concurrent.futures.TimeoutError"):
+            return True
+    return False
+
+
+def check_retry19(fi: FileInfo) -> Iterator[Violation]:
+    if not fi.rel.startswith(_RETRY_PREFIXES):
+        return
+    out: List[Violation] = []
+    for node in ast.walk(fi.tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            _retry19_async_fn(fi, node, out)
+        elif isinstance(node, ast.Try):
+            for h in node.handlers:
+                if _retry19_handler_catches_timeout(h, fi.aliases) \
+                        and len(h.body) == 1 \
+                        and isinstance(h.body[0], ast.Pass) \
+                        and not fi.waived("RETRY19", h.lineno):
+                    out.append(Violation(
+                        "RETRY19", fi.rel, h.lineno,
+                        "bare `except TimeoutError: pass` swallows a "
+                        "timeout with no backoff, give-up tag or "
+                        "counter — handle it through the shared "
+                        "policy (common/backoff.py) or waive with "
+                        "the reason the silence is safe"))
+    yield from out
+
+
 # --------------------------------------------------------------- registry
 
 RULES: Dict[str, Tuple[str, Callable[[FileInfo], Iterator[Violation]]]] = {
@@ -1141,6 +1260,8 @@ RULES: Dict[str, Tuple[str, Callable[[FileInfo], Iterator[Violation]]]] = {
                 check_epoch10),
     "SHARD11": ("PG state is touched only from its home shard",
                 check_shard11),
+    "RETRY19": ("op-path retry loops ride the shared jittered backoff",
+                check_retry19),
 }
 
 def _seam_rule(rule_id: str):

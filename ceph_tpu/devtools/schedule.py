@@ -193,17 +193,34 @@ class _VirtualSelector:
         self._inner = inner
         self._loop = loop
 
+    def _select(self, timeout):
+        """select() tolerating closed-but-registered fds: an osd kill
+        event closes sockets whose transports are still registered —
+        the epoll selector of a real loop silently drops closed fds,
+        but SelectSelector raises EBADF, so prune and retry."""
+        try:
+            return self._inner.select(timeout)
+        except OSError:
+            import os
+            for key in list(self._inner.get_map().values()):
+                try:
+                    os.fstat(key.fd)
+                except OSError:
+                    with contextlib.suppress(KeyError):
+                        self._inner.unregister(key.fileobj)
+            return self._inner.select(timeout)
+
     def select(self, timeout=None):
         loop = self._loop
         loop._close_cb_measure()
-        events = self._inner.select(0)
+        events = self._select(0)
         if events or timeout == 0:
             return events
         if loop._scheduled:
             loop._advance_to(loop._scheduled[0]._when)
             return events
         if timeout is None:
-            return self._inner.select(loop.idle_wait)
+            return self._select(loop.idle_wait)
         return events
 
     def __getattr__(self, name):
@@ -404,6 +421,85 @@ def watch_last_complete(findings: List[str]):
         PG.complete_to = orig
 
 
+@contextlib.contextmanager
+def watch_backfill_cursors(findings: List[str]):
+    """Class-level canaries for the per-object backfill cursor
+    invariants (the PR-17 recovery correctness contract):
+
+      * past its own durable ``last_backfill`` cursor a shard only
+        serves VERSIONED bytes (a coherent generation the primary's
+        cohort check can judge) and never answers ENOENT — a
+        versionless blob is the stale-half-copy corruption window, and
+        an ENOENT past the cursor is the backfill hole masquerading as
+        deletion (must be EAGAIN so the gather routes around it);
+      * a target's cursor is MONOTONE within an interval: an
+        ``apply_push`` may only advance it (an interval change may
+        legitimately reset it — peering owns that transition)."""
+    import errno as errno_mod
+
+    from ceph_tpu.osd import backend as backend_mod
+    from ceph_tpu.osd.backend import VERSION_XATTR
+    from ceph_tpu.osd.pglog import LB_MAX
+    orig_read = backend_mod.ECBackend._handle_ec_sub_read
+    orig_push = backend_mod.PGBackend.apply_push
+
+    def watched_read(self, m):
+        pg = self.pg
+        cursor = pg.info.last_backfill
+        oids = [r[0] for r in m.reads]
+        send = self.osd.send_osd
+
+        def checking_send(dst, reply, *a, **kw):
+            if cursor != LB_MAX and \
+                    getattr(reply, "tid", None) == m.tid:
+                past = [o for o in oids if o > cursor]
+                if past and getattr(reply, "result", 0) \
+                        == -errno_mod.ENOENT:
+                    findings.append(
+                        f"cursor hole served as ENOENT: "
+                        f"osd.{self.osd.whoami} {pg.pgid} answered "
+                        f"ENOENT for {past!r} past its last_backfill "
+                        f"{cursor!r} (must be EAGAIN)")
+                versioned = VERSION_XATTR in getattr(
+                    reply, "attrs", {})
+                for oid, blob in zip(oids, getattr(reply, "data", ())):
+                    if oid > cursor and blob and not versioned:
+                        findings.append(
+                            f"cursor read leak: osd.{self.osd.whoami} "
+                            f"{pg.pgid} served versionless {oid!r} "
+                            f"past its last_backfill {cursor!r}")
+            return send(dst, reply, *a, **kw)
+
+        # _handle_ec_sub_read is synchronous (no suspension point), so
+        # the instance-level shadow cannot interleave with another op
+        self.osd.send_osd = checking_send
+        try:
+            return orig_read(self, m)
+        finally:
+            self.osd.__dict__.pop("send_osd", None)
+
+    def watched_push(self, m, on_commit=None):
+        pg = self.pg
+        interval = pg.info.same_interval_since
+        before = pg.info.last_backfill
+        r = orig_push(self, m, on_commit=on_commit)
+        if pg.info.same_interval_since == interval \
+                and pg.info.last_backfill < before:
+            findings.append(
+                f"last_backfill regressed within interval {interval} "
+                f"on osd.{self.osd.whoami} {pg.pgid}: {before!r} -> "
+                f"{pg.info.last_backfill!r}")
+        return r
+
+    backend_mod.ECBackend._handle_ec_sub_read = watched_read
+    backend_mod.PGBackend.apply_push = watched_push
+    try:
+        yield
+    finally:
+        backend_mod.ECBackend._handle_ec_sub_read = orig_read
+        backend_mod.PGBackend.apply_push = orig_push
+
+
 # ------------------------------------------------------ invariant checks
 
 
@@ -463,6 +559,7 @@ class ScheduleReport:
     steps: int = 0
     findings: List[str] = field(default_factory=list)
     crash: Optional[Tuple[int, str, int]] = None
+    kill: Optional[Tuple[int, ...]] = None
     acked: int = 0
     unacked: int = 0
     trace_tail: List[str] = field(default_factory=list)
@@ -473,6 +570,7 @@ class ScheduleReport:
 
     def render(self) -> str:
         head = (f"seed={self.seed} crash={self.crash} "
+                f"kill={self.kill} "
                 f"steps={self.steps} hash={self.trace_hash[:16]} "
                 f"acked={self.acked} unacked={self.unacked}")
         if self.ok:
@@ -500,33 +598,61 @@ async def _quiesce(cl, timeout: float = 120.0) -> None:
         await asyncio.sleep(0.5)
 
 
-def _sim_ctx_factory(num_shards: int):
+def _sim_ctx_factory(num_shards: int,
+                     cfg: Optional[Dict] = None):
     """make_sim_ctx, optionally with the sharded data plane enabled:
     under the deterministic loop shard threads are forced off, so each
     shard's pump is an ordinary task the seeded scheduler permutes —
-    shard interleavings become explored schedules."""
+    shard interleavings become explored schedules.  ``cfg`` overlays
+    extra config (e.g. the recovery throttle knobs, so a schedule can
+    hold the backfill window open across many scheduling points)."""
     from ceph_tpu.qa.cluster import make_sim_ctx
-    if num_shards <= 1:
+    if num_shards <= 1 and not cfg:
         return make_sim_ctx
 
     def f(name):
         ctx = make_sim_ctx(name)
-        ctx.config.set("osd_op_num_shards", num_shards)
+        if num_shards > 1:
+            ctx.config.set("osd_op_num_shards", num_shards)
+        for k, v in (cfg or {}).items():
+            ctx.config.set(k, v)
         return ctx
     return f
+
+
+async def _wait_recovered(cl, findings: List[str],
+                          timeout: float = 120.0) -> None:
+    """Wait until every PG on every OSD has drained its missing set and
+    finished backfill (cursor back at LB_MAX) — the restarted OSD must
+    CONVERGE, not merely boot, before acked reads are re-verified."""
+    from ceph_tpu.osd.pglog import LB_MAX
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        lag = [(osd.whoami, str(pg.pgid))
+               for osd in cl.osds.values()
+               for pg in osd.pgs.values()
+               if pg.missing.items or pg.info.last_backfill != LB_MAX]
+        if not lag:
+            return
+        await asyncio.sleep(0.5)
+    findings.append(f"recovery did not converge after kill+restart: "
+                    f"still degraded on {lag}")
 
 
 async def _ec_mini_body(report: ScheduleReport, *,
                         n_objects: int, iodepth: int,
                         pool_type: str, k: int, m: int, n_osds: int,
                         crash: Optional[Tuple[int, str, int]],
+                        kill: Optional[Tuple[int, ...]] = None,
                         inject_probe: Optional[Callable] = None,
-                        num_shards: int = 1) -> None:
+                        num_shards: int = 1,
+                        cfg: Optional[Dict] = None) -> None:
     from ceph_tpu.msg import payload as payload_mod
     from ceph_tpu.qa.cluster import Cluster
     findings = report.findings
     encode_base = payload_mod.counters()["msg_encode_calls"]
-    cl = Cluster(ctx_factory=_sim_ctx_factory(num_shards))
+    cl = Cluster(ctx_factory=_sim_ctx_factory(num_shards, cfg))
     admin = await cl.start(n_osds)
     if pool_type == "erasure":
         await admin.pool_create("sim", pg_num=1, pool_type="erasure",
@@ -559,10 +685,104 @@ async def _ec_mini_body(report: ScheduleReport, *,
                 # is that the cluster never claimed durability for it
                 pass
 
-    await asyncio.gather(*(one(n, d) for n, d in blobs.items()),
-                         return_exceptions=True)
+    async def killer() -> None:
+        """Kill an OSD once `after_acks` writes have acked, let the
+        burst run degraded, then restart it.  A SURVIVING store
+        exercises log-based recovery (peer_missing pulls); a FRESH
+        store (``kill`` third element truthy) forces a full resync —
+        the per-object backfill-cursor window the canaries police.
+        The kill lands at a seed-permuted scheduling point (this is an
+        ordinary task the controller interleaves), so each seed
+        explores a different kill position relative to in-flight ops,
+        pushes and cursor advances."""
+        osd_id, after_acks = kill[0], kill[1]
+        fresh = bool(kill[2]) if len(kill) > 2 else False
+        while len(acked) < after_acks:
+            await asyncio.sleep(0.05)
+        store = await cl.kill_osd(osd_id)
+        await cl.mark_down_and_wait(admin, osd_id)
+        # degraded window: reads/writes must route around the hole
+        await asyncio.sleep(1.0)
+        osd = await cl.start_osd(osd_id,
+                                 store=None if fresh else store)
+        await osd.wait_for_boot()
+
+    async def degraded_reader(stop: asyncio.Event) -> None:
+        """Read acked objects THROUGH the degraded/backfill window —
+        the stream the backfill-cursor canaries police.  An acked
+        write reading back ENOENT mid-rebuild is the phantom-deletion
+        class the per-object cursor exists to prevent (a backfill hole
+        served as a data statement); transient routing errors and
+        starved schedules are not verdicts and are skipped.  The
+        cadence is recovery-aware: while any PG is visibly rebuilding
+        the reader stays in the READY set (sleep(0)) — under the
+        VIRTUAL clock a timer only fires when the loop idles, so a
+        timer-sleeping reader would never interleave with a busy
+        backfill — but every 16th pass (and whenever recovery is
+        quiet) it yields through a real timer so the virtual clock can
+        still advance for recovery's own backoff/timeout timers."""
+
+        def recovery_active() -> bool:
+            for osd in list(cl.osds.values()):
+                for p in list(getattr(osd, "pgs", {}).values()):
+                    if getattr(p, "_backfilling", None) \
+                            or p.missing.items \
+                            or any(pm.items for pm in
+                                   p.peer_missing.values()):
+                        return True
+            return False
+
+        import errno as errno_mod
+
+        from ceph_tpu.client.objecter import ObjectOperationError
+        passes = 0
+        while not stop.is_set():
+            passes += 1
+            for name in sorted(acked):
+                if stop.is_set():
+                    return
+                data = acked[name]
+                try:
+                    got = await asyncio.wait_for(io.read(name), 20.0)
+                except ObjectOperationError as e:
+                    if e.retcode == -errno_mod.ENOENT:
+                        findings.append(
+                            f"acked write {name!r} read ENOENT during "
+                            f"the degraded window (backfill hole "
+                            f"served as deletion)")
+                    continue
+                except (Exception, asyncio.CancelledError):
+                    continue
+                if got != data:
+                    findings.append(
+                        f"acked write {name!r} corrupt during the "
+                        f"degraded window: {len(got)} bytes != "
+                        f"{len(data)}")
+            if recovery_active() and passes % 16:
+                await asyncio.sleep(0)
+            else:
+                await asyncio.sleep(0.02)
+
+    burst = [one(n, d) for n, d in blobs.items()]
+    reader_task = None
+    if kill is not None:
+        burst.append(killer())
+        stop_reader = asyncio.Event()
+        reader_task = asyncio.ensure_future(
+            degraded_reader(stop_reader))
+    await asyncio.gather(*burst, return_exceptions=True)
+    if reader_task is not None:
+        stop_reader.set()
+        try:
+            await asyncio.wait_for(reader_task, 30.0)
+        except (Exception, asyncio.CancelledError):
+            reader_task.cancel()
     report.acked = len(acked)
     report.unacked = len(blobs) - len(acked)
+    if kill is not None:
+        # acked-write retention across kill+rebuild only holds once the
+        # restarted target has caught back up
+        await _wait_recovered(cl, findings)
     await _quiesce(cl)
     # no phantom acks: every ACKED write must read back intact, even
     # after a commit-thread crash somewhere in the acting set
@@ -599,25 +819,33 @@ def run_ec_mini(seed: int = 0, *,
                 pool_type: str = "erasure", k: int = 2, m: int = 2,
                 n_osds: int = 4,
                 crash: Optional[Tuple[int, str, int]] = None,
+                kill: Optional[Tuple[int, ...]] = None,
                 inject_probe: Optional[Callable] = None,
-                num_shards: int = 1
+                num_shards: int = 1,
+                cfg: Optional[Dict] = None
                 ) -> ScheduleReport:
     """One schedule of the ec_e2e mini-workload under the deterministic
     loop: boot a FAST_CFG sim cluster, burst writes through the per-PG
     window, quiesce, check every machine-checked invariant, tear down.
     ``crash`` = (osd_id, injection_point, occurrence) arms the PR-1
-    commit-thread fault hook on that OSD's store.  ``num_shards`` > 1
-    runs the sharded data plane (osd/shards.py) with its shard pumps
-    driven — and permuted — by this seeded scheduler."""
-    report = ScheduleReport(seed=seed, crash=crash)
+    commit-thread fault hook on that OSD's store.  ``kill`` =
+    (osd_id, after_acks) kills that OSD mid-burst at a seed-permuted
+    point and restarts it with its surviving store — the backfill
+    cursor canaries (watch_backfill_cursors) then police the degraded
+    window and the resume.  ``num_shards`` > 1 runs the sharded data
+    plane (osd/shards.py) with its shard pumps driven — and permuted —
+    by this seeded scheduler."""
+    report = ScheduleReport(seed=seed, crash=crash, kill=kill)
 
     async def main():
         with commit_observation() as obs, \
-                watch_last_complete(report.findings):
+                watch_last_complete(report.findings), \
+                watch_backfill_cursors(report.findings):
             await _ec_mini_body(
                 report, n_objects=n_objects, iodepth=iodepth,
                 pool_type=pool_type, k=k, m=m, n_osds=n_osds,
-                crash=crash, inject_probe=inject_probe,
+                crash=crash, kill=kill, inject_probe=inject_probe,
+                cfg=cfg,
                 num_shards=num_shards)
             report.findings.extend(obs.findings)
 
@@ -641,12 +869,14 @@ def run_ec_mini(seed: int = 0, *,
 class ExploreReport:
     schedules: List[ScheduleReport] = field(default_factory=list)
     crash_runs: List[ScheduleReport] = field(default_factory=list)
+    kill_runs: List[ScheduleReport] = field(default_factory=list)
     crash_points: List[Tuple[int, str, int]] = field(
         default_factory=list)
 
     @property
     def failures(self) -> List[ScheduleReport]:
-        return [r for r in self.schedules + self.crash_runs
+        return [r for r in
+                self.schedules + self.crash_runs + self.kill_runs
                 if not r.ok]
 
     def render_failures(self) -> str:
@@ -695,12 +925,17 @@ def enumerate_crash_points(crash_osd: int = 0,
 
 def explore(n_schedules: int = 8, *, seeds: Optional[Sequence[int]] = None,
             crash_osd: int = 0, max_crash_occurrences: int = 4,
-            with_crashes: bool = True, **workload_kw) -> ExploreReport:
+            with_crashes: bool = True,
+            with_kills: bool = False, kill_osd: int = 1,
+            kill_seeds: Optional[Sequence[int]] = None,
+            **workload_kw) -> ExploreReport:
     """Bounded exploration: N seeded schedules of the mini-workload,
     plus every enumerated commit-thread crash point under the FIFO
-    schedule.  Every report is replayable from its seed.  The
-    controllers are owned here (RandomScheduler per seed; FIFO for the
-    crash phase) — pass seeds to vary coverage, not a controller."""
+    schedule, plus (``with_kills``) osd kill+restart events landing at
+    seed-permuted points under the backfill-cursor canaries.  Every
+    report is replayable from its seed.  The controllers are owned
+    here (RandomScheduler per seed; FIFO for the crash phase) — pass
+    seeds to vary coverage, not a controller."""
     if "controller" in workload_kw:
         raise ValueError("explore() owns the schedule controllers "
                          "(RandomScheduler per seed, FIFO for crash "
@@ -722,4 +957,21 @@ def explore(n_schedules: int = 8, *, seeds: Optional[Sequence[int]] = None,
             out.crash_runs.append(
                 run_ec_mini(seed=0, controller=ScheduleController(),
                             crash=cp, **workload_kw))
+    if with_kills:
+        n_objects = workload_kw.get("n_objects", 6)
+        # two kill flavors per seed: an early kill restarted with a
+        # FRESH store (full resync — the backfill-cursor window under
+        # maximum racing writes) and a late kill restarted with its
+        # SURVIVING store (log-based recovery races the burst tail) —
+        # the seed then permutes WHERE inside that window the kill
+        # actually lands
+        for seed in (kill_seeds if kill_seeds is not None
+                     else (seeds if seeds is not None
+                           else range(n_schedules))):
+            for after_acks, fresh in ((1, True),
+                                      (max(2, n_objects // 2), False)):
+                out.kill_runs.append(
+                    run_ec_mini(seed=seed,
+                                kill=(kill_osd, after_acks, fresh),
+                                **workload_kw))
     return out

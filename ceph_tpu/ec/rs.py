@@ -146,16 +146,20 @@ class _MatrixCodec(ErasureCode):
             return self._bitengine.encode(data_chunks)
         return self._apply(self.generator[self._k:], data_chunks)
 
-    def decode_chunks(self, want: Sequence[int],
-                      chunks: Dict[int, np.ndarray]) -> Dict[int, np.ndarray]:
-        if self._bitengine is not None:
-            return self._bitengine.decode(list(want), chunks)
-        present = sorted(chunks)[:self._k]
+    def decode_matrix_for(self, present: Sequence[int],
+                          want: Sequence[int]) -> np.ndarray:
+        """The cached [len(want), k] decode matrix reconstructing `want`
+        chunk ids from the first k `present` ids — the rows a batching
+        dispatcher (osd/ec_queue.py, parallel/mesh_exec.py) applies
+        itself so concurrent degraded reads / rebuild decodes sharing a
+        survivor set fold into one device launch.  Raises
+        ErasureCodeError when no such matrix exists (non-MDS want)."""
         key = (tuple(present), tuple(want))
         mat = self._decode_cache.get(key)
         if mat is None:
             try:
-                mat = gf256.decode_matrix(self.generator, present, want)
+                mat = gf256.decode_matrix(self.generator, list(present),
+                                          list(want))
             except ValueError as e:
                 raise ErasureCodeError(f"cannot decode {list(want)}: {e}")
             self._decode_cache[key] = mat
@@ -163,6 +167,14 @@ class _MatrixCodec(ErasureCode):
                 self._decode_cache.popitem(last=False)
         else:
             self._decode_cache.move_to_end(key)
+        return mat
+
+    def decode_chunks(self, want: Sequence[int],
+                      chunks: Dict[int, np.ndarray]) -> Dict[int, np.ndarray]:
+        if self._bitengine is not None:
+            return self._bitengine.decode(list(want), chunks)
+        present = sorted(chunks)[:self._k]
+        mat = self.decode_matrix_for(present, want)
         src = np.stack([np.asarray(chunks[i], np.uint8) for i in present])
         out = self._apply(mat, src)
         return {w: out[i] for i, w in enumerate(want)}

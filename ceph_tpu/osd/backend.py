@@ -117,11 +117,22 @@ class PGBackend:
             if not fut.done():
                 fut.set_result(True)
 
-    async def _await_acks(self, fut: asyncio.Future, timeout=20.0) -> bool:
+    async def _await_acks(self, fut: asyncio.Future,
+                          timeout: Optional[float] = None) -> bool:
+        """Await replica acks under the shared backoff policy: the
+        budget comes from config (osd_recovery_push_timeout class of
+        knobs), the give-up is cause-tagged and counted
+        (osd.recovery backoff census) instead of a silent magic-20s
+        wait_for."""
+        from ceph_tpu.common.backoff import Backoff, BackoffGiveUp
+        bo = Backoff("repl_ack",
+                     timeout=timeout if timeout is not None
+                     else float(self.osd.cfg["osd_ack_timeout"]),
+                     perf=getattr(self.osd, "perf_recovery", None))
         try:
-            await asyncio.wait_for(fut, timeout)
+            await bo.wait_for(fut)
             return True
-        except (asyncio.TimeoutError, PGIntervalChanged):
+        except (BackoffGiveUp, PGIntervalChanged):
             return False
 
     def _repl_trace(self, m) -> "Optional[_ReplTrace]":
@@ -150,11 +161,16 @@ class PGBackend:
         return fut
 
     async def _await_commit(self, fut: asyncio.Future,
-                            timeout=20.0) -> bool:
+                            timeout: Optional[float] = None) -> bool:
+        from ceph_tpu.common.backoff import Backoff, BackoffGiveUp
+        bo = Backoff("local_commit",
+                     timeout=timeout if timeout is not None
+                     else float(self.osd.cfg["osd_ack_timeout"]),
+                     perf=getattr(self.osd, "perf_recovery", None))
         try:
-            await asyncio.wait_for(fut, timeout)
+            await bo.wait_for(fut)
             return True
-        except asyncio.TimeoutError:
+        except BackoffGiveUp:
             return False
 
     def apply_push(self, m: MPGPush, on_commit=None) -> bool:
@@ -230,6 +246,20 @@ class PGBackend:
                 m.backfill_progress > pg.info.last_backfill:
             pg.info.last_backfill = m.backfill_progress
         pg.save_meta(txn)
+        # recovery accounting at the LANDING site: one inc per payload
+        # installed on this (target) OSD whichever path carried it —
+        # primary push, backfill window, or pull-requested push.  The
+        # pusher does not count; a push serves exactly one landing.
+        if not m.deleted:
+            nbytes = len(m.data or b"") \
+                + sum(len(cd) for _, cd, _ in m.clones)
+            perf = getattr(self.osd, "perf_osd", None)
+            if perf is not None:
+                perf.inc("recovery_bytes", nbytes)
+            rec = getattr(self.osd, "perf_recovery", None)
+            if rec is not None:
+                rec.inc("objects_pulled")
+                rec.inc("pull_bytes", nbytes)
         # the push ack (on_commit) rides the commit callback: the
         # pusher's cursor advance must vouch for DURABLE state
         self.osd.store.queue_transactions([txn], on_commit=on_commit)
@@ -273,15 +303,27 @@ class PGBackend:
                         pass    # trimmed under us: receiver trims too
         msg.backfill_progress = progress
         self.osd.send_osd(peer, msg)
+        return len(msg.data or b"") \
+            + sum(len(c[1]) for c in msg.clones)
 
     async def _push_and_wait(self, peer: int, oid: str,
                              progress: str = "") -> None:
+        from ceph_tpu.common.backoff import Backoff
+        bo = Backoff("push_ack", perf=getattr(self.osd,
+                                              "perf_recovery", None),
+                     timeout=float(
+                         self.osd.cfg["osd_recovery_push_timeout"]))
         fut = asyncio.get_running_loop().create_future()
         self.pg._push_acks[(peer, oid)] = fut
         try:
-            self.push_object(peer, oid, self.pg.info.last_update,
-                             progress)
-            await asyncio.wait_for(fut, 20.0)
+            nbytes = self.push_object(peer, oid,
+                                      self.pg.info.last_update,
+                                      progress)
+            await bo.wait_for(fut)
+            perf = getattr(self.osd, "perf_recovery", None)
+            if perf is not None:
+                perf.inc("objects_pushed")
+                perf.inc("push_bytes", nbytes)
         finally:
             self.pg._push_acks.pop((peer, oid), None)
 
@@ -311,6 +353,60 @@ class PGBackend:
                              exclude=frozenset(),
                              progress: str = "") -> None:
         await self._push_and_wait(peer, oid, progress)
+
+    async def recover_objects(self, peer: int, oids: List[str],
+                              progress: str = ""
+                              ) -> Tuple[List[str],
+                                         Optional[BaseException]]:
+        """Recover a sorted window of objects to `peer` CONCURRENTLY,
+        bounded by the OSD-wide recovery budget (reservation-style cap
+        on in-flight pushes, osd_recovery_max_active) so a rebuild
+        storm cannot starve client ops of store/messenger time.  All
+        pushes stamp the same `progress` floor — cursor ordering is
+        the caller's (PG._recover) job.  Returns (oids that landed,
+        first failure or None); the caller retries the failures."""
+        budget = self.osd.recovery_budget() \
+            if hasattr(self.osd, "recovery_budget") else None
+        tr = self.osd.ctx.tracer
+        rec = getattr(self.osd, "perf_recovery", None)
+        tracker = getattr(self.osd, "op_tracker", None)
+
+        async def one(oid: str) -> None:
+            if budget is not None:
+                await budget.acquire()
+            # recovery rides the SAME slow-op machinery as client ops:
+            # a push stalled behind a flapping target complains once
+            # and lands its stage record in the flight recorder
+            top = tracker.create(
+                f"recovery_push({self.pg.pgid} {oid} -> "
+                f"osd.{peer})") if tracker is not None else None
+            if rec is not None:
+                rec.inc("active_pulls")
+            try:
+                t0 = time.monotonic()
+                await self.recover_object(peer, oid, progress=progress)
+                if tr.enabled:
+                    # aux stage: overlaps the client chain (recovery
+                    # runs concurrently with ops), never summed into it
+                    tr.hist.hinc("recovery_pull",
+                                 time.monotonic() - t0)
+            finally:
+                if rec is not None:
+                    rec.inc("active_pulls", -1)
+                if top is not None:
+                    tracker.finish(top)
+                if budget is not None:
+                    budget.release()
+
+        res = await asyncio.gather(*(one(o) for o in oids),
+                                   return_exceptions=True)
+        done = [o for o, r in zip(oids, res)
+                if not isinstance(r, BaseException)]
+        err = next((r for r in res if isinstance(r, BaseException)),
+                   None)
+        if isinstance(err, asyncio.CancelledError):
+            raise err
+        return done, err
 
     async def pull_object(self, peer: int, oid: str, epoch: int,
                           exclude=frozenset()) -> None:
@@ -755,6 +851,69 @@ class ECBackend(PGBackend):
                     for i in range(self.n - self.k)})
         return out
 
+    async def _decode_shards(self, want, streams: Dict[int, np.ndarray]
+                             ) -> Dict[int, np.ndarray]:
+        """Reconstruct `want` chunk ids from gathered shard streams —
+        the decode twin of _encode_object.  Concurrent degraded reads
+        and rebuild decodes sharing a survivor set fold into single
+        device launches via the cross-PG batch collector (the queue
+        groups by matrix bytes); mesh mode runs the pjit recover
+        program (parallel/mesh_exec.py) instead.  Host codec when the
+        codec has no plain generator (bitmatrix/lrc layering)."""
+        want = sorted(set(want))
+        out = {i: np.asarray(streams[i], np.uint8)
+               for i in want if i in streams}
+        missing = [w for w in want if w not in streams]
+        if not missing:
+            return out
+        present = sorted(streams)[:self.k]
+        if len(present) < self.k:
+            # not enough survivors gathered — fail cleanly instead of
+            # letting the matrix build crash on an empty submatrix
+            raise ValueError(
+                f"need {self.k} shards to decode, have {len(present)}")
+        lens = {len(streams[i]) for i in present}
+        if len(lens) != 1:
+            # mixed generations slipped past the cohort check:
+            # undecodable, same contract as the host codec path
+            raise ValueError(f"mixed chunk lengths {sorted(lens)}")
+        gen = getattr(self.codec, "generator", None)
+        mat_for = getattr(self.codec, "decode_matrix_for", None)
+        t0 = time.monotonic()
+        ex = getattr(self.osd, "mesh_exec", None)
+        if ex is not None and gen is not None:
+            try:
+                rec = await ex.recover_chunks(self.codec, missing,
+                                              streams)
+                out.update(rec)
+                self._note_decode(t0)
+                return out
+            except Exception as e:
+                self.log_.warning(f"mesh decode failed ({e}); "
+                                  f"falling back to batch queue")
+        q = self.osd.ec_batch_queue() \
+            if hasattr(self.osd, "ec_batch_queue") \
+            else getattr(self.osd, "ec_queue", None)
+        if gen is None or mat_for is None or q is None:
+            out.update(self.codec.decode_chunks(missing, streams))
+            self._note_decode(t0)
+            return out
+        mat = mat_for(present, missing)
+        src = np.stack([np.asarray(streams[i], np.uint8)
+                        for i in present])
+        # device-candidate:ec-decode@landed the live degraded-read/rebuild
+        # decode call site: awaits the cross-PG collector
+        # (LANE_BUCKETS-bucketed, executor dispatch) like encodes do
+        dec = await q.apply(mat, src)
+        out.update({w: dec[j] for j, w in enumerate(missing)})
+        self._note_decode(t0)
+        return out
+
+    def _note_decode(self, t0: float) -> None:
+        tr = self.osd.ctx.tracer
+        if tr.enabled:
+            tr.hist.hinc("decode_rebuild", time.monotonic() - t0)
+
     @property
     def my_shard(self) -> int:
         return self.pg.pgid.shard
@@ -1180,10 +1339,22 @@ class ECBackend(PGBackend):
             if osd_id == CRUSH_ITEM_NONE or i in exclude:
                 continue
             if i == my:
+                from ceph_tpu.osd.pglog import LB_MAX
                 try:
+                    my_attrs = self.osd.store.getattrs(pg.cid, soid)
+                    if pg.info.last_backfill != LB_MAX \
+                            and oid > pg.info.last_backfill \
+                            and VERSION_XATTR not in my_attrs:
+                        # OUR OWN copy is mid-backfill, this name is
+                        # past the durable cursor AND versionless: an
+                        # untrusted half-copy — the same read gate
+                        # _handle_ec_sub_read applies for peers
+                        # (PG.h:1911).  A versioned row still joins
+                        # the gather; the cohort check judges it.
+                        continue
                     streams[i] = np.frombuffer(
                         self.osd.store.read(pg.cid, soid), np.uint8)
-                    attrs = self.osd.store.getattrs(pg.cid, soid)
+                    attrs = my_attrs
                     shard_attrs[i] = attrs
                     shard_vers[i] = attrs.get(VERSION_XATTR, b"")
                 except (NoSuchObject, NoSuchCollection):
@@ -1191,9 +1362,8 @@ class ECBackend(PGBackend):
             else:
                 candidates.append(i)
         need = self.k - len(streams)
-        for i in candidates:
-            if need <= 0:
-                break
+
+        async def ask_shard(i: int):
             osd_id = pg.acting[i]
             tid = self.osd.next_tid()
             fut = asyncio.get_running_loop().create_future()
@@ -1203,21 +1373,40 @@ class ECBackend(PGBackend):
             try:
                 reply: MOSDECSubOpReadReply = \
                     await asyncio.wait_for(fut, 15.0)
-            except asyncio.TimeoutError:
-                self._inflight.pop(tid, None)
-                continue
-            except PGIntervalChanged:
-                # don't degrade the gather to EIO — abort the whole op
-                # so the caller retries under the new acting set
+            except (asyncio.TimeoutError, PGIntervalChanged):
                 self._inflight.pop(tid, None)
                 raise
-            if reply.result == 0 and reply.data:
-                streams[i] = np.frombuffer(reply.data[0], np.uint8)
-                if reply.attrs:
-                    attrs = reply.attrs
-                    shard_attrs[i] = reply.attrs
-                    shard_vers[i] = reply.attrs.get(VERSION_XATTR, b"")
-                need -= 1
+            return i, reply
+
+        # fan out to exactly `need` candidates CONCURRENTLY — a
+        # degraded k-shard read is one RTT, not k sequential ones —
+        # topping up from the remaining candidates (preference order
+        # preserved) as refusals and timeouts come back
+        pending = list(candidates)
+        while need > 0 and pending:
+            wave, pending = pending[:need], pending[need:]
+            replies = await asyncio.gather(
+                *[ask_shard(i) for i in wave], return_exceptions=True)
+            interval_err = None
+            for r in replies:
+                if isinstance(r, PGIntervalChanged):
+                    # don't degrade the gather to EIO — abort the whole
+                    # op so the caller retries under the new acting set
+                    interval_err = r
+                    continue
+                if isinstance(r, BaseException):
+                    continue
+                i, reply = r
+                if reply.result == 0 and reply.data:
+                    streams[i] = np.frombuffer(reply.data[0], np.uint8)
+                    if reply.attrs:
+                        attrs = reply.attrs
+                        shard_attrs[i] = reply.attrs
+                        shard_vers[i] = reply.attrs.get(
+                            VERSION_XATTR, b"")
+                    need -= 1
+            if interval_err is not None:
+                raise interval_err
         if len(streams) < self.k:
             return None
         lens = {len(s) for s in streams.values()}
@@ -1236,27 +1425,25 @@ class ECBackend(PGBackend):
             # reconstructs garbage SILENTLY — so the cohort must also
             # agree on VERSION_XATTR.  Pull every remaining candidate
             # and decode from the best consistent cohort.
-            for i in candidates:
-                if i in streams:
+            rest = [i for i in candidates if i not in streams]
+            replies = await asyncio.gather(
+                *[ask_shard(i) for i in rest], return_exceptions=True)
+            interval_err = None
+            for r in replies:
+                if isinstance(r, PGIntervalChanged):
+                    interval_err = r
                     continue
-                osd_id = pg.acting[i]
-                tid = self.osd.next_tid()
-                fut = asyncio.get_running_loop().create_future()
-                self._inflight[tid] = ({osd_id}, fut)
-                self.osd.send_osd(osd_id, MOSDECSubOpRead(
-                    pg.pgid.with_shard(i), tid, [(oid, 0, -1)],
-                    snap=snap))
-                try:
-                    reply = await asyncio.wait_for(fut, 15.0)
-                except asyncio.TimeoutError:
-                    self._inflight.pop(tid, None)
+                if isinstance(r, BaseException):
                     continue
+                i, reply = r
                 if reply.result == 0 and reply.data:
                     streams[i] = np.frombuffer(reply.data[0], np.uint8)
                     if reply.attrs:
                         shard_attrs[i] = reply.attrs
                         shard_vers[i] = reply.attrs.get(VERSION_XATTR,
                                                         b"")
+            if interval_err is not None:
+                raise interval_err
             cohorts: Dict[tuple, Dict[int, np.ndarray]] = {}
             for i, s in streams.items():
                 cohorts.setdefault(
@@ -1297,9 +1484,11 @@ class ECBackend(PGBackend):
         # wait_for_degraded_object) instead of failing the read — an
         # EIO here reads as data loss to the client during windows
         # that heal themselves in under a second
+        from ceph_tpu.common.backoff import Backoff, BackoffGiveUp
         pg = self.pg
         epoch = pg.interval_epoch
-        deadline = asyncio.get_running_loop().time() + 8.0
+        bo = Backoff("degraded_read", base=0.05, cap=0.5, timeout=8.0,
+                     perf=getattr(self.osd, "perf_recovery", None))
         while True:
             got = await self._gather_shards(
                 oid, snap=snap,
@@ -1309,17 +1498,19 @@ class ECBackend(PGBackend):
             if epoch != pg.interval_epoch:
                 raise PGIntervalChanged(
                     f"pg {pg.pgid} interval changed during read")
-            if asyncio.get_running_loop().time() >= deadline:
-                return None
-            await asyncio.sleep(0.2)
+            try:
+                await bo.sleep()
+            except BackoffGiveUp:
+                return None    # caller maps to EIO after the budget
         streams, gattrs = got
         from ceph_tpu.ec.interface import ErasureCodeError
         try:
-            # device-candidate:ec-decode@landed degraded-read rebuild runs the
-            # host codec inline today; route it through the ec_queue
-            # collector (LANE_BUCKETS-bucketed) so recovery-window
-            # reads batch their decodes like writes batch encodes
-            data = self.codec.decode_concat(streams)
+            # degraded-read rebuild: decode through the cross-PG batch
+            # collector, so concurrent recovery-window reads fold
+            # their decodes into single launches like writes do
+            decoded = await self._decode_shards(range(self.k), streams)
+            data = b"".join(np.asarray(decoded[i]).tobytes()
+                            for i in range(self.k))
         except (ErasureCodeError, ValueError):
             # ValueError: mixed-generation chunk lengths — undecodable
             return None
@@ -1341,13 +1532,26 @@ class ECBackend(PGBackend):
     async def _send_push_and_wait(self, peer: int, oid: str,
                                   msg: MPGPush) -> None:
         """Send a prebuilt push and await its ack (one copy of the
-        future-register/timeout/cleanup plumbing)."""
+        future-register/timeout/cleanup plumbing).  The wait budget is
+        the shared backoff policy's (osd_recovery_push_timeout), so a
+        dead target surfaces as a cause-tagged counted give-up."""
+        from ceph_tpu.common.backoff import Backoff
         pg = self.pg
+        bo = Backoff("push_ack", perf=getattr(self.osd,
+                                              "perf_recovery", None),
+                     timeout=float(
+                         self.osd.cfg["osd_recovery_push_timeout"]))
         fut = asyncio.get_running_loop().create_future()
         pg._push_acks[(peer, oid)] = fut
         try:
             self.osd.send_osd(peer, msg)
-            await asyncio.wait_for(fut, 20.0)
+            await bo.wait_for(fut)
+            perf = getattr(self.osd, "perf_recovery", None)
+            if perf is not None:
+                perf.inc("objects_pushed")
+                perf.inc("push_bytes",
+                         len(msg.data or b"")
+                         + sum(len(c[1]) for c in msg.clones))
         finally:
             pg._push_acks.pop((peer, oid), None)
 
@@ -1381,8 +1585,8 @@ class ECBackend(PGBackend):
             if cgot is None:
                 return None, []    # incomplete: claim nothing
             cstreams, cattrs = cgot
-            crebuilt = self.codec.decode(
-                {target}, cstreams)[target].tobytes()
+            crebuilt = (await self._decode_shards(
+                [target], cstreams))[target].tobytes()
             # keep the clone's xattrs (SIZE_XATTR drives snap reads);
             # only the per-shard digest is its own
             cattrs = dict(cattrs)
@@ -1423,11 +1627,12 @@ class ECBackend(PGBackend):
             raise RuntimeError(f"{pg.pgid}: cannot reconstruct {oid} "
                                f"for shard {target}: insufficient shards")
         streams, _ = got
-        # device-candidate:decode-rebuild@landed recovery rebuild decodes one
-        # object at a time on the host codec; whole-PG rebuild is one
-        # embarrassingly parallel decode (LANE_BUCKETS-bucketed fold,
-        # or the pjit mesh path parallel/mesh_exec.py proves)
-        rebuilt = self.codec.decode({target}, streams)[target]
+        # device-candidate:decode-rebuild@landed whole-PG rebuild decodes
+        # through the batch collector: _recover feeds windows of
+        # objects concurrently, so their decodes fold into single
+        # LANE_BUCKETS launches (or the pjit recover program in mesh
+        # mode) instead of one host decode per object
+        rebuilt = (await self._decode_shards([target], streams))[target]
         # the digest xattr is PER SHARD: the rebuilt chunk gets its own,
         # never a copy of ours (scrub would flag it forever)
         from ceph_tpu.common.crc import crc32c
@@ -1481,14 +1686,15 @@ class ECBackend(PGBackend):
                 f"{pg.pgid}: cannot reconstruct {oid}: insufficient "
                 f"shards (transient)")
         streams, attrs = got
-        rebuilt = self.codec.decode({my}, streams)[my]
+        rebuilt = (await self._decode_shards([my], streams))[my]
+        blob = rebuilt.tobytes()
         from ceph_tpu.common.crc import crc32c
         from ceph_tpu.osd.scrub import CRC_XATTR
         attrs = dict(attrs)
-        attrs[CRC_XATTR] = str(crc32c(rebuilt.tobytes())).encode()
+        attrs[CRC_XATTR] = str(crc32c(blob)).encode()
         txn = Transaction()
         txn.remove(pg.cid, soid)
-        txn.write(pg.cid, soid, 0, rebuilt.tobytes())
+        txn.write(pg.cid, soid, 0, blob)
         if attrs:
             txn.setattrs(pg.cid, soid, attrs)
         # rebuild OUR clone chunks the same way (decode over the peers'
@@ -1499,6 +1705,17 @@ class ECBackend(PGBackend):
             self._txn_install_clones(txn, soid, clones)
         pg.save_meta(txn)
         self.osd.store.apply_transaction(txn)
+        # a self-reconstructed shard IS the EC rebuild landing: count
+        # it exactly like a received push (recovery_bytes accounts
+        # bytes landed on the recovering OSD, whoever produced them)
+        nbytes = len(blob) + sum(len(cd) for _, cd, _ in clones)
+        perf = getattr(self.osd, "perf_osd", None)
+        if perf is not None:
+            perf.inc("recovery_bytes", nbytes)
+        rec = getattr(self.osd, "perf_recovery", None)
+        if rec is not None:
+            rec.inc("objects_pulled")
+            rec.inc("pull_bytes", nbytes)
 
     # ------------------------------------------------------------ sub-ops
     async def handle_sub_message(self, m) -> None:
@@ -1558,19 +1775,39 @@ class ECBackend(PGBackend):
         self.osd.store.queue_transactions([txn],
                                           on_commit=_committed)
     def _handle_ec_sub_read(self, m) -> None:
+        from ceph_tpu.osd.pglog import LB_MAX
         pg = self.pg
         data, attrs = [], {}
         result = 0
         for oid, off, ln in m.reads:
+            # mid-backfill read gate (the reference's last_backfill
+            # gate, PG.h:1911): past OUR durable cursor the local
+            # object SET is not authoritative.  An object we hold WITH
+            # a version xattr is still a coherent generation — serve
+            # it and let the primary's version-cohort check judge it
+            # (refusing those too deadlocks peering-time heals against
+            # the backfill that would advance our cursor).  An ABSENT
+            # or versionless name past the cursor answers EAGAIN, not
+            # ENOENT: the primary must route around the half-copy,
+            # never mistake a backfill hole for deletion.
+            past_cursor = pg.info.last_backfill != LB_MAX \
+                and oid > pg.info.last_backfill
             soid = pg.object_id(oid)
             if m.snap:
                 soid = soid.with_snap(m.snap)
             try:
-                data.append(self.osd.store.read(
-                    pg.cid, soid, off, ln if ln >= 0 else -1))
-                attrs = self.osd.store.getattrs(pg.cid, soid)
+                blob = self.osd.store.read(
+                    pg.cid, soid, off, ln if ln >= 0 else -1)
+                oattrs = self.osd.store.getattrs(pg.cid, soid)
+                if past_cursor and VERSION_XATTR not in oattrs:
+                    result = -errno.EAGAIN
+                    data.append(b"")
+                    continue
+                data.append(blob)
+                attrs = oattrs
             except (NoSuchObject, NoSuchCollection):
-                result = -errno.ENOENT
+                result = -errno.EAGAIN if past_cursor \
+                    else -errno.ENOENT
                 data.append(b"")
         reply = MOSDECSubOpReadReply(
             pg.pgid, m.tid, self.my_shard, result, data, attrs)

@@ -134,6 +134,40 @@ class OSD(Dispatcher):
         # daemon-scope counters (osd.slow_ops etc — osd/OSD.cc l_osd_*)
         self.perf_osd = ctx.perf.create("osd")
         self.perf_osd.add_u64("slow_ops")
+        # recovery retry rounds (PG._recover backoff loop): a storm
+        # that only warn-logged was invisible in `perf dump --cluster`
+        self.perf_osd.add_u64("recovery_retries")
+        # payload bytes landed on THIS osd by recovery (installed
+        # pushes + self-reconstructed EC shards): the numerator of
+        # bench.py's rebuild MB/s axis, counted at the landing site
+        self.perf_osd.add_u64("recovery_bytes")
+        # recovery observability (`perf dump --cluster` osd.recovery):
+        # the failure plane gets the same first-class counters the
+        # write path has.  objects_pushed counts pushes WE sent as
+        # primary; objects_pulled counts objects landed on THIS osd
+        # (installed pushes + self-reconstructed EC shards);
+        # active_pulls is the live in-flight gauge under the
+        # osd_recovery_max_active budget; backoff_retries/_give_ups
+        # are the shared-policy census (common/backoff.py);
+        # cursor_lag is the number of objects still short of the
+        # worst backfill target's cursor across this osd's primary
+        # PGs (0 = every cursor at LB_MAX)
+        self.perf_recovery = ctx.perf.create("recovery")
+        for key in ("objects_pushed", "objects_pulled",
+                    "push_bytes", "pull_bytes", "active_pulls",
+                    "backoff_retries", "backoff_give_ups",
+                    "cursor_lag"):
+            self.perf_recovery.add_u64(key)
+        # per-PG backfill shortfall feeding the cursor_lag gauge; each
+        # PG reports ONLY itself from its home shard (SHARD11: no
+        # cross-shard PG reads), the gauge is the sum
+        self._cursor_lag: Dict = {}
+        # reservation-style recovery budget: loop-local semaphores
+        # capping in-flight recovery pushes (osd_recovery_max_active)
+        # so a rebuild storm can't starve client ops.  Keyed per event
+        # loop like the EC batch collectors — asyncio primitives are
+        # loop-affine under threaded shards
+        self._recovery_budgets: Dict[int, object] = {}
         from ceph_tpu.common.op_tracker import OpTracker
         self.op_tracker = OpTracker(
             complaint_time=self.cfg["osd_op_complaint_time"],
@@ -155,6 +189,39 @@ class OSD(Dispatcher):
 
     def next_tid(self) -> int:
         return next(self._tid)
+
+    def note_cursor_lag(self, pgid, lag: int) -> None:
+        """One PG's backfill shortfall (objects its worst target's
+        cursor is still short of).  Gauge = sum across primary PGs;
+        0 = every cursor at LB_MAX."""
+        # gil-atomic:begin _cursor_lag per-PG slots: each PG only ever
+        # writes its OWN pgid key from its home shard, and the gauge
+        # sum is a racy-read-tolerant snapshot
+        if lag > 0:
+            self._cursor_lag[pgid] = lag
+        else:
+            self._cursor_lag.pop(pgid, None)
+        self.perf_recovery.set("cursor_lag",
+                               sum(self._cursor_lag.values()))
+        # gil-atomic:end
+
+    def recovery_budget(self) -> asyncio.Semaphore:
+        """The CURRENT loop's recovery-push reservation semaphore (the
+        recovery-vs-client budget, reference AsyncReserver role): at
+        most osd_recovery_max_active pushes in flight per loop, across
+        every PG it runs.  Backends acquire it around each recovery
+        push (PGBackend.recover_objects)."""
+        loop = asyncio.get_running_loop()
+        sem = self._recovery_budgets.get(id(loop))
+        if sem is None:
+            sem = asyncio.Semaphore(
+                max(1, int(self.cfg["osd_recovery_max_active"])))
+            # gil-atomic:begin _recovery_budgets lazy init: each loop
+            # only ever stores its own id(loop) key, so concurrent
+            # stores from shard threads never collide on a slot
+            self._recovery_budgets[id(loop)] = sem
+            # gil-atomic:end
+        return sem
 
     def ec_batch_queue(self):
         """The cross-PG EC batch collector for the CURRENT loop.  The
@@ -264,11 +331,14 @@ class OSD(Dispatcher):
         self.messenger.require_authorizer = True
 
     async def wait_for_boot(self, timeout: float = 30.0) -> None:
-        deadline = asyncio.get_event_loop().time() + timeout
+        from ceph_tpu.common.backoff import Backoff, BackoffGiveUp
+        bo = Backoff("boot_wait", base=0.02, cap=0.5, timeout=timeout)
         while not (self.osdmap.epoch and self.osdmap.is_up(self.whoami)):
-            if asyncio.get_event_loop().time() > deadline:
-                raise TimeoutError(f"osd.{self.whoami} failed to boot")
-            await asyncio.sleep(0.05)
+            try:
+                await bo.sleep()
+            except BackoffGiveUp:
+                raise TimeoutError(
+                    f"osd.{self.whoami} failed to boot") from None
 
     async def shutdown(self) -> None:
         self.running = False
@@ -568,13 +638,24 @@ class OSD(Dispatcher):
         if pg is None:
             return
         # judge membership from the CURRENT map, not possibly-stale pg
-        # state
+        # state.  Membership is per-SHARD: after an EC role change we
+        # are still in acting — under the NEW shard — while the
+        # old-shard instance is a removable stray; an osd-id check
+        # would shield it forever
         up, _, acting, _ = self.osdmap.pg_to_up_acting_osds(
             m.pgid.without_shard())
         if self.whoami in acting or self.whoami in up:
-            self.logger.warning(
-                f"ignoring pg remove for {m.pgid}: we are in up/acting")
-            return
+            my_shard = NO_SHARD
+            if pg.pool.is_erasure():
+                if self.whoami in acting:
+                    my_shard = acting.index(self.whoami)
+                elif self.whoami in up:
+                    my_shard = up.index(self.whoami)
+            if pg.pgid.shard == my_shard or my_shard == NO_SHARD:
+                self.logger.warning(
+                    f"ignoring pg remove for {m.pgid}: we are in "
+                    f"up/acting")
+                return
         # gil-atomic:begin pgs registry drop (MPGRemove on the home
         # shard); one GIL step, snapshot readers unaffected
         self.pgs.pop(pg.pgid, None)
@@ -597,16 +678,41 @@ class OSD(Dispatcher):
         peer_type = req.src_name.type if req.src_name else None
         self.messenger.send_message(msg, req.src_addr, peer_type=peer_type)
 
+    def _pg_matches(self, pgid: PGId) -> List[PG]:
+        base = pgid.without_shard()
+        return [inst for p, inst in list(self.pgs.items())
+                if p.without_shard() == base]
+
     def _pg_for(self, pgid: PGId) -> Optional[PG]:
         pg = self.pgs.get(pgid)
         if pg is None and pgid.shard != NO_SHARD:
             pg = self.pgs.get(pgid.without_shard())
         if pg is None:
-            # shard-agnostic lookup (EC peers address us by shard)
-            for p, inst in list(self.pgs.items()):
-                if p.without_shard() == pgid.without_shard():
+            # shard-agnostic lookup (EC peers address us by shard).
+            # After an EC role change this osd briefly hosts TWO
+            # instances of one PG — the newborn keyed by its new shard
+            # and the old-shard copy lingering as a stray — so prefer
+            # the instance keyed by our CURRENT role: first-match
+            # handed client ops and peering traffic to the stray and
+            # starved the newborn primary (recovery-under-load wedge)
+            matches = self._pg_matches(pgid)
+            for inst in matches:
+                if inst.pgid.shard == inst.shard_of(self.whoami):
                     return inst
+            if matches:
+                return matches[0]
         return pg
+
+    def _pg_for_reply(self, pgid: PGId, waiting) -> Optional[PG]:
+        """Route a request/reply-matched message to the instance that
+        actually awaits it.  Replies are addressed by the REPLIER's
+        shard, so with two local instances of one PG (role change) the
+        addressed key can name the wrong one — the registered waiter,
+        not the address, identifies the consumer."""
+        for inst in self._pg_matches(pgid):
+            if waiting(inst):
+                return inst
+        return self._pg_for(pgid)
 
     # ------------------------------------------------------------- dispatch
     def ms_dispatch(self, m: Message) -> bool:
@@ -741,7 +847,8 @@ class OSD(Dispatcher):
             # acks resolve futures the PG worker awaits: handle off
             # the op queue the worker is blocked on (the shard pump is
             # a separate task, so delivery stays prompt)
-            pg = self._pg_for(m.pgid)
+            pg = self._pg_for_reply(
+                m.pgid, lambda i: m.tid in i.backend._inflight)
             if pg is not None:
                 pg.backend.handle_reply(m)
             return
@@ -761,7 +868,8 @@ class OSD(Dispatcher):
             self._pg_remove(m)
             return
         if isinstance(m, MPGNotify):
-            pg = self._pg_for(m.pgid)
+            pg = self._pg_for_reply(
+                m.pgid, lambda i: m.from_osd in i._notify_waiters)
             if pg is not None:
                 pg.on_notify(m)
             return
@@ -771,7 +879,11 @@ class OSD(Dispatcher):
                 pg.on_log_request(m)
             return
         if isinstance(m, MPGLog):
-            pg = self._pg_for(m.pgid)
+            # activation targets the addressed shard; a GetLog reply
+            # targets whichever instance asked
+            pg = (self._pg_for(m.pgid) if m.activate
+                  else self._pg_for_reply(
+                      m.pgid, lambda i: m.from_osd in i._log_waiters))
             if pg is not None:
                 pg.on_pg_log(m)
             else:
@@ -784,12 +896,15 @@ class OSD(Dispatcher):
                 pg.on_push(m)
             return
         if isinstance(m, MPGPushReply):
-            pg = self._pg_for(m.pgid)
+            pg = self._pg_for_reply(
+                m.pgid,
+                lambda i: (m.from_osd, m.oid) in i._push_acks)
             if pg is not None:
                 pg.on_push_reply(m)
             return
         if isinstance(m, MPGObjectList):
-            pg = self._pg_for(m.pgid)
+            pg = self._pg_for_reply(
+                m.pgid, lambda i: m.from_osd in i._list_waiters)
             if pg is not None:
                 pg.on_object_list(m)
             return
@@ -1280,13 +1395,15 @@ class OSD(Dispatcher):
         up.  Rotation matters: boots are leader-only intake and the osd
         doesn't know the leader, so spraying ranks guarantees one lands
         once ANY quorum exists."""
+        from ceph_tpu.common.backoff import Backoff
         rank = self.monc.cur_mon
+        bo = Backoff("boot_resend", base=0.25, cap=2.0)
         while self.running and not self.osdmap.is_up(self.whoami):
             self.monc.messenger.send_message(
                 MOSDBoot(self.whoami, self.messenger.addr),
                 self.monc.monmap.addr_of_rank(rank), peer_type="mon")
             rank = (rank + 1) % self.monc.monmap.size()
-            await asyncio.sleep(1.0)
+            await bo.sleep()
 
     async def _heartbeat(self) -> None:
         interval = self.cfg["osd_heartbeat_interval"]

@@ -288,6 +288,7 @@ class ProcessLane:
             while (self.proc.is_alive()
                    and time.monotonic() < deadline):
                 self._on_wake()
+                # lint: allow[RETRY19] bounded shutdown join, not an op-path retry
                 await asyncio.sleep(0.01)
             if self.proc.is_alive():
                 _log.error("lane %d did not stop in %.0fs; killing",
@@ -955,6 +956,7 @@ class LaneRuntime:
         next_sweep = time.monotonic() + sweep_every
         try:
             while not self._stopping:
+                # lint: allow[RETRY19] fixed pump cadence (belt), wakeup pipe is the fast path
                 await asyncio.sleep(0.2)
                 self._pump()      # belt: poll alongside wakeups
                 now = time.monotonic()

@@ -71,16 +71,24 @@ class TierClient:
     async def op(self, pool_id: int, oid: str, ops: List[OSDOp],
                  timeout: float = 20.0) -> MOSDOpReply:
         """Submit one op to `pool_id`'s primary; resends on EAGAIN
-        (stale map) like the Objecter's resend loop."""
-        deadline = asyncio.get_running_loop().time() + timeout
+        (stale map) like the Objecter's resend loop.  Primary waits
+        and EAGAIN resends back off under the shared policy (one
+        monotonic deadline for the whole op) instead of fixed-interval
+        polling that hammers a recovering map in lockstep."""
+        from ceph_tpu.common.backoff import Backoff, BackoffGiveUp
+        bo = Backoff("tier_primary_wait", base=0.05, cap=1.0,
+                     timeout=timeout,
+                     perf=getattr(self.osd, "perf_recovery", None))
         while True:
             osdmap = self.osd.osdmap
             loc = ObjectLocator(pool_id)
             pg, acting, primary = osdmap.object_to_acting(oid, loc)
             if primary < 0:
-                await asyncio.sleep(0.2)
-                if asyncio.get_running_loop().time() > deadline:
-                    raise TimeoutError(f"tier op: no primary for {oid}")
+                try:
+                    await bo.sleep()
+                except BackoffGiveUp:
+                    raise TimeoutError(
+                        f"tier op: no primary for {oid}") from None
                 continue
             tid = next(self._tids)
             fut = asyncio.get_running_loop().create_future()
@@ -89,14 +97,16 @@ class TierClient:
             self.osd.send_osd(primary, MOSDOp(
                 pg, oid, loc, ops, tid, osdmap.epoch, reqid))
             try:
-                reply: MOSDOpReply = await asyncio.wait_for(
-                    fut, max(0.5, deadline
-                             - asyncio.get_running_loop().time()))
-            except asyncio.TimeoutError:
+                reply: MOSDOpReply = await bo.wait_for(fut)
+            except BackoffGiveUp:
                 self._pending.pop(tid, None)
-                raise TimeoutError(f"tier op timeout: {oid}")
+                raise TimeoutError(f"tier op timeout: {oid}") from None
             if reply.result == -errno.EAGAIN:
-                await asyncio.sleep(0.1)
+                try:
+                    await bo.sleep()
+                except BackoffGiveUp:
+                    raise TimeoutError(
+                        f"tier op timeout: {oid}") from None
                 continue
             return reply
 

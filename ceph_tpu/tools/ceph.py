@@ -154,7 +154,16 @@ def _cluster_perf_dump(cluster_dir: str, prom: bool) -> int:
     snaps, lane_dead, errors = [], [], []
     for path in socks:
         who = os.path.basename(path)[:-len(".asok")]
-        out = admin_command(path, "perf dump full")
+        try:
+            out = admin_command(path, "perf dump full")
+        except OSError:
+            # a dead daemon leaves a stale socket behind; the scrape
+            # exists precisely for degraded windows, so the survivors'
+            # metrics must come through with the dead source carried
+            # loudly — an operator mid-outage gets data, not a
+            # traceback
+            errors.append(who)
+            continue
         if not isinstance(out, dict) or "snapshots" not in out:
             errors.append(who)
             continue

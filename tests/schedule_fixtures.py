@@ -99,3 +99,63 @@ def commit_callbacks_before_durability():
         yield
     finally:
         KVSyncThread._commit = orig_commit
+
+
+@contextlib.contextmanager
+def boolean_backfill_marker():
+    """Reintroduce the pre-PR-17 boolean-marker bug on ECBackend: a
+    backfilling shard has no per-object cursor, only an all-or-nothing
+    "complete" flag, so the sub-read path trusts the LOCAL object set
+    over its whole namespace — an absent name inside the unfinished
+    copy answers ENOENT (a data statement: "deleted") instead of
+    EAGAIN (a topology statement: "ask elsewhere"), and a half-copied
+    versionless blob is served as authoritative.  This is the
+    historical ~1/6-seed EC model-checker phantom-deletion window the
+    per-object last_backfill cursor closed; the explorer's
+    watch_backfill_cursors canaries must flag any schedule that
+    exercises it."""
+    from ceph_tpu.osd.backend import ECBackend
+    from ceph_tpu.osd.pglog import LB_MAX
+
+    orig_read = ECBackend._handle_ec_sub_read
+    orig_stale = ECBackend._stale_shards
+
+    def buggy_read(self, m):
+        pg = self.pg
+        real = pg.info.last_backfill
+        # the bug, replica half: reads see "backfilled or not" as a
+        # boolean — a mid-copy shard claims cursor-complete authority.
+        # The read handler is synchronous (no suspension point), so
+        # the flip cannot leak into a concurrent op.
+        pg.info.last_backfill = LB_MAX
+        try:
+            return orig_read(self, m)
+        finally:
+            pg.info.last_backfill = real
+
+    def buggy_stale(self, oid):
+        # the bug, primary half: with only a boolean marker the
+        # primary has no per-object view of a backfill target — it
+        # either drops the shard for the WHOLE copy or trusts it
+        # wholesale.  The buggy replica claims completion, so the
+        # boolean-era primary trusts it: skip both the cursor clause
+        # AND the backfill-tracking missing set for targets mid-copy
+        # (log-recovery peers keep their missing-set gate — that
+        # plumbing predates the cursor)
+        pg = self.pg
+        out = set()
+        for i, osd_id in enumerate(pg.acting):
+            if osd_id in getattr(pg, "_backfilling", ()):
+                continue
+            pm = pg.peer_missing.get(osd_id)
+            if pm is not None and oid in pm:
+                out.add(i)
+        return out
+
+    ECBackend._handle_ec_sub_read = buggy_read
+    ECBackend._stale_shards = buggy_stale
+    try:
+        yield
+    finally:
+        ECBackend._handle_ec_sub_read = orig_read
+        ECBackend._stale_shards = orig_stale

@@ -50,7 +50,7 @@ def test_cli_entry_point_runs_standalone():
     for rid in ("AF01", "FP02", "SEND03", "BLK04", "MONO05",
                 "LOCK06", "FIN07", "PROTO08", "REPLY09", "EPOCH10",
                 "SHARD11", "ESC12", "PORT13", "ATOM14", "SYNC15",
-                "JIT16", "XFER17", "STAGE18"):
+                "JIT16", "XFER17", "STAGE18", "RETRY19"):
         assert rid in out.stdout
 
 
@@ -1054,6 +1054,121 @@ def test_lint_json_carries_stage_coverage_block():
     assert json.loads(json.dumps(doc["stages"])) == doc["stages"]
 
 
+# ================================ 2d2. RETRY19 (retry-backoff policy)
+
+
+def test_retry19_fixed_sleep_retry_loop_trips():
+    """ISSUE 18: a constant-interval sleep inside a retry/poll while
+    loop of an async op-path function hammers a degraded cluster in
+    lockstep — violation; the same loop riding the shared Backoff
+    passes."""
+    src = (
+        "import asyncio\n"
+        "async def wait_primary(self):\n"
+        "    while self.primary < 0:\n"
+        "        await asyncio.sleep(0.05)\n"
+    )
+    vio = lint_source(src, "osd/fixture.py", rule="RETRY19")
+    assert [v.rule for v in vio] == ["RETRY19"], vio
+    assert "shared jittered backoff" in vio[0].msg
+    backed = (
+        "import asyncio\n"
+        "from ceph_tpu.common.backoff import Backoff\n"
+        "async def wait_primary(self):\n"
+        "    bo = Backoff(\"primary_wait\", base=0.05)\n"
+        "    while self.primary < 0:\n"
+        "        await bo.sleep()\n"
+    )
+    assert lint_source(backed, "osd/fixture.py", rule="RETRY19") == []
+
+
+def test_retry19_same_loop_backoff_covers_aux_sleep():
+    """A loop already riding the policy may carry an extra literal
+    sleep (e.g. a post-resend settle) — the Backoff await in the SAME
+    loop is the discipline, so it passes."""
+    src = (
+        "import asyncio\n"
+        "from ceph_tpu.common.backoff import Backoff\n"
+        "async def resend(self):\n"
+        "    bo = Backoff(\"resend\")\n"
+        "    while True:\n"
+        "        await bo.wait_for(self.fut)\n"
+        "        await asyncio.sleep(0.1)\n"
+    )
+    assert lint_source(src, "osd/fixture.py", rule="RETRY19") == []
+
+
+def test_retry19_exemptions_yield_config_scope():
+    """sleep(0) yield-to-loop, config-driven delays, sync functions and
+    files outside osd//client/ are all out of scope."""
+    yield_idiom = (
+        "import asyncio\n"
+        "async def drain(self):\n"
+        "    while self.q:\n"
+        "        await asyncio.sleep(0)\n"
+    )
+    assert lint_source(yield_idiom, "osd/fixture.py", rule="RETRY19") == []
+    config_driven = (
+        "import asyncio\n"
+        "async def throttle(self):\n"
+        "    d = float(self.cfg[\"osd_recovery_sleep\"])\n"
+        "    while self.more():\n"
+        "        await asyncio.sleep(d)\n"
+    )
+    assert lint_source(config_driven, "osd/fixture.py", rule="RETRY19") == []
+    fixed = (
+        "import asyncio\n"
+        "async def wait(self):\n"
+        "    while self.primary < 0:\n"
+        "        await asyncio.sleep(0.05)\n"
+    )
+    # common/ (the policy's own home) is not held to the rule
+    assert lint_source(fixed, "common/fixture.py", rule="RETRY19") == []
+
+
+def test_retry19_swallowed_timeout_trips():
+    """`except TimeoutError: pass` (either flavour — 3.10 still splits
+    asyncio.TimeoutError from TimeoutError) silently drops a deadline
+    with no counter or give-up tag — violation; a waiver stating why
+    the silence is safe passes."""
+    src = (
+        "import asyncio\n"
+        "async def notify(self, fut):\n"
+        "    try:\n"
+        "        await asyncio.wait_for(fut, 5.0)\n"
+        "    except asyncio.TimeoutError:\n"
+        "        pass\n"
+    )
+    vio = lint_source(src, "osd/fixture.py", rule="RETRY19")
+    assert [v.rule for v in vio] == ["RETRY19"], vio
+    assert "swallows" in vio[0].msg
+    bare = src.replace("asyncio.TimeoutError", "TimeoutError")
+    vio = lint_source(bare, "client/fixture.py", rule="RETRY19")
+    assert [v.rule for v in vio] == ["RETRY19"], vio
+    waived = src.replace(
+        "    except asyncio.TimeoutError:",
+        "    # lint: allow[RETRY19] fixture: timeout is the protocol\n"
+        "    except asyncio.TimeoutError:")
+    assert lint_source(waived, "osd/fixture.py", rule="RETRY19") == []
+    # a handler that DOES something with the timeout is fine
+    handled = src.replace("        pass\n",
+                          "        self.perf.inc(\"notify_timeout\")\n")
+    assert lint_source(handled, "osd/fixture.py", rule="RETRY19") == []
+
+
+def test_retry19_waiver_on_sleep_line():
+    """Waiver escape hatch for legitimate fixed cadences (pump belts,
+    heartbeat-scale polls) — on the sleep line or the line above."""
+    src = (
+        "import asyncio\n"
+        "async def pump(self):\n"
+        "    while not self._stopping:\n"
+        "        # lint: allow[RETRY19] fixture: pump belt cadence\n"
+        "        await asyncio.sleep(0.2)\n"
+    )
+    assert lint_source(src, "osd/fixture.py", rule="RETRY19") == []
+
+
 # ================================ 2e. waiver audit + lint performance
 
 
@@ -1166,9 +1281,13 @@ def test_lint_parse_cache_cuts_full_tree_wall_time():
 
 
 def test_cli_changed_mode_smoke():
-    """--changed lints only git-touched package files (pre-commit
-    mode): exit must be clean whether the worktree is dirty (touched
-    files are part of the clean live tree) or pristine."""
+    """--changed reports only git-touched package files (pre-commit
+    mode) but ANALYZES the whole package — a subset call graph can't
+    see the callers that prove a function single-sided, so the seam
+    rules would flag phantom cross-side escapes in untouched
+    architecture whenever a seam-adjacent file is in the diff.  Exit
+    must be clean whether the worktree is dirty (touched files are
+    part of the clean live tree) or pristine."""
     out = subprocess.run(
         [sys.executable, "-m", "ceph_tpu.devtools.lint", "--changed"],
         capture_output=True, text=True, timeout=300)

@@ -165,6 +165,19 @@ def test_ec_shard_failure_reconstruction():
             assert copies == 6, (i, copies)
             assert (await io.read(f"e{i}")) == \
                 payload + bytes([i])
+        # recovery observability (ISSUE 18): the rebuild left
+        # first-class counters in the osd.recovery perf group that
+        # `perf dump --cluster` scrapes per daemon and merges
+        rec = {}
+        for osd in cl.osds.values():
+            assert "recovery" in osd.ctx.perf.dump()
+            for k, v in osd.perf_recovery.dump().items():
+                rec[k] = rec.get(k, 0) + int(v)
+        assert rec["objects_pushed"] > 0, rec
+        assert rec["objects_pulled"] > 0, rec
+        assert rec["push_bytes"] > 0 and rec["pull_bytes"] > 0, rec
+        # converged: every backfill cursor back at LB_MAX, no lag left
+        assert rec["cursor_lag"] == 0, rec
         await cl.stop()
     asyncio.run(run())
 

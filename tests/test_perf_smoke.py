@@ -466,6 +466,72 @@ def test_device_kernel_compile_count_plateaus():
         "device-queue workload bumped the message codec"
 
 
+def test_degraded_read_decode_plateaus_and_zero_encode():
+    """ISSUE 17 guard (recovery under fire): with one EC shard-holder
+    dead and UNREPLACEABLE (pool width == cluster size, so recovery
+    keeps retrying but can never remap the hole), every read of an
+    object whose data shard died must reconstruct it through the
+    device decode queue — and the decode signatures must PLATEAU: the
+    first round of degraded reads pays the jit compiles, every later
+    round replays them while launches keep growing.  A per-read
+    retrace in the decode path (shape drift, unhashable matrix key)
+    fails here in tier-1 instead of in a bench review.  The whole
+    degraded window — client reads, shard gathers, recovery retries —
+    rides the local path with ZERO message-body encodes."""
+    from ceph_tpu.common import devstats
+    from ceph_tpu.msg import payload as payload_mod
+    from ceph_tpu.qa.cluster import Cluster, make_ctx
+
+    def ctx_f(name):
+        c = make_ctx(name)
+        c.config.set("ms_local_delivery", True)
+        c.config.set("osd_ec_batch_device", "force")
+        c.config.set("osd_ec_batch_min_bytes", 1)
+        return c
+
+    async def run():
+        cl = Cluster(ctx_factory=ctx_f)
+        admin = await cl.start(4)
+        # width k+m == n_osds: killing any osd leaves a hole no
+        # backfill target can fill — the degraded window stays open
+        await admin.pool_create("degpool", pg_num=4,
+                                pool_type="erasure", k=2, m=2)
+        await _settle(cl, 4 * 4)
+        io = admin.open_ioctx("degpool")
+        blobs = {f"dg{i:03d}": bytes([i + 1]) * 8192 for i in range(16)}
+        for k, v in blobs.items():
+            await io.write_full(k, v)
+        # kill an osd that holds a DATA shard (shard < k) somewhere:
+        # reads of those objects must decode, not just re-route
+        victim = next(o.whoami for o in cl.osds.values()
+                      if any(pg.pgid.shard < 2 for pg in o.pgs.values()))
+        await cl.kill_osd(victim)
+        await cl.mark_down_and_wait(admin, victim)
+        devstats.reset()
+        payload_mod.reset_counters()
+        snaps = []
+        for _round in range(3):
+            got = await asyncio.gather(*[io.read(k) for k in blobs])
+            assert list(got) == list(blobs.values())
+            snaps.append(devstats.counters())
+        enc = payload_mod.counters()
+        await cl.stop()
+        return snaps, enc
+
+    snaps, enc = asyncio.run(run())
+    compiles = [s["compiles"].get("ec_apply", 0) for s in snaps]
+    launches = [s["launches"].get("ec_apply", 0) for s in snaps]
+    assert compiles[0] >= 1, (compiles, launches)   # decode engaged
+    assert launches[2] > launches[1] >= 1, launches  # and kept flowing
+    assert compiles[2] == compiles[1] == compiles[0], \
+        (f"degraded-read decode compiles kept growing {compiles}: "
+         f"a per-read retrace slipped into the decode path")
+    # the degraded window (including recovery retrying in the
+    # background) never touched the message codec on the local path
+    assert enc["msg_encode_calls"] == 0, enc
+    assert enc["msg_encode_bytes"] == 0, enc
+
+
 def test_objecter_cork_is_one_placement_kernel_launch():
     """ISSUE 16 guard (batched CRUSH in the data path): ONE corked
     Objecter flush computes placement for the whole burst in exactly

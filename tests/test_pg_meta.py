@@ -105,8 +105,42 @@ def test_legacy_full_blob_layout_still_loads():
         osd2, pg2 = _primary_pg(cl, "lg")
         assert pg2.log.head == head
         assert len(pg2.log.entries) == n
+        # legacy layouts predate per-target backfill cursors: the
+        # missing b"peer_cursors" key must load as "no records"
+        assert pg2.peer_backfill_cursors == {}
         for i in range(6):
             assert await io.read(f"l{i}") == bytes([i]) * 256
+        await cl.stop()
+
+    asyncio.run(run())
+
+
+def test_peer_backfill_cursors_roundtrip_across_restart():
+    """ISSUE 17: the primary-side per-target backfill cursor record
+    (b"peer_cursors" in PG meta) must survive a primary restart via
+    the incremental layout's full-save path — a primary crash
+    mid-backfill must not forget how far each target actually got."""
+    async def run():
+        from ceph_tpu.store.objectstore import Transaction
+        cl = Cluster()
+        admin = await cl.start(2)
+        await admin.pool_create("pc", pg_num=1, size=2)
+        io = admin.open_ioctx("pc")
+        for i in range(4):
+            await io.write_full(f"c{i}", bytes([i]) * 128)
+        osd, pg = _primary_pg(cl, "pc")
+        pg.peer_backfill_cursors = {1: "c0002", 3: "c0040"}
+        txn = Transaction()
+        pg.save_meta(txn)
+        osd.store.apply_transaction(txn)
+        _, omap = osd.store.omap_get(pg.cid, pg.meta_oid)
+        assert b"peer_cursors" in omap
+
+        store = await cl.kill_osd(0)
+        await cl.start_osd(0, store=store)
+        await cl.osds[0].wait_for_boot()
+        osd2, pg2 = _primary_pg(cl, "pc")
+        assert pg2.peer_backfill_cursors == {1: "c0002", 3: "c0040"}
         await cl.stop()
 
     asyncio.run(run())

@@ -101,6 +101,19 @@ def test_two_shard_sim_exploration_is_clean():
     assert r1.trace_hash == r2.trace_hash
 
 
+def test_kill_restart_exploration_cursor_invariants():
+    """ISSUE 17 tentpole: an osd kill+restart event landing at
+    seed-permuted points in >= 64 explored schedules (32 seeds x two
+    kill depths), under the backfill-cursor canaries — no shard serves
+    a read past its own durable cursor, no cursor regresses within an
+    interval, and no acked write is lost across the kill + rebuild
+    (the restarted OSD must CONVERGE before acked reads re-verify)."""
+    rep = explore(32, with_crashes=False, with_kills=True)
+    assert len(rep.kill_runs) >= 64, len(rep.kill_runs)
+    assert all(r.kill is not None for r in rep.kill_runs)
+    assert not rep.failures, rep.render_failures()
+
+
 # ----------------------------------------------------- seeded-bug fixtures
 
 
@@ -136,6 +149,40 @@ def test_explorer_catches_commit_callbacks_before_durability():
                    for f in rep2.findings), rep2.findings
     rep3 = run_ec_mini(seed=0, controller=ScheduleController(), **kw)
     assert rep3.ok, rep3.render()
+
+
+def test_explorer_catches_boolean_backfill_marker():
+    """ISSUE 18 regression fixture: reintroduce the pre-cursor
+    boolean backfill marker (a mid-copy EC shard claims authority over
+    its whole namespace — absent names answer ENOENT, half-copies
+    serve) and assert the backfill-cursor canaries catch it within a
+    bounded kill-schedule budget.  A checker that never caught its
+    target bug is a no-op with good marketing."""
+    from schedule_fixtures import boolean_backfill_marker
+    # recovery throttle keeps the backfill-cursor window open long
+    # enough for degraded reads to race it
+    kw = dict(n_objects=8, iodepth=8,
+              cfg={"osd_recovery_max_active": 1,
+                   "osd_recovery_sleep": 0.05})
+    caught = None
+    with boolean_backfill_marker():
+        for seed in range(16):          # bounded schedule budget
+            # fresh-store restart: full resync, so reads race a live
+            # backfill-cursor window (a surviving store does log-based
+            # recovery and never opens the window)
+            rep = run_ec_mini(seed=seed, kill=(1, 1, True), **kw)
+            if any("cursor hole served as ENOENT" in f
+                   or "cursor read leak" in f
+                   or "served as deletion" in f
+                   for f in rep.findings):
+                caught = rep
+                break
+        assert caught is not None, \
+            "canaries missed the boolean-marker bug in 16 kill schedules"
+    # and the fix holds: same schedule, bug removed => cursor-clean
+    rep2 = run_ec_mini(seed=caught.seed, kill=(1, 1, True), **kw)
+    assert not any("cursor" in f or "served as deletion" in f
+                   for f in rep2.findings), rep2.render()
 
 
 # ------------------------------------- sequencer EAGAIN path (satellite)
