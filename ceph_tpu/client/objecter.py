@@ -13,6 +13,7 @@ import asyncio
 import errno
 from typing import Dict, List, Optional, Tuple
 
+from ceph_tpu.common.qos import QOS_CLASS, QosFeedback
 from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Dispatcher, Messenger
 from ceph_tpu.mon.client import MonClient
@@ -29,7 +30,8 @@ class ObjectOperationError(Exception):
 
 class _InFlight:
     __slots__ = ("tid", "oid", "loc", "ops", "fut", "attempts", "snapid",
-                 "snapc", "span", "span_sent", "sent", "corked")
+                 "snapc", "span", "span_sent", "sent", "corked",
+                 "qos_class")
 
     def __init__(self, tid, oid, loc, ops, fut, snapid=0, snapc=None):
         self.tid = tid
@@ -44,6 +46,7 @@ class _InFlight:
         self.span_sent = False  # first-send cut taken (resends skip)
         self.sent = False       # first send left — resends skip the cork
         self.corked = False     # parked in a pending cork (no re-entry)
+        self.qos_class = "client"   # dmClock class riding the envelope
 
 
 class Objecter(Dispatcher):
@@ -67,6 +70,13 @@ class Objecter(Dispatcher):
         self._cork: List[_InFlight] = []
         self.batches_sent = 0       # introspection (bench/tests)
         self.ops_batched = 0
+        # dmClock client half (common/qos.py): ops carry a class tag
+        # plus (delta, rho) completion feedback so the per-PG queues —
+        # many servers from the scheduler's viewpoint — keep aggregate
+        # rates equal to the configured spec
+        self._default_qos_class = str(
+            ctx.config["objecter_qos_class"] or "")
+        self._qos = QosFeedback()
 
     @property
     def osdmap(self) -> Optional[OSDMap]:
@@ -88,6 +98,7 @@ class Objecter(Dispatcher):
                     self._resend_later(op))
                 return True
             del self._inflight[m.tid]
+            self._qos.note_done(op.qos_class, m.qos_phase)
             if op.span is not None and not op.span.finished:
                 # close the trace: the reply transit back is the last
                 # chain segment, then op_total (t0 -> now) lands as the
@@ -172,6 +183,9 @@ class Objecter(Dispatcher):
         m = MOSDOp(pg, op.oid, loc, op.ops, op.tid,
                    self.osdmap.epoch, reqid, snap_seq=snap_seq,
                    snaps=snaps, snapid=op.snapid)
+        m.qos_class = op.qos_class
+        m.qos_delta, m.qos_rho = self._qos.note_sent(op.qos_class,
+                                                     primary)
         span = op.span
         if span is not None and not op.span_sent:
             # trace context rides the op: payload fields for the wire,
@@ -274,6 +288,10 @@ class Objecter(Dispatcher):
         tid = self._tid
         fut = asyncio.get_running_loop().create_future()
         op = _InFlight(tid, oid, loc, ops, fut, snapid, snapc)
+        # class resolution order: per-task contextvar (multi-tenant
+        # gateway) > per-client config default > "client"
+        op.qos_class = QOS_CLASS.get() or self._default_qos_class \
+            or "client"
         tr = self.ctx.tracer
         if tr.enabled:
             op.span = tr.start("osd_op")

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import errno
 import json
+import zlib
 from typing import Dict
 
 from ceph_tpu.cls import ClsContext, cls_method
@@ -38,6 +39,27 @@ MAX_LIST_ENTRIES = 1001
 
 def pending_key(tag: str) -> bytes:
     return PENDING_PREFIX + tag.encode()
+
+
+# ------------------------------------------------------- sharded layout
+# N-shard bucket index (cls_rgw.cc + rgw_bucket.cc bucket_index_max_
+# shards role): instead of ONE omap object serializing every index
+# mutation on one PG, keys hash across N shard objects — each lands on
+# its own PG via the normal placement pipeline, so a many-client PUT
+# burst spreads.  Shard oids carry a GENERATION so a reshard can build
+# the next layout beside the live one and flip atomically.
+
+def index_shard_oid(bucket: str, gen: int, shard: int) -> str:
+    return f".bucket.index.{bucket}.g{gen}.{shard}"
+
+
+def shard_of_key(key: str, num_shards: int) -> int:
+    """Owning shard of an object key: stable crc32 hash (the
+    reference's rgw_bucket_shard_index hash ring, ceph_str_hash
+    role) — every writer/reader agrees without coordination."""
+    if num_shards <= 1:
+        return 0
+    return zlib.crc32(key.encode()) % num_shards
 
 
 def _bad_key(key: str) -> bool:
@@ -247,6 +269,26 @@ def bucket_rebuild_index(hctx: ClsContext, inbl: bytes):
     for v in omap.values():
         hdr["entries"] += 1
         hdr["bytes"] += int(json.loads(v.decode()).get("size", 0))
+    hctx.omap_set_header(json.dumps(hdr).encode())
+    return 0, json.dumps(hdr).encode()
+
+
+@cls_method("rgw.bucket_install_entries", writes=True)
+def bucket_install_entries(hctx: ClsContext, inbl: bytes):
+    """in: {entries: {key: entry, ...}} — bulk-install committed
+    entries into this (new-generation) shard during a reshard copy
+    (cls_rgw bi_put batch role).  The writer gate is closed for the
+    whole window, so keys are fresh by construction; a repeated key
+    (resumed copy) replaces without double-counting."""
+    req = json.loads(inbl.decode())
+    entries = req.get("entries") or {}
+    keys = [k.encode() for k in entries if not _bad_key(k)]
+    old = hctx.omap_get_values(keys)
+    hdr = _decode_header(hctx.omap_get_header())
+    for key, entry in entries.items():
+        if _bad_key(key):
+            continue
+        _apply_put(hctx, old, hdr, key.encode(), entry or {})
     hctx.omap_set_header(json.dumps(hdr).encode())
     return 0, json.dumps(hdr).encode()
 

@@ -267,7 +267,6 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("osd_pool_default_size", "int", 3, "replica count"),
     Option("osd_pool_default_min_size", "int", 0, "0 = size - size/2"),
     Option("osd_pool_default_pg_num", "int", 8, "pgs per new pool"),
-    Option("osd_op_queue", "str", "wpq", "op scheduler (config_opts.h:706)"),
     Option("osd_pg_max_inflight_ops", "int", 16,
            "per-PG client-op window: ops on disjoint objects run "
            "concurrently up to this depth, dependency-tracked by "
@@ -325,8 +324,19 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("osd_tier_agent_interval", "float", 2.0,
            "cache-tier agent pass cadence (flush/evict scheduling)"),
     Option("osd_op_queue", "str", "wpq",
-           "PG op scheduler: wpq (weighted class round-robin, "
-           "WeightedPriorityQueue.h) | fifo"),
+           "PG op scheduler (config_opts.h:706): wpq (weighted class "
+           "round-robin, WeightedPriorityQueue.h — the deterministic "
+           "FAST_CFG default, bit-for-bit the pre-QoS queue) | "
+           "mclock (dmClock reservation/weight/limit tags per client "
+           "class, common/qos.py; mClockScheduler role) | fifo"),
+    Option("osd_qos_specs", "str",
+           "client:r=40,w=60,l=0;background:r=8,w=4,l=0;"
+           "default:r=0,w=10,l=0",
+           "per-class dmClock specs for osd_op_queue=mclock: "
+           "';'-separated class:r=<ops/s reservation>,w=<share>,"
+           "l=<ops/s limit, 0=uncapped>.  recovery/scrub/agent work "
+           "folds into 'background'; unlisted client classes take "
+           "'default' (osd_mclock_scheduler_* role)"),
     Option("osd_deep_scrub_interval", "float", 300.0,
            "deep scrub cadence (reads + recomputes every digest)"),
     Option("osd_mon_report_interval", "float", 2.0,
@@ -364,6 +374,18 @@ DEFAULT_OPTIONS: List[Option] = [
            "handoff (MOSDOpBatch), amortizing the per-message "
            "deliver/ack hops the op tracer attributes ~40% of local "
            "e2e to.  Replies stay per-op; resends bypass the cork"),
+    Option("objecter_qos_class", "str", "",
+           "default dmClock class stamped on this client's ops "
+           "('' = client).  Per-task override: common/qos.py "
+           "QOS_CLASS contextvar (a multi-tenant gateway sets it per "
+           "request task over one shared rados client)"),
+    Option("rgw_bucket_index_shards", "int", 1,
+           "bucket-index shards for NEW buckets (rgw_override_bucket_"
+           "index_max_shards role, config_opts.h:1305): keys hash to "
+           "N shard objects so a PUT burst spreads over N PGs instead "
+           "of serializing on one index object.  1 = legacy unsharded "
+           "layout; existing buckets reshard via radosgw-admin bucket "
+           "reshard"),
     Option("ec_batch_window_us", "int", 200,
            "TPU EC batch-collector window (ShardedOpWQ analog)"),
     Option("ec_batch_max_stripes", "int", 64, "max stripes per TPU launch"),

@@ -1,0 +1,328 @@
+"""dmClock QoS scheduler: tag-based op-queue discipline.
+
+Reference parity: Gulati et al., *mClock: Handling Throughput
+Variability for Hypervisor IO Scheduling* (OSDI 2010) and its
+distributed extension dmClock; in Ceph this is osd/scheduler/
+mClockScheduler.cc behind ``osd_op_queue = mclock_scheduler``.
+
+Every client CLASS (not every TCP peer: "client", "background", or a
+caller-chosen label like "bulk") carries a three-knob spec —
+
+  reservation  minimum rate (ops/sec) the class is guaranteed even
+               under full contention: served first whenever its
+               reservation tag is in the past,
+  weight       dimensionless share of whatever capacity is left after
+               reservations are met,
+  limit        hard rate cap (ops/sec) the class can never exceed,
+               even on an idle server (0 = uncapped).
+
+Each enqueued op is stamped with three TAGS (absolute deadline times):
+R = prev_R + rho/reservation, P = prev_P + delta/weight, L = prev_L +
+delta/limit, each clamped up to ``now`` so an idle class re-anchors
+instead of building up credit.  Dequeue is two-phase (mClock
+Algorithm 1): first any head whose R tag is due (reservation phase,
+smallest R wins); otherwise the smallest P tag among classes whose L
+tag is due (proportional phase) — and a proportional serve discounts
+the class's outstanding R tags by one reservation quantum so work is
+never double-counted against the guarantee.
+
+``delta``/``rho`` are the dmClock distributed-server feedback: a PG
+queue is one server among many (PGs spread over shards, process lanes
+and OSDs), so a class's ops fan out and per-server tag spacing of
+``1/rate`` would over-reserve by the fan-out factor.  The client
+(QosFeedback, fed by the MOSDOpReply ``qos_phase`` echo) counts ops
+completed ANYWHERE since its last send to this server and ships
+(delta, rho) on the MOSDOp envelope; tags then advance delta (rho)
+quanta at once, keeping the aggregate rate — not the per-server rate —
+equal to the spec.
+
+The queue is API-compatible with common/wpq.py (put_nowait/get/
+get_nowait/qsize/empty) so it slots into the PG op-queue seam in every
+lane mode; ``osd_op_queue = wpq`` keeps the old queue bit-for-bit.
+Clocking uses the running loop's clock, so under the deterministic
+loop (devtools/schedule.py) tags ride virtual time and schedules stay
+replayable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from contextvars import ContextVar
+from dataclasses import dataclass
+from typing import Deque, Dict, Optional, Tuple
+
+#: per-task client class stamped onto outgoing ops by the Objecter —
+#: a gateway serving many tenants over ONE rados client sets this per
+#: request task (contextvars are task-local) instead of threading a
+#: parameter through every io call
+QOS_CLASS: ContextVar[str] = ContextVar("qos_class", default="")
+
+PHASE_NONE = 0          # forced dequeue (drain) / non-QoS queue
+PHASE_RESERVATION = 1   # served against the class's guaranteed rate
+PHASE_PROPORTIONAL = 2  # served from the weight-shared remainder
+
+
+@dataclass(frozen=True)
+class QosSpec:
+    reservation: float = 0.0    # ops/sec floor (0 = none)
+    weight: float = 1.0         # share of surplus capacity
+    limit: float = 0.0          # ops/sec ceiling (0 = uncapped)
+
+
+#: queue_op's internal tags fold into one background stream: recovery
+#: pushes, scrub scans and tier-agent passes compete as ONE class
+#: against client I/O (the mClockScheduler background_recovery /
+#: background_best_effort role, collapsed to a single knob here)
+CLASS_ALIASES = {"scrub": "background", "agent": "background",
+                 "recovery": "background"}
+
+DEFAULT_SPECS: Dict[str, QosSpec] = {
+    "client": QosSpec(reservation=40.0, weight=60.0, limit=0.0),
+    "background": QosSpec(reservation=8.0, weight=4.0, limit=0.0),
+    "default": QosSpec(reservation=0.0, weight=10.0, limit=0.0),
+}
+
+
+def parse_specs(text: str) -> Dict[str, QosSpec]:
+    """``"client:r=40,w=60,l=0;background:r=8,w=4,l=40"`` -> specs.
+    Unknown keys and malformed groups are ignored (config is operator
+    input); classes absent from the string keep the defaults."""
+    specs = dict(DEFAULT_SPECS)
+    for group in (text or "").split(";"):
+        group = group.strip()
+        if not group or ":" not in group:
+            continue
+        name, _, body = group.partition(":")
+        r = w = l = None
+        for kv in body.split(","):
+            k, _, v = kv.partition("=")
+            try:
+                val = float(v)
+            except ValueError:
+                continue
+            k = k.strip()
+            if k == "r":
+                r = val
+            elif k == "w":
+                w = val
+            elif k == "l":
+                l = val
+        base = specs.get(name.strip(), specs["default"])
+        specs[name.strip()] = QosSpec(
+            reservation=base.reservation if r is None else r,
+            weight=base.weight if w is None else w,
+            limit=base.limit if l is None else l)
+    return specs
+
+
+class _ClassRec:
+    """Tag state + backlog of one client class at one queue."""
+
+    __slots__ = ("spec", "items", "last_r", "last_p", "last_l",
+                 "r_shift", "served_res", "served_prop", "served_forced")
+
+    def __init__(self, spec: QosSpec):
+        self.spec = spec
+        #: (item, R, P, L) — R is None for reservation-less classes,
+        #: L is -inf for uncapped ones
+        self.items: Deque[Tuple[object, Optional[float], float, float]] \
+            = deque()
+        self.last_r: Optional[float] = None
+        self.last_p: Optional[float] = None
+        self.last_l: Optional[float] = None
+        #: reservation quanta already covered by proportional serves:
+        #: effective R tag = stored R - r_shift (lazy subtraction, so
+        #: a proportional serve is O(1) instead of rewriting the deque)
+        self.r_shift = 0.0
+        self.served_res = 0
+        self.served_prop = 0
+        self.served_forced = 0
+
+
+class DmClockQueue:
+    """dmClock per-class tag queue, WPQ-seam compatible."""
+
+    QOS = True
+
+    def __init__(self, specs: Optional[Dict[str, QosSpec]] = None,
+                 clock=None):
+        self.specs = dict(specs or DEFAULT_SPECS)
+        self._classes: Dict[str, _ClassRec] = {}
+        self._size = 0
+        self._event = asyncio.Event()
+        self._clock = clock
+
+    # ------------------------------------------------------------ helpers
+    def _now(self) -> float:
+        if self._clock is not None:
+            return self._clock()
+        try:
+            return asyncio.get_running_loop().time()
+        except RuntimeError:
+            return time.monotonic()
+
+    def _rec(self, klass: str) -> _ClassRec:
+        name = CLASS_ALIASES.get(klass, klass) or "client"
+        rec = self._classes.get(name)
+        if rec is None:
+            spec = self.specs.get(name) or self.specs.get("default") \
+                or QosSpec()
+            rec = self._classes[name] = _ClassRec(spec)
+        return rec
+
+    # ----------------------------------------------------------- enqueue
+    def put_nowait(self, item, klass: str = "client") -> None:
+        rec = self._rec(klass)
+        spec = rec.spec
+        now = self._now()
+        # dmClock feedback off the op envelope; plain items (scrub
+        # messages, agent callables, sub-ops) advance one quantum
+        delta = max(1, int(getattr(item, "qos_delta", 0) or 1))
+        rho = max(1, int(getattr(item, "qos_rho", 0) or 1))
+        if spec.reservation > 0.0:
+            if rec.last_r is None:
+                eff = now
+            else:
+                eff = max(now, (rec.last_r - rec.r_shift)
+                          + rho / spec.reservation)
+            r_tag: Optional[float] = eff + rec.r_shift
+            rec.last_r = r_tag
+        else:
+            r_tag = None
+        w = spec.weight if spec.weight > 0.0 else 1e-9
+        p_tag = now if rec.last_p is None \
+            else max(now, rec.last_p + delta / w)
+        rec.last_p = p_tag
+        if spec.limit > 0.0:
+            l_tag = now if rec.last_l is None \
+                else max(now, rec.last_l + delta / spec.limit)
+            rec.last_l = l_tag
+        else:
+            l_tag = float("-inf")
+        rec.items.append((item, r_tag, p_tag, l_tag))
+        self._size += 1
+        self._event.set()
+
+    # ----------------------------------------------------------- dequeue
+    def _select(self, now: float):
+        """(rec, phase) servable right now, or the absolute time the
+        earliest head becomes eligible, or None when empty."""
+        best_res = best_prop = None
+        wake: Optional[float] = None
+        for rec in self._classes.values():
+            if not rec.items:
+                continue
+            _item, r, p, l = rec.items[0]
+            eff_r = (r - rec.r_shift) if r is not None else None
+            if eff_r is not None and eff_r <= now:
+                if best_res is None or eff_r < best_res[1]:
+                    best_res = (rec, eff_r)
+            if l <= now:
+                if best_prop is None or p < best_prop[1]:
+                    best_prop = (rec, p)
+            horizon = min(x for x in (eff_r, l if l > now else None)
+                          if x is not None) \
+                if (eff_r is not None or l > now) else None
+            if horizon is not None:
+                wake = horizon if wake is None else min(wake, horizon)
+        if best_res is not None:
+            return best_res[0], PHASE_RESERVATION
+        if best_prop is not None:
+            return best_prop[0], PHASE_PROPORTIONAL
+        if self._size == 0:
+            return None
+        return wake if wake is not None else now
+
+    def _serve(self, rec: _ClassRec, phase: int):
+        item, _r, _p, _l = rec.items.popleft()
+        self._size -= 1
+        if phase == PHASE_RESERVATION:
+            rec.served_res += 1
+        elif phase == PHASE_PROPORTIONAL:
+            rec.served_prop += 1
+            if rec.spec.reservation > 0.0:
+                # a proportional serve also covers one reservation
+                # quantum: discount the class's outstanding R tags so
+                # weight-phase throughput counts toward the floor
+                rec.r_shift += 1.0 / rec.spec.reservation
+        else:
+            rec.served_forced += 1
+        try:
+            item._qos_phase = phase
+        except AttributeError:
+            pass   # plain callables without a __dict__
+        return item
+
+    def get_nowait(self):
+        """Forced dequeue: tag ORDER holds (smallest P tag) but rate
+        eligibility is ignored — this is the teardown-drain / test
+        path, never the scheduler's serve path."""
+        best = None
+        for rec in self._classes.values():
+            if rec.items and (best is None or rec.items[0][2] < best[1]):
+                best = (rec, rec.items[0][2])
+        if best is None:
+            raise asyncio.QueueEmpty
+        return self._serve(best[0], PHASE_NONE)
+
+    async def get(self):
+        while True:
+            sel = self._select(self._now())
+            if isinstance(sel, tuple):
+                return self._serve(*sel)
+            self._event.clear()
+            if sel is None:                       # empty: wait for a put
+                await self._event.wait()
+                continue
+            # backlog exists but every head is future-dated (limit or
+            # reservation horizon): sleep to the earliest tag, wake
+            # early on any new arrival
+            delay = max(0.0, sel - self._now())
+            try:
+                await asyncio.wait_for(self._event.wait(),
+                                       delay + 1e-4)
+            except asyncio.TimeoutError:
+                pass
+
+    # ------------------------------------------------------ introspection
+    def qsize(self) -> int:
+        return self._size
+
+    def empty(self) -> bool:
+        return self._size == 0
+
+    def counters(self) -> Dict[str, Dict[str, int]]:
+        """Per-class serve counts by phase (tests / perf scrape)."""
+        return {name: {"reservation": rec.served_res,
+                       "proportional": rec.served_prop,
+                       "forced": rec.served_forced,
+                       "queued": len(rec.items)}
+                for name, rec in self._classes.items()}
+
+
+class QosFeedback:
+    """Client half of dmClock: per-class completion counters feeding
+    (delta, rho) envelope stamps.  ``note_done`` is driven by the
+    qos_phase echo on MOSDOpReply; ``note_sent(klass, server)``
+    returns how many ops completed (total, reservation-phase) since
+    the previous send to that server — both at least 1, counting the
+    request itself, exactly the paper's delta/rho definition."""
+
+    def __init__(self):
+        self._total: Dict[str, int] = {}
+        self._res: Dict[str, int] = {}
+        self._last: Dict[Tuple[str, int], Tuple[int, int]] = {}
+
+    def note_sent(self, klass: str, server: int) -> Tuple[int, int]:
+        t = self._total.get(klass, 0)
+        r = self._res.get(klass, 0)
+        lt, lr = self._last.get((klass, server), (t, r))
+        self._last[(klass, server)] = (t, r)
+        return max(1, t - lt + 1), max(1, r - lr + 1)
+
+    def note_done(self, klass: str, phase: int) -> None:
+        self._total[klass] = self._total.get(klass, 0) + 1
+        if phase == PHASE_RESERVATION:
+            self._res[klass] = self._res.get(klass, 0) + 1

@@ -25,6 +25,11 @@ DEFAULT_WEIGHTS = {"client": 63, "scrub": 2, "agent": 2}
 
 
 class WeightedPriorityQueue:
+    #: static-weight queue: queue_op must NOT rewrite class tags for it
+    #: (osd_op_queue=wpq stays bit-for-bit the pre-QoS scheduler; the
+    #: dmClock queue in common/qos.py sets QOS = True)
+    QOS = False
+
     def __init__(self, weights: Optional[Dict[str, int]] = None):
         self.weights = dict(weights or DEFAULT_WEIGHTS)
         self._classes: Dict[str, deque] = {k: deque()

@@ -110,6 +110,20 @@ Each rule mechanically enforces one PR-landed write-path invariant
                            waiver required (``asyncio.sleep(0)`` — a
                            pure yield — is exempt).
 
+  QOS20 qos-class-tag    — every enqueue to a PG op queue
+                           (``*op_queue*.put_nowait(...)`` in osd/
+                           modules) must pass the QoS class explicitly
+                           (second positional argument or ``klass=``).
+                           The op-queue seam is scheduler-polymorphic
+                           (wpq | dmClock): an untagged put silently
+                           rides the "client" default, which under
+                           dmClock bills foreign work against the
+                           client class's reservation and under wpq
+                           jumps the weighted rotation.  ``queue_op``
+                           is the sanctioned tagging front door; a
+                           deliberate default-class put carries a
+                           waiver.
+
 Waivers: a site that is allowed to break a rule for a documented reason
 carries ``# lint: allow[RULE] reason`` on the same line or the line
 directly above.  Waivers are counted and reported; an undocumented
@@ -1246,6 +1260,35 @@ def check_retry19(fi: FileInfo) -> Iterator[Violation]:
     yield from out
 
 
+# ------------------------------------------------------------------ QOS20
+
+_QOS20_PREFIXES = ("osd/",)
+
+
+def check_qos20(fi: FileInfo) -> Iterator[Violation]:
+    if not fi.rel.startswith(_QOS20_PREFIXES):
+        return
+    for node in ast.walk(fi.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "put_nowait"):
+            continue
+        recv = _attr_text(node.func.value) or ""
+        if "op_queue" not in recv:
+            continue
+        tagged = len(node.args) >= 2 or \
+            any(kw.arg == "klass" for kw in node.keywords)
+        if tagged or fi.waived("QOS20", node.lineno):
+            continue
+        yield Violation(
+            "QOS20", fi.rel, node.lineno,
+            f"untagged enqueue {recv}.put_nowait(op): ops entering the "
+            f"PG op queue must carry an explicit QoS class (the seam "
+            f"is scheduler-polymorphic — an untagged put bills the "
+            f"'client' reservation under dmClock).  Route through "
+            f"queue_op, pass the class, or waive a deliberate default")
+
+
 # --------------------------------------------------------------- registry
 
 RULES: Dict[str, Tuple[str, Callable[[FileInfo], Iterator[Violation]]]] = {
@@ -1262,6 +1305,8 @@ RULES: Dict[str, Tuple[str, Callable[[FileInfo], Iterator[Violation]]]] = {
                 check_shard11),
     "RETRY19": ("op-path retry loops ride the shared jittered backoff",
                 check_retry19),
+    "QOS20": ("op-queue enqueues carry an explicit QoS class tag",
+              check_qos20),
 }
 
 def _seam_rule(rule_id: str):

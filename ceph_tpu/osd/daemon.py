@@ -675,6 +675,13 @@ class OSD(Dispatcher):
         self.messenger.send_message(msg, addr, peer_type="osd")
 
     def reply_to(self, req: Message, msg: Message) -> None:
+        # dmClock phase echo: the queue stamped which phase served the
+        # op (_qos_phase envelope attr); mirroring it onto the reply
+        # feeds the client's delta/rho counters.  One seam covers
+        # every MOSDOpReply construction site.
+        phase = getattr(req, "_qos_phase", 0)
+        if phase and isinstance(msg, MOSDOpReply):
+            msg.qos_phase = phase
         peer_type = req.src_name.type if req.src_name else None
         self.messenger.send_message(msg, req.src_addr, peer_type=peer_type)
 
@@ -891,9 +898,26 @@ class OSD(Dispatcher):
                     self._waiting_maps.append(m)
             return
         if isinstance(m, MPGPush):
+            from ceph_tpu.osd.pg import STATE_ACTIVE
             pg = self._pg_for(m.pgid)
             if pg is not None:
-                pg.on_push(m)
+                if pg._op_queue.QOS and pg.state == STATE_ACTIVE:
+                    # dmClock: recovery pushes are ADMITTED by the
+                    # background class's tags instead of running
+                    # straight off the pump — client reservations
+                    # hold during a recovery storm.  The push ACK
+                    # (MPGPushReply below) stays direct: it resolves
+                    # a future the primary's capped push window
+                    # already awaits.  Only while ACTIVE: a peering
+                    # PG's worker may be parked inline on a client op
+                    # waiting-for-active, and peering's own catch-up
+                    # pulls wait on these pushes — queueing one
+                    # behind the park would deadlock the PG (client
+                    # service is parked during peering anyway, so
+                    # there is nothing to arbitrate)
+                    pg.queue_op(m)
+                else:
+                    pg.on_push(m)
             return
         if isinstance(m, MPGPushReply):
             pg = self._pg_for_reply(

@@ -158,9 +158,14 @@ class MOSDOp(Message):
     snapid (0 = head), mirroring MOSDOp's snapc/snapid fields.  v3 adds
     the optional trace header (trace_id/span_id, 0 = untraced —
     common/tracer.py; blkin trace info role): old decoders skip it via
-    struct framing, old bytes decode as untraced."""
+    struct framing, old bytes decode as untraced.  v4 adds the dmClock
+    QoS envelope (common/qos.py): the client CLASS plus the delta/rho
+    distributed-feedback counters; old bytes decode as class '' (=
+    client, quantum 1).  Riding the payload means the tag survives
+    MOSDOpBatch packing and the process-lane IPC hop unchanged — both
+    re-encode/decode this frame verbatim."""
     TYPE = 200
-    STRUCT_V = 3
+    STRUCT_V = 4
     THROTTLE_DISPATCH = True     # client data ops bound OSD intake
 
     def __init__(self, pgid: Optional[PGId] = None, oid: str = "",
@@ -182,6 +187,9 @@ class MOSDOp(Message):
         self.snapid = snapid          # read target snap (0 = head)
         self.trace_id = 0             # tracer span context (0 = none)
         self.span_id = 0
+        self.qos_class = ""           # dmClock class ('' = client)
+        self.qos_delta = 1            # ops done anywhere since last
+        self.qos_rho = 1              # ...and reservation-phase subset
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid).string(self.oid).struct(self.loc)
@@ -191,6 +199,8 @@ class MOSDOp(Message):
         enc.list_(self.snaps, lambda e, v: e.u64(v))
         enc.u64(self.snapid)
         enc.u64(self.trace_id).u64(self.span_id)
+        enc.string(self.qos_class)
+        enc.u32(self.qos_delta).u32(self.qos_rho)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDOp":
@@ -204,6 +214,10 @@ class MOSDOp(Message):
         if struct_v >= 3:
             m.trace_id = dec.u64()
             m.span_id = dec.u64()
+        if struct_v >= 4:
+            m.qos_class = dec.string()
+            m.qos_delta = dec.u32()
+            m.qos_rho = dec.u32()
         return m
 
     def local_view(self) -> "MOSDOp":
@@ -215,6 +229,8 @@ class MOSDOp(Message):
                       self.map_epoch, self.reqid, self.snap_seq,
                       self.snaps, self.snapid)
         view.trace_id, view.span_id = self.trace_id, self.span_id
+        view.qos_class = self.qos_class
+        view.qos_delta, view.qos_rho = self.qos_delta, self.qos_rho
         # zero-encode local delivery carries the LIVE span: co-located
         # daemons cut stages on the client's span object directly
         view._span = self._span
@@ -227,9 +243,12 @@ class MOSDOp(Message):
 @register_message
 class MOSDOpReply(Message):
     """v2 adds the trace header mirrored back from the request, so a
-    wire client can correlate replies to its spans."""
+    wire client can correlate replies to its spans.  v3 adds the
+    dmClock phase echo (common/qos.py PHASE_*): which scheduler phase
+    served the op, feeding the client's delta/rho counters — old bytes
+    decode as phase 0 (untagged)."""
     TYPE = 201
-    STRUCT_V = 2
+    STRUCT_V = 3
 
     def __init__(self, tid: int = 0, result: int = 0,
                  ops: Optional[List[OSDOp]] = None, map_epoch: int = 0):
@@ -240,12 +259,14 @@ class MOSDOpReply(Message):
         self.map_epoch = map_epoch
         self.trace_id = 0
         self.span_id = 0
+        self.qos_phase = 0          # PHASE_NONE: no QoS queue on path
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u64(self.tid).s32(self.result)
         enc.list_(self.ops, lambda e, o: e.struct(o))
         enc.u32(self.map_epoch)
         enc.u64(self.trace_id).u64(self.span_id)
+        enc.u8(self.qos_phase)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDOpReply":
@@ -254,6 +275,8 @@ class MOSDOpReply(Message):
         if struct_v >= 2:
             m.trace_id = dec.u64()
             m.span_id = dec.u64()
+        if struct_v >= 3:
+            m.qos_phase = dec.u8()
         return m
 
     def local_cost(self) -> int:
@@ -1022,6 +1045,10 @@ class MOSDOpBatch(Message):
     encoded frames, so the inner format (and its versioning) is
     exactly MOSDOp's."""
     TYPE = 233
+    # v2: inner MOSDOp frames are v4 (QoS envelope).  The batch framing
+    # itself is unchanged — the bump tracks the inner format so the
+    # encoding corpus can tell a v1-era blob from a fresh one.
+    STRUCT_V = 2
     THROTTLE_DISPATCH = True     # client data ops bound OSD intake
     THROTTLE_SPLIT = True        # ...accounted PER INNER OP at unpack
 

@@ -66,6 +66,8 @@ STATE_ACTIVE = "active"
 class _FifoQueue(asyncio.Queue):
     """osd_op_queue=fifo: plain queue ignoring the class tag."""
 
+    QOS = False
+
     def put_nowait(self, item, klass: str = "client") -> None:
         super().put_nowait(item)
 
@@ -111,8 +113,17 @@ class PG:
         # op scheduler (osd_op_queue, config_opts.h:706): wpq arbitrates
         # client ops vs scrub vs tier-agent passes on the PG worker so
         # neither housekeeping class starves client latency nor a client
-        # flood starves housekeeping (WeightedPriorityQueue.h role)
-        if osd.cfg["osd_op_queue"] == "wpq":
+        # flood starves housekeeping (WeightedPriorityQueue.h role).
+        # mclock swaps in the dmClock tag queue (common/qos.py) at the
+        # SAME seam — the PG worker runs identically in inline, thread
+        # and process lanes, so one seam covers every lane mode; wpq
+        # stays bit-for-bit the pre-QoS queue (FAST_CFG determinism)
+        qname = osd.cfg["osd_op_queue"]
+        if qname == "mclock":
+            from ceph_tpu.common.qos import DmClockQueue, parse_specs
+            self._op_queue = DmClockQueue(
+                parse_specs(osd.cfg["osd_qos_specs"]))
+        elif qname == "wpq":
             from ceph_tpu.common.wpq import WeightedPriorityQueue
             self._op_queue = WeightedPriorityQueue()
         else:
@@ -1496,11 +1507,26 @@ class PG:
             await tiering.maybe_promote(self, m)
 
     def queue_op(self, m) -> None:
-        from ceph_tpu.osd.messages import MPGScrub, MPGScrubScan
+        from ceph_tpu.osd.messages import (MPGPush, MPGScrub,
+                                           MPGScrubScan)
         if callable(m):
             klass = "agent"
         elif isinstance(m, (MPGScrub, MPGScrubScan)):
             klass = "scrub"
+        elif isinstance(m, MPGPush):
+            # recovery admission rides the queue only under the QoS
+            # scheduler (daemon routes pushes here when QOS), where
+            # scrub/agent/recovery all fold into the 'background'
+            # dmClock class — one policy knob for the rebuild-rate vs
+            # client-p99 tradeoff.  osd_recovery_max_active stays the
+            # hard cap on the PRIMARY's push window (recovery_budget)
+            klass = "recovery"
+        elif self._op_queue.QOS and isinstance(m, MOSDOp) \
+                and m.qos_class:
+            # dmClock: the client class rides the MOSDOp envelope
+            # (wpq must never see these tags: an unknown class would
+            # auto-register at weight 1 and change wpq scheduling)
+            klass = m.qos_class
         else:
             # MOSDOp AND replica sub-ops: replica work carries the
             # client's priority (a deprioritized sub-op would stall the
@@ -1590,6 +1616,12 @@ class PG:
                         self._scrub_queued = False
                 elif isinstance(m, MPGScrubScan):
                     scrub_mod.handle_scrub_scan(self, m)
+                elif isinstance(m, MPGPush):
+                    # QoS-admitted recovery push (background class):
+                    # apply + ack exactly as the direct path — the
+                    # queue only decided WHEN it runs relative to
+                    # client work
+                    self.on_push(m)
                 else:
                     await self.backend.handle_sub_message(m)
             except asyncio.CancelledError:

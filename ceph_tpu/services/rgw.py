@@ -66,7 +66,34 @@ _USAGE_OWNER: contextvars.ContextVar = contextvars.ContextVar(
 
 
 def _index_oid(bucket: str) -> str:
+    """Legacy (unsharded) index object — buckets whose rec carries no
+    "index" layout keep the pre-shard oid bit-for-bit."""
     return f".bucket.index.{bucket}"
+
+
+def _shard_oids(bucket: str, layout: Optional[dict]) -> List[str]:
+    """Every index object of a bucket under `layout` (the bucket rec's
+    "index" dict: {"shards": N, "gen": G}; None = legacy single
+    object)."""
+    if not layout:
+        return [_index_oid(bucket)]
+    from ceph_tpu.cls.rgw import index_shard_oid
+    gen = int(layout.get("gen", 0))
+    return [index_shard_oid(bucket, gen, s)
+            for s in range(max(1, int(layout.get("shards", 1))))]
+
+
+def _owning_oid(bucket: str, key: str, layout: Optional[dict]) -> str:
+    """The index shard object that owns `key` (crc32 hash routing —
+    the reference's rgw_bucket_shard_index role): prepare and complete
+    of one op MUST target the same shard or the pending marker would
+    never clear."""
+    if not layout:
+        return _index_oid(bucket)
+    from ceph_tpu.cls.rgw import index_shard_oid, shard_of_key
+    return index_shard_oid(
+        bucket, int(layout.get("gen", 0)),
+        shard_of_key(key, max(1, int(layout.get("shards", 1)))))
 
 
 def _committed(idx: Dict[bytes, bytes]) -> Dict[bytes, bytes]:
@@ -76,16 +103,16 @@ def _committed(idx: Dict[bytes, bytes]) -> Dict[bytes, bytes]:
     return _entries(idx)
 
 
-async def _iter_index(io, bucket: str, prefix: str = "",
+async def _iter_shard(io, oid: str, prefix: str = "",
                       start: str = ""):
-    """Page the bucket index through the OSD-side cls bucket_list —
+    """Page ONE index object through the OSD-side cls bucket_list —
     bounded per call — yielding (key, entry) in key order.  `start`
     seeds the walk strictly-after that key (resume without re-reading
     every preceding page)."""
     marker = start
     while True:
         out = json.loads(await io.exec(
-            _index_oid(bucket), "rgw", "bucket_list",
+            oid, "rgw", "bucket_list",
             json.dumps({"marker": marker, "prefix": prefix}).encode()))
         for e in out["entries"]:
             yield e["key"], e["entry"]
@@ -306,11 +333,25 @@ class S3Gateway:
                  require_auth: bool = True, datalog: bool = False,
                  gc_min_wait: float = 0.0, gc_interval: float = 0.0,
                  lc_interval: float = 0.0,
-                 usage_interval: float = 0.0):
+                 usage_interval: float = 0.0,
+                 index_shards: Optional[int] = None):
         self.rados = rados
         self.io = rados.open_ioctx(pool)
         self.users = UserDB(self.io)
         self.require_auth = require_auth
+        # default index shard count for NEW buckets (rgw_override_
+        # bucket_index_max_shards role); existing buckets keep the
+        # layout recorded in their rec.  1 = legacy unsharded object.
+        if index_shards is None:
+            cfg = getattr(getattr(rados, "ctx", None), "config", None)
+            if cfg is not None:
+                index_shards = int(cfg["rgw_bucket_index_shards"])
+        self.index_shards = max(1, int(index_shards or 1))
+        # per-bucket layout cache for READ-path routing (per-object ops
+        # must not add a bucket-rec read each); every _bucket_rec read
+        # refreshes it, so the ACL/exists gate at request entry keeps
+        # it at most one request stale across a foreign reshard
+        self._layouts: Dict[str, Optional[dict]] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self.port = 0
         # deferred deletion of data chains (rgw_gc.cc role); workers
@@ -799,8 +840,8 @@ class S3Gateway:
             if not await self._bucket_exists(cont):
                 return 404, {}, b""
             rows = []
-            async for key, meta in _iter_index(self.io, cont,
-                                               q.get("prefix", "")):
+            async for key, meta in self._iter_index(
+                    cont, q.get("prefix", "")):
                 rows.append({"name": key, "bytes": meta["size"],
                              "hash": meta["etag"]})
             if q.get("format") == "json":
@@ -849,11 +890,73 @@ class S3Gateway:
         except ObjectOperationError:
             return None
         raw = got.get(bucket.encode())
-        return json.loads(raw.decode()) if raw else None
+        rec = json.loads(raw.decode()) if raw else None
+        # side effect: every rec read refreshes the index-layout cache,
+        # so the read path (routed off the cache) follows a reshard by
+        # the next request's ACL/exists gate
+        self._layouts[bucket] = (rec or {}).get("index")
+        return rec
+
+    async def _read_layout(self, bucket: str) -> Optional[dict]:
+        """Index layout for READ-path shard routing, cached per
+        gateway.  Writers resolve through the live rec instead — the
+        reshard copy window (503 gate) must be visible immediately,
+        not a cache-refresh later."""
+        if bucket in self._layouts:
+            return self._layouts[bucket]
+        rec = await self._bucket_rec(bucket)    # caches as side effect
+        return (rec or {}).get("index")
+
+    async def _iter_index(self, bucket: str, prefix: str = "",
+                          start: str = ""):
+        """Key-ordered (key, entry) walk of the whole bucket index:
+        per-shard cls bucket_list pagers (each shard is internally
+        sorted) k-way merged by head key, so the spread index still
+        serves ONE globally ordered listing (RGWRados::cls_bucket_list
+        shard-merge role)."""
+        import heapq
+        oids = _shard_oids(bucket, await self._read_layout(bucket))
+        if len(oids) == 1:
+            async for kv in _iter_shard(self.io, oids[0], prefix,
+                                        start):
+                yield kv
+            return
+        pagers = [_iter_shard(self.io, oid, prefix, start)
+                  for oid in oids]
+        heads = []
+        for i, it in enumerate(pagers):
+            try:
+                k, e = await it.__anext__()
+                heads.append((k, i, e))
+            except StopAsyncIteration:
+                pass
+        heapq.heapify(heads)
+        while heads:
+            k, i, e = heapq.heappop(heads)
+            yield k, e
+            try:
+                k2, e2 = await pagers[i].__anext__()
+                heapq.heappush(heads, (k2, i, e2))
+            except StopAsyncIteration:
+                pass
+
+    async def _index_snapshot(self, bucket: str) -> Dict[bytes, bytes]:
+        """Committed entries of every shard merged into one dict — the
+        full-scan path (lifecycle, multisite bootstrap), NOT the
+        request path."""
+        out: Dict[bytes, bytes] = {}
+        for oid in _shard_oids(bucket,
+                               await self._read_layout(bucket)):
+            try:
+                out.update(_committed(await self.io.omap_get(oid)))
+            except ObjectOperationError:
+                pass
+        return out
 
     async def _save_bucket_rec(self, bucket: str, rec: dict) -> None:
         await self.io.omap_set(BUCKETS_OID, {
             bucket.encode(): json.dumps(rec).encode()})
+        self._layouts[bucket] = rec.get("index")
 
     async def _bucket_usage(self, bucket: str) -> Tuple[int, int]:
         """(bytes, objects) from the cls-maintained index header — the
@@ -865,16 +968,25 @@ class S3Gateway:
         A MISSING header ("uninit") is a legacy (pre-cls) bucket whose
         entries predate the header: rebuild it in place once, so quota
         enforcement never runs against phantom zeros.  An initialized
-        empty bucket never re-triggers the probe."""
-        try:
-            hdr = json.loads(await self.io.exec(
-                _index_oid(bucket), "rgw", "bucket_read_header"))
-            if hdr.get("uninit"):
+        empty bucket never re-triggers the probe.
+
+        A sharded bucket's usage is the SUM of its shard headers —
+        each shard accounts its own keys atomically, so the sum is as
+        crash-consistent as the single header was."""
+        size = count = 0
+        for oid in _shard_oids(bucket,
+                               await self._read_layout(bucket)):
+            try:
                 hdr = json.loads(await self.io.exec(
-                    _index_oid(bucket), "rgw", "bucket_rebuild_index"))
-        except ObjectOperationError:
-            return 0, 0
-        return int(hdr.get("bytes", 0)), int(hdr.get("entries", 0))
+                    oid, "rgw", "bucket_read_header"))
+                if hdr.get("uninit"):
+                    hdr = json.loads(await self.io.exec(
+                        oid, "rgw", "bucket_rebuild_index"))
+            except ObjectOperationError:
+                continue
+            size += int(hdr.get("bytes", 0))
+            count += int(hdr.get("entries", 0))
+        return size, count
 
     async def _check_quota(self, bucket: str, add_size: int,
                            add_count: int) -> bool:
@@ -997,6 +1109,12 @@ class S3Gateway:
         canned = self._canned_from_headers(headers) or "private"
         if key:
             import errno as _errno
+            rec = await self._bucket_rec(bucket)
+            if rec is None:
+                return 404, {}, _xml_error("NoSuchBucket")
+            if rec.get("resharding"):
+                return 503, {"Retry-After": "1"}, _xml_error("SlowDown")
+            lay = rec.get("index")
             for _ in range(5):
                 meta = await self._obj_meta(bucket, key)
                 if meta is None:
@@ -1009,7 +1127,8 @@ class S3Gateway:
                     # our read and this write would otherwise be
                     # reverted to a stale (already gc-deferred) entry
                     await self.io.exec(
-                        _index_oid(bucket), "rgw", "bucket_complete_op",
+                        _owning_oid(bucket, key, lay), "rgw",
+                        "bucket_complete_op",
                         json.dumps({"op": "put", "key": key,
                                     "entry": meta,
                                     "observed": observed}).encode())
@@ -1097,18 +1216,16 @@ class S3Gateway:
             buckets = {}
         for braw, vraw in buckets.items():
             bucket = braw.decode()
-            rules = json.loads(vraw.decode()).get("lifecycle") or []
+            rec = json.loads(vraw.decode())
+            self._layouts[bucket] = rec.get("index")
+            rules = rec.get("lifecycle") or []
             if not rules:
                 continue
             exp_rules = [r for r in rules
                          if r.get("days") is not None
                          or r.get("date") is not None]
             if exp_rules:
-                try:
-                    idx = _committed(
-                        await self.io.omap_get(_index_oid(bucket)))
-                except ObjectOperationError:
-                    idx = {}
+                idx = await self._index_snapshot(bucket)
                 for kraw in sorted(idx):
                     key = kraw.decode()
                     meta = json.loads(idx[kraw].decode())
@@ -1158,38 +1275,197 @@ class S3Gateway:
         if await self._bucket_exists(bucket):
             return 409, {}, _xml_error("BucketAlreadyExists")
         rec = {"created": time.time(), "owner": owner}
+        if self.index_shards > 1:
+            # sharded from birth: keys hash across N index objects,
+            # each placed on its own PG by the normal pipeline
+            rec["index"] = {"shards": self.index_shards, "gen": 0}
         if acl:
             rec["acl"] = acl
-        await self.io.omap_set(BUCKETS_OID, {
-            bucket.encode(): json.dumps(rec).encode()})
-        try:
-            await self.io.exec(_index_oid(bucket), "rgw", "bucket_init")
-        except ObjectOperationError as e:
-            import errno as _errno
-            if e.retcode != -_errno.EEXIST:
-                raise               # only re-init of a live index is
-                #                     benign; real failures must surface
+        await self._save_bucket_rec(bucket, rec)
+        for oid in _shard_oids(bucket, rec.get("index")):
+            try:
+                await self.io.exec(oid, "rgw", "bucket_init")
+            except ObjectOperationError as e:
+                import errno as _errno
+                if e.retcode != -_errno.EEXIST:
+                    raise           # only re-init of a live index is
+                    #                 benign; real failures must surface
         await self._log_change("mkb", bucket)
         return 200, {}, b""
 
     async def _delete_bucket(self, bucket: str):
-        if not await self._bucket_exists(bucket):
+        rec = await self._bucket_rec(bucket)
+        if rec is None:
             return 404, {}, _xml_error("NoSuchBucket")
+        if rec.get("resharding"):
+            return 503, {"Retry-After": "1"}, _xml_error("SlowDown")
         # a bucket with committed entries OR in-flight ops (pending
-        # markers) is not empty: deleting under an in-flight PUT would
-        # let its complete_op resurrect a phantom entry in the orphaned
-        # index (reference: cls_rgw list includes pending dirents)
-        chk = json.loads(await self.io.exec(
-            _index_oid(bucket), "rgw", "bucket_check"))
-        if chk["actual"]["entries"] or chk["pending"]:
-            return 409, {}, _xml_error("BucketNotEmpty")
+        # markers) on ANY shard is not empty: deleting under an
+        # in-flight PUT would let its complete_op resurrect a phantom
+        # entry in the orphaned index (reference: cls_rgw list
+        # includes pending dirents)
+        oids = _shard_oids(bucket, rec.get("index"))
+        for oid in oids:
+            try:
+                chk = json.loads(await self.io.exec(
+                    oid, "rgw", "bucket_check"))
+            except ObjectOperationError:
+                continue            # missing shard object = empty
+            if chk["actual"]["entries"] or chk["pending"]:
+                return 409, {}, _xml_error("BucketNotEmpty")
         await self.io.omap_rm_keys(BUCKETS_OID, [bucket.encode()])
-        try:
-            await self.io.remove(_index_oid(bucket))
-        except ObjectOperationError:
-            pass
+        self._layouts.pop(bucket, None)
+        for oid in oids:
+            try:
+                await self.io.remove(oid)
+            except ObjectOperationError:
+                pass
         await self._log_change("rmb", bucket)
         return 204, {}, b""
+
+    # ------------------------------------------------------------- reshard
+    async def reshard_bucket(self, bucket: str,
+                             num_shards: int) -> Optional[dict]:
+        """Re-spread the bucket index across `num_shards` fresh
+        generation-(G+1) shard objects (rgw_reshard.cc role):
+
+          1. mark the rec `resharding`: every writer 503s (SlowDown)
+             for the copy window while READS keep serving the old
+             layout untouched,
+          2. init the new shards, then stream every old shard's
+             committed entries through cls bucket_install_entries
+             batches routed by the NEW key hash,
+          3. flip rec["index"] atomically and drop the old objects.
+
+        Pending markers are NOT carried: the write gate is closed, so
+        only a pre-reshard gateway crash can have left one, and that
+        op already lost its data race (the reference's resharding
+        drops them the same way — `bucket check --fix` beforehand
+        reconciles).  Returns the new layout + entry count, or None if
+        the bucket is missing or already mid-reshard."""
+        from ceph_tpu.cls.rgw import index_shard_oid, shard_of_key
+        num_shards = max(1, int(num_shards))
+        rec = await self._bucket_rec(bucket)
+        if rec is None or rec.get("resharding"):
+            return None
+        old_lay = rec.get("index")
+        new_gen = int(old_lay.get("gen", 0)) + 1 if old_lay else 0
+        new_lay = {"shards": num_shards, "gen": new_gen}
+        rec["resharding"] = new_lay
+        await self._save_bucket_rec(bucket, rec)
+        for s in range(num_shards):
+            try:
+                await self.io.exec(
+                    index_shard_oid(bucket, new_gen, s), "rgw",
+                    "bucket_init")
+            except ObjectOperationError as e:
+                import errno as _errno
+                if e.retcode != -_errno.EEXIST:
+                    raise
+        copied = 0
+        batches: Dict[int, dict] = {s: {} for s in range(num_shards)}
+
+        async def _flush(s: int) -> None:
+            if not batches[s]:
+                return
+            await self.io.exec(
+                index_shard_oid(bucket, new_gen, s), "rgw",
+                "bucket_install_entries",
+                json.dumps({"entries": batches[s]}).encode())
+            batches[s] = {}
+
+        for old_oid in _shard_oids(bucket, old_lay):
+            async for key, entry in _iter_shard(self.io, old_oid):
+                s = shard_of_key(key, num_shards)
+                batches[s][key] = entry
+                copied += 1
+                if len(batches[s]) >= 256:
+                    await _flush(s)
+        for s in range(num_shards):
+            await _flush(s)
+        # atomic flip: one rec write publishes the new layout and
+        # reopens the write gate together
+        rec = await self._bucket_rec(bucket) or rec
+        rec["index"] = new_lay
+        rec.pop("resharding", None)
+        await self._save_bucket_rec(bucket, rec)
+        for oid in _shard_oids(bucket, old_lay):
+            try:
+                await self.io.remove(oid)
+            except ObjectOperationError:
+                pass
+        return {"shards": num_shards, "gen": new_gen,
+                "entries": copied}
+
+    async def bucket_shard_stats(self, bucket: str) -> Optional[dict]:
+        """Per-shard index header stats + totals (radosgw-admin
+        `bucket stats` / `limit check` surface)."""
+        rec = await self._bucket_rec(bucket)
+        if rec is None:
+            return None
+        lay = rec.get("index")
+        per = []
+        total = {"entries": 0, "bytes": 0}
+        for oid in _shard_oids(bucket, lay):
+            try:
+                hdr = json.loads(await self.io.exec(
+                    oid, "rgw", "bucket_read_header"))
+            except ObjectOperationError:
+                hdr = {}
+            per.append({"oid": oid,
+                        "entries": int(hdr.get("entries", 0)),
+                        "bytes": int(hdr.get("bytes", 0))})
+            total["entries"] += int(hdr.get("entries", 0))
+            total["bytes"] += int(hdr.get("bytes", 0))
+        return {"bucket": bucket,
+                "shards": int(lay["shards"]) if lay else 1,
+                "gen": int(lay["gen"]) if lay else -1,
+                "resharding": bool(rec.get("resharding")),
+                "per_shard": per, **total}
+
+    async def bucket_check(self, bucket: str, fix: bool = False,
+                           min_age: float = 3600.0,
+                           now: Optional[float] = None
+                           ) -> Optional[dict]:
+        """`bucket check [--fix]` aggregated across every shard:
+        header-vs-actual plus stale pending markers per shard; --fix
+        expires markers older than min_age (a young marker may belong
+        to an op in flight RIGHT NOW) and rebuilds each header."""
+        rec = await self._bucket_rec(bucket)
+        if rec is None:
+            return None
+        now = time.time() if now is None else now
+        rep: dict = {"header": {"entries": 0, "bytes": 0},
+                     "actual": {"entries": 0, "bytes": 0},
+                     "pending": [], "shards": []}
+        expired: List[str] = []
+        for oid in _shard_oids(bucket, rec.get("index")):
+            try:
+                chk = json.loads(await self.io.exec(
+                    oid, "rgw", "bucket_check"))
+            except ObjectOperationError:
+                continue
+            if fix:
+                stale = [p["tag"] for p in chk["pending"]
+                         if p.get("ts", 0.0) <= now - min_age]
+                if stale:
+                    await self.io.exec(
+                        oid, "rgw", "dir_suggest_changes",
+                        json.dumps({"expire_tags": stale}).encode())
+                    expired.extend(stale)
+                chk["header"] = json.loads(await self.io.exec(
+                    oid, "rgw", "bucket_rebuild_index"))
+                chk["pending"] = [p for p in chk["pending"]
+                                  if p["tag"] not in stale]
+            for f in ("entries", "bytes"):
+                rep["header"][f] += int(chk["header"].get(f, 0))
+                rep["actual"][f] += int(chk["actual"].get(f, 0))
+            rep["pending"].extend(chk["pending"])
+            rep["shards"].append({"oid": oid, **chk["actual"]})
+        rep["pending"].sort(key=lambda p: p.get("ts", 0.0))
+        if fix:
+            rep["fixed"] = {"expired_tags": expired}
+        return rep
 
     async def _list_objects(self, bucket: str, query: str):
         """ListObjects v1 + v2 (rgw_rest_s3.cc RGWListBucket): prefix,
@@ -1244,8 +1520,8 @@ class S3Gateway:
         scanning = True
         while scanning:
             scanning = False
-            async for key, meta in _iter_index(self.io, bucket, prefix,
-                                               start=restart):
+            async for key, meta in self._iter_index(bucket, prefix,
+                                                    start=restart):
                 if after and key <= after:
                     continue
                 if delim:
@@ -1320,8 +1596,15 @@ class S3Gateway:
         if _bad_key(key):
             # the index's special namespace (cls_rgw pending markers)
             return 400, {}, _xml_error("InvalidArgument")
-        if not await self._bucket_exists(bucket):
+        rec = await self._bucket_rec(bucket)
+        if rec is None:
             return 404, {}, _xml_error("NoSuchBucket")
+        if rec.get("resharding"):
+            # reshard copy window (RGWRados::block_while_resharding):
+            # the entry would land in an index generation about to be
+            # dropped — S3 surfaces 503 SlowDown and clients retry
+            return 503, {"Retry-After": "1"}, _xml_error("SlowDown")
+        idx_oid = _owning_oid(bucket, key, rec.get("index"))
         old = await self._obj_meta(bucket, key)
         dsize = len(body) - (old["size"] if old else 0)
         if not await self._check_quota(bucket, max(0, dsize),
@@ -1339,7 +1622,7 @@ class S3Gateway:
         # atomically.  A crash in between leaves a tagged marker, never
         # a half-updated index.
         tag = f"{time.time_ns():x}"
-        await self.io.exec(_index_oid(bucket), "rgw", "bucket_prepare_op",
+        await self.io.exec(idx_oid, "rgw", "bucket_prepare_op",
                            json.dumps({"tag": tag, "op": "put",
                                        "key": key,
                                        "ts": time.time()}).encode())
@@ -1351,7 +1634,7 @@ class S3Gateway:
             # bucket deletion until an admin expires it
             try:
                 await self.io.exec(
-                    _index_oid(bucket), "rgw", "bucket_complete_op",
+                    idx_oid, "rgw", "bucket_complete_op",
                     json.dumps({"tag": tag, "op": "cancel",
                                 "key": key}).encode())
             except ObjectOperationError:
@@ -1363,7 +1646,7 @@ class S3Gateway:
         canned = self._canned_from_headers(headers)
         if canned:
             entry["acl"] = canned
-        await self.io.exec(_index_oid(bucket), "rgw", "bucket_complete_op",
+        await self.io.exec(idx_oid, "rgw", "bucket_complete_op",
                            json.dumps({"tag": tag, "op": "put", "key": key,
                                        "entry": entry}).encode())
         await self.gc.defer(self._chain_of(old, bucket, key))
@@ -1416,7 +1699,9 @@ class S3Gateway:
             # overwrite won the race meanwhile, the index skips it.
             try:
                 await self.io.exec(
-                    _index_oid(bucket), "rgw", "dir_suggest_changes",
+                    _owning_oid(bucket, key,
+                                await self._read_layout(bucket)),
+                    "rgw", "dir_suggest_changes",
                     json.dumps({"changes": [
                         {"op": "remove", "key": key,
                          "observed": {"etag": meta.get("etag"),
@@ -1469,6 +1754,10 @@ class S3Gateway:
                      "ETag": f'"{meta["etag"]}"'}, b""
 
     async def _delete_object(self, bucket: str, key: str):
+        rec = await self._bucket_rec(bucket)
+        if rec is not None and rec.get("resharding"):
+            return 503, {"Retry-After": "1"}, _xml_error("SlowDown")
+        idx_oid = _owning_oid(bucket, key, (rec or {}).get("index"))
         meta = await self._obj_meta(bucket, key)
         if meta is None:
             return 404, {}, _xml_error("NoSuchKey")
@@ -1476,7 +1765,7 @@ class S3Gateway:
         # the header stats honest); the bytes die later via the gc
         # queue (rgw_gc.cc send_chain on delete_obj)
         tag = f"{time.time_ns():x}"
-        await self.io.exec(_index_oid(bucket), "rgw", "bucket_prepare_op",
+        await self.io.exec(idx_oid, "rgw", "bucket_prepare_op",
                            json.dumps({"tag": tag, "op": "del",
                                        "key": key,
                                        "ts": time.time()}).encode())
@@ -1486,7 +1775,7 @@ class S3Gateway:
         # the meta WE read — if an overwrite landed since, its fresh
         # entry (and data) survive and the gc chain stays ours alone.
         out = json.loads(await self.io.exec(
-            _index_oid(bucket), "rgw", "bucket_complete_op",
+            idx_oid, "rgw", "bucket_complete_op",
             json.dumps({"tag": tag, "op": "del", "key": key,
                         "observed": {"etag": meta.get("etag"),
                                      "mtime": meta.get("mtime")},
@@ -1505,10 +1794,12 @@ class S3Gateway:
         if _bad_key(key):
             return None     # marker namespace is never object metadata
         try:
-            # single-key fetch: per-object ops must not ship the whole
-            # bucket index over the wire
-            idx = await self.io.omap_get(_index_oid(bucket),
-                                         keys=[key.encode()])
+            # single-key fetch on the OWNING shard: per-object ops
+            # must not ship the whole bucket index over the wire
+            idx = await self.io.omap_get(
+                _owning_oid(bucket, key,
+                            await self._read_layout(bucket)),
+                keys=[key.encode()])
         except ObjectOperationError:
             return None
         raw = idx.get(key.encode())
@@ -1680,12 +1971,17 @@ class S3Gateway:
             total += meta["size"]
             md5s += bytes.fromhex(meta["etag"])
         final_etag = f"{hashlib.md5(md5s).hexdigest()}-{len(want)}"
+        rec = await self._bucket_rec(bucket)
+        if rec is not None and rec.get("resharding"):
+            return 503, {"Retry-After": "1"}, _xml_error("SlowDown")
         old = await self._obj_meta(bucket, key)
         if not await self._check_quota(
                 bucket, max(0, total - (old["size"] if old else 0)),
                 0 if old else 1):
             return 403, {}, _xml_error("QuotaExceeded")
-        await self.io.exec(_index_oid(bucket), "rgw", "bucket_complete_op",
+        await self.io.exec(_owning_oid(bucket, key,
+                                       (rec or {}).get("index")),
+                           "rgw", "bucket_complete_op",
                            json.dumps({"op": "put", "key": key,
                                        "entry": {
                                            "size": total,

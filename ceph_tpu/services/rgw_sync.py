@@ -60,8 +60,7 @@ class ZoneSyncAgent:
             await jr.create()
         await jr.register_client(self.client_id)
         start_seq = await jr.tail_seq()
-        from ceph_tpu.services.rgw import (BUCKETS_OID, _committed,
-                                           _index_oid)
+        from ceph_tpu.services.rgw import BUCKETS_OID
         try:
             buckets = sorted(
                 k.decode()
@@ -71,7 +70,8 @@ class ZoneSyncAgent:
         for b in buckets:
             if not await self.dst._bucket_exists(b):
                 await self.dst._put_bucket(b)
-            idx = _committed(await self.src.io.omap_get(_index_oid(b)))
+            # shard-layout aware full scan (merged across shards)
+            idx = await self.src._index_snapshot(b)
             for k in sorted(idx):
                 await self._sync_object(b, k.decode())
         await jr.commit(self.client_id, start_seq)

@@ -86,30 +86,45 @@ async def run(args) -> int:
                 print(json.dumps({"trimmed": n}))
             return 0
         if args.cmd == "bucket":
-            from ceph_tpu.services.rgw import _index_oid
-            oid = _index_oid(args.bucket)
+            # shard-layout aware ops ride the gateway's routing (the
+            # bucket rec decides legacy vs N-shard generation oids)
+            gw = S3Gateway(r, pool=args.pool, require_auth=False)
             if args.op == "stats":
-                print((await io.exec(oid, "rgw",
-                                     "bucket_read_header")).decode())
+                rep = await gw.bucket_shard_stats(args.bucket)
+                if rep is None:
+                    print(json.dumps({"error": "NoSuchBucket"}))
+                    return 1
+                print(json.dumps({"entries": rep["entries"],
+                                  "bytes": rep["bytes"],
+                                  "shards": rep["shards"]}))
+                return 0
+            if args.op == "shard-stats":
+                rep = await gw.bucket_shard_stats(args.bucket)
+                if rep is None:
+                    print(json.dumps({"error": "NoSuchBucket"}))
+                    return 1
+                print(json.dumps(rep))
+                return 0
+            if args.op == "reshard":
+                out = await gw.reshard_bucket(args.bucket,
+                                              args.num_shards)
+                if out is None:
+                    print(json.dumps(
+                        {"error": "NoSuchBucket or reshard in "
+                                  "progress"}))
+                    return 1
+                print(json.dumps(out))
                 return 0
             # check [--fix]: header-vs-actual + stale pending markers
-            # (rgw_admin.cc bucket check / cls_rgw bucket_check role)
-            rep = json.loads(await io.exec(oid, "rgw", "bucket_check"))
-            if args.fix:
-                import time as _time
-                # only expire markers older than --min-age: a young
-                # marker may belong to an op in flight RIGHT NOW, and
-                # expiring it defeats crash reconciliation
-                cutoff = _time.time() - args.min_age
-                stale = [p["tag"] for p in rep["pending"]
-                         if p.get("ts", 0.0) <= cutoff]
-                if stale:
-                    await io.exec(oid, "rgw", "dir_suggest_changes",
-                                  json.dumps(
-                                      {"expire_tags": stale}).encode())
-                rep["header"] = json.loads(await io.exec(
-                    oid, "rgw", "bucket_rebuild_index"))
-                rep["fixed"] = {"expired_tags": stale}
+            # aggregated across every shard (rgw_admin.cc bucket
+            # check / cls_rgw bucket_check role).  --min-age guards
+            # young markers: one may belong to an op in flight RIGHT
+            # NOW, and expiring it defeats crash reconciliation.
+            rep = await gw.bucket_check(args.bucket, fix=args.fix,
+                                        min_age=args.min_age)
+            if rep is None:
+                print(json.dumps({"error": "NoSuchBucket"}))
+                return 1
             print(json.dumps(rep))
             return 0
         if args.cmd == "serve":
@@ -154,11 +169,14 @@ def main(argv=None) -> int:
     us.add_argument("--end-epoch", type=int, default=-1)
     us.add_argument("--before-epoch", type=int, default=0)
     b = sub.add_parser("bucket")
-    b.add_argument("op", choices=("stats", "check"))
+    b.add_argument("op", choices=("stats", "check", "reshard",
+                                  "shard-stats"))
     b.add_argument("--bucket", required=True)
     b.add_argument("--fix", action="store_true")
     b.add_argument("--min-age", type=float, default=3600.0,
                    help="only expire pending markers older than this")
+    b.add_argument("--num-shards", type=int, default=4,
+                   help="target shard count for `bucket reshard`")
     s = sub.add_parser("serve")
     s.add_argument("--port", type=int, default=7480)
     s.add_argument("--no-auth", action="store_true")

@@ -50,7 +50,7 @@ def test_cli_entry_point_runs_standalone():
     for rid in ("AF01", "FP02", "SEND03", "BLK04", "MONO05",
                 "LOCK06", "FIN07", "PROTO08", "REPLY09", "EPOCH10",
                 "SHARD11", "ESC12", "PORT13", "ATOM14", "SYNC15",
-                "JIT16", "XFER17", "STAGE18", "RETRY19"):
+                "JIT16", "XFER17", "STAGE18", "RETRY19", "QOS20"):
         assert rid in out.stdout
 
 
@@ -1167,6 +1167,52 @@ def test_retry19_waiver_on_sleep_line():
         "        await asyncio.sleep(0.2)\n"
     )
     assert lint_source(src, "osd/fixture.py", rule="RETRY19") == []
+
+
+def test_qos20_untagged_op_queue_put_trips():
+    """ISSUE 19: an op enqueued to a PG op queue without an explicit
+    class rides the 'client' default — under dmClock that bills
+    foreign work against the client reservation; violation.  The
+    tagged put (positional or klass=) passes."""
+    src = (
+        "def requeue(self, m):\n"
+        "    self._op_queue.put_nowait(m)\n"
+    )
+    vio = lint_source(src, "osd/fixture.py", rule="QOS20")
+    assert [v.rule for v in vio] == ["QOS20"], vio
+    assert "QoS class" in vio[0].msg
+    tagged = (
+        "def requeue(self, m):\n"
+        "    self._op_queue.put_nowait(m, \"background\")\n"
+    )
+    assert lint_source(tagged, "osd/fixture.py", rule="QOS20") == []
+    kw = (
+        "def requeue(self, m):\n"
+        "    self.pg._op_queue.put_nowait(m, klass=\"scrub\")\n"
+    )
+    assert lint_source(kw, "osd/fixture.py", rule="QOS20") == []
+
+
+def test_qos20_scope_and_waiver():
+    """Only op-queue receivers in osd/ are in scope: plain asyncio
+    queues and non-osd modules pass untagged; a documented
+    default-class put passes with the waiver."""
+    plain_queue = (
+        "def hand_off(self, m):\n"
+        "    self._ring.put_nowait(m)\n"
+    )
+    assert lint_source(plain_queue, "osd/fixture.py", rule="QOS20") == []
+    outside = (
+        "def requeue(self, m):\n"
+        "    self._op_queue.put_nowait(m)\n"
+    )
+    assert lint_source(outside, "client/fixture.py", rule="QOS20") == []
+    waived = (
+        "def requeue(self, m):\n"
+        "    # lint: allow[QOS20] fixture: deliberate default class\n"
+        "    self._op_queue.put_nowait(m)\n"
+    )
+    assert lint_source(waived, "osd/fixture.py", rule="QOS20") == []
 
 
 # ================================ 2e. waiver audit + lint performance

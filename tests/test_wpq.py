@@ -65,6 +65,42 @@ def test_weight_shares_between_busy_classes():
     asyncio.run(run())
 
 
+def test_credit_rotation_is_deterministic():
+    """Exact credit-rotation order: spend weight[k] credits on class k,
+    then rotate; empty classes forfeit their turn.  This trace is part
+    of the qos=off contract — FAST_CFG determinism (and the seeded
+    schedule explorer) ride on wpq serving bit-for-bit this order."""
+    q = WeightedPriorityQueue({"client": 2, "scrub": 1})
+    for i in range(4):
+        q.put_nowait(("c", i), "client")
+    for i in range(2):
+        q.put_nowait(("s", i), "scrub")
+    got = [q.get_nowait() for _ in range(6)]
+    assert got == [("c", 0), ("c", 1), ("s", 0), ("c", 2), ("c", 3),
+                   ("s", 1)]
+
+
+def test_unknown_class_auto_registers_weight_one():
+    """A class outside the configured weights (e.g. 'recovery' on the
+    default map) joins the rotation at weight 1 instead of being
+    dropped or starving."""
+    q = WeightedPriorityQueue({"client": 4})
+    q.put_nowait("r", "recovery")          # not pre-registered
+    assert q.weights["recovery"] == 1
+    for i in range(8):
+        q.put_nowait(("c", i), "client")
+    got = [q.get_nowait() for _ in range(6)]
+    assert "r" in got                      # one credit per cycle
+    assert q.qsize() == 3
+
+
+def test_qos_seam_flag_is_off():
+    """queue_op keys class-tag rewrites off the queue's QOS attr: wpq
+    must never see envelope classes (an unknown class would register
+    at weight 1 and change the deterministic rotation above)."""
+    assert WeightedPriorityQueue.QOS is False
+
+
 def test_async_consumer_wakes_on_put():
     async def run():
         q = WeightedPriorityQueue()
