@@ -398,7 +398,7 @@ def stage_ec_e2e():
     N_OBJS, OBJ_SIZE, CONC = 192, 64 * 1024, 16
 
     def ctx_factory(batch_mode, shards=4, op_batching=True,
-                    lanes=None):
+                    lanes=None, ext_min=None):
         def f(name):
             c = make_ctx(name)
             c.config.set("osd_ec_batch_device", batch_mode)
@@ -406,6 +406,11 @@ def stage_ec_e2e():
                 # lane-backend axis (ISSUE 13): inline | thread |
                 # process shard lanes, same run, same workload
                 c.config.set("osd_shard_lanes", lanes)
+            if ext_min is not None:
+                # payload-sweep axis (ISSUE 20): 0 disables the
+                # shared-memory extent path (everything rides the
+                # ring inline — the pre-zero-copy transport)
+                c.config.set("osd_lane_extent_min_bytes", ext_min)
             # co-located daemons skip TCP framing/crc/acks entirely
             # (messenger local fast path) — the bench cluster is one
             # process, so per-message socket round trips are pure
@@ -428,11 +433,14 @@ def stage_ec_e2e():
         return f
 
     async def run_once(batch_mode, iodepth=CONC, pg_num=8, shards=4,
-                       op_batching=True, lanes=None):
+                       op_batching=True, lanes=None,
+                       n_objs=N_OBJS, obj_size=OBJ_SIZE,
+                       ext_min=None):
         from ceph_tpu.msg import payload as payload_mod
         payload_mod.reset_counters()
         cl = Cluster(ctx_factory=ctx_factory(batch_mode, shards,
-                                             op_batching, lanes))
+                                             op_batching, lanes,
+                                             ext_min))
         admin = await cl.start(5)
         # pg_num 8 for the HEADLINE on/off runs (comparable with the
         # r1-r5 recorded series); the op-window axis runs pg_num 4 so
@@ -441,7 +449,7 @@ def stage_ec_e2e():
         await admin.pool_create("bpool", pg_num=pg_num,
                                 pool_type="erasure", k=2, m=2)
         io = admin.open_ioctx("bpool")
-        data = bytes(range(256)) * (OBJ_SIZE // 256)
+        data = bytes(range(256)) * (obj_size // 256)
         lats = []
         sem = asyncio.Semaphore(iodepth)
 
@@ -452,7 +460,7 @@ def stage_ec_e2e():
                 lats.append(time.perf_counter() - t0)
 
         t0 = time.perf_counter()
-        await asyncio.gather(*[one(i) for i in range(N_OBJS)])
+        await asyncio.gather(*[one(i) for i in range(n_objs)])
         wall = time.perf_counter() - t0
         dev = host = 0
         # store group-commit counters (read BEFORE stop: umount drops
@@ -481,6 +489,48 @@ def stage_ec_e2e():
         # plane, FRAME_RPC), or the lane-side pipeline would read as
         # one unattributed hole
         await cl.refresh_lane_metrics()
+        # zero-copy transport evidence (ISSUE 20): parent-side lane
+        # counters (cork ratio, fastpath forwards, tx-pool extents)
+        # plus each worker's view over the id-keyed RPC plane
+        transport = {"corked_frames": 0, "cork_pushes": 0,
+                     "fastpath_fwd": 0, "acks_sent": 0,
+                     "acks_coalesced": 0, "ack_batches": 0,
+                     "ext_allocs": 0, "ext_frees": 0, "ext_swept": 0,
+                     "ext_alloc_full": 0}
+        for osd in cl.osds.values():
+            sc = osd.shards.counters()
+            for k in ("acks_sent", "acks_coalesced", "ack_batches"):
+                transport[k] += int(osd.perf_repack.dump().get(k, 0))
+            for ek, v in (sc.get("extents") or {}).items():
+                k = ek if ek in transport else None
+                if k:
+                    transport[k] += int(v)
+            for ln in (sc.get("lanes") or {}).values():
+                for k in ("corked_frames", "cork_pushes",
+                          "fastpath_fwd"):
+                    transport[k] += int(ln.get(k, 0))
+            if osd.shards.process_lanes is not None:
+                for lane in osd.shards.process_lanes:
+                    if lane.dead:
+                        continue
+                    try:
+                        lt = await lane.admin_rpc(
+                            {"prefix": "lane_transport"})
+                    except Exception:
+                        continue
+                    for k in ("corked_frames", "cork_pushes"):
+                        transport[k] += int(
+                            (lt.get("cork") or {}).get(k, 0))
+                    for k in ("acks_sent", "acks_coalesced",
+                              "ack_batches"):
+                        transport[k] += int(
+                            (lt.get("acks") or {}).get(k, 0))
+                    for ek, v in (lt.get("extents") or {}).items():
+                        if ek in transport:
+                            transport[ek] += int(v)
+        transport["frames_per_push"] = round(
+            transport["corked_frames"] / transport["cork_pushes"], 2) \
+            if transport["cork_pushes"] else 0.0
         bd = cl.stage_breakdown(measured_e2e_s=sum(lats))
         # lazy-payload guard: with ms_local_delivery on, in-process hops
         # must not serialize message bodies at all (read BEFORE stop)
@@ -536,7 +586,9 @@ def stage_ec_e2e():
             "mean_inflight_depth": round(win["mean_inflight_depth"], 2),
             "max_inflight_depth": win["max_inflight_depth"],
             "ops_admitted": win["ops_admitted"],
-            "mb_s": round(N_OBJS * OBJ_SIZE / wall / 1e6, 1),
+            "obj_size": obj_size,
+            "lane_transport": transport,
+            "mb_s": round(n_objs * obj_size / wall / 1e6, 1),
             "p50_ms": round(lats[len(lats) // 2] * 1e3, 2),
             "p99_ms": round(lats[int(len(lats) * 0.99) - 1] * 1e3, 2),
             "device_bytes": dev, "host_bytes": host,
@@ -833,7 +885,33 @@ def stage_ec_e2e():
         base = lane_axis["inline"]["mb_s"] or 1.0
         for k, r in lane_axis.items():
             r["vs_inline"] = round(r["mb_s"] / base, 3)
+    # payload-size sweep (ISSUE 20, zero-copy lane transport): the
+    # lane_codec claim is that with shared-memory extents on, ring
+    # codec cost stays FLAT with object size (the data bytes cross as
+    # a 16-ish-byte handle; the one copy moves to extent_write/read).
+    # 4 KB (under threshold: inline either way) vs 256 KB with
+    # extents on vs 256 KB with extents off (the pre-zero-copy ring).
+    payload_sweep = {}
+    for label, osize, emin in (("4k", 4 * 1024, None),
+                               ("256k", 256 * 1024, None),
+                               ("256k_inline", 256 * 1024, 0)):
+        if remaining() < 60:
+            log(f"ec_e2e payload sweep: skipping {label} (budget)")
+            break
+        r = asyncio.run(run_once("off", iodepth=16, pg_num=4,
+                                 shards=4, lanes="process",
+                                 n_objs=96, obj_size=osize,
+                                 ext_min=emin))
+        payload_sweep[label] = r
+        lc = (r.get("stage_p50_p99_ms") or {}).get("lane_codec") or [0, 0]
+        tr = r.get("lane_transport") or {}
+        log(f"ec_e2e lanes payload {label}: {r['mb_s']} MB/s "
+            f"p50={r['p50_ms']} lane_codec_p50={lc[0]}ms "
+            f"frames/push={tr.get('frames_per_push')} "
+            f"acks_coalesced={tr.get('acks_coalesced')} "
+            f"ext_allocs={tr.get('ext_allocs')}")
     return {"on": on, "off": off,
+            "ec_e2e_lane_payload_sweep": payload_sweep,
             "window_iodepth16": win16, "window_iodepth1": win1,
             "shards4": sh4, "shards1": sh1, "reads": reads,
             "recovery": recovery,
@@ -1521,6 +1599,35 @@ def main():
                         "stage_p50_p99_ms": r.get(
                             "stage_p50_p99_ms", {}),
                     } for mode, r in lanes.items()},
+                # ISSUE 20 zero-copy row: lane_codec p50 per payload
+                # size (flat-with-size is the extent claim), corked
+                # frames per ring push, replica-ack coalescing
+                "payload_sweep": {
+                    label: {
+                        "obj_size": r.get("obj_size", 0),
+                        "mb_s": r.get("mb_s", 0.0),
+                        "p50_ms": r.get("p50_ms", 0.0),
+                        "p99_ms": r.get("p99_ms", 0.0),
+                        "lane_codec_p50_ms": ((r.get(
+                            "stage_p50_p99_ms") or {}).get(
+                            "lane_codec") or [0.0, 0.0])[0],
+                        "frames_per_push": (r.get(
+                            "lane_transport") or {}).get(
+                            "frames_per_push", 0.0),
+                        "acks_coalesced": (r.get(
+                            "lane_transport") or {}).get(
+                            "acks_coalesced", 0),
+                        "ext_allocs": (r.get(
+                            "lane_transport") or {}).get(
+                            "ext_allocs", 0),
+                        "ext_frees": (r.get(
+                            "lane_transport") or {}).get(
+                            "ext_frees", 0),
+                        "fastpath_fwd": (r.get(
+                            "lane_transport") or {}).get(
+                            "fastpath_fwd", 0),
+                    } for label, r in (e2e.get(
+                        "ec_e2e_lane_payload_sweep") or {}).items()},
             })
     if burst:
         # ISSUE 19 fairness row.  value = interactive p99 on the

@@ -262,6 +262,12 @@ DEFAULT_OPTIONS: List[Option] = [
     Option("mon_data", "str", "", "monitor store path"),
     Option("mon_paxos_batch_interval", "float", 0.05,
            "pending-proposal batching window (PaxosService)"),
+    Option("paxos_propose_interval", "float", 1.0,
+           "up_thru grant batching window after a down-mark "
+           "(OSDMonitor::prepare_alive riding Paxos batching): a grant "
+           "held across this window is dropped if its requester dies, "
+           "so a doomed solo survivor's interval is never branded "
+           "maybe_went_rw"),
     Option("osd_heartbeat_interval", "float", 1.0, "osd/OSD.cc:4223"),
     Option("osd_heartbeat_grace", "float", 6.0, "mark-down grace"),
     Option("osd_pool_default_size", "int", 3, "replica count"),
@@ -292,6 +298,23 @@ DEFAULT_OPTIONS: List[Option] = [
            "per-direction shared-memory ring capacity for process "
            "lanes (osd/laneipc.py); the ring bound IS the handoff "
            "backpressure"),
+    Option("osd_lane_extent_min_bytes", "size", "32k",
+           "object-data payloads at or above this ride the lane "
+           "transport as shared-memory extents (one copy + a tiny "
+           "handle on the ring) instead of inline wire bytes "
+           "(osd/extents.py); 0 disables extents entirely"),
+    Option("osd_lane_extent_pool_bytes", "size", "4m",
+           "per-direction extent-pool arena per process lane; a full "
+           "pool falls back to inline bytes (counted ext_alloc_full), "
+           "it never blocks — backpressure belongs to the ring"),
+    Option("osd_lane_cork", "bool", True,
+           "cork every lane-bound frame queued in one loop pass into "
+           "ONE ring frame (FRAME_BURST): one push, one wakeup, one "
+           "drain per burst instead of per message"),
+    Option("osd_rep_ack_coalesce", "bool", True,
+           "coalesce replica commit acks per target OSD per drained "
+           "commit burst into one MOSDRepAckBatch frame (the burst "
+           "boundary is the store's batched completion callback)"),
     Option("osd_shard_threads", "bool", True,
            "run each shard's event loop on its own thread "
            "(msgr-worker split).  Forced off under the deterministic "

@@ -99,8 +99,14 @@ QUEUE_WAIT_CAUSES = (
 #: queue / mesh plane) overlap client chain stages — recovery runs
 #: CONCURRENTLY with the op path, so they must never join the chain
 #: sum.
+#: extent_write / extent_read are the zero-copy lane transport's two
+#: real payload copies (publish into / materialize out of a shared-
+#: memory extent pool, osd/extents.py): they are exactly the bytes
+#: REMOVED from lane_codec, so the pair next to a flat lane_codec is
+#: the evidence the copy moved rather than vanished.
 AUX_STAGES = ("op_total", "repl_apply", "repl_commit",
-              "recovery_pull", "decode_rebuild")
+              "recovery_pull", "decode_rebuild",
+              "extent_write", "extent_read")
 
 STAGE_GROUP = "op_stages"
 

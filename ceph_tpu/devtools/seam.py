@@ -80,8 +80,9 @@ SEAM_SCHEMA = 1
 #: handoff ring, the daemon intake surface, the messenger marshalling
 #: layer, the lazy-payload counters, the commit-thread staging
 SEAM_MODULES = ("osd/shards.py", "osd/daemon.py", "osd/lanes.py",
-                "osd/laneipc.py", "msg/messenger.py",
-                "msg/payload.py", "store/commit.py")
+                "osd/laneipc.py", "osd/extents.py",
+                "msg/messenger.py", "msg/payload.py",
+                "store/commit.py")
 
 #: call-graph / reachability scope (PROTO08-grade name resolution is
 #: only meaningful inside the data plane's own packages; the client
@@ -374,11 +375,21 @@ CLS_HOME_BOUND = "home-bound"      # bound method of the target lane's
 CLS_FORWARDED = "forwarded"        # seam plumbing re-forwarding its
 #                                    already-classified payload
 CLS_FUTURE = "target-future"       # future owned by the target loop
+CLS_EXTENT = "extent-handle"       # (pool, gen, off, len) shared-
+#                                    memory extent handle: a named
+#                                    segment plus scalars, portable by
+#                                    construction (osd/extents.py; the
+#                                    wire carries it as the
+#                                    EXTENT_MARK form of data_bytes_)
 CLS_CLOSURE = "closure"            # lambda / nested def: VIOLATION
 CLS_LIVE = "live-ref"              # live shared object as data: VIOLATION
+CLS_RAW_BYTES = "raw-bytes"        # bulk payload bytes as seam DATA:
+#                                    VIOLATION — an over-threshold
+#                                    payload must publish ONCE to an
+#                                    extent pool and cross as a handle
 CLS_OPAQUE = "opaque"              # unclassifiable: VIOLATION
 
-_VIOLATING = {CLS_CLOSURE, CLS_LIVE, CLS_OPAQUE}
+_VIOLATING = {CLS_CLOSURE, CLS_LIVE, CLS_OPAQUE, CLS_RAW_BYTES}
 
 _PRIMITIVE_NAMES = {
     "pgid", "pool_id", "pool", "epoch", "key", "cost", "seq", "idx",
@@ -400,6 +411,13 @@ _WIRE_NAMES = {
     "osdmap", "addr", "info", "entry", "txn",
 }
 _FUTURE_NAMES = {"fut", "future"}
+#: extent-handle conventions (osd/extents.py Handle / ExtentRef): the
+#: zero-copy replacement for raw payload bytes on the seam
+_EXTENT_NAMES = {"handle", "handles", "ext_handle", "extent",
+                 "extent_handle"}
+#: bulk payload buffer conventions: crossing a seam INLINE is the
+#: raw-bytes-over-threshold escape the extent pool exists to close
+_RAW_BYTES_NAMES = {"data", "payload", "payloads", "blob", "raw"}
 _LIVE_NAMES = {"pg", "conn", "loop", "task", "store", "shard",
                "writer", "reader", "gate", "q", "osd", "backend"}
 #: constructor calls whose result has a wire form
@@ -530,6 +548,10 @@ class _FnEnv:
             return CLS_WIRE
         if name in _FUTURE_NAMES:
             return CLS_FUTURE
+        if name in _EXTENT_NAMES:
+            return CLS_EXTENT
+        if name in _RAW_BYTES_NAMES:
+            return CLS_RAW_BYTES
         if name in _LIVE_NAMES:
             return CLS_LIVE
         if name in ("fn", "cb", "callback", "post", "on_commit"):
@@ -574,6 +596,8 @@ class _FnEnv:
             fname = _callee_name(node)
             if fname in _WIRE_CALLS:
                 return CLS_WIRE
+            if fname == "make_ref":
+                return CLS_EXTENT
             if fname in _PORTABLE_CALLS:
                 return CLS_PRIMITIVE
             if fname in _LIVE_SOURCES:
@@ -864,6 +888,14 @@ class SeamAnalysis:
                     f"are processes the sender cannot hold it — pass "
                     f"the routing key (pgid) and re-resolve on the "
                     f"home lane")
+        if cls == CLS_RAW_BYTES:
+            return (f"{role} {src!r} crossing the {kind} seam is a "
+                    f"raw payload byte buffer: copying an over-"
+                    f"threshold payload inline through the seam "
+                    f"defeats the zero-copy transport — publish it "
+                    f"once to a shared-memory extent pool "
+                    f"(data_bytes_/ExtentSink, osd/extents.py) and "
+                    f"pass the (pool, gen, off, len) handle instead")
         return (f"{role} {src!r} crossing the {kind} seam is not "
                 f"classifiable as portable (frozen payload with wire "
                 f"fallback, allowlisted primitive, or home-bound "
@@ -1146,6 +1178,11 @@ class SeamAnalysis:
             "sites": sites,
             "gil_atomic_regions": regions,
             "shared_state": shared,
+            "value_classes": {
+                "portable": [CLS_PRIMITIVE, CLS_WIRE, CLS_HOME_BOUND,
+                             CLS_FORWARDED, CLS_FUTURE, CLS_EXTENT],
+                "violating": sorted(_VIOLATING),
+            },
             "summary": {
                 "sites": len(sites),
                 "values": sum(len(s["values"]) for s in sites),

@@ -39,6 +39,12 @@ class OSDMonitor(PaxosService):
         # failure tracking: target osd -> {reporter osd: monotonic stamp}
         self.failure_reports: Dict[int, Dict[int, float]] = {}
         self.down_stamp: Dict[int, float] = {}
+        # up_thru grants held for a propose window after a down-mark
+        # (prepare_alive); folded into the next proposal, dropped if
+        # the requester dies while held
+        self._held_alive: Dict[int, float] = {}
+        self._alive_flush = None
+        self._last_down_mark = 0.0
         # absolute flag word most recently PROPOSED but possibly not
         # yet committed — the read-modify-write base for a second `osd
         # set` arriving in that window (pending_inc resets on propose,
@@ -115,6 +121,7 @@ class OSDMonitor(PaxosService):
         return True
 
     def propose_pending(self, done=None) -> None:
+        self._fold_held_alive()
         txn = KVTransaction()
         try:
             ok = self.encode_pending(txn)
@@ -241,14 +248,64 @@ class OSDMonitor(PaxosService):
                 self.pending_inc.new_state.get(target, 0) | OSD_UP
             self.failure_reports.pop(target, None)
             self.down_stamp[target] = time.monotonic()
+            self._last_down_mark = time.monotonic()
             self.propose_pending()
 
     def prepare_alive(self, m: MOSDAlive) -> None:
         if not self.osdmap.exists(m.osd_id):
             return   # stray daemon: a bad id would poison the incremental
+        # An up_thru grant asserts "this osd could serve its interval",
+        # which is exactly what a later PriorSet walk reads back as
+        # maybe_went_rw.  In steady state grant immediately; but inside
+        # the propose window after a down-mark, HOLD the grant (real
+        # mons batch proposals across paxos_propose_interval): the
+        # requester is typically the failure's new solo primary, and if
+        # it dies before the window closes the grant is dropped — its
+        # never-activated interval must not be branded rw, or a
+        # restarted partner would block on the corpse forever
+        if time.monotonic() - self._last_down_mark \
+                < self.mon.cfg["paxos_propose_interval"]:
+            self._held_alive[m.osd_id] = time.monotonic()
+            self._arm_alive_flush(self.mon.cfg["paxos_propose_interval"])
+            return
         # grant up_thru = the pending epoch (>= the osd's want_epoch)
         self.pending_inc.new_up_thru[m.osd_id] = self.pending_inc.epoch
         self.propose_pending()
+
+    def _arm_alive_flush(self, delay: float) -> None:
+        if self._alive_flush is None:
+            import asyncio
+            self._alive_flush = asyncio.get_running_loop().call_later(
+                delay, self._flush_alive)
+
+    def _flush_alive(self) -> None:
+        self._alive_flush = None
+        if not self._held_alive:
+            return
+        if not (self.mon.running and self.mon.is_leader()
+                and self.mon.paxos.is_writeable()):
+            self._arm_alive_flush(0.25)   # grants ride out an election
+            return
+        self.propose_pending()
+
+    def _fold_held_alive(self) -> None:
+        """Move held up_thru grants into the pending incremental: every
+        proposal carries them (one paxos transaction per epoch).  A
+        grant whose requester went down while held is DROPPED — up_thru
+        is a liveness assertion, and committing it posthumously would
+        poison maybe_went_rw for intervals that never activated."""
+        if not self._held_alive:
+            return
+        inc = self.pending_inc
+        for osd in list(self._held_alive):
+            down_in_inc = bool(inc.new_state.get(osd, 0) & OSD_UP)
+            if self.osdmap.is_up(osd) and not down_in_inc:
+                inc.new_up_thru[osd] = inc.epoch
+            else:
+                self.log.warning(
+                    f"dropping held up_thru grant for osd.{osd}: "
+                    f"requester went down before the grant committed")
+            del self._held_alive[osd]
 
     def prepare_pgtemp(self, m: MPGTemp) -> None:
         changed = False
@@ -322,6 +379,8 @@ class OSDMonitor(PaxosService):
                     (self.pending_inc.new_state.get(osd, 0) & OSD_UP):
                 self.pending_inc.new_state[osd] = \
                     self.pending_inc.new_state.get(osd, 0) | OSD_UP
+                self.down_stamp[osd] = time.monotonic()
+                self._last_down_mark = time.monotonic()
             self._propose_and_ack(m)
         elif prefix in ("osd set", "osd unset"):
             # cluster flags: `osd set noout|noscrub|nodeep-scrub`

@@ -104,11 +104,15 @@ class LazyPayload:
         the type provides one, decode-from-bytes otherwise.
     """
 
-    __slots__ = ("_obj", "_raw")
+    __slots__ = ("_obj", "_raw", "_ext")
 
-    def __init__(self, obj=None, raw: Optional[bytes] = None):
+    def __init__(self, obj=None, raw: Optional[bytes] = None, ext=None):
         self._obj = obj
         self._raw = raw
+        #: shared-memory extent backing (osd/extents.ExtentRef) — the
+        #: lane-transport zero-copy source: bytes materialize from it
+        #: lazily, once, attributed to the extent_read stage
+        self._ext = ext
 
     # ------------------------------------------------------ construction
     @classmethod
@@ -129,24 +133,32 @@ class LazyPayload:
             return v
         if v is None:
             return cls(raw=b"")
+        if getattr(v, "_is_extent_ref", False):
+            # lane-transport zero-copy path: keep the shared-memory
+            # handle, defer the one copy to first real use
+            return cls(ext=v)
         if isinstance(v, (bytes, bytearray, memoryview)):
             return cls(raw=bytes(v))
         return cls.seal(v)
 
     # ------------------------------------------------------------ access
     def empty(self) -> bool:
-        return self._obj is None and not self._raw
+        return self._obj is None and not self._raw and self._ext is None
 
     def bytes(self) -> bytes:
         """Wire form, materialized lazily and exactly once.  Objects
         that keep their own framed-encoding cache (LogEntry
         ``framed_bytes`` — pglog persistence already paid for it) are
-        asked for that instead of re-encoding."""
+        asked for that instead of re-encoding; extent-backed payloads
+        pay their single copy out of shared memory here."""
         raw = self._raw
         if raw is None:
-            fb = getattr(self._obj, "framed_bytes", None)
-            raw = self._raw = (fb() if callable(fb)
-                               else self._obj.to_bytes())
+            if self._ext is not None:
+                raw = self._raw = self._ext.materialize()
+            else:
+                fb = getattr(self._obj, "framed_bytes", None)
+                raw = self._raw = (fb() if callable(fb)
+                                   else self._obj.to_bytes())
         return raw
 
     def peek(self, kind: Type):
@@ -155,10 +167,10 @@ class LazyPayload:
         decode and share one object on BOTH transports)."""
         if self._obj is not None:
             return self._obj
-        if not self._raw:
+        if not self._raw and self._ext is None:
             return None
         note_decode()
-        self._obj = kind.from_bytes(self._raw)
+        self._obj = kind.from_bytes(self.bytes())
         return self._obj
 
     def mutable(self, kind: Type):
@@ -174,16 +186,18 @@ class LazyPayload:
                 note_encode(len(self.bytes()))
             note_decode()
             return kind.from_bytes(self.bytes())
-        if not self._raw:
+        if not self._raw and self._ext is None:
             return kind()
         note_decode()
-        return kind.from_bytes(self._raw)
+        return kind.from_bytes(self.bytes())
 
     def cost(self) -> int:
         """Byte-budget estimate WITHOUT materializing (intake gates must
         never force the encode they exist to avoid)."""
         if self._raw is not None:
             return len(self._raw)
+        if self._ext is not None:
+            return self._ext.ln    # handle knows its length; no copy
         approx = getattr(self._obj, "approx_size", None)
         if callable(approx):
             return approx()

@@ -38,6 +38,7 @@ from ceph_tpu.osd.messages import (
 )
 from ceph_tpu.crush.constants import CRUSH_ITEM_NONE
 from ceph_tpu.msg.payload import LazyPayload
+from ceph_tpu.osd import extents
 from ceph_tpu.osd.pglog import LOG_DELETE, LOG_MODIFY, LogEntry
 from ceph_tpu.store.objectstore import (
     NoSuchCollection, NoSuchObject, Transaction,
@@ -435,8 +436,21 @@ def _list_snaps(pg, oid: str, op: OSDOp) -> int:
     return 0
 
 
+def _ops_materialize(ops) -> None:
+    """Lane-received ops may carry extent-backed data (the zero-copy
+    ring transport ships a shared-memory handle, not bytes); execution
+    is the first real use, so the single copy out of shared memory is
+    paid here — attributed to the extent_read stage, NOT lane_codec."""
+    for op in ops:
+        d = op.data
+        if getattr(d, "_is_extent_ref", False):
+            op.data = d.materialize()
+
+
 def execute_read_op(store, cid, soid, op: OSDOp) -> int:
     """One read-class op against committed state; fills rval/outdata."""
+    if getattr(op.data, "_is_extent_ref", False):
+        op.data = op.data.materialize()
     try:
         if op.op == OP_ASSERT_EXISTS:
             store.stat(cid, soid)
@@ -500,6 +514,7 @@ def build_write_txn(store, cid, soid, ops: List[OSDOp],
                     txn: Transaction) -> Tuple[int, bool]:
     """Translate write-class ops into store txn ops (do_osd_ops write
     side).  Returns (result, deletes_object)."""
+    _ops_materialize(ops)
     deleted = False
     for op in ops:
         if not op.is_write():
@@ -549,6 +564,7 @@ class ReplicatedBackend(PGBackend):
     async def submit_client_write(self, m: MOSDOp) -> int:
         pg = self.pg
         soid = pg.object_id(m.oid)
+        _ops_materialize(m.ops)
         # watch registration is primary-local state, not a store txn
         watch_ops = [op for op in m.ops if op.op == OP_WATCH]
         if watch_ops:
@@ -740,7 +756,10 @@ class ReplicatedBackend(PGBackend):
             # map.  Applying it would graft a divergent entry onto
             # a log the new interval's peering has already judged;
             # drop it — the old primary's in-flight ack wait aborts
-            # on its own interval change and the client resends
+            # on its own interval change and the client resends.
+            # A dropped sub-op still owns its extent slots: release
+            # here or they leak until the lane-death sweep
+            extents.release_message(m)
             return
         rt = self._repl_trace(m)
         # copy discipline: txn() is OUR mutable copy (save_meta
@@ -769,12 +788,17 @@ class ReplicatedBackend(PGBackend):
             # the commit callback — the ack can never outrun the
             # durability of the pglog entry it vouches for, and the
             # PG worker is already applying the next sub-op while
-            # this one's group commits (commit pipelining)
+            # this one's group commits (commit pipelining).  The op's
+            # extent slots retire with the same durability point, and
+            # the ack rides the per-connection cork: the commit thread
+            # runs a drained group's callbacks in ONE loop callback,
+            # so every ack of the burst coalesces into one frame
+            extents.release_message(m)
             if advance is not None:
                 pg.complete_to(advance)
             if rt is not None:
                 rt.committed()
-            self.osd.send_osd(src, reply)
+            self.osd.queue_rep_ack(src, reply)
 
         self.osd.store.queue_transactions([txn],
                                           on_commit=_committed)
@@ -922,6 +946,7 @@ class ECBackend(PGBackend):
     async def submit_client_write(self, m: MOSDOp) -> int:
         pg = self.pg
         soid = pg.object_id(m.oid)
+        _ops_materialize(m.ops)
         watch_ops = [op for op in m.ops if op.op == OP_WATCH]
         if watch_ops:
             for op in watch_ops:
@@ -1737,10 +1762,12 @@ class ECBackend(PGBackend):
         queue/worker hop when nothing is queued ahead."""
         pg = self.pg
         if m.map_epoch < pg.info.same_interval_since:
-        # stale-interval shard write: same drop rule as the
+            # stale-interval shard write: same drop rule as the
             # replicated sub-op path (see ReplicatedBackend) — a
             # closed interval's fan-out must not append to a log
-            # the new interval already peered over
+            # the new interval already peered over; release its
+            # extent slots like any other terminal outcome
+            extents.release_message(m)
             return
         rt = self._repl_trace(m)
         # copy discipline: mutable txn copy, shared immutable entry
@@ -1765,12 +1792,14 @@ class ECBackend(PGBackend):
 
         def _committed():
             # EC sub-op ack + last_complete ride the commit callback
-            # in submission order (see MOSDRepOp above)
+            # in submission order (see MOSDRepOp above); extents
+            # retire here and the ack coalesces per drained burst
+            extents.release_message(m)
             if advance is not None:
                 pg.complete_to(advance)
             if rt is not None:
                 rt.committed()
-            self.osd.send_osd(src, reply)
+            self.osd.queue_rep_ack(src, reply)
 
         self.osd.store.queue_transactions([txn],
                                           on_commit=_committed)

@@ -27,10 +27,11 @@ from ceph_tpu.mon.monmap import MonMap
 from ceph_tpu.osd.messages import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDOp, MOSDOpBatch, MOSDOpReply, MOSDPing,
-    MOSDRepOp, MOSDRepOpReply, MPGLog, MPGLogRequest, MPGNotify,
-    MPGObjectList, MPGPush, MPGPushReply, MPGQuery, MPGRemove, MPGScrub,
-    MPGScrubMap, MPGScrubScan, MWatchNotifyAck,
+    MOSDRepAckBatch, MOSDRepOp, MOSDRepOpReply, MPGLog, MPGLogRequest,
+    MPGNotify, MPGObjectList, MPGPush, MPGPushReply, MPGQuery, MPGRemove,
+    MPGScrub, MPGScrubMap, MPGScrubScan, MWatchNotifyAck,
 )
+from ceph_tpu.osd import extents
 from ceph_tpu.osd.osdmap import OSDMap
 from ceph_tpu.osd.pg import PG
 from ceph_tpu.osd.types import NO_SHARD, PGId
@@ -186,6 +187,21 @@ class OSD(Dispatcher):
         # per-shard EC batch collectors (threaded mode only: the
         # daemon-wide collector's wake event is loop-affine)
         self._shard_ec_queues: Dict[int, object] = {}
+        # replica commit-ack coalescer: acks produced in one drained
+        # commit burst cork per target OSD and leave as ONE
+        # MOSDRepAckBatch frame (the commit thread runs a burst's
+        # callbacks in one loop callback, so call_soon IS the burst
+        # boundary — zero added latency).  Keyed per loop id like the
+        # recovery budgets: corks are loop-affine under threaded
+        # shards, and the flush must drain the cork IT armed
+        self._rep_ack_on = bool(self.cfg["osd_rep_ack_coalesce"])
+        self._rep_ack_corks: Dict[int, Dict[int, list]] = {}
+        # acks_coalesced = acks that rode a batch frame instead of
+        # their own send; ack_batches = batch frames sent (the bench
+        # extra row reports both — acceptance: counter-proven)
+        self.perf_repack = ctx.perf.create("osd_rep_ack")
+        for key in ("acks_sent", "acks_coalesced", "ack_batches"):
+            self.perf_repack.add_u64(key)
 
     def next_tid(self) -> int:
         return next(self._tid)
@@ -588,16 +604,31 @@ class OSD(Dispatcher):
             pg.ensure_peering()
         pg.maybe_trim_snaps()
 
-    def note_pg_active(self, pg: PG) -> None:
-        """Primary finished peering: assert up_thru (MOSDAlive), once per
-        epoch (the reference batches this the same way)."""
-        if getattr(self, "_alive_epoch", 0) >= self.osdmap.epoch:
+    def request_up_thru(self) -> None:
+        """WaitUpThru support (PG::build_prior need_up_thru): ask the
+        mon to commit our up_thru for the current epoch (MOSDAlive).
+        Deduped across PGs — once per epoch — but re-sent on a slow
+        timer so a request lost to a mon election doesn't wedge the
+        waiting peering loops."""
+        now = time.monotonic()
+        if getattr(self, "_alive_epoch", 0) >= self.osdmap.epoch \
+                and now - getattr(self, "_alive_sent_at", 0.0) < 2.0:
             return
         self._alive_epoch = self.osdmap.epoch
+        self._alive_sent_at = now
         self.messenger.send_message(
             MOSDAlive(self.whoami, self.osdmap.epoch),
             self.monc.monmap.addr_of_rank(self.monc.cur_mon),
             peer_type="mon")
+
+    def note_pg_active(self, pg: PG) -> None:
+        """Primary finished peering.  WaitUpThru already proved our
+        up_thru covers this interval, so only re-assert when a later
+        map left it behind (the reference's once-per-epoch batching)."""
+        if self.osdmap.get_up_thru(self.whoami) \
+                >= pg.info.same_interval_since:
+            return
+        self.request_up_thru()
 
     def _load_stray_pg(self, pgid: PGId):
         """A peering query arrived for a PG we are not mapped to.  If a
@@ -674,7 +705,61 @@ class OSD(Dispatcher):
             return
         self.messenger.send_message(msg, addr, peer_type="osd")
 
+    def queue_rep_ack(self, osd_id: int, reply: Message) -> None:
+        """Replica commit-ack send seam: corks the acks one drained
+        commit burst produces (they all run in ONE loop callback —
+        store/commit.py batches completion records per loop) and
+        flushes them per target OSD as a single MOSDRepAckBatch.  A
+        lone ack still goes out unbatched, so the coalescer adds no
+        frame overhead at queue depth 1."""
+        self.perf_repack.inc("acks_sent")
+        if not self._rep_ack_on:
+            self.send_osd(osd_id, reply)
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # off-loop caller (teardown, direct-call tests): nothing
+            # to cork against — send through
+            self.send_osd(osd_id, reply)
+            return
+        cork = self._rep_ack_corks.get(id(loop))
+        if cork is None:
+            # gil-atomic:begin _rep_ack_corks lazy init: each loop
+            # only ever stores its own id(loop) key
+            cork = self._rep_ack_corks[id(loop)] = {}
+            # gil-atomic:end
+        if not cork:
+            loop.call_soon(self._flush_rep_acks, cork)
+        cork.setdefault(osd_id, []).append(reply)
+
+    def _flush_rep_acks(self, cork: Dict[int, list]) -> None:
+        for osd_id, acks in list(cork.items()):
+            if len(acks) == 1:
+                self.send_osd(osd_id, acks[0])
+            else:
+                self.perf_repack.inc("acks_coalesced", len(acks))
+                self.perf_repack.inc("ack_batches")
+                self.send_osd(osd_id, MOSDRepAckBatch(acks))
+        cork.clear()
+
+    def _dispatch_rep_ack_batch(self, m: MOSDRepAckBatch) -> None:
+        """Unpack a coalesced ack batch: each inner reply inherits the
+        envelope's transport stamps and routes through the normal
+        reply path (its own PG's home shard)."""
+        for rep in m.msgs:
+            rep.src_name = m.src_name
+            rep.src_addr = m.src_addr
+            rep.transport_id = m.transport_id
+            rep.recv_stamp = m.recv_stamp
+            self.shards.route(rep.pgid, self._dispatch_pg_msg, rep)
+
     def reply_to(self, req: Message, msg: Message) -> None:
+        # the reply is the op's terminal act on this OSD: any extent
+        # slots the request rode in on (lane ring transport) are done
+        # now — success, error and EAGAIN-after-requeue all funnel
+        # through here, so this one release balances every path
+        extents.release_message(req)
         # dmClock phase echo: the queue stamped which phase served the
         # op (_qos_phase envelope attr); mirroring it onto the reply
         # feeds the client's delta/rho counters.  One seam covers
@@ -729,6 +814,9 @@ class OSD(Dispatcher):
         handled inline on the intake loop."""
         if isinstance(m, MOSDOpBatch):
             self._dispatch_op_batch(m)
+            return True
+        if isinstance(m, MOSDRepAckBatch):
+            self._dispatch_rep_ack_batch(m)
             return True
         if isinstance(m, _PG_BOUND):
             self.shards.route(m.pgid, self._dispatch_pg_msg, m)

@@ -45,8 +45,10 @@ from typing import List, Optional, Tuple
 
 __all__ = ["ShmRing", "FRAME_MSG", "FRAME_OUT", "FRAME_MAP",
            "FRAME_RPC", "FRAME_RESP", "FRAME_STOP", "FRAME_BYE",
-           "FRAME_PING", "FRAME_PONG", "FRAME_STATS", "LaneDead",
-           "pack_frame", "unpack_frame"]
+           "FRAME_PING", "FRAME_PONG", "FRAME_STATS", "FRAME_BURST",
+           "FRAME_EXTFREE", "LaneDead", "pack_frame", "unpack_frame",
+           "pack_bursts", "unpack_burst", "pack_extfree",
+           "unpack_extfree"]
 
 # frame kinds (first byte of every frame payload)
 FRAME_MSG = 1     # parent -> lane: one PG-bound message (envelope+wire)
@@ -67,6 +69,15 @@ FRAME_PONG = 9    # lane -> parent: probe reply (ring drained to here)
 #                   + the lane's monotonic receive stamp
 FRAME_STATS = 10  # lane -> parent: periodic PG stat rows + metrics
 #                   snapshot + slow-op count (json)
+FRAME_BURST = 11  # either direction: every frame the producer corked
+#                   in one loop pass, concatenated [u32 len][frame]...
+#                   — ONE ring push + ONE wakeup per burst, the Courier
+#                   batched-handoff discipline applied to the ring edge
+FRAME_EXTFREE = 12  # consumer -> extent-pool owner: refcount drops for
+#                   shared-memory payload extents (osd/extents.py),
+#                   batched [count u32] then per-entry
+#                   [name str][gen u32][off u32][len u32]; rides the
+#                   cork like any other frame
 
 _HDR = 24                      # head u64 | tail u64 | waiting u32 | pad
 _OFF_HEAD = 0
@@ -244,3 +255,70 @@ def pack_frame(kind: int, body: bytes = b"") -> bytes:
 
 def unpack_frame(frame: bytes) -> Tuple[int, bytes]:
     return frame[0], frame[1:]
+
+
+def pack_bursts(frames: List[bytes], cap: int) -> List[bytes]:
+    """Cork ``frames`` into as few FRAME_BURST frames as fit the ring:
+    one burst per ~cap/2 bytes so a single cork can never exceed ring
+    capacity (which try_push hard-errors on).  A frame that is alone in
+    its burst goes out AS ITSELF — the burst envelope only pays for
+    itself when it actually coalesces."""
+    budget = max(1, cap // 2)
+    out: List[bytes] = []
+    batch: List[bytes] = []
+    size = 0
+    def flush():
+        if not batch:
+            return
+        if len(batch) == 1:
+            out.append(batch[0])
+        else:
+            out.append(bytes([FRAME_BURST]) + b"".join(
+                struct.pack("<I", len(f)) + f for f in batch))
+        del batch[:]
+    for f in frames:
+        if batch and size + 4 + len(f) > budget:
+            flush()
+            size = 0
+        batch.append(f)
+        size += 4 + len(f)
+    flush()
+    return out
+
+
+def unpack_burst(body: bytes) -> List[bytes]:
+    out: List[bytes] = []
+    off = 0
+    n = len(body)
+    while off < n:
+        ln = struct.unpack_from("<I", body, off)[0]
+        off += 4
+        out.append(body[off:off + ln])
+        off += ln
+    return out
+
+
+def pack_extfree(handles: List[Tuple[str, int, int, int]]) -> bytes:
+    """FRAME_EXTFREE body: batched extent refcount drops."""
+    parts = [struct.pack("<I", len(handles))]
+    for name, gen, off, ln in handles:
+        nb = name.encode("utf-8")
+        parts.append(struct.pack("<I", len(nb)))
+        parts.append(nb)
+        parts.append(struct.pack("<III", gen, off, ln))
+    return b"".join(parts)
+
+
+def unpack_extfree(body: bytes) -> List[Tuple[str, int, int, int]]:
+    count = struct.unpack_from("<I", body, 0)[0]
+    off = 4
+    out: List[Tuple[str, int, int, int]] = []
+    for _ in range(count):
+        nl = struct.unpack_from("<I", body, off)[0]
+        off += 4
+        name = body[off:off + nl].decode("utf-8")
+        off += nl
+        gen, soff, ln = struct.unpack_from("<III", body, off)
+        off += 12
+        out.append((name, gen, soff, ln))
+    return out

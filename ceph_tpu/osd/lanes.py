@@ -62,10 +62,14 @@ import time
 from typing import Dict, List, Optional
 
 from ceph_tpu.common.encoding import Decoder, Encoder
+from ceph_tpu.osd import extents as extents_mod
 from ceph_tpu.osd.laneipc import (
-    FRAME_BYE, FRAME_MAP, FRAME_MSG, FRAME_OUT, FRAME_PING, FRAME_PONG,
-    FRAME_RESP, FRAME_RPC, FRAME_STATS, FRAME_STOP, LaneDead, ShmRing,
-    pack_frame, unpack_frame)
+    FRAME_BURST, FRAME_BYE, FRAME_EXTFREE, FRAME_MAP, FRAME_MSG,
+    FRAME_OUT, FRAME_PING, FRAME_PONG, FRAME_RESP, FRAME_RPC,
+    FRAME_STATS, FRAME_STOP, LaneDead, ShmRing, pack_bursts,
+    pack_extfree, pack_frame, unpack_burst, unpack_extfree,
+    unpack_frame)
+from ceph_tpu.osd.shards import shard_index
 
 _log = logging.getLogger("ceph-tpu.osd.lanes")
 
@@ -73,10 +77,60 @@ _log = logging.getLogger("ceph-tpu.osd.lanes")
 #: spin; the consumer advertises progress through the head cursor)
 _RETRY_S = 0.001
 
+#: message types eligible for the lane->lane same-host fastpath: the
+#: parent routes the STILL-ENCODED frame to the target lane by the out
+#: frame's (addr, pgid) header alone — no parent-side decode/re-encode.
+#: Only PG-bound replication traffic qualifies: each type's handler
+#: runs on the pgid's home shard, which IS the lane we forward to.
+_FASTPATH_TYPES = frozenset((202, 203, 204, 205))
+
+#: same-host OSD registry for the fastpath: messenger addr (sans nonce)
+#: -> that OSD's ShardedDataPlane, registered only when the OSD runs
+#: process lanes AND ms_local_delivery allows same-process shortcuts
+_LOCAL_PLANES: Dict = {}
+
+
+def register_local_plane(addr, plane) -> None:
+    _LOCAL_PLANES[addr.without_nonce()] = plane
+
+
+def unregister_local_plane(addr) -> None:
+    _LOCAL_PLANES.pop(addr.without_nonce(), None)
+
+
+def _local_lane_for(addr, pgid):
+    """Resolve (target addr, pgid) to a live local process lane, or
+    None -> the caller takes the real-socket slow path."""
+    plane = _LOCAL_PLANES.get(addr.without_nonce())
+    if plane is None or plane.process_lanes is None:
+        return None
+    lane = plane.process_lanes[shard_index(pgid, plane.num_shards)]
+    return None if lane.dead else lane
+
+
+def _parent_free_router(handle) -> None:
+    """Parent-side free routing for pools the parent does not own: a
+    lane-owned out pool's free relays down the owning lane's ring
+    (where extents.release resolves it as owner)."""
+    lane = _EXT_POOL_LANES.get(handle[0])
+    if lane is not None and not lane.dead:
+        try:
+            lane._push(pack_frame(FRAME_EXTFREE, pack_extfree([handle])))
+            return
+        except LaneDead:
+            pass
+    # owner gone: the pool was (or will be) swept with the lane —
+    # count it so a systematic leak cannot hide
+    extents_mod._C.unroutable += 1
+
+
+#: out-pool name -> owning ProcessLane (parent process only)
+_EXT_POOL_LANES: Dict[str, "ProcessLane"] = {}
+
 
 # ------------------------------------------------------------- envelopes
 
-def encode_msg_envelope(m) -> bytes:
+def encode_msg_envelope(m, sink=None) -> bytes:
     """Transport envelope + wire body for one message crossing a ring.
     The envelope carries what the messenger stamps out-of-band (source
     identity/address, receive stamp, transport id) so the lane-side
@@ -104,7 +158,7 @@ def encode_msg_envelope(m) -> bytes:
         enc.u64(0)
         enc.u64(0)
         enc.f64(0.0)
-    body = m.wire_bytes()
+    body = _wire_for_ring(m, sink)
     # the push stamp is the LAST field written: everything after it on
     # the parent side is the try_push itself, so lane-side
     # (t_push - cursor) is an honest wire-encode cost sample
@@ -112,6 +166,25 @@ def encode_msg_envelope(m) -> bytes:
             else 0.0)
     enc.bytes_(body)
     return enc.getvalue()
+
+
+def _wire_for_ring(m, sink) -> bytes:
+    """Ring-bound wire body.  With an extent sink installed the encode
+    bypasses the wire_bytes cache on purpose: over-threshold data
+    payloads divert into shared memory (Encoder.data_bytes_) so the
+    handle-bearing form must never be cached as the message's socket
+    form — a later real-socket send re-encodes inline from the same
+    sealed payloads.  Without a sink this IS wire_bytes (cached,
+    counted)."""
+    if sink is None:
+        return m.wire_bytes()
+    from ceph_tpu.msg import payload as payload_mod
+    enc = Encoder()
+    enc.extent_sink = sink
+    m.encode(enc)
+    body = enc.getvalue()
+    payload_mod.note_encode(len(body))
+    return body
 
 
 def decode_msg_envelope(body: bytes, t_pop: Optional[float] = None,
@@ -132,7 +205,15 @@ def decode_msg_envelope(body: bytes, t_pop: Optional[float] = None,
     cls = message_class(mtype)
     if cls is None:
         raise ValueError(f"unregistered message type {mtype} on ring")
-    m = cls.from_bytes(dec.bytes_())
+    # collect every ExtentRef the body decode mints so the consuming
+    # op's commit callback can release them (extents.release_message)
+    extents_mod.begin_collect()
+    try:
+        m = cls.from_bytes(dec.bytes_())
+    finally:
+        refs = extents_mod.end_collect()
+    if refs:
+        m._extent_refs = refs
     from ceph_tpu.msg import payload as payload_mod
     payload_mod.note_decode()
     m.src_name = src_name
@@ -146,41 +227,74 @@ def decode_msg_envelope(body: bytes, t_pop: Optional[float] = None,
     return m
 
 
-def encode_out_frame(m, addr, peer_type: Optional[str]) -> bytes:
+def encode_out_frame(m, addr, peer_type: Optional[str],
+                     sink=None, pgid=None) -> bytes:
     """Lane -> parent outbound send: (target addr, peer type, send
-    stamp, wire).  The send stamp (lane monotonic clock) is the reply
-    leg's anchor: the parent converts it through the PING/PONG clock
-    offset and the client rebases its span cursor onto it, so
-    ``ack_delivery`` covers only the reply transit — the lane's
-    service time was already recorded by the lane's own span."""
+    stamp, routing pgid, wire).  The send stamp (lane monotonic clock)
+    is the reply leg's anchor: the parent converts it through the
+    PING/PONG clock offset and the client rebases its span cursor onto
+    it, so ``ack_delivery`` covers only the reply transit — the lane's
+    service time was already recorded by the lane's own span.  The
+    optional pgid is the fastpath routing key: present only for
+    replication types the parent may forward still-encoded to a
+    same-host lane (header-only routing, no re-decode)."""
     enc = Encoder()
     enc.string(peer_type or "")
     enc.struct(addr)
     enc.u16(m.get_type())
     enc.opt_struct(m.src_name)
     enc.f64(time.monotonic())
-    enc.bytes_(m.wire_bytes())
+    enc.opt_struct(pgid)
+    enc.bytes_(_wire_for_ring(m, sink))
     return enc.getvalue()
 
 
 def decode_out_frame(body: bytes):
     from ceph_tpu.msg.message import message_class
     from ceph_tpu.msg.types import EntityAddr, EntityName
+    from ceph_tpu.osd.types import PGId
     dec = Decoder(body)
     peer_type = dec.string() or None
     addr = dec.struct(EntityAddr)
     mtype = dec.u16()
     src_name = dec.opt_struct(EntityName)
     t_send = dec.f64()
+    dec.opt_struct(PGId)        # fastpath routing key (header-only)
     cls = message_class(mtype)
     if cls is None:
         raise ValueError(f"unregistered message type {mtype} on ring")
-    m = cls.from_bytes(dec.bytes_())
+    extents_mod.begin_collect()
+    try:
+        m = cls.from_bytes(dec.bytes_())
+    finally:
+        refs = extents_mod.end_collect()
+    if refs:
+        m._extent_refs = refs
     from ceph_tpu.msg import payload as payload_mod
     payload_mod.note_decode()
     if src_name is not None:
         m.src_name = src_name
     return m, addr, peer_type, t_send
+
+
+def _encode_fwd_envelope(mtype: int, src_name, wire: bytes) -> bytes:
+    """FRAME_MSG envelope the parent builds around a STILL-ENCODED
+    fastpath frame: transport stamps only — no span context (trace id
+    0 means the target lane skips adoption; the message's own payload
+    trace fields survive untouched inside ``wire``)."""
+    enc = Encoder()
+    enc.u16(mtype)
+    enc.opt_struct(src_name)
+    enc.opt_struct(None)                 # src_addr: peers reply by id
+    # recv stamp (forward instant): same wall-clock field the socket
+    # intake stamps
+    enc.f64(time.time())  # lint: allow[MONO05] wire recv_stamp is wall time
+    enc.u64(0)                           # transport id: no socket rode
+    enc.u64(0)                           # throttle: no intake budget taken
+    enc.u64(0).u64(0).f64(0.0)           # no span adoption
+    enc.f64(0.0)                         # no push stamp
+    enc.bytes_(wire)
+    return enc.getvalue()
 
 
 # ------------------------------------------------------------ parent side
@@ -194,6 +308,13 @@ class ProcessLane:
 
     ring = ()            # route()'s fast-path probe: never "queued work
     _busy = False        # visible in-parent" — lanes drain via ping()
+    # class-level defaults: teardown/death paths must be safe on a
+    # partially-constructed lane (a start() that threw mid-way)
+    ext_tx = ext_out = _tx_sink = None
+    _cork_on = False
+    _cork_armed = False
+    corked_frames = cork_pushes = fastpath_fwd = 0
+    lane_cork: dict = {}
 
     def __init__(self, plane, idx: int):
         self.plane = plane
@@ -202,6 +323,35 @@ class ProcessLane:
         cap = int(self.osd.cfg["osd_lane_ring_bytes"])
         self.to_lane = ShmRing(capacity=cap, create=True)
         self.from_lane = ShmRing(capacity=cap, create=True)
+        # extent pools: the parent CREATES both segments (a dead worker
+        # can never strand a named segment) and owns the tx allocator;
+        # the lane worker owns the out allocator (attaches by name)
+        ext_min = int(self.osd.cfg["osd_lane_extent_min_bytes"])
+        self.ext_tx = self.ext_out = self._tx_sink = None
+        if ext_min > 0:
+            from ceph_tpu.osd.extents import ExtentPool, ExtentSink
+            pool_cap = int(self.osd.cfg["osd_lane_extent_pool_bytes"])
+            self.ext_tx = ExtentPool(capacity=pool_cap,
+                                     threshold=ext_min,
+                                     create=True).register()
+            self.ext_out = ExtentPool(capacity=pool_cap,
+                                      threshold=ext_min, create=True)
+            self._tx_sink = ExtentSink(self.ext_tx)
+            _EXT_POOL_LANES[self.ext_out.name] = self
+            extents_mod.set_free_router(_parent_free_router)
+            tr = self.osd.ctx.tracer
+            extents_mod.set_stage_recorder(
+                lambda stage, dt: tr.hist.hinc(stage, dt)
+                if tr.enabled else None)
+        # ring-frame corking: frames queued in one loop pass coalesce
+        # into one FRAME_BURST (one push, one wakeup, one drain)
+        self._cork_on = bool(self.osd.cfg["osd_lane_cork"])
+        self._cork: List[bytes] = []
+        self._cork_armed = False
+        self.corked_frames = 0      # frames that rode a cork flush
+        self.cork_pushes = 0        # ring pushes those flushes cost
+        self.fastpath_fwd = 0       # lane->lane frames never re-decoded
+        self.lane_cork: dict = {}   # lane-reported cork counters
         # wake channels (mp.Pipe connections pickle across spawn)
         self._to_wake_r, self._to_wake_w = multiprocessing.Pipe(False)
         self._from_wake_r, self._from_wake_w = multiprocessing.Pipe(False)
@@ -247,6 +397,9 @@ class ProcessLane:
             "to_lane": self.to_lane.name,
             "from_lane": self.from_lane.name,
             "ring_bytes": self.to_lane.capacity,
+            "ext_tx": self.ext_tx.name if self.ext_tx else "",
+            "ext_out": self.ext_out.name if self.ext_out else "",
+            "ext_min": (self.ext_tx.threshold if self.ext_tx else 0),
         }
         ctx = multiprocessing.get_context("spawn")
         self.proc = ctx.Process(
@@ -318,6 +471,25 @@ class ProcessLane:
         self.to_lane.unlink()
         self.from_lane.close()
         self.from_lane.unlink()
+        self._reclaim_extents("lane stop")
+        if self.ext_tx is not None:
+            self.ext_tx.close()
+            self.ext_tx.unlink()
+            self.ext_tx = None
+        if self.ext_out is not None:
+            self.ext_out.close()
+            self.ext_out.unlink()
+            self.ext_out = None
+        self._tx_sink = None
+
+    def _reclaim_extents(self, reason: str) -> None:
+        """Force-free every live tx slot (the parent's side of the
+        leak-proof contract): loud per-slot accounting via sweep_all,
+        routing unregistered so late frees count unroutable instead of
+        resolving against a reused arena."""
+        if self.ext_tx is not None:
+            _EXT_POOL_LANES.pop(self.ext_out.name, None)
+            self.ext_tx.sweep_all(reason)
 
     def _on_exit(self) -> None:
         """Worker sentinel fired: clean only during stop().  Anything
@@ -340,17 +512,53 @@ class ProcessLane:
                 fut.set_exception(LaneDead(
                     f"lane {self.idx} worker died"))
         self._pending.clear()
+        # a dead lane's in-flight extents never see their commit
+        # callback: reclaim NOW (loudly), not at daemon stop
+        self._reclaim_extents(f"lane {self.idx} worker died")
 
     # -------------------------------------------------------------- sending
     def _push(self, frame: bytes) -> None:
         if self.dead:
             raise LaneDead(f"lane {self.idx} worker is dead")
+        if self._cork_on and self._loop is not None:
+            # cork: everything queued in one loop pass rides ONE ring
+            # frame (FRAME_BURST) — one push, one wakeup, one drain.
+            # FIFO holds: control frames cork too, in arrival order.
+            self._cork.append(frame)
+            if not self._cork_armed:
+                self._cork_armed = True
+                self._loop.call_soon(self._flush_cork)
+            return
+        self._push_now(frame)
+
+    def _push_now(self, frame: bytes) -> None:
         if self._overflow or not self.to_lane.try_push(frame):
             # ring full: keep FIFO order through the overflow queue
             self._overflow.append(frame)
             self._arm_retry()
             return
         self._wake_lane()
+
+    def _flush_cork(self) -> None:
+        self._cork_armed = False
+        frames = self._cork
+        if not frames:
+            return
+        self._cork = []
+        if self.dead:
+            return          # drop, like the post() LaneDead contract
+        self.corked_frames += len(frames)
+        packed = pack_bursts(frames, self.to_lane.capacity)
+        self.cork_pushes += len(packed)
+        wake = False
+        for f in packed:
+            if self._overflow or not self.to_lane.try_push(f):
+                self._overflow.append(f)
+                self._arm_retry()
+            else:
+                wake = True
+        if wake:
+            self._wake_lane()
 
     def _wake_lane(self) -> None:
         if self.to_lane.peer_waiting():
@@ -392,8 +600,8 @@ class ProcessLane:
         if fn == osd._dispatch_pg_msg:
             m = args[0]
             try:
-                self._push(pack_frame(FRAME_MSG,
-                                      encode_msg_envelope(m)))
+                self._push(pack_frame(FRAME_MSG, encode_msg_envelope(
+                    m, sink=self._tx_sink)))
             except LaneDead:
                 # drop, like a crashed OSD would: the death was
                 # already logged loudly and the client resends/times
@@ -487,15 +695,16 @@ class ProcessLane:
     def _handle_frame(self, frame: bytes) -> None:
         kind, body = unpack_frame(frame)
         osd = self.osd
-        if kind == FRAME_OUT:
-            m, addr, peer_type, t_send = decode_out_frame(body)
-            if t_send:
-                # reply-leg anchor in the PARENT/client clock: the
-                # objecter rebases its span cursor onto this so
-                # ack_delivery covers only the reply transit (the
-                # lane's span already recorded the service time)
-                m._lane_sent_mono = t_send - self.clock_offset
-            osd.messenger.send_message(m, addr, peer_type=peer_type)
+        if kind == FRAME_BURST:
+            for inner in unpack_burst(body):
+                self._handle_frame(inner)
+        elif kind == FRAME_EXTFREE:
+            # lane-sent refcount drops: owned tx pool decrefs here;
+            # another lane's out pool relays via _parent_free_router
+            for h in unpack_extfree(body):
+                extents_mod.release(h)
+        elif kind == FRAME_OUT:
+            self._handle_out(body)
         elif kind == FRAME_RPC:
             dec = Decoder(body)
             rid = dec.u64()
@@ -532,6 +741,65 @@ class ProcessLane:
         elif kind == FRAME_BYE:
             self._byed = True
 
+    def _handle_out(self, body: bytes) -> None:
+        """One lane-originated outbound send.  Header first: when the
+        target address resolves to a same-host OSD running process
+        lanes and the type is PG-bound replication traffic, the parent
+        forwards the STILL-ENCODED wire to the target pgid's home lane
+        (header-only routing — the payload, including any extent
+        handles, is never touched in the parent).  Everything else
+        decodes and goes out the real messenger."""
+        from ceph_tpu.msg.message import message_class
+        from ceph_tpu.msg.types import EntityAddr, EntityName
+        from ceph_tpu.osd.types import PGId
+        osd = self.osd
+        dec = Decoder(body)
+        peer_type = dec.string() or None
+        addr = dec.struct(EntityAddr)
+        mtype = dec.u16()
+        src_name = dec.opt_struct(EntityName)
+        t_send = dec.f64()
+        pgid = dec.opt_struct(PGId)
+        if pgid is not None and mtype in _FASTPATH_TYPES \
+                and bool(osd.cfg["ms_local_delivery"]):
+            target = _local_lane_for(addr, pgid)
+            if target is not None:
+                try:
+                    target._push(pack_frame(FRAME_MSG,
+                                            _encode_fwd_envelope(
+                                                mtype, src_name,
+                                                dec.bytes_())))
+                    self.fastpath_fwd += 1
+                    return
+                except LaneDead:
+                    return   # dead target lane == crashed OSD: drop
+        cls = message_class(mtype)
+        if cls is None:
+            raise ValueError(
+                f"unregistered message type {mtype} on ring")
+        extents_mod.begin_collect()
+        try:
+            m = cls.from_bytes(dec.bytes_())
+        finally:
+            refs = extents_mod.end_collect()
+        from ceph_tpu.msg import payload as payload_mod
+        payload_mod.note_decode()
+        if src_name is not None:
+            m.src_name = src_name
+        # a slow-path frame that carried extents pays its one copy NOW
+        # (the socket encoder needs real bytes) and frees the slot
+        # promptly; the cached copy keeps later re-encodes safe
+        for r in refs:
+            r.materialize()
+            r.release()
+        if t_send:
+            # reply-leg anchor in the PARENT/client clock: the
+            # objecter rebases its span cursor onto this so
+            # ack_delivery covers only the reply transit (the
+            # lane's span already recorded the service time)
+            m._lane_sent_mono = t_send - self.clock_offset
+        osd.messenger.send_message(m, addr, peer_type=peer_type)
+
     def _on_stats(self, data) -> None:
         if isinstance(data, list):          # legacy shape: rows only
             self.stat_rows = data
@@ -540,6 +808,9 @@ class ProcessLane:
         snap = data.get("metrics")
         if snap:
             self.metrics = snap
+        cork = data.get("cork")
+        if cork:
+            self.lane_cork = cork
         slow = int(data.get("slow_ops", 0))
         if slow > self.slow_ops:
             # forwarded complaints: the lane swept its own OpTracker
@@ -579,6 +850,13 @@ class ProcessLane:
             "from_lane_bytes": self.from_lane.pop_bytes,
             "from_lane_backlog": self.from_lane.backlog_bytes,
             "overflow_pending": len(self._overflow),
+            "corked_frames": self.corked_frames,
+            "cork_pushes": self.cork_pushes,
+            "fastpath_fwd": self.fastpath_fwd,
+            "lane_cork": self.lane_cork,
+            "ext_tx_live": (self.ext_tx.live if self.ext_tx else 0),
+            "ext_tx_live_bytes": (self.ext_tx.live_bytes
+                                  if self.ext_tx else 0),
             "slow_ops": self.slow_ops,
             "clock_offset_s": round(self.clock_offset, 6),
             "has_metrics": self.metrics is not None,
@@ -620,8 +898,14 @@ class RingMessenger:
             return
         if msg.src_name is None:
             msg.src_name = self.runtime.entity_name
-        self.runtime.push(pack_frame(
-            FRAME_OUT, encode_out_frame(msg, addr, peer_type)))
+        rt = self.runtime
+        # fastpath routing key: only replication types carry a pgid
+        # header — the parent may forward those to a same-host lane
+        # without decoding the body
+        pgid = (getattr(msg, "pgid", None)
+                if msg.get_type() in _FASTPATH_TYPES else None)
+        rt.push(pack_frame(FRAME_OUT, encode_out_frame(
+            msg, addr, peer_type, sink=rt.ext_sink, pgid=pgid)))
 
     def put_dispatch_throttle(self, msg) -> None:
         # intake budget lives (and was already released) parent-side
@@ -728,6 +1012,14 @@ class LaneRuntime:
         from collections import deque
         self._overflow = deque()
         self._retry_handle = None
+        # cork + extents state (armed in run(): cfg and loop live there)
+        self._cork_on = False
+        self._cork: List[bytes] = []
+        self._cork_armed = False
+        self.corked_frames = 0
+        self.cork_pushes = 0
+        self.out_pool = None        # this lane's OWNED out-pool allocator
+        self.ext_sink = None
         #: parent->lane monotonic offset (lane ≈ parent + offset),
         #: delivered by the parent's PING after its PONG-measured
         #: handshake; 0.0 (correct on same-host Linux) until then
@@ -763,16 +1055,49 @@ class LaneRuntime:
     # ------------------------------------------------------------- outbound
     def push(self, frame: bytes) -> None:
         with self._mu:
+            if self._cork_on and self.loop is not None \
+                    and not self._stopping:
+                # producer-side cork: one FRAME_BURST per loop pass
+                # (the teardown path bypasses — its loop stops running
+                # callbacks before a call_soon flush would fire)
+                self._cork.append(frame)
+                if not self._cork_armed:
+                    self._cork_armed = True
+                    self.loop.call_soon(self._flush_cork)
+                return
             if self._overflow or not self.from_lane.try_push(frame):
                 self._overflow.append(frame)
-                if self._retry_handle is None \
-                        and self.loop is not None:
-                    self._retry_handle = self.loop.call_later(
-                        _RETRY_S, self._drain_overflow)
+                self._arm_retry()
                 return
         self._wake_parent()
 
+    def _arm_retry(self) -> None:
+        if self._retry_handle is None and self.loop is not None:
+            self._retry_handle = self.loop.call_later(
+                _RETRY_S, self._drain_overflow)
+
+    def _flush_cork(self) -> None:
+        wake = False
+        with self._mu:
+            self._cork_armed = False
+            frames = self._cork
+            if not frames:
+                return
+            self._cork = []
+            self.corked_frames += len(frames)
+            packed = pack_bursts(frames, self.from_lane.capacity)
+            self.cork_pushes += len(packed)
+            for f in packed:
+                if self._overflow or not self.from_lane.try_push(f):
+                    self._overflow.append(f)
+                    self._arm_retry()
+                else:
+                    wake = True
+        if wake:
+            self._wake_parent()
+
     def _drain_overflow(self) -> None:
+        self._flush_cork()      # corked frames keep FIFO ahead of retry
         pushed = False
         with self._mu:
             self._retry_handle = None
@@ -792,6 +1117,15 @@ class LaneRuntime:
                 self._wake_w.send_bytes(b"w")
             except (BrokenPipeError, OSError):
                 pass
+
+    def _route_free(self, handle) -> None:
+        """extents.set_free_router hook: a drop against a pool this
+        lane does not own rides the ring to the parent (corked like
+        any other frame); the parent resolves or relays it."""
+        try:
+            self.push(pack_frame(FRAME_EXTFREE, pack_extfree([handle])))
+        except Exception:
+            pass        # teardown race: the sweep accounts the slot
 
     async def rpc(self, cmd: dict, timeout: float = 15.0) -> bytes:
         fut = asyncio.get_running_loop().create_future()
@@ -839,7 +1173,15 @@ class LaneRuntime:
 
     def _handle_frame(self, frame: bytes) -> None:
         kind, body = unpack_frame(frame)
-        if kind == FRAME_MSG:
+        if kind == FRAME_BURST:
+            # one ring pop, one wakeup, then the whole corked batch
+            for inner in unpack_burst(body):
+                self._handle_frame(inner)
+        elif kind == FRAME_EXTFREE:
+            # parent-relayed drops against this lane's OWN out pool
+            for h in unpack_extfree(body):
+                extents_mod.release(h)
+        elif kind == FRAME_MSG:
             t_pop = time.monotonic()
             self.messenger.dispatch_inbound(
                 decode_msg_envelope(body, t_pop=t_pop, runtime=self))
@@ -900,6 +1242,16 @@ class LaneRuntime:
                 out = osd.op_tracker.dump_flight_recorder()
             elif prefix == "check_slow":
                 out = {"raised": osd.op_tracker.check_slow()}
+            elif prefix == "lane_transport":
+                # zero-copy transport evidence, read at bench end:
+                # producer-side cork ratio, replica-ack coalescing,
+                # and this worker's extent (out-pool) accounting
+                out = {
+                    "cork": {"corked_frames": self.corked_frames,
+                             "cork_pushes": self.cork_pushes},
+                    "acks": osd.perf_repack.dump(),
+                    "extents": extents_mod.counters(),
+                }
             else:
                 status = -1
                 out = {"error": f"unknown lane rpc {prefix!r}"}
@@ -935,6 +1287,24 @@ class LaneRuntime:
         store.mount()
         osd.shards.start()        # disabled plane: inline route()
         osd.running = True
+        # zero-copy transport wiring: this lane OWNS the out-pool
+        # allocator (segment created — and on death unlinked — by the
+        # parent), publishes its over-threshold sends there, and
+        # routes frees for foreign pools (the parent's tx arena,
+        # sibling lanes' out arenas) back over the ring
+        self._cork_on = bool(osd.cfg["osd_lane_cork"])
+        if spec.get("ext_out"):
+            from ceph_tpu.osd.extents import ExtentPool, ExtentSink
+            self.out_pool = ExtentPool(
+                name=spec["ext_out"],
+                threshold=int(spec.get("ext_min") or 1),
+                create=False).register()
+            self.ext_sink = ExtentSink(self.out_pool)
+            extents_mod.set_free_router(self._route_free)
+        tr = ctx.tracer
+        extents_mod.set_stage_recorder(
+            lambda stage, dt: tr.hist.hinc(stage, dt)
+            if tr.enabled else None)
         # stats reporting: compute rows like the daemon would and ship
         # them BOTH to the mon (via the ring messenger, rows merge
         # per-pgid in the PGMap) and to the parent (FRAME_STATS, for
@@ -993,6 +1363,9 @@ class LaneRuntime:
             except Exception:
                 pass
             self._drain_overflow()
+            if self.out_pool is not None:
+                self.out_pool.close()     # parent owns the unlink
+            extents_mod.detach_all()
 
     async def _stats_loop(self) -> None:
         interval = float(self.osd.cfg["osd_mon_report_interval"])
@@ -1008,6 +1381,8 @@ class LaneRuntime:
                 body = {
                     "pg_rows": rows,
                     "slow_ops": self.osd.op_tracker.slow_op_count,
+                    "cork": {"corked_frames": self.corked_frames,
+                             "cork_pushes": self.cork_pushes},
                     "metrics": metrics.snapshot(
                         self.osd.ctx,
                         source=f"osd.{self.whoami}/lane{self.lane}"),

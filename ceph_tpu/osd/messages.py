@@ -73,14 +73,18 @@ class OSDOp(Encodable):
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u16(self.op).u64(self.offset).u64(self.length)
-        enc.string(self.name).bytes_(self.data)
+        # data rides the extent pool on the lane transport (handle on
+        # the wire, payload in shared memory); outdata stays inline —
+        # it flows toward the CLIENT, which must get plain bytes
+        enc.string(self.name).data_bytes_(self.data)
         enc.map_(self.kv, lambda e, k: e.bytes_(k), lambda e, v: e.bytes_(v))
         enc.list_(self.keys, lambda e, k: e.bytes_(k))
         enc.s32(self.rval).bytes_(self.outdata)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "OSDOp":
-        o = cls(dec.u16(), dec.u64(), dec.u64(), dec.string(), dec.bytes_(),
+        o = cls(dec.u16(), dec.u64(), dec.u64(), dec.string(),
+                dec.data_bytes_(),
                 dec.map_(lambda d: d.bytes_(), lambda d: d.bytes_()),
                 dec.list_(lambda d: d.bytes_()))
         o.rval = dec.s32()
@@ -324,15 +328,18 @@ class MOSDRepOp(Message):
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.struct(self.pgid).u64(self.tid)
-        enc.bytes_(self.txn_payload.bytes())
-        enc.bytes_(self.log_payload.bytes())
+        # the txn body (which embeds the object data) rides the extent
+        # pool on the lane transport; the log entry is small and stays
+        # inline either way (data_bytes_ == bytes_ under threshold)
+        enc.data_bytes_(self.txn_payload.bytes())
+        enc.data_bytes_(self.log_payload.bytes())
         enc.struct(self.version).u32(self.map_epoch)
         enc.u64(self.trace_id).u64(self.span_id)
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDRepOp":
-        m = cls(dec.struct(PGId), dec.u64(), dec.bytes_(), dec.bytes_(),
-                dec.struct(EVersion), dec.u32())
+        m = cls(dec.struct(PGId), dec.u64(), dec.data_bytes_(),
+                dec.data_bytes_(), dec.struct(EVersion), dec.u32())
         if struct_v >= 2:
             m.trace_id = dec.u64()
             m.span_id = dec.u64()
@@ -398,8 +405,8 @@ class MOSDECSubOpWrite(Message):
 
     @classmethod
     def decode_payload(cls, dec: Decoder, struct_v: int):
-        m = cls(dec.struct(PGId), dec.u64(), dec.bytes_(), dec.bytes_(),
-                dec.struct(EVersion), dec.u32())
+        m = cls(dec.struct(PGId), dec.u64(), dec.data_bytes_(),
+                dec.data_bytes_(), dec.struct(EVersion), dec.u32())
         if struct_v >= 2:
             m.trace_id = dec.u64()
             m.span_id = dec.u64()
@@ -1071,6 +1078,46 @@ class MOSDOpBatch(Message):
         # copy-on-send view (result-vector copies + live span), same
         # discipline as an unbatched send
         return MOSDOpBatch([m.local_view() for m in self.msgs])
+
+    def local_cost(self) -> int:
+        return 64 + sum(m.local_cost() for m in self.msgs)
+
+
+@register_message
+class MOSDRepAckBatch(Message):
+    """Replica -> primary coalesced commit acks (the server half of
+    the corked data plane): ONE frame carrying every MOSDRepOpReply /
+    MOSDECSubOpWriteReply a replica produced for one primary in one
+    drained commit burst.  The store's completion batching
+    (store/commit.py runs a drained group's callbacks in one loop
+    callback) means a deep client window commits N rep-txns back to
+    back — without coalescing each ack is its own ring frame + wakeup
+    + dispatch, and replica_rtt eats the per-hop overhead N times.
+    Purely a transport envelope like MOSDOpBatch: inner replies keep
+    their own tid/pgid and unpack through the normal dispatch path at
+    intake.  Inner frames are [type u16][reply frame] since the two
+    reply types mix in one burst."""
+    TYPE = 234
+
+    def __init__(self, msgs: Optional[List[Message]] = None):
+        super().__init__()
+        self.msgs: List[Message] = msgs or []
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.list_(self.msgs,
+                  lambda e, m: e.u16(m.TYPE).bytes_(m.to_bytes()))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder, struct_v: int) -> "MOSDRepAckBatch":
+        from ceph_tpu.msg.message import message_class
+
+        def one(d):
+            mcls = message_class(d.u16())
+            return mcls.from_bytes(d.bytes_())
+        return cls(dec.list_(one))
+
+    def local_view(self) -> "MOSDRepAckBatch":
+        return MOSDRepAckBatch([m.local_view() for m in self.msgs])
 
     def local_cost(self) -> int:
         return 64 + sum(m.local_cost() for m in self.msgs)

@@ -722,6 +722,21 @@ class PG:
                 self.save_meta(txn)
                 self.osd.store.apply_transaction(txn)
 
+        # WaitUpThru (PG.h WaitUpThru state): don't activate until the
+        # COMMITTED map carries our up_thru for this interval.  The
+        # discipline is what makes maybe_went_rw sound in BOTH
+        # directions: writes can only have landed in intervals whose
+        # primary's grant committed, so the mon may drop a grant whose
+        # requester died holding it — and a restarted survivor stops
+        # blocking on its dead partner's never-activated solo interval
+        while self.osd.osdmap.get_up_thru(self.osd.whoami) \
+                < self.info.same_interval_since:
+            self.osd.request_up_thru()
+            # lint: allow[RETRY19] map poll at grant-commit granularity
+            await asyncio.sleep(0.05)
+            if epoch != self.interval_epoch:
+                return
+
         # compute peer missing + activate peers
         await self._activate(epoch)
 

@@ -404,12 +404,18 @@ class ShardedDataPlane:
             backend = "inline"
         self.active_backend = backend
         if backend == "process":
-            from ceph_tpu.osd.lanes import ProcessLane
-            self.process_lanes = [ProcessLane(self, i)
+            from ceph_tpu.osd import lanes as lanes_mod
+            self.process_lanes = [lanes_mod.ProcessLane(self, i)
                                   for i in range(self.num_shards)]
             for lane in self.process_lanes:
                 lane.start()
             self.threaded = False
+            # lane->lane fastpath registry: same-host replication
+            # frames route still-encoded to the target OSD's lane;
+            # gated by the same knob as every local-delivery shortcut
+            if bool(self.osd.cfg["ms_local_delivery"]):
+                lanes_mod.register_local_plane(
+                    self.osd.messenger.addr, self)
             return
         self.threaded = backend == "thread"
         for s in self.shards:
@@ -419,6 +425,8 @@ class ShardedDataPlane:
         if not self.enabled:
             return
         if self.process_lanes is not None:
+            from ceph_tpu.osd import lanes as lanes_mod
+            lanes_mod.unregister_local_plane(self.osd.messenger.addr)
             for lane in self.process_lanes:
                 await lane.stop()
             self.process_lanes = None
@@ -550,4 +558,6 @@ class ShardedDataPlane:
             # courier counters go PER LANE (frames/bytes/stalls each)
             d["lanes"] = {lane.idx: lane.counters()
                           for lane in self.process_lanes}
+            from ceph_tpu.osd import extents as ext_mod
+            d["extents"] = ext_mod.counters()
         return d
